@@ -1,0 +1,31 @@
+"""geomesa-tpu: a TPU-native framework for large-scale spatio-temporal
+indexing and analytics.
+
+A ground-up rebuild of the capabilities of GeoMesa (reference:
+/root/reference, surveyed in SURVEY.md) designed for TPU hardware:
+
+- columnar ``FeatureBatch`` arrays sharded over a ``jax.sharding.Mesh``
+  replace distributed key-value tables;
+- space-filling-curve encoding, range filtering, geometry predicates and
+  aggregations are vmapped/jitted JAX kernels;
+- "server-side iterators / coprocessors" become fused shard-local scan
+  kernels, with ICI collectives (psum / all_gather) replacing the
+  client-side reduce.
+
+Layer map (mirrors SURVEY.md section 1):
+
+- :mod:`geomesa_tpu.curves`   -- L0 space-filling curves (Z2/Z3/XZ2/XZ3)
+- :mod:`geomesa_tpu.features` -- L1/L2 schema + columnar feature model
+- :mod:`geomesa_tpu.filters`  -- L3 CQL filter algebra
+- :mod:`geomesa_tpu.geometry` -- JTS-replacement geometry kernels
+- :mod:`geomesa_tpu.index`    -- L4 index key spaces + query planner
+- :mod:`geomesa_tpu.scan`     -- L6 pushdown scan/aggregation kernels
+- :mod:`geomesa_tpu.parallel` -- mesh/sharding + distributed scans
+- :mod:`geomesa_tpu.analytics`-- L7 ST_* kernels, joins, KNN, processes
+- :mod:`geomesa_tpu.store`    -- L5 datastores (memory / fs / live)
+- :mod:`geomesa_tpu.convert`  -- L8 ingest converters
+- :mod:`geomesa_tpu.tools`    -- L9 CLI
+- :mod:`geomesa_tpu.security` -- LX visibility / authorizations
+"""
+
+__version__ = "0.1.0"

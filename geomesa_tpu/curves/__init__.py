@@ -1,0 +1,23 @@
+"""L0 math core: space-filling curves (SURVEY.md section 2.1, geomesa-z3).
+
+Host-side numpy implementations of z-order encode/decode, bit
+normalization, time binning and z-range decomposition.  The device scan
+path never touches 64-bit z keys: it compares normalized int32
+coordinates, matching the reference's server-side Z3Filter semantics.
+"""
+
+from .normalize import NormalizedDimension, normalized_lat, normalized_lon, normalized_time
+from .timebin import BinnedTime, TimePeriod, bins_of_interval, from_binned, max_offset, to_binned
+from .zorder import (Z2_BITS, Z3_BITS, z2_combine, z2_decode, z2_encode, z2_split,
+                     z3_combine, z3_decode, z3_encode, z3_split)
+from .zranges import DEFAULT_MAX_RANGES, merge_ranges, zranges
+from .sfc import Z2SFC, Z3SFC, z2sfc, z3sfc
+
+__all__ = [
+    "NormalizedDimension", "normalized_lat", "normalized_lon", "normalized_time",
+    "BinnedTime", "TimePeriod", "bins_of_interval", "from_binned", "max_offset",
+    "to_binned", "Z2_BITS", "Z3_BITS", "z2_combine", "z2_decode", "z2_encode",
+    "z2_split", "z3_combine", "z3_decode", "z3_encode", "z3_split",
+    "DEFAULT_MAX_RANGES", "merge_ranges", "zranges",
+    "Z2SFC", "Z3SFC", "z2sfc", "z3sfc",
+]

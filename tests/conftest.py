@@ -1,0 +1,12 @@
+"""Test configuration: force an 8-virtual-device CPU platform BEFORE jax
+is imported anywhere, so sharding/mesh tests exercise real multi-device
+code paths without TPU hardware (SURVEY.md section 4 test strategy)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "false")

@@ -1,0 +1,270 @@
+"""L0 curve tests: invariants + golden values mirroring the reference's
+Z3Test / Z2Test / BinnedTimeTest / NormalizedDimensionTest suites
+(geomesa-z3/src/test — same properties, re-derived expectations)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curves import (
+    TimePeriod, Z2SFC, Z3SFC, bins_of_interval, from_binned, max_offset,
+    merge_ranges, to_binned, z2_decode, z2_encode, z3_decode, z3_encode,
+    z3_split, z3_combine, zranges as zr, z3sfc, z2sfc,
+)
+from geomesa_tpu.curves.timebin import max_date_millis
+from geomesa_tpu.curves.zranges import zranges
+
+
+class TestZOrder:
+    def test_z3_split_golden(self):
+        # Z3Test "split": bits spread to every 3rd position
+        for v in [0x00FFFFFF & 0x1FFFFF, 0, 1, 0x0C0F02, 0x000802]:
+            expected = int("".join(f"00{c}" for c in bin(v)[2:]), 2) if v else 0
+            assert int(z3_split(v)) == expected
+
+    def test_z3_split_combine_roundtrip(self):
+        rng = np.random.default_rng(574)
+        vals = rng.integers(0, 1 << 21, size=1000)
+        assert np.array_equal(z3_combine(z3_split(vals)), vals)
+
+    def test_z3_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, 1 << 21, size=1000)
+        y = rng.integers(0, 1 << 21, size=1000)
+        t = rng.integers(0, 1 << 21, size=1000)
+        dx, dy, dt = z3_decode(z3_encode(x, y, t))
+        assert np.array_equal(dx, x)
+        assert np.array_equal(dy, y)
+        assert np.array_equal(dt, t)
+
+    def test_z3_extremes(self):
+        m = (1 << 21) - 1
+        assert int(z3_encode(0, 0, 0)) == 0
+        assert int(z3_encode(m, m, m)) == (1 << 63) - 1
+        dx, dy, dt = z3_decode(z3_encode(m, 0, m))
+        assert (int(dx), int(dy), int(dt)) == (m, 0, m)
+
+    def test_z2_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(2)
+        x = rng.integers(0, 1 << 31, size=1000)
+        y = rng.integers(0, 1 << 31, size=1000)
+        dx, dy = z2_decode(z2_encode(x, y))
+        assert np.array_equal(dx, x)
+        assert np.array_equal(dy, y)
+
+    def test_z2_extremes(self):
+        m = (1 << 31) - 1
+        assert int(z2_encode(0, 0)) == 0
+        assert int(z2_encode(m, m)) == (1 << 62) - 1
+
+    def test_z_ordering_is_monotonic_in_prefix(self):
+        # points in the same quadrant share z prefix: (0..3) quadrant test
+        z00 = int(z2_encode(0, 0))
+        z10 = int(z2_encode(1 << 30, 0))
+        z01 = int(z2_encode(0, 1 << 30))
+        z11 = int(z2_encode(1 << 30, 1 << 30))
+        assert z00 < z10 < z01 < z11
+
+
+class TestNormalize:
+    def test_lon_lat_bounds(self):
+        sfc = Z3SFC(TimePeriod.WEEK)
+        assert int(sfc.lon.normalize(-180.0)) == 0
+        assert int(sfc.lon.normalize(180.0)) == sfc.lon.max_index
+        assert int(sfc.lat.normalize(-90.0)) == 0
+        assert int(sfc.lat.normalize(90.0)) == sfc.lat.max_index
+
+    def test_normalize_denormalize_within_bin(self):
+        dim = Z2SFC().lon
+        rng = np.random.default_rng(3)
+        xs = rng.uniform(-180, 180, size=1000)
+        i = dim.normalize(xs)
+        back = dim.denormalize(i)
+        width = 360.0 / dim.bins
+        assert np.all(np.abs(back - xs) <= width)
+
+    def test_denormalize_is_bin_center(self):
+        dim = Z3SFC().lon
+        i = dim.normalize(0.0)
+        c = dim.denormalize(i)
+        assert abs(c - 0.0) <= 360.0 / dim.bins
+
+    def test_strict_bounds_raise(self):
+        sfc = z3sfc(TimePeriod.WEEK)
+        for (x, y, t) in [(-180.1, 0, 0), (180.1, 0, 0), (0, -90.1, 0),
+                          (0, 90.1, 0), (0, 0, -1), (0, 0, int(sfc.time.max) + 1)]:
+            with pytest.raises(ValueError):
+                sfc.index(x, y, t)
+
+    def test_lenient_clamps(self):
+        sfc = z3sfc(TimePeriod.WEEK)
+        z = sfc.index(-181.0, -91.0, -5, lenient=True)
+        assert int(z) == int(sfc.index(-180.0, -90.0, 0))
+
+
+class TestBinnedTime:
+    def test_max_offsets(self):
+        # BinnedTime.scala maxOffset golden values
+        assert max_offset(TimePeriod.DAY) == 86_400_000
+        assert max_offset(TimePeriod.WEEK) == 604_800
+        assert max_offset(TimePeriod.MONTH) == 2_678_400
+        assert max_offset(TimePeriod.YEAR) == 524_160
+
+    def test_epoch_is_bin_zero(self):
+        for p in TimePeriod:
+            b, o = to_binned(0, p)
+            assert (int(b), int(o)) == (0, 0)
+
+    def test_known_week(self):
+        # 2017-01-02T00:00:00Z = 1483315200000 ms = 2453 weeks exactly
+        ms = 1_483_315_200_000
+        b, o = to_binned(ms, TimePeriod.WEEK)
+        assert int(b) == ms // (7 * 86_400_000)
+        assert int(o) == (ms % (7 * 86_400_000)) // 1000
+
+    def test_calendar_month_binning(self):
+        # 2000-03-15T12:00:00Z -> month bin = (2000-1970)*12 + 2
+        ms = int(np.datetime64("2000-03-15T12:00:00", "ms").astype(np.int64))
+        b, o = to_binned(ms, TimePeriod.MONTH)
+        assert int(b) == 30 * 12 + 2
+        start = int(np.datetime64("2000-03-01T00:00:00", "ms").astype(np.int64))
+        assert int(o) == (ms - start) // 1000
+
+    def test_calendar_year_binning(self):
+        ms = int(np.datetime64("1999-07-04T06:30:00", "ms").astype(np.int64))
+        b, o = to_binned(ms, TimePeriod.YEAR)
+        assert int(b) == 29
+        start = int(np.datetime64("1999-01-01", "ms").astype(np.int64))
+        assert int(o) == (ms - start) // 60_000
+
+    def test_roundtrip_all_periods(self):
+        rng = np.random.default_rng(4)
+        for p in TimePeriod:
+            ms = rng.integers(0, min(max_date_millis(p), 4_000_000_000_000), size=500)
+            b, o = to_binned(ms, p)
+            back = from_binned(b, o, p)
+            # offsets truncate to the period's resolution
+            res = {TimePeriod.DAY: 1, TimePeriod.WEEK: 1000,
+                   TimePeriod.MONTH: 1000, TimePeriod.YEAR: 60_000}[p]
+            assert np.all(back == (ms // res) * res)
+
+    def test_out_of_range_raises(self):
+        with pytest.raises(ValueError):
+            to_binned(-1, TimePeriod.DAY)
+        with pytest.raises(ValueError):
+            to_binned(max_date_millis(TimePeriod.DAY), TimePeriod.DAY)
+
+    def test_bins_of_interval_fanout(self):
+        ms0 = int(np.datetime64("2017-01-02T10:00:00", "ms").astype(np.int64))
+        ms1 = int(np.datetime64("2017-01-20T15:00:00", "ms").astype(np.int64))
+        bins, los, his = bins_of_interval(ms0, ms1, TimePeriod.WEEK)
+        assert len(bins) == 4  # spans four epoch-weeks (weeks anchor Thursday)
+        assert los[0] > 0 and his[-1] < max_offset(TimePeriod.WEEK)
+        assert np.all(los[1:] == 0)
+        assert np.all(his[:-1] == max_offset(TimePeriod.WEEK))
+
+
+class TestReviewRegressions:
+    def test_normalize_no_int32_wrap_at_domain_edge(self):
+        # in-bounds value just below max must not round up past max_index
+        sfc = z2sfc()
+        x = np.nextafter(180.0, -np.inf)
+        xi = int(sfc.lon.normalize(x))
+        assert xi == sfc.lon.max_index
+        z = int(sfc.index(x, 0.0))
+        r = sfc.ranges([(179.0, -1.0, 180.0, 1.0)])
+        i = np.searchsorted(r[:, 0], z, side="right") - 1
+        assert i >= 0 and z <= r[i, 1]
+
+    def test_merge_ranges_full_domain_no_overflow(self):
+        full = (1 << 63) - 1
+        m = merge_ranges(np.array([[0, full], [5, 10]], dtype=np.int64))
+        assert m.tolist() == [[0, full]]
+
+    def test_bins_of_interval_outside_range_is_empty(self):
+        cap = max_date_millis(TimePeriod.DAY)
+        for lo, hi in [(cap + 5, cap + 10), (-100, -5)]:
+            bins, _, _ = bins_of_interval(lo, hi, TimePeriod.DAY)
+            assert len(bins) == 0
+
+
+class TestZRanges:
+    def test_merge(self):
+        r = np.array([[5, 9], [0, 3], [4, 6], [20, 30]], dtype=np.int64)
+        m = merge_ranges(r)
+        assert m.tolist() == [[0, 9], [20, 30]]
+
+    def test_full_domain_single_range(self):
+        r = zranges((0, 0), ((1 << 21) - 1, (1 << 21) - 1), 21)
+        assert r.tolist() == [[0, (1 << 42) - 1]]
+
+    def test_coverage_exactness_small(self):
+        # brute-force check on a tiny 6-bit/dim grid: ranges must cover
+        # exactly the z keys of in-box points (plus allowed overshoot),
+        # and with no max_ranges pressure coverage should be exact.
+        bits = 6
+        lo, hi = (5, 9), (40, 33)
+        r = zranges(lo, hi, bits, max_ranges=10_000)
+        xs, ys = np.meshgrid(np.arange(64), np.arange(64), indexing="ij")
+        inbox = ((xs >= 5) & (xs <= 40) & (ys >= 9) & (ys <= 33)).ravel()
+        z = z2_encode(xs.ravel().astype(np.int64), ys.ravel().astype(np.int64))
+        covered = np.zeros(len(z), dtype=bool)
+        for zlo, zhi in r.tolist():
+            covered |= (z >= zlo) & (z <= zhi)
+        assert np.array_equal(covered, inbox)
+
+    def test_max_ranges_cap_still_covers(self):
+        bits = 16
+        lo, hi = (100, 200), (5000, 7000)
+        r = zranges(lo, hi, bits, max_ranges=50)
+        assert len(r) <= 50
+        # sample points in the box must be covered
+        rng = np.random.default_rng(5)
+        xs = rng.integers(100, 5001, size=200)
+        ys = rng.integers(200, 7001, size=200)
+        z = z2_encode(xs, ys).astype(np.int64)
+        starts = r[:, 0]
+        idx = np.searchsorted(starts, z, side="right") - 1
+        assert np.all(idx >= 0)
+        assert np.all(z <= r[idx, 1])
+
+    def test_z3_ranges_3d(self):
+        lo, hi = (10, 10, 10), (50, 50, 50)
+        r = zranges(lo, hi, 21, max_ranges=2000)
+        assert len(r) > 0
+        z_in = int(z3_encode(30, 30, 30))
+        covered = any(a <= z_in <= b for a, b in r.tolist())
+        assert covered
+
+    def test_empty_box(self):
+        r = zranges((10, 10), (5, 20), 21)
+        assert len(r) == 0
+
+
+class TestSFCEndToEnd:
+    def test_z3_sfc_index_and_ranges_consistent(self):
+        sfc = z3sfc(TimePeriod.WEEK)
+        # a point inside the query box must fall in the covering ranges
+        x, y, t = -75.3, 38.5, 12_000
+        z = int(sfc.index(x, y, t))
+        r = sfc.ranges([(-80.0, 35.0, -70.0, 40.0)], [(0, 100_000)])
+        idx = np.searchsorted(r[:, 0], z, side="right") - 1
+        assert idx >= 0 and z <= r[idx, 1]
+
+    def test_z3_point_outside_box_not_needed(self):
+        sfc = z3sfc(TimePeriod.WEEK)
+        r = sfc.ranges([(-80.0, 35.0, -70.0, 40.0)], [(0, 100_000)],
+                       max_ranges=4000)
+        z_out = int(sfc.index(100.0, -60.0, 400_000))
+        idx = np.searchsorted(r[:, 0], z_out, side="right") - 1
+        covered = idx >= 0 and z_out <= r[idx, 1]
+        assert not covered
+
+    def test_z2_sfc_roundtrip_precision(self):
+        sfc = z2sfc()
+        xs = np.array([-180.0, -75.123456, 0.0, 179.999999])
+        ys = np.array([-90.0, 38.654321, 0.0, 89.999999])
+        z = sfc.index(xs, ys)
+        bx, by = sfc.invert(z)
+        # 31-bit grid: ~1.7e-7 deg lon resolution
+        assert np.all(np.abs(bx - xs) < 2e-7)
+        assert np.all(np.abs(by - ys) < 1e-7)
