@@ -1,0 +1,10 @@
+"""L1/L2 feature model: schemas + columnar batches (SURVEY.md 2.1,
+geomesa-utils SimpleFeatureTypes + geomesa-features serializers)."""
+
+from .sft import AttributeSpec, AttributeType, Configs, SimpleFeatureType, parse_spec
+from .batch import (BoolColumn, Column, DateColumn, FeatureBatch,
+                    GeometryColumn, NumericColumn, PointColumn, StringColumn)
+
+__all__ = ["AttributeSpec", "AttributeType", "Configs", "SimpleFeatureType",
+           "parse_spec", "BoolColumn", "Column", "DateColumn", "FeatureBatch",
+           "GeometryColumn", "NumericColumn", "PointColumn", "StringColumn"]

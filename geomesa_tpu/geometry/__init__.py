@@ -1,0 +1,24 @@
+"""JTS-replacement geometry model.
+
+The reference leans on JTS for all geometry math (vector data model,
+predicates, WKT/WKB). Here:
+
+- :mod:`geomesa_tpu.geometry.base` -- numpy-backed geometry classes with
+  exact float64 host predicates (planner-time + borderline rechecks)
+- :mod:`geomesa_tpu.geometry.wkt` -- WKT parse/format
+- :mod:`geomesa_tpu.geometry.packed` -- flat device-friendly buffers
+  (vertex arrays + offsets + per-feature bboxes) for scan kernels
+
+Device kernels evaluate predicates in f32 with a conservative error
+band; points in the band are re-checked on the host in f64, so final
+results match exact double semantics without putting f64 on the TPU.
+"""
+
+from .base import (Geometry, Point, LineString, Polygon, MultiPoint,
+                   MultiLineString, MultiPolygon, GeometryCollection,
+                   Envelope)
+from .wkt import parse_wkt, to_wkt
+
+__all__ = ["Geometry", "Point", "LineString", "Polygon", "MultiPoint",
+           "MultiLineString", "MultiPolygon", "GeometryCollection",
+           "Envelope", "parse_wkt", "to_wkt"]

@@ -1,0 +1,247 @@
+"""SFT spec parser + FeatureBatch + geometry/WKT tests (mirroring
+SimpleFeatureTypesTest and feature-serialization test intent)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.geometry import (LineString, MultiPolygon, Point, Polygon,
+                                  parse_wkt, to_wkt)
+
+
+class TestSftSpec:
+    def test_basic_spec(self):
+        sft = parse_spec("gdelt", "name:String,dtg:Date,*geom:Point:srid=4326")
+        assert [a.name for a in sft.attributes] == ["name", "dtg", "geom"]
+        assert sft.geom_field == "geom"
+        assert sft.dtg_field == "dtg"
+        assert sft.is_points
+        assert sft.attr("geom").options["srid"] == "4326"
+
+    def test_options_and_userdata(self):
+        sft = parse_spec(
+            "t", "a:Integer:index=true,*g:Point;geomesa.z3.interval='month',"
+                 "geomesa.xz.precision=10")
+        assert sft.attr("a").indexed
+        assert sft.z3_interval.value == "month"
+        assert sft.xz_precision == 10
+
+    def test_list_map_types(self):
+        sft = parse_spec("t", "tags:List[String],counts:Map[String,Integer],*g:Point")
+        assert str(sft.attr("tags").type) == "List[String]"
+        assert str(sft.attr("counts").type) == "Map[String,Integer]"
+
+    def test_spec_roundtrip(self):
+        spec = "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326"
+        sft = parse_spec("x", spec)
+        sft2 = parse_spec("x", sft.to_spec())
+        assert sft == sft2
+
+    def test_default_dtg_override(self):
+        sft = parse_spec("t", "d1:Date,d2:Date,*g:Point;geomesa.index.dtg='d2'")
+        assert sft.dtg_field == "d2"
+
+    def test_invalid_specs(self):
+        with pytest.raises(ValueError):
+            parse_spec("t", "name:NotAType")
+        with pytest.raises(ValueError):
+            parse_spec("t", "*name:String")  # star on non-geometry
+
+    def test_non_point_geom(self):
+        sft = parse_spec("t", "*poly:Polygon,dtg:Date")
+        assert not sft.is_points
+        assert sft.geom_field == "poly"
+
+
+class TestWkt:
+    CASES = [
+        "POINT (30 10)",
+        "LINESTRING (30 10, 10 30, 40 40)",
+        "POLYGON ((30 10, 40 40, 20 40, 10 20, 30 10))",
+        "POLYGON ((35 10, 45 45, 15 40, 10 20, 35 10), (20 30, 35 35, 30 20, 20 30))",
+        "MULTIPOINT ((10 40), (40 30), (20 20), (30 10))",
+        "MULTILINESTRING ((10 10, 20 20, 10 40), (40 40, 30 30, 40 20, 30 10))",
+        "MULTIPOLYGON (((30 20, 45 40, 10 40, 30 20)), ((15 5, 40 10, 10 20, 5 10, 15 5)))",
+        "GEOMETRYCOLLECTION (POINT (40 10), LINESTRING (10 10, 20 20, 10 40))",
+        "POINT EMPTY",
+        "POLYGON EMPTY",
+    ]
+
+    @pytest.mark.parametrize("wkt", CASES)
+    def test_roundtrip(self, wkt):
+        g = parse_wkt(wkt)
+        g2 = parse_wkt(to_wkt(g))
+        assert g == g2 or (g.is_empty and g2.is_empty)
+
+    def test_z_ordinates_dropped(self):
+        g = parse_wkt("POINT (30 10 5)")
+        assert isinstance(g, Point) and g.x == 30 and g.y == 10
+
+    def test_scientific_notation(self):
+        g = parse_wkt("POINT (1e2 -2.5E-1)")
+        assert g.x == 100.0 and g.y == -0.25
+
+    def test_garbage_raises(self):
+        with pytest.raises(ValueError):
+            parse_wkt("CIRCLE (0 0, 5)")
+        with pytest.raises(ValueError):
+            parse_wkt("POINT (1 2) extra")
+
+
+class TestGeometryPredicates:
+    def test_point_in_polygon(self):
+        poly = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+        assert poly.contains(Point(5, 5))
+        assert not poly.contains(Point(15, 5))
+        # boundary is inclusive (covers semantics)
+        assert poly.contains(Point(0, 5))
+
+    def test_polygon_with_hole(self):
+        poly = parse_wkt(
+            "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), (4 4, 6 4, 6 6, 4 6, 4 4))")
+        assert poly.contains(Point(2, 2))
+        assert not poly.contains(Point(5, 5))  # in the hole
+
+    def test_vectorized_pip(self):
+        poly = parse_wkt("POLYGON ((0 0, 10 0, 5 10, 0 0))")
+        rng = np.random.default_rng(11)
+        xs = rng.uniform(-2, 12, 5000)
+        ys = rng.uniform(-2, 12, 5000)
+        got = poly.contains_points(xs, ys)
+        # cross-check a sample against scalar evaluation
+        for i in range(0, 5000, 517):
+            assert bool(got[i]) == poly.contains(Point(xs[i], ys[i]))
+
+    def test_intersects_lines(self):
+        a = LineString([[0, 0], [10, 10]])
+        b = LineString([[0, 10], [10, 0]])
+        c = LineString([[20, 20], [30, 30]])
+        assert a.intersects(b)
+        assert not a.intersects(c)
+
+    def test_polygon_polygon(self):
+        a = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+        b = parse_wkt("POLYGON ((5 5, 15 5, 15 15, 5 15, 5 5))")
+        c = parse_wkt("POLYGON ((20 20, 30 20, 30 30, 20 30, 20 20))")
+        inner = parse_wkt("POLYGON ((2 2, 4 2, 4 4, 2 4, 2 2))")
+        assert a.intersects(b)
+        assert not a.intersects(c)
+        assert a.contains(inner)
+        assert not a.contains(b)
+        # containment when no vertices of a are in b and vice versa
+        cross1 = parse_wkt("POLYGON ((-1 4, 11 4, 11 6, -1 6, -1 4))")
+        assert a.intersects(cross1)
+
+    def test_hole_boundary_crossing_detected(self):
+        # b's vertices sit inside a's hole, but an edge crosses solid area
+        a = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0),"
+                      " (3 3, 7 3, 7 8, 3 8, 3 3))")
+        b = parse_wkt("POLYGON ((4 4, 6 4, 5 9.5, 4 4))")  # tip pokes out
+        assert a.intersects(b)
+
+    def test_nested_collection_predicates(self):
+        g = parse_wkt("GEOMETRYCOLLECTION (MULTIPOINT ((1 1), (2 2)))")
+        assert g.intersects(Point(1, 1))
+        assert not g.intersects(Point(9, 9))
+
+    def test_wkt_nan_safe(self):
+        s = to_wkt(LineString([[1.0, float("nan")], [2.0, 3.0]]))
+        assert "nan" in s
+
+    def test_distance_and_dwithin(self):
+        p = Point(0, 0)
+        q = Point(3, 4)
+        assert p.distance(q) == 5.0
+        assert p.dwithin(q, 5.0)
+        assert not p.dwithin(q, 4.99)
+        line = LineString([[0, 10], [10, 10]])
+        assert p.distance(line) == 10.0
+
+    def test_area_centroid(self):
+        sq = parse_wkt("POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))")
+        assert sq.area == 16.0
+        c = sq.centroid
+        assert (c.x, c.y) == (2.0, 2.0)
+        mp = MultiPolygon([sq, parse_wkt("POLYGON ((10 0, 12 0, 12 2, 10 2, 10 0))")])
+        assert mp.area == 20.0
+
+
+class TestFeatureBatch:
+    SFT = parse_spec("gdelt", "name:String,count:Integer,val:Double,"
+                              "dtg:Date,*geom:Point:srid=4326")
+
+    def make(self, n=100):
+        rng = np.random.default_rng(12)
+        return FeatureBatch.from_dict(
+            self.SFT, [f"f{i}" for i in range(n)],
+            {
+                "name": [f"name{i % 7}" if i % 11 else None for i in range(n)],
+                "count": rng.integers(0, 100, n),
+                "val": rng.uniform(0, 1, n),
+                "dtg": rng.integers(1_400_000_000_000, 1_500_000_000_000, n),
+                "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+            })
+
+    def test_build_and_access(self):
+        b = self.make()
+        assert b.n == 100
+        f = b.feature(0)
+        assert f["id"] == "f0"
+        assert f["name"] is None  # i % 11 == 0
+        assert isinstance(f["geom"], Point)
+
+    def test_string_dictionary(self):
+        b = self.make()
+        col = b.col("name")
+        assert col.code_of("name3") >= 0
+        assert col.code_of("nope") == -1
+        assert col.value(1) == "name1"
+
+    def test_take(self):
+        b = self.make()
+        sub = b.take(np.array([5, 10, 15]))
+        assert sub.n == 3
+        assert sub.ids[0] == "f5"
+        assert sub.feature(1)["count"] == b.feature(10)["count"]
+
+    def test_concat(self):
+        b = self.make(50)
+        c = b.concat(b)
+        assert c.n == 100
+        assert c.feature(75)["val"] == b.feature(25)["val"]
+
+    def test_arrow_roundtrip(self):
+        b = self.make(64)
+        rb = b.to_arrow()
+        assert rb.num_rows == 64
+        back = FeatureBatch.from_arrow(self.SFT, rb)
+        assert back.n == b.n
+        for i in (0, 13, 63):
+            fa, fb = b.feature(i), back.feature(i)
+            assert fa["name"] == fb["name"]
+            assert fa["count"] == fb["count"]
+            assert fa["dtg"] == fb["dtg"]
+            assert abs(fa["geom"].x - fb["geom"].x) < 1e-12
+
+    def test_take_boolean_mask_geometry_column(self):
+        sft = parse_spec("t", "*g:Geometry")
+        b = FeatureBatch.from_dict(
+            sft, ["a", "b", "c"],
+            {"g": ["POINT (1 1)", "POINT (2 2)", "POINT (3 3)"]})
+        sub = b.take(np.array([True, False, True]))
+        assert sub.n == 2
+        assert sub.feature(0)["g"].x == 1 and sub.feature(1)["g"].x == 3
+
+    def test_concat_null_strings_preserved(self):
+        sft = parse_spec("t", "s:String,*g:Point")
+        a = FeatureBatch.from_dict(sft, ["a"], {"s": [None], "g": ([0.0], [0.0])})
+        b = FeatureBatch.from_dict(sft, ["b"], {"s": ["z"], "g": ([1.0], [1.0])})
+        c = a.concat(b)
+        assert c.feature(0)["s"] is None and c.feature(1)["s"] == "z"
+
+    def test_length_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            FeatureBatch.from_dict(
+                self.SFT, ["a"],
+                {"name": ["x", "y"], "count": [1], "val": [0.5],
+                 "dtg": [0], "geom": ([0.0], [0.0])})

@@ -1,0 +1,119 @@
+"""XZ2/XZ3 curve tests mirroring the reference's XZ2SFCTest / XZ3SFCTest
+scenarios (same boxes and expectations, re-derived)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curves.xz import XZ2SFC, XZ3SFC, xz2sfc, xz3sfc
+
+
+def covers(ranges: np.ndarray, code: int) -> bool:
+    return bool(np.any((ranges[:, 0] <= code) & (ranges[:, 1] >= code)))
+
+
+class TestXZ2:
+    sfc = xz2sfc(12)
+
+    # scenarios from XZ2SFCTest "index polygons and query them"
+    CONTAINING = [(9.0, 9.0, 13.0, 13.0), (-180.0, -90.0, 180.0, 90.0),
+                  (0.0, 0.0, 180.0, 90.0), (0.0, 0.0, 20.0, 20.0)]
+    OVERLAPPING = [(11.0, 11.0, 13.0, 13.0), (9.0, 9.0, 11.0, 11.0),
+                   (10.5, 10.5, 11.5, 11.5), (11.0, 11.0, 11.0, 11.0)]
+    DISJOINT_POLY = [(-180.0, -90.0, 8.0, 8.0), (0.0, 0.0, 8.0, 8.0),
+                     (9.0, 9.0, 9.5, 9.5), (20.0, 20.0, 180.0, 90.0)]
+
+    def test_polygon_query_matches(self):
+        poly = int(self.sfc.index_boxes(10, 10, 12, 12)[0])
+        for bbox in self.CONTAINING + self.OVERLAPPING:
+            r = self.sfc.ranges([bbox])
+            assert covers(r, poly), f"{bbox} should match"
+        for bbox in self.DISJOINT_POLY:
+            r = self.sfc.ranges([bbox])
+            assert not covers(r, poly), f"{bbox} should not match"
+
+    def test_point_query_matches(self):
+        pt = int(self.sfc.index_boxes(11, 11, 11, 11)[0])
+        disjoint = self.DISJOINT_POLY + [(12.5, 12.5, 13.5, 13.5)]
+        for bbox in self.CONTAINING + self.OVERLAPPING:
+            assert covers(self.sfc.ranges([bbox]), pt), f"{bbox} should match"
+        for bbox in disjoint:
+            assert not covers(self.sfc.ranges([bbox]), pt), f"{bbox} no match"
+
+    def test_vectorized_index_matches_scalar(self):
+        rng = np.random.default_rng(7)
+        xmin = rng.uniform(-179, 178, 200)
+        ymin = rng.uniform(-89, 88, 200)
+        xmax = xmin + rng.uniform(0, 1, 200)
+        ymax = ymin + rng.uniform(0, 1, 200)
+        batch = self.sfc.index_boxes(xmin, ymin, xmax, ymax)
+        for i in range(0, 200, 37):
+            single = self.sfc.index_boxes(xmin[i], ymin[i], xmax[i], ymax[i])
+            assert int(single[0]) == int(batch[i])
+
+    def test_randomized_coverage(self):
+        # any indexed box intersecting the query window must be covered
+        rng = np.random.default_rng(8)
+        n = 2000
+        xmin = rng.uniform(-180, 179, n)
+        ymin = rng.uniform(-90, 89, n)
+        xmax = np.minimum(xmin + rng.uniform(0, 2, n), 180.0)
+        ymax = np.minimum(ymin + rng.uniform(0, 2, n), 90.0)
+        codes = self.sfc.index_boxes(xmin, ymin, xmax, ymax)
+        q = (-20.0, -20.0, 15.0, 25.0)
+        r = self.sfc.ranges([q])
+        intersects = ((xmin <= q[2]) & (xmax >= q[0])
+                      & (ymin <= q[3]) & (ymax >= q[1]))
+        starts = r[:, 0]
+        idx = np.searchsorted(starts, codes, side="right") - 1
+        covered = (idx >= 0) & (codes <= r[idx, 1])
+        # every intersecting geometry must be covered (no false negatives)
+        assert np.all(covered[intersects])
+
+    def test_contained_flag(self):
+        # flags are 0/1 (edge cells' extended bounds stick past the domain,
+        # so whole-world merges to contained=0 — matches reference)
+        r = self.sfc.ranges([(-20.0, -20.0, 15.0, 25.0)], max_ranges=4000)
+        assert set(np.unique(r[:, 2])) <= {0, 1}
+
+    def test_large_geometry_is_findable(self):
+        # a geometry spanning most of the domain (short code) must be
+        # covered by ranges of even a small window it intersects
+        code = int(self.sfc.index_boxes(-170, -80, 170, 80)[0])
+        assert code >= 1  # code 0 is unreachable
+        r = self.sfc.ranges([(-10.0, -10.0, 10.0, 10.0)])
+        assert covers(r, code)
+
+    def test_max_ranges_respected(self):
+        r = self.sfc.ranges([(-20.0, -20.0, 15.0, 25.0)], max_ranges=30)
+        r2 = self.sfc.ranges([(-20.0, -20.0, 15.0, 25.0)], max_ranges=4000)
+        assert len(r) <= 60  # soft cap: level granularity overshoot allowed
+        assert len(r2) > len(r)
+
+    def test_out_of_bounds(self):
+        with pytest.raises(ValueError):
+            self.sfc.index_boxes(-181, 0, 0, 0)
+        z = self.sfc.index_boxes(-181, -91, 181, 91, lenient=True)
+        assert int(z[0]) == int(self.sfc.index_boxes(-180, -90, 180, 90)[0])
+
+    def test_unordered_bounds_raise(self):
+        with pytest.raises(ValueError):
+            self.sfc.index_boxes(10, 10, 5, 12)
+
+
+class TestXZ3:
+    sfc = xz3sfc(12, "week")
+
+    def test_spatiotemporal_box(self):
+        code = int(self.sfc.index_boxes(10, 10, 1000, 12, 12, 2000)[0])
+        # containing in space and time
+        assert covers(self.sfc.ranges([(9, 9, 500, 13, 13, 3000)]), code)
+        # whole domain
+        assert covers(self.sfc.ranges([(-180, -90, 0, 180, 90, 604800)]), code)
+        # disjoint in time only
+        assert not covers(self.sfc.ranges([(9, 9, 100000, 13, 13, 200000)]), code)
+        # disjoint in space only
+        assert not covers(self.sfc.ranges([(50, 50, 500, 60, 60, 3000)]), code)
+
+    def test_point_roundtrip_consistency(self):
+        pts = self.sfc.index_boxes(11, 11, 1500, 11, 11, 1500)
+        assert covers(self.sfc.ranges([(10, 10, 1000, 12, 12, 2000)]), int(pts[0]))
