@@ -1,0 +1,24 @@
+"""L3 filter algebra (SURVEY.md 2.1, geomesa-filter): ECQL parsing,
+index-value extraction, and the exact vectorized reference evaluator."""
+
+from . import ast
+from .ast import (After, And, BBox, Before, Between, Compare, CompareOp,
+                  Contains, Crosses, Disjoint, During, DWithin, Exclude,
+                  FidFilter, Filter, Include, InList, Intersects, IsNull,
+                  Like, Not, Or, Overlaps, TEquals, Touches, Within)
+from .ecql import ECQLError, parse_ecql
+from .evaluate import evaluate
+from .helper import (Bound, Bounds, FilterValues, distance_degrees,
+                     extract_attribute_bounds, extract_geometries,
+                     extract_intervals, is_filter_whole_world)
+
+__all__ = [
+    "ast", "parse_ecql", "ECQLError", "evaluate",
+    "Bound", "Bounds", "FilterValues", "distance_degrees",
+    "extract_attribute_bounds", "extract_geometries", "extract_intervals",
+    "is_filter_whole_world",
+    "After", "And", "BBox", "Before", "Between", "Compare", "CompareOp",
+    "Contains", "Crosses", "Disjoint", "During", "DWithin", "Exclude",
+    "FidFilter", "Filter", "Include", "InList", "Intersects", "IsNull",
+    "Like", "Not", "Or", "Overlaps", "TEquals", "Touches", "Within",
+]

@@ -1,0 +1,274 @@
+"""Filter AST: the CQL/OpenGIS filter algebra, minus GeoTools.
+
+Node set covers what the reference's planner and evaluators consume
+(geomesa-filter/.../FilterHelper.scala, FilterSplitter, the iterator
+residual filters): logical ops, comparisons, BETWEEN/LIKE/IN/IS NULL,
+spatial predicates over geometry literals, temporal predicates over
+date attributes, and feature-ID filters.
+
+All nodes are immutable dataclasses; geometry literals are
+geomesa_tpu.geometry objects; temporal literals are epoch millis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+from ..geometry import Geometry
+
+__all__ = [
+    "Filter", "Include", "Exclude", "And", "Or", "Not", "FidFilter",
+    "Compare", "CompareOp", "Between", "Like", "IsNull", "InList",
+    "SpatialPredicate", "BBox", "Intersects", "Disjoint", "Contains",
+    "Within", "Touches", "Crosses", "Overlaps", "DWithin",
+    "During", "Before", "After", "TEquals",
+]
+
+
+class Filter:
+    """Base class for all filter nodes."""
+
+    def __and__(self, other: "Filter") -> "Filter":
+        return And([self, other])
+
+    def __or__(self, other: "Filter") -> "Filter":
+        return Or([self, other])
+
+    def __invert__(self) -> "Filter":
+        return Not(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Include(Filter):
+    """Matches everything (Filter.INCLUDE)."""
+    def __str__(self) -> str:
+        return "INCLUDE"
+
+
+@dataclasses.dataclass(frozen=True)
+class Exclude(Filter):
+    """Matches nothing (Filter.EXCLUDE)."""
+    def __str__(self) -> str:
+        return "EXCLUDE"
+
+
+@dataclasses.dataclass(frozen=True)
+class And(Filter):
+    children: tuple
+
+    def __init__(self, children: Sequence[Filter]):
+        flat: list[Filter] = []
+        for c in children:
+            if isinstance(c, And):
+                flat.extend(c.children)
+            else:
+                flat.append(c)
+        object.__setattr__(self, "children", tuple(flat))
+
+    def __str__(self) -> str:
+        return "(" + " AND ".join(str(c) for c in self.children) + ")"
+
+
+@dataclasses.dataclass(frozen=True)
+class Or(Filter):
+    children: tuple
+
+    def __init__(self, children: Sequence[Filter]):
+        flat: list[Filter] = []
+        for c in children:
+            if isinstance(c, Or):
+                flat.extend(c.children)
+            else:
+                flat.append(c)
+        object.__setattr__(self, "children", tuple(flat))
+
+    def __str__(self) -> str:
+        return "(" + " OR ".join(str(c) for c in self.children) + ")"
+
+
+@dataclasses.dataclass(frozen=True)
+class Not(Filter):
+    child: Filter
+
+    def __str__(self) -> str:
+        return f"NOT ({self.child})"
+
+
+@dataclasses.dataclass(frozen=True)
+class FidFilter(Filter):
+    """Feature-ID filter (GeoTools Id filter)."""
+    ids: tuple
+
+    def __init__(self, ids):
+        object.__setattr__(self, "ids", tuple(ids))
+
+    def __str__(self) -> str:
+        return "IN (" + ", ".join(f"'{i}'" for i in self.ids) + ")"
+
+
+class CompareOp:
+    EQ = "="
+    NE = "<>"
+    LT = "<"
+    GT = ">"
+    LE = "<="
+    GE = ">="
+
+
+@dataclasses.dataclass(frozen=True)
+class Compare(Filter):
+    op: str
+    prop: str
+    value: Any
+
+    def __str__(self) -> str:
+        v = f"'{self.value}'" if isinstance(self.value, str) else self.value
+        return f"{self.prop} {self.op} {v}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Between(Filter):
+    prop: str
+    lo: Any
+    hi: Any
+
+    def __str__(self) -> str:
+        return f"{self.prop} BETWEEN {self.lo} AND {self.hi}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Like(Filter):
+    prop: str
+    pattern: str        # SQL LIKE: % and _ wildcards
+    case_sensitive: bool = True
+
+    def __str__(self) -> str:
+        op = "LIKE" if self.case_sensitive else "ILIKE"
+        return f"{self.prop} {op} '{self.pattern}'"
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Filter):
+    prop: str
+
+    def __str__(self) -> str:
+        return f"{self.prop} IS NULL"
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Filter):
+    prop: str
+    values: tuple
+
+    def __init__(self, prop: str, values):
+        object.__setattr__(self, "prop", prop)
+        object.__setattr__(self, "values", tuple(values))
+
+    def __str__(self) -> str:
+        vals = ", ".join(f"'{v}'" if isinstance(v, str) else str(v)
+                         for v in self.values)
+        return f"{self.prop} IN ({vals})"
+
+
+@dataclasses.dataclass(frozen=True)
+class SpatialPredicate(Filter):
+    prop: str
+    geom: Geometry
+
+    op_name = "?"
+
+    def __str__(self) -> str:
+        return f"{self.op_name}({self.prop}, {self.geom!r})"
+
+
+class Intersects(SpatialPredicate):
+    op_name = "INTERSECTS"
+
+
+class Disjoint(SpatialPredicate):
+    op_name = "DISJOINT"
+
+
+class Contains(SpatialPredicate):
+    op_name = "CONTAINS"
+
+
+class Within(SpatialPredicate):
+    op_name = "WITHIN"
+
+
+class Touches(SpatialPredicate):
+    op_name = "TOUCHES"
+
+
+class Crosses(SpatialPredicate):
+    op_name = "CROSSES"
+
+
+class Overlaps(SpatialPredicate):
+    op_name = "OVERLAPS"
+
+
+@dataclasses.dataclass(frozen=True)
+class BBox(Filter):
+    prop: str
+    xmin: float
+    ymin: float
+    xmax: float
+    ymax: float
+
+    def __str__(self) -> str:
+        return (f"BBOX({self.prop}, {self.xmin}, {self.ymin}, "
+                f"{self.xmax}, {self.ymax})")
+
+
+@dataclasses.dataclass(frozen=True)
+class DWithin(Filter):
+    prop: str
+    geom: Geometry
+    distance: float
+    units: str = "meters"
+
+    def __str__(self) -> str:
+        return (f"DWITHIN({self.prop}, {self.geom!r}, "
+                f"{self.distance}, {self.units})")
+
+
+@dataclasses.dataclass(frozen=True)
+class During(Filter):
+    """dtg DURING start/end — both epoch millis, exclusive bounds per
+    ECQL semantics (the reference treats DURING as exclusive)."""
+    prop: str
+    start: int
+    end: int
+
+    def __str__(self) -> str:
+        return f"{self.prop} DURING {self.start}/{self.end}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Before(Filter):
+    prop: str
+    time: int
+
+    def __str__(self) -> str:
+        return f"{self.prop} BEFORE {self.time}"
+
+
+@dataclasses.dataclass(frozen=True)
+class After(Filter):
+    prop: str
+    time: int
+
+    def __str__(self) -> str:
+        return f"{self.prop} AFTER {self.time}"
+
+
+@dataclasses.dataclass(frozen=True)
+class TEquals(Filter):
+    prop: str
+    time: int
+
+    def __str__(self) -> str:
+        return f"{self.prop} TEQUALS {self.time}"

@@ -1,0 +1,500 @@
+"""Filter analysis: extract index-consumable values from a Filter AST.
+
+Mirrors FilterHelper (geomesa-filter/.../FilterHelper.scala):
+
+- ``extract_geometries`` (:201): AND intersects extracted geometries,
+  OR unions them; DWithin buffers by its distance in degrees; BBOXes
+  crossing the antimeridian split IDL-safe; results clip to the world.
+- ``extract_intervals`` (:267): date bounds with the reference's
+  exclusive-bound second-rounding semantics.
+- ``extract_attribute_bounds`` (:318): typed bounds lattice for
+  attribute-index planning.
+- ``is_filter_whole_world`` (:157).
+
+Bounds carry inclusivity; ``FilterValues.disjoint`` marks provably-empty
+extractions (e.g. ANDed non-overlapping boxes) so planners can return
+empty plans without scanning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Generic, TypeVar
+
+import numpy as np
+
+from ..geometry import Envelope, Geometry, Polygon
+from ..geometry.base import WHOLE_WORLD, _Multi
+from . import ast
+
+T = TypeVar("T")
+
+__all__ = ["Bound", "Bounds", "FilterValues", "extract_geometries",
+           "extract_intervals", "extract_attribute_bounds",
+           "is_filter_whole_world", "distance_degrees", "METERS_MULTIPLIERS"]
+
+# ECQL distance units -> meters (FilterHelper.visitDwithin:93-101)
+METERS_MULTIPLIERS = {
+    "meters": 1.0,
+    "kilometers": 1000.0,
+    "feet": 0.3048,
+    "statute miles": 1609.347,
+    "nautical miles": 1852.0,
+}
+
+_WGS84_A = 6378137.0
+_WGS84_E2 = 0.00669437999014
+
+
+def distance_degrees(geom: Geometry, meters: float) -> float:
+    """Meters -> degrees: the widest eastward arc at the geometry's
+    envelope corners (GeometryUtils.distanceDegrees, GeometryUtils.scala:25-39)."""
+    env = geom.envelope
+    best = 0.0
+    for lat in (env.ymin, env.ymax):
+        phi = math.radians(lat)
+        # prime-vertical radius of curvature
+        n = _WGS84_A / math.sqrt(1 - _WGS84_E2 * math.sin(phi) ** 2)
+        circ = n * math.cos(phi)
+        if circ <= 0:
+            continue
+        best = max(best, math.degrees(meters / circ))
+    return best if best > 0 else math.degrees(meters / _WGS84_A)
+
+
+@dataclasses.dataclass(frozen=True)
+class Bound(Generic[T]):
+    """One side of an interval; value None = unbounded."""
+    value: Any
+    inclusive: bool
+
+    @staticmethod
+    def unbounded() -> "Bound":
+        return Bound(None, True)
+
+    @property
+    def is_bounded(self) -> bool:
+        return self.value is not None
+
+
+@dataclasses.dataclass(frozen=True)
+class Bounds(Generic[T]):
+    lower: Bound
+    upper: Bound
+
+    @staticmethod
+    def everything() -> "Bounds":
+        return Bounds(Bound.unbounded(), Bound.unbounded())
+
+    @property
+    def is_equality(self) -> bool:
+        return (self.lower.is_bounded and self.lower.value == self.upper.value
+                and self.lower.inclusive and self.upper.inclusive)
+
+    @property
+    def is_bounded(self) -> bool:
+        return self.lower.is_bounded or self.upper.is_bounded
+
+    def intersection(self, other: "Bounds") -> "Bounds | None":
+        lo = _lower_max(self.lower, other.lower)
+        hi = _upper_min(self.upper, other.upper)
+        if lo.is_bounded and hi.is_bounded:
+            if lo.value > hi.value:
+                return None
+            if lo.value == hi.value and not (lo.inclusive and hi.inclusive):
+                return None
+        return Bounds(lo, hi)
+
+    def union_if_overlapping(self, other: "Bounds") -> "Bounds | None":
+        """Merge if the two intervals overlap or touch, else None."""
+        if self._disjoint_from(other):
+            return None
+        return Bounds(_lower_min(self.lower, other.lower),
+                      _upper_max(self.upper, other.upper))
+
+    def _disjoint_from(self, other: "Bounds") -> bool:
+        for a, b in ((self, other), (other, self)):
+            if a.upper.is_bounded and b.lower.is_bounded:
+                if a.upper.value < b.lower.value:
+                    return True
+                if (a.upper.value == b.lower.value
+                        and not a.upper.inclusive and not b.lower.inclusive):
+                    return True
+        return False
+
+    def contains_value(self, v) -> bool:
+        if self.lower.is_bounded:
+            if v < self.lower.value:
+                return False
+            if v == self.lower.value and not self.lower.inclusive:
+                return False
+        if self.upper.is_bounded:
+            if v > self.upper.value:
+                return False
+            if v == self.upper.value and not self.upper.inclusive:
+                return False
+        return True
+
+
+# A lower bound of None means -inf; an upper bound of None means +inf.
+# Intersections tighten (finite wins, inclusivity ANDs); unions loosen
+# (unbounded wins, inclusivity ORs).
+
+def _lower_max(a: Bound, b: Bound) -> Bound:
+    if not a.is_bounded:
+        return b
+    if not b.is_bounded:
+        return a
+    if a.value != b.value:
+        return a if a.value > b.value else b
+    return Bound(a.value, a.inclusive and b.inclusive)
+
+
+def _upper_min(a: Bound, b: Bound) -> Bound:
+    if not a.is_bounded:
+        return b
+    if not b.is_bounded:
+        return a
+    if a.value != b.value:
+        return a if a.value < b.value else b
+    return Bound(a.value, a.inclusive and b.inclusive)
+
+
+def _lower_min(a: Bound, b: Bound) -> Bound:
+    if not a.is_bounded or not b.is_bounded:
+        return Bound.unbounded()
+    if a.value != b.value:
+        return a if a.value < b.value else b
+    return Bound(a.value, a.inclusive or b.inclusive)
+
+
+def _upper_max(a: Bound, b: Bound) -> Bound:
+    if not a.is_bounded or not b.is_bounded:
+        return Bound.unbounded()
+    if a.value != b.value:
+        return a if a.value > b.value else b
+    return Bound(a.value, a.inclusive or b.inclusive)
+
+
+@dataclasses.dataclass
+class FilterValues(Generic[T]):
+    """Extraction result: OR'd values + flags (FilterValues.scala)."""
+    values: list
+    precise: bool = True
+    disjoint: bool = False
+
+    @staticmethod
+    def empty() -> "FilterValues":
+        return FilterValues([])
+
+    @staticmethod
+    def make_disjoint() -> "FilterValues":
+        return FilterValues([], disjoint=True)
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.values and not self.disjoint
+
+    def __bool__(self) -> bool:
+        return bool(self.values) or self.disjoint
+
+    def __iter__(self):
+        return iter(self.values)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+
+# -- geometry extraction ---------------------------------------------------
+
+
+def _split_idl(g: Geometry) -> list[Geometry]:
+    """Split geometries whose longitudes run past +/-180 into wrapped
+    parts (getInternationalDateLineSafeGeometry analog): the x interval
+    is treated as an arc on the circle. Only envelope-level splitting is
+    needed for planning; exact predicates run downstream."""
+    env = g.envelope
+    if env.xmin >= -180 and env.xmax <= 180:
+        return [g]
+    span = env.xmax - env.xmin
+    if span >= 360:
+        return [Envelope(-180.0, env.ymin, 180.0, env.ymax).to_polygon()]
+    # rotate the start into [-180, 180)
+    start = ((env.xmin + 180.0) % 360.0) - 180.0
+    end = start + span
+    if end <= 180:
+        return [Envelope(start, env.ymin, end, env.ymax).to_polygon()]
+    return [Envelope(start, env.ymin, 180.0, env.ymax).to_polygon(),
+            Envelope(-180.0, env.ymin, end - 360.0, env.ymax).to_polygon()]
+
+
+def _clip_world(g: Geometry) -> Geometry | None:
+    env = g.envelope
+    world = WHOLE_WORLD.envelope
+    if world.contains_env(env):
+        return g
+    clipped = env.intersection(world)
+    if clipped.is_empty:
+        return None
+    return clipped.to_polygon()
+
+
+def _geom_intersection(a: Geometry, b: Geometry) -> Geometry | None:
+    """AND-combination of two extracted geometries. Exact when either is
+    an axis-aligned envelope box (the overwhelmingly common case);
+    otherwise conservatively returns the one with the smaller envelope,
+    clipped to the other's envelope box (a superset of the true
+    intersection — safe for planning, residual filters keep exactness)."""
+    if not a.envelope.intersects(b.envelope):
+        return None
+    ea, eb = a.envelope, b.envelope
+    a_is_box = isinstance(a, Polygon) and not a.holes and _is_box(a)
+    b_is_box = isinstance(b, Polygon) and not b.holes and _is_box(b)
+    if a_is_box and b_is_box:
+        e = ea.intersection(eb)
+        return None if e.is_empty else e.to_polygon()
+    if a_is_box:
+        return b if ea.contains_env(eb) else eb.intersection(ea).to_polygon()
+    if b_is_box:
+        return a if eb.contains_env(ea) else ea.intersection(eb).to_polygon()
+    if not a.intersects(b):
+        return None
+    # both complex: keep the smaller-envelope one (conservative)
+    area_a = (ea.xmax - ea.xmin) * (ea.ymax - ea.ymin)
+    area_b = (eb.xmax - eb.xmin) * (eb.ymax - eb.ymin)
+    return a if area_a <= area_b else b
+
+
+def _is_box(p: Polygon) -> bool:
+    if len(p.shell) != 5:
+        return False
+    xs = set(p.shell[:, 0].tolist())
+    ys = set(p.shell[:, 1].tolist())
+    return len(xs) == 2 and len(ys) == 2
+
+
+def _flatten(g: Geometry) -> list[Geometry]:
+    if isinstance(g, _Multi) and g.geom_type == "GeometryCollection":
+        return [s for p in g.parts for s in _flatten(p)]
+    return [g]
+
+
+def extract_geometries(f: ast.Filter, attribute: str | None,
+                       intersect: bool = True) -> FilterValues:
+    """Extract query geometries for `attribute` (FilterHelper.scala:201)."""
+    out = _extract_geoms(f, attribute, intersect)
+    clipped = []
+    for g in out.values:
+        c = _clip_world(g)
+        if c is not None:
+            clipped.append(c)
+    if out.values and not clipped:
+        return FilterValues.make_disjoint()
+    return FilterValues(clipped, out.precise, out.disjoint)
+
+
+def _extract_geoms(f: ast.Filter, attribute: str | None,
+                   intersect: bool) -> FilterValues:
+    if isinstance(f, ast.Or):
+        vals: list[Geometry] = []
+        any_nonempty = False
+        for c in f.children:
+            child = _extract_geoms(c, attribute, intersect)
+            if child.is_empty and not child.disjoint:
+                # a child with no spatial constraint matches everywhere:
+                # the OR extraction is unbounded
+                return FilterValues.empty()
+            any_nonempty = True
+            vals.extend(child.values)
+        if not any_nonempty:
+            return FilterValues.empty()
+        if not vals:
+            return FilterValues.make_disjoint()
+        return FilterValues(vals)
+    if isinstance(f, ast.And):
+        children = [c for c in (
+            _extract_geoms(c, attribute, intersect) for c in f.children)
+            if not c.is_empty or c.disjoint]
+        if not children:
+            return FilterValues.empty()
+        if any(c.disjoint for c in children):
+            return FilterValues.make_disjoint()
+        if not intersect:
+            return FilterValues([v for c in children for v in c.values])
+        acc = children[0].values
+        for c in children[1:]:
+            new: list[Geometry] = []
+            for a in acc:
+                for b in c.values:
+                    g = _geom_intersection(a, b)
+                    if g is not None:
+                        new.append(g)
+            acc = new
+            if not acc:
+                return FilterValues.make_disjoint()
+        return FilterValues(acc)
+    if isinstance(f, ast.BBox):
+        if attribute is not None and f.prop != attribute:
+            return FilterValues.empty()
+        box = Envelope(f.xmin, f.ymin, f.xmax, f.ymax).to_polygon()
+        return FilterValues([p for g in _split_idl(box) for p in _flatten(g)])
+    if isinstance(f, ast.DWithin):
+        if attribute is not None and f.prop != attribute:
+            return FilterValues.empty()
+        mult = METERS_MULTIPLIERS.get(f.units, 1.0)
+        deg = distance_degrees(f.geom, f.distance * mult)
+        buffered = f.geom.envelope.buffer(deg).to_polygon()
+        return FilterValues([p for g in _split_idl(buffered) for p in _flatten(g)])
+    if isinstance(f, (ast.Intersects, ast.Contains, ast.Within,
+                      ast.Overlaps, ast.Touches, ast.Crosses)):
+        if attribute is not None and f.prop != attribute:
+            return FilterValues.empty()
+        return FilterValues([p for g in _split_idl(f.geom) for p in _flatten(g)])
+    return FilterValues.empty()
+
+
+def is_filter_whole_world(f: ast.Filter) -> bool:
+    """True if the filter's spatial component covers the whole world
+    (FilterHelper.scala:157)."""
+    geoms = extract_geometries(f, None)
+    if geoms.is_empty:
+        return True
+    for g in geoms:
+        if g.envelope.contains_env(WHOLE_WORLD.envelope):
+            return True
+    return False
+
+
+# -- attribute bounds ------------------------------------------------------
+
+
+def extract_attribute_bounds(f: ast.Filter, attribute: str) -> FilterValues:
+    """Typed bounds for one attribute (FilterHelper.scala:318)."""
+    if isinstance(f, ast.Or):
+        all_bounds: list[Bounds] = []
+        for c in f.children:
+            child = extract_attribute_bounds(c, attribute)
+            if child.is_empty:
+                return FilterValues.empty()  # unconstrained child
+            all_bounds = _union(all_bounds, child.values)
+        return FilterValues(all_bounds) if all_bounds else FilterValues.empty()
+    if isinstance(f, ast.And):
+        acc: list[Bounds] | None = None
+        for c in f.children:
+            child = extract_attribute_bounds(c, attribute)
+            if child.disjoint:
+                return FilterValues.make_disjoint()
+            if child.is_empty:
+                continue
+            if acc is None:
+                acc = list(child.values)
+            else:
+                new = []
+                for a in acc:
+                    for b in child.values:
+                        i = a.intersection(b)
+                        if i is not None:
+                            new.append(i)
+                if not new:
+                    return FilterValues.make_disjoint()
+                acc = new
+        return FilterValues(acc) if acc else FilterValues.empty()
+    if isinstance(f, ast.Compare) and f.prop == attribute:
+        v = f.value
+        if f.op == ast.CompareOp.EQ:
+            return FilterValues([Bounds(Bound(v, True), Bound(v, True))])
+        if f.op == ast.CompareOp.LT:
+            return FilterValues([Bounds(Bound.unbounded(), Bound(v, False))])
+        if f.op == ast.CompareOp.LE:
+            return FilterValues([Bounds(Bound.unbounded(), Bound(v, True))])
+        if f.op == ast.CompareOp.GT:
+            return FilterValues([Bounds(Bound(v, False), Bound.unbounded())])
+        if f.op == ast.CompareOp.GE:
+            return FilterValues([Bounds(Bound(v, True), Bound.unbounded())])
+        return FilterValues.empty()  # <> is not index-consumable
+    if isinstance(f, ast.Between) and f.prop == attribute:
+        return FilterValues([Bounds(Bound(f.lo, True), Bound(f.hi, True))])
+    if isinstance(f, ast.InList) and f.prop == attribute:
+        return FilterValues([Bounds(Bound(v, True), Bound(v, True))
+                             for v in f.values])
+    if isinstance(f, ast.During) and f.prop == attribute:
+        return FilterValues([Bounds(Bound(f.start, False), Bound(f.end, False))])
+    if isinstance(f, ast.Before) and f.prop == attribute:
+        return FilterValues([Bounds(Bound.unbounded(), Bound(f.time, False))])
+    if isinstance(f, ast.After) and f.prop == attribute:
+        return FilterValues([Bounds(Bound(f.time, False), Bound.unbounded())])
+    if isinstance(f, ast.TEquals) and f.prop == attribute:
+        return FilterValues([Bounds(Bound(f.time, True), Bound(f.time, True))])
+    if isinstance(f, ast.Like) and f.prop == attribute and f.case_sensitive:
+        # prefix patterns are index-consumable: 'abc%' -> [abc, abd)
+        pat = f.pattern
+        i = min((pat.index(c) for c in "%_" if c in pat), default=len(pat))
+        prefix = pat[:i]
+        if prefix and pat[i:] in ("%", ""):
+            hi = prefix[:-1] + chr(ord(prefix[-1]) + 1)
+            return FilterValues([Bounds(Bound(prefix, True), Bound(hi, False))])
+        return FilterValues.empty()
+    return FilterValues.empty()
+
+
+def _union(acc: list[Bounds], more: list[Bounds]) -> list[Bounds]:
+    out = list(acc)
+    for b in more:
+        merged = b
+        keep = []
+        for a in out:
+            u = merged.union_if_overlapping(a)
+            if u is None:
+                keep.append(a)
+            else:
+                merged = u
+        keep.append(merged)
+        out = keep
+    return out
+
+
+# -- interval extraction ---------------------------------------------------
+
+
+def _round_seconds_up(ms: int) -> int:
+    return (ms // 1000 + 1) * 1000
+
+
+def _round_seconds_down(ms: int) -> int:
+    return (ms // 1000 - 1) * 1000 if ms % 1000 == 0 else (ms // 1000) * 1000
+
+
+def extract_intervals(f: ast.Filter, attribute: str,
+                      intersect: bool = True,
+                      handle_exclusive: bool = False) -> FilterValues:
+    """Date intervals in epoch millis (FilterHelper.extractIntervals:267).
+
+    With ``handle_exclusive``, exclusive bounds round to the next whole
+    second and become inclusive (matching the reference's key-range
+    construction for second-resolution backends)."""
+    bounds = extract_attribute_bounds(f, attribute)
+    if not bounds or bounds.disjoint:
+        return bounds
+    out = []
+    for b in bounds.values:
+        lower, upper = b.lower, b.upper
+        if handle_exclusive and lower.is_bounded and upper.is_bounded \
+                and not (lower.inclusive and upper.inclusive):
+            margin = 1000 if (lower.inclusive or upper.inclusive) else 2000
+            do_round = upper.value - lower.value > margin
+            lower = _adjust(lower, _round_seconds_up, do_round)
+            upper = _adjust(upper, _round_seconds_down, do_round)
+        elif handle_exclusive:
+            lower = _adjust(lower, _round_seconds_up, True)
+            upper = _adjust(upper, _round_seconds_down, True)
+        out.append(Bounds(lower, upper))
+    return FilterValues(out, bounds.precise, bounds.disjoint)
+
+
+def _adjust(bound: Bound, round_fn, do_round: bool) -> Bound:
+    if not bound.is_bounded:
+        return bound
+    if do_round and not bound.inclusive:
+        return Bound(round_fn(bound.value), True)
+    return bound

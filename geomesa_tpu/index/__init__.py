@@ -1,0 +1,8 @@
+"""L4 index & query-planning core (SURVEY.md section 1, geomesa-index-api)."""
+
+from .api import Explainer, FilterStrategy, Query, QueryHints, QueryPlan
+from .planner import decide_strategy, heuristic_cost
+from .splitter import split_filter
+
+__all__ = ["Explainer", "FilterStrategy", "Query", "QueryHints", "QueryPlan",
+           "decide_strategy", "heuristic_cost", "split_filter"]

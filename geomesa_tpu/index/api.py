@@ -1,0 +1,138 @@
+"""Index/query API core: Query, FilterStrategy, QueryPlan, Explainer.
+
+Mirrors the reference's geomesa-index-api surface (SURVEY.md section 1):
+``GeoMesaFeatureIndex.getFilterStrategy/getQueryPlan``
+(index/api/GeoMesaFeatureIndex.scala:140-156), ``FilterStrategy`` /
+``FilterPlan`` (index/api/FilterPlan.scala:19-34), and the tree-style
+``Explainer`` (index/utils/Explainer.scala:16-56).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+from ..filters import ast
+from ..filters.ecql import parse_ecql
+
+__all__ = ["Query", "FilterStrategy", "QueryPlan", "Explainer", "QueryHints"]
+
+
+class QueryHints:
+    """Per-query hint keys (index/conf/QueryHints.scala:22-68)."""
+    DENSITY_BBOX = "DENSITY_BBOX"
+    DENSITY_WIDTH = "DENSITY_WIDTH"
+    DENSITY_HEIGHT = "DENSITY_HEIGHT"
+    DENSITY_WEIGHT = "DENSITY_WEIGHT"
+    STATS_STRING = "STATS_STRING"
+    ENCODE_STATS = "ENCODE_STATS"
+    BIN_TRACK = "BIN_TRACK"
+    BIN_GEOM = "BIN_GEOM"
+    BIN_DTG = "BIN_DTG"
+    BIN_LABEL = "BIN_LABEL"
+    BIN_SORT = "BIN_SORT"
+    BIN_BATCH_SIZE = "BIN_BATCH_SIZE"
+    ARROW_ENCODE = "ARROW_ENCODE"
+    ARROW_DICTIONARY_FIELDS = "ARROW_DICTIONARY_FIELDS"
+    SAMPLING = "SAMPLING"
+    SAMPLE_BY = "SAMPLE_BY"
+    QUERY_INDEX = "QUERY_INDEX"
+    COST_EVALUATION = "COST_EVALUATION"
+    EXACT_COUNT = "EXACT_COUNT"
+    LOOSE_BBOX = "LOOSE_BBOX"
+
+
+@dataclasses.dataclass
+class Query:
+    """A query against one feature type (GeoTools Query analog)."""
+    type_name: str
+    filter: ast.Filter = dataclasses.field(default_factory=ast.Include)
+    properties: list[str] | None = None      # projection; None = all
+    max_features: int | None = None
+    sort_by: str | None = None
+    sort_desc: bool = False
+    hints: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if isinstance(self.filter, str):
+            self.filter = parse_ecql(self.filter)
+
+
+@dataclasses.dataclass
+class FilterStrategy:
+    """A possible way to run a query against one index: the primary
+    (index-consumable) part and the secondary (residual) part
+    (index/api/FilterPlan.scala:19)."""
+    index: str
+    primary: ast.Filter | None
+    secondary: ast.Filter | None
+    cost: float = 0.0
+
+    def __str__(self) -> str:
+        p = str(self.primary) if self.primary else "INCLUDE"
+        s = str(self.secondary) if self.secondary else "None"
+        return f"{self.index}[primary={p}, secondary={s}, cost={self.cost:g}]"
+
+
+@dataclasses.dataclass
+class QueryPlan:
+    """An executable plan: strategy + the executor closure that runs it.
+
+    ``execute(hints) -> result``; the store wires concrete executors.
+    Mirrors QueryPlan (index/api/QueryPlan.scala:27) minus the
+    byte-range machinery, which has no TPU analog.
+    """
+    strategy: FilterStrategy
+    execute: Callable[..., Any]
+    details: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class Explainer:
+    """Tree-structured explain output (index/utils/Explainer.scala)."""
+
+    def __init__(self, out: Callable[[str], None] | None = None):
+        self._depth = 0
+        self._lines: list[str] = []
+        self._out = out
+
+    def __call__(self, msg: str) -> "Explainer":
+        line = "  " * self._depth + msg
+        self._lines.append(line)
+        if self._out:
+            self._out(line)
+        return self
+
+    def push(self, msg: str | None = None) -> "Explainer":
+        if msg is not None:
+            self(msg)
+        self._depth += 1
+        return self
+
+    def pop(self) -> "Explainer":
+        self._depth = max(0, self._depth - 1)
+        return self
+
+    @property
+    def text(self) -> str:
+        return "\n".join(self._lines)
+
+
+class Timing:
+    """Inline timer (MethodProfiling/Timings analog)."""
+
+    def __init__(self):
+        self.times: dict[str, float] = {}
+
+    def time(self, key: str):
+        timing = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+
+            def __exit__(self, *exc):
+                timing.times[key] = timing.times.get(key, 0.0) + (
+                    time.perf_counter() - self.t0)
+
+        return _Ctx()

@@ -1,0 +1,96 @@
+"""Query planner: strategy selection + explain.
+
+Mirrors QueryPlanner (index/planning/QueryPlanner.scala:43) and
+StrategyDecider/CostBasedStrategyDecider (index/planning/StrategyDecider.scala:47-64):
+enumerate strategy options via the splitter, cost each (stats-based when
+stats exist, index-priority heuristics otherwise), pick the cheapest,
+honoring the QUERY_INDEX hint override.
+"""
+
+from __future__ import annotations
+
+from ..features.sft import SimpleFeatureType
+from ..filters import ast
+from ..filters.helper import extract_geometries, extract_intervals
+from .api import Explainer, FilterStrategy, Query, QueryHints
+from .splitter import split_filter
+
+__all__ = ["decide_strategy", "heuristic_cost"]
+
+# index-priority costs when no stats are available, mirroring the
+# reference's fixed-cost fallback ordering (id < attr-eq < z3 < z2 < scan)
+_BASE_COST = {
+    "empty": 0.0,
+    "id": 1.0,
+    "z3": 200.0,
+    "xz3": 201.0,
+    "z2": 400.0,
+    "xz2": 401.0,
+    "fullscan": 1e9,
+}
+
+
+def heuristic_cost(sft: SimpleFeatureType, s: FilterStrategy,
+                   n_features: int) -> float:
+    if s.index.startswith("attr:"):
+        # equality cheaper than range (AttributeIndex cost heuristics)
+        base = 10.0
+        if isinstance(s.primary, (ast.Compare,)) and s.primary.op == "=":
+            return base
+        return base * 10
+    base = _BASE_COST.get(s.index, 1e9)
+    if s.index == "fullscan":
+        return float(max(n_features, 1))
+    return base
+
+
+def decide_strategy(sft: SimpleFeatureType, query: Query,
+                    indices: list[str], n_features: int,
+                    stats=None, explain: Explainer | None = None
+                    ) -> FilterStrategy:
+    """Pick the best strategy (StrategyDecider.getFilterPlan analog)."""
+    explain = explain or Explainer()
+    options = split_filter(sft, query.filter, indices)
+    explain.push(f"Strategy options for '{query.filter}':")
+
+    forced = query.hints.get(QueryHints.QUERY_INDEX)
+    if forced:
+        for s in options:
+            if s.index == forced or s.index.startswith(f"{forced}:"):
+                explain(f"Forced via QUERY_INDEX hint: {s}")
+                explain.pop()
+                return s
+        explain(f"QUERY_INDEX={forced} requested but not viable; ignoring")
+
+    best = None
+    for s in options:
+        if stats is not None:
+            s.cost = _stats_cost(sft, s, stats, n_features)
+        else:
+            s.cost = heuristic_cost(sft, s, n_features)
+        explain(f"option: {s}")
+        if best is None or s.cost < best.cost:
+            best = s
+    explain(f"Selected: {best}")
+    explain.pop()
+    return best
+
+
+def _stats_cost(sft: SimpleFeatureType, s: FilterStrategy, stats,
+                n_features: int) -> float:
+    """Stats-based cost: estimated matching count for the primary
+    (StatsBasedEstimator analog); falls back to heuristics."""
+    if s.index == "empty":
+        return 0.0
+    if s.index == "fullscan":
+        return float(max(n_features, 1))
+    if s.primary is None:
+        return float(max(n_features, 1))
+    try:
+        est = stats.estimate_count(s.primary)
+    except Exception:
+        est = None
+    if est is None:
+        return heuristic_cost(sft, s, n_features)
+    # small bias keeps z3 preferred over z2 at equal selectivity
+    return est + _BASE_COST.get(s.index, 500.0) / 1e6

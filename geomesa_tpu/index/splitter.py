@@ -1,0 +1,144 @@
+"""FilterSplitter: decompose a filter into per-index strategy options.
+
+Mirrors the reference's FilterSplitter (index/planning/FilterSplitter.scala:25)
++ per-index ``getFilterStrategy``: for each available index, split the
+query filter into a *primary* part the index can turn into ranges and a
+*secondary* residual evaluated on scan results.
+
+Index applicability (reference key spaces):
+- z3/xz3:  spatial values on the default geometry AND intervals on the
+           default date (Z3IndexKeySpace.scala:63-119)
+- z2/xz2:  spatial values on the default geometry (Z2IndexKeySpace)
+- attr:    bounds on an indexed attribute (AttributeIndex)
+- id:      FidFilter (RecordIndex/IdIndex)
+- fullscan: anything (the in-memory fallback; no reference analog needed
+            because tables always have at least one index)
+"""
+
+from __future__ import annotations
+
+from ..features.sft import SimpleFeatureType
+from ..filters import ast
+from ..filters.helper import (FilterValues, extract_attribute_bounds,
+                              extract_geometries, extract_intervals)
+from .api import FilterStrategy
+
+__all__ = ["split_filter", "spatial_part", "temporal_part"]
+
+
+def _is_spatial(f: ast.Filter, geom: str) -> bool:
+    return (isinstance(f, (ast.BBox, ast.DWithin, ast.SpatialPredicate))
+            and f.prop == geom)
+
+
+def _is_temporal(f: ast.Filter, dtg: str | None) -> bool:
+    return (dtg is not None
+            and isinstance(f, (ast.During, ast.Before, ast.After, ast.TEquals,
+                               ast.Compare, ast.Between))
+            and getattr(f, "prop", None) == dtg)
+
+
+def _partition(f: ast.Filter, pred) -> tuple[ast.Filter | None, ast.Filter | None]:
+    """Split an AND tree into (matching, rest). Non-AND filters are all
+    or nothing. Returns (None, f) when nothing matches."""
+    if isinstance(f, ast.Include):
+        return None, None
+    if pred(f):
+        return f, None
+    if isinstance(f, ast.And):
+        hit = [c for c in f.children if pred(c)]
+        rest = [c for c in f.children if not pred(c)]
+        hit_f = None if not hit else (hit[0] if len(hit) == 1 else ast.And(hit))
+        rest_f = None if not rest else (rest[0] if len(rest) == 1 else ast.And(rest))
+        return hit_f, rest_f
+    return None, f
+
+
+def spatial_part(f: ast.Filter, geom: str):
+    return _partition(f, lambda c: _is_spatial(c, geom))
+
+
+def temporal_part(f: ast.Filter, dtg: str | None):
+    return _partition(f, lambda c: _is_temporal(c, dtg))
+
+
+def _and_opt(a: ast.Filter | None, b: ast.Filter | None) -> ast.Filter | None:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return ast.And([a, b])
+
+
+def split_filter(sft: SimpleFeatureType, f: ast.Filter,
+                 indices: list[str]) -> list[FilterStrategy]:
+    """All viable FilterStrategy options for the filter.
+
+    OR filters at the top level are handled as in the reference: if every
+    OR child constrains the same dimension the whole OR is usable as a
+    primary; otherwise only fullscan applies (FilterSplitter's
+    'cannot split an OR across indices' rule, simplified).
+    """
+    geom = sft.geom_field
+    dtg = sft.dtg_field
+    options: list[FilterStrategy] = []
+
+    if isinstance(f, ast.Exclude):
+        return [FilterStrategy("empty", None, None, cost=0)]
+
+    for index in indices:
+        if index in ("z3", "xz3") and geom is not None and dtg is not None:
+            geoms = extract_geometries(f, geom)
+            intervals = extract_intervals(f, dtg)
+            if geoms.disjoint or intervals.disjoint:
+                return [FilterStrategy("empty", None, None, cost=0)]
+            # z3 needs a bounded time interval (Z3IndexKeySpace requires
+            # intervals; unbounded falls through to z2/fullscan)
+            bounded = bool(intervals) and all(
+                b.lower.is_bounded and b.upper.is_bounded for b in intervals)
+            if bounded:
+                spatial, rest1 = spatial_part(f, geom)
+                temporal, rest2 = temporal_part(rest1, dtg) if rest1 else (None, None)
+                primary = _and_opt(spatial, temporal)
+                if primary is not None:
+                    options.append(FilterStrategy(index, primary, rest2))
+        elif index in ("z2", "xz2") and geom is not None:
+            geoms = extract_geometries(f, geom)
+            if geoms.disjoint:
+                return [FilterStrategy("empty", None, None, cost=0)]
+            if geoms:
+                spatial, rest = spatial_part(f, geom)
+                if spatial is not None:
+                    options.append(FilterStrategy(index, spatial, rest))
+        elif index == "id":
+            if isinstance(f, ast.FidFilter):
+                options.append(FilterStrategy("id", f, None))
+            elif isinstance(f, ast.And):
+                fids = [c for c in f.children if isinstance(c, ast.FidFilter)]
+                if fids:
+                    # multiple fid filters AND together: intersect the sets
+                    ids = set(fids[0].ids)
+                    for extra in fids[1:]:
+                        ids &= set(extra.ids)
+                    rest = [c for c in f.children if c not in fids]
+                    rest_f = None if not rest else (
+                        rest[0] if len(rest) == 1 else ast.And(rest))
+                    options.append(FilterStrategy(
+                        "id", ast.FidFilter(sorted(ids)), rest_f))
+        elif index.startswith("attr:"):
+            attr = index.split(":", 1)[1]
+            bounds = extract_attribute_bounds(f, attr)
+            if bounds.disjoint:
+                return [FilterStrategy("empty", None, None, cost=0)]
+            if bounds and any(b.is_bounded for b in bounds):
+                primary, rest = _partition(
+                    f, lambda c: getattr(c, "prop", None) == attr
+                    and isinstance(c, (ast.Compare, ast.Between, ast.InList,
+                                       ast.Like)))
+                if primary is not None:
+                    options.append(FilterStrategy(index, primary, rest))
+
+    # fullscan is always viable
+    residual = None if isinstance(f, ast.Include) else f
+    options.append(FilterStrategy("fullscan", None, residual))
+    return options

@@ -1,0 +1,16 @@
+"""Distribution layer: device meshes, sharded scans, ICI collectives.
+
+The reference distributes scans across database tablet/region servers
+and reduces partial aggregates client-side (SURVEY.md 2.5/2.6); here the
+"servers" are mesh devices holding column shards, the "iterator stack"
+is a shard_map'd kernel, and the "client reduce" is a psum/all_gather
+over ICI.
+"""
+
+from .mesh import (DistributedScanData, data_mesh, distributed_count,
+                   distributed_density, distributed_scan_mask,
+                   exact_host_mask, shard_scan_data)
+
+__all__ = ["DistributedScanData", "data_mesh", "distributed_count",
+           "distributed_density", "distributed_scan_mask",
+           "exact_host_mask", "shard_scan_data"]

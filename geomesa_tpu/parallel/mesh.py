@@ -1,0 +1,219 @@
+"""Mesh-sharded scans: the multi-chip execution path.
+
+Data parallelism over a ``jax.sharding.Mesh`` axis ``"data"``: feature
+columns shard evenly across devices (the analog of tablet splits,
+SURVEY.md 2.5 #2-3); the scan kernel runs shard-locally under
+``shard_map``; aggregations reduce over ICI with ``psum`` (the analog of
+"server-side aggregate -> client reduce", SURVEY.md 2.5 #5).
+
+Masks stay device-resident and sharded — downstream aggregation kernels
+(density/stats/bin) consume them without gathering; only final small
+results cross to the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..scan import zscan
+
+__all__ = ["data_mesh", "DistributedScanData", "shard_scan_data",
+           "distributed_scan_mask", "distributed_count",
+           "distributed_density"]
+
+
+def data_mesh(n_devices: int | None = None) -> Mesh:
+    """A 1-D mesh over the data axis."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), axis_names=("data",))
+
+
+@dataclasses.dataclass
+class DistributedScanData:
+    """Sharded device columns + padding info + host originals (kept for
+    the exact f64 boundary patch, mirroring the single-chip store)."""
+    xhi: jax.Array
+    xlo: jax.Array
+    yhi: jax.Array
+    ylo: jax.Array
+    tday: jax.Array
+    tms: jax.Array
+    n: int            # true (unpadded) row count
+    n_padded: int
+    mesh: Mesh
+    host_x: np.ndarray
+    host_y: np.ndarray
+    host_millis: np.ndarray
+    host_xhi: np.ndarray
+    host_yhi: np.ndarray
+
+
+def shard_scan_data(x: np.ndarray, y: np.ndarray, millis: np.ndarray,
+                    mesh: Mesh) -> DistributedScanData:
+    """Host columns -> evenly-sharded device columns (padded so every
+    shard is equal; pad rows carry out-of-domain coords so no query
+    matches them)."""
+    n = len(x)
+    k = mesh.devices.size
+    n_padded = ((n + k - 1) // k) * k
+    pad = n_padded - n
+
+    def prep(arr, fill):
+        arr = np.asarray(arr)
+        if pad:
+            arr = np.concatenate([arr, np.full(pad, fill, dtype=arr.dtype)])
+        return arr
+
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    millis_h = np.asarray(millis, np.int64)
+    xhi, xlo = zscan.split_two_float(prep(x, 1e9))
+    yhi, ylo = zscan.split_two_float(prep(y, 1e9))
+    millis_p = prep(millis_h, -1)
+    tday = (millis_p // zscan.MILLIS_PER_DAY).astype(np.int32)
+    tms = (millis_p - tday.astype(np.int64) * zscan.MILLIS_PER_DAY).astype(np.int32)
+
+    sharding = NamedSharding(mesh, P("data"))
+    put = functools.partial(jax.device_put, device=sharding)
+    return DistributedScanData(
+        put(xhi), put(xlo), put(yhi), put(ylo),
+        put(tday), put(tms),
+        n, n_padded, mesh, x, y, millis_h, xhi[:n], yhi[:n])
+
+
+def _shard_mask_fn(time_any: bool):
+    """Shard-local scan body; runs identically on every device."""
+    def body(xhi, xlo, yhi, ylo, tday, tms, boxes, box_valid, times, tvalid):
+        return zscan._scan_mask(xhi, xlo, yhi, ylo, tday, tms,
+                                boxes, box_valid, times, tvalid, time_any)
+    return body
+
+
+_SPECS_IN = (P("data"), P("data"), P("data"), P("data"),
+             P("data"), P("data"), P(), P(), P(), P())
+
+
+@functools.lru_cache(maxsize=32)
+def _mask_fn(mesh: Mesh, time_any: bool):
+    return jax.jit(jax.shard_map(_shard_mask_fn(time_any), mesh=mesh,
+                                 in_specs=_SPECS_IN, out_specs=P("data")))
+
+
+@functools.lru_cache(maxsize=32)
+def _count_fn(mesh: Mesh, time_any: bool):
+    body = _shard_mask_fn(time_any)
+
+    def counted(*args):
+        mask = body(*args)
+        return jax.lax.psum(jnp.sum(mask, dtype=jnp.int32), "data")
+
+    return jax.jit(jax.shard_map(counted, mesh=mesh,
+                                 in_specs=_SPECS_IN, out_specs=P()))
+
+
+def _args(data: DistributedScanData, q: zscan.ScanQuery):
+    return (data.xhi, data.xlo, data.yhi, data.ylo, data.tday, data.tms,
+            q.boxes, q.box_valid, q.times, q.time_valid)
+
+
+def distributed_scan_mask(data: DistributedScanData,
+                          q: zscan.ScanQuery) -> jax.Array:
+    """Run the scan on every shard; returns the sharded bool mask (raw
+    device verdict; use ``exact_host_mask`` for the f64-patched result)."""
+    return _mask_fn(data.mesh, q.time_any)(*_args(data, q))
+
+
+def exact_host_mask(data: DistributedScanData, q: zscan.ScanQuery) -> np.ndarray:
+    """Gathered host mask with the exact f64 boundary patch applied
+    (drops padding rows)."""
+    mask = np.asarray(distributed_scan_mask(data, q))[:data.n]
+    cand = zscan.boundary_candidates(data.host_xhi, data.host_yhi, q)
+    return zscan.exact_patch(mask, cand, data.host_x, data.host_y,
+                             data.host_millis, q)
+
+
+def _exact_count_adjustment(data: DistributedScanData,
+                            q: zscan.ScanQuery) -> int:
+    """Difference between exact-f64 and two-float verdicts over the
+    boundary candidates (time is exact in both, so only spatial flips)."""
+    cand = zscan.boundary_candidates(data.host_xhi, data.host_yhi, q)
+    if len(cand) == 0:
+        return 0
+    # two-float verdict, recomputed on host with identical arithmetic
+    dev = np.zeros(len(cand), dtype=bool)
+    xhi, xlo = zscan.split_two_float(data.host_x[cand])
+    yhi, ylo = zscan.split_two_float(data.host_y[cand])
+    boxes = np.asarray(q.boxes)
+    for i in range(q.n_boxes):
+        b = boxes[i]
+        dev |= (((xhi > b[0]) | ((xhi == b[0]) & (xlo >= b[1])))
+                & ((xhi < b[2]) | ((xhi == b[2]) & (xlo <= b[3])))
+                & ((yhi > b[4]) | ((yhi == b[4]) & (ylo >= b[5])))
+                & ((yhi < b[6]) | ((yhi == b[6]) & (ylo <= b[7]))))
+    exact = np.zeros(len(cand), dtype=bool)
+    for i in range(q.n_boxes):
+        xmin, ymin, xmax, ymax = q.host_boxes[i]
+        cx, cy = data.host_x[cand], data.host_y[cand]
+        exact |= (cx >= xmin) & (cx <= xmax) & (cy >= ymin) & (cy <= ymax)
+    if not q.time_any:
+        cm = data.host_millis[cand]
+        t_ok = np.zeros(len(cand), dtype=bool)
+        for lo, hi in q.host_intervals:
+            t_ok |= (cm >= lo) & (cm <= hi)
+        dev &= t_ok
+        exact &= t_ok
+    return int(exact.sum()) - int(dev.sum())
+
+
+def distributed_count(data: DistributedScanData, q: zscan.ScanQuery) -> int:
+    """Fused scan + global count: psum over the mesh (the 'server-side
+    aggregate, client reduce' shape in one XLA program), corrected by the
+    host boundary adjustment so the result is exact-f64."""
+    device = int(_count_fn(data.mesh, q.time_any)(*_args(data, q)))
+    return device + _exact_count_adjustment(data, q)
+
+
+@functools.lru_cache(maxsize=32)
+def _density_fn(mesh: Mesh, time_any: bool,
+                bbox: tuple[float, float, float, float],
+                width: int, height: int):
+    body = _shard_mask_fn(time_any)
+    xmin, ymin, xmax, ymax = bbox
+    sx = width / (xmax - xmin) if xmax > xmin else 0.0
+    sy = height / (ymax - ymin) if ymax > ymin else 0.0
+
+    def density(xhi, xlo, yhi, ylo, tday, tms, boxes, bvalid, times, tvalid):
+        mask = body(xhi, xlo, yhi, ylo, tday, tms, boxes, bvalid, times, tvalid)
+        # GridSnap pixel binning; f32 coords are ample for pixel indices
+        x = xhi.astype(jnp.float32) + xlo
+        y = yhi.astype(jnp.float32) + ylo
+        col = jnp.clip(((x - xmin) * sx).astype(jnp.int32), 0, width - 1)
+        row = jnp.clip(((y - ymin) * sy).astype(jnp.int32), 0, height - 1)
+        flat = row * width + col
+        grid = jnp.zeros((height * width,), dtype=jnp.float32)
+        grid = grid.at[flat].add(mask.astype(jnp.float32))
+        return jax.lax.psum(grid, "data")
+
+    return jax.jit(jax.shard_map(density, mesh=mesh,
+                                 in_specs=_SPECS_IN, out_specs=P()))
+
+
+def distributed_density(data: DistributedScanData, q: zscan.ScanQuery,
+                        bbox: tuple[float, float, float, float],
+                        width: int, height: int) -> np.ndarray:
+    """Density surface: shard-local scatter-add onto the pixel grid,
+    psum over ICI (DensityScan analog, index/iterators/DensityScan.scala:30).
+    Pixel-snap output; boundary-band f64 differences are below pixel
+    resolution, so no host patch is applied."""
+    fn = _density_fn(data.mesh, q.time_any,
+                     tuple(float(v) for v in bbox), width, height)
+    out = fn(*_args(data, q))
+    return np.asarray(out).reshape(height, width)

@@ -1,0 +1,7 @@
+"""L5 datastores. The in-memory TPU store is the flagship execution
+engine (geomesa-memory/CQEngine analog, but device-resident); fs/live
+tiers layer on top of it."""
+
+from .memory import InMemoryDataStore, QueryResult
+
+__all__ = ["InMemoryDataStore", "QueryResult"]

@@ -1,0 +1,349 @@
+"""In-memory TPU datastore: the end-to-end execution engine.
+
+The reference's in-memory store (geomesa-memory/.../GeoCQEngine.scala:33)
+indexes features in CQEngine collections and evaluates queries on the
+CPU; here feature batches live as columnar device arrays and queries run
+as fused XLA scans:
+
+    write(batch) -> host columns + device scan arrays
+    query(q)     -> plan (splitter + cost decider)
+                 -> device kernel mask (spatio-temporal, exact via
+                    two-float + boundary f64 patch)
+                 -> residual filter on surviving candidates (host f64
+                    reference evaluator; device compilation later)
+                 -> QueryResult (ids / batches / aggregates)
+
+This single-device path is the building block the mesh-sharded store
+(parallel/) distributes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator
+
+import numpy as np
+
+from ..features.batch import FeatureBatch, PointColumn
+from ..features.sft import SimpleFeatureType, parse_spec
+from ..filters import ast
+from ..filters.ecql import parse_ecql
+from ..filters.evaluate import evaluate
+from ..filters.helper import extract_geometries, extract_intervals
+from ..geometry import Envelope
+from ..index.api import Explainer, FilterStrategy, Query, QueryHints
+from ..index.planner import decide_strategy
+from ..scan import zscan
+
+__all__ = ["InMemoryDataStore", "QueryResult"]
+
+
+@dataclasses.dataclass
+class QueryResult:
+    """Result of a feature query."""
+    ids: np.ndarray                  # object array of matched feature ids
+    batch: FeatureBatch | None       # projected features (None = ids only)
+    explain: Explainer
+    plan: FilterStrategy
+
+    @property
+    def n(self) -> int:
+        return len(self.ids)
+
+    def features(self) -> Iterator[dict[str, Any]]:
+        if self.batch is None:
+            return iter(())
+        return (self.batch.feature(i) for i in range(self.batch.n))
+
+
+class _TypeState:
+    """Per-feature-type storage: host batch + lazily-built device index."""
+
+    def __init__(self, sft: SimpleFeatureType):
+        self.sft = sft
+        self.batch: FeatureBatch | None = None
+        self.scan_data: zscan.DeviceScanData | None = None
+        self.host_xhi: np.ndarray | None = None
+        self.host_yhi: np.ndarray | None = None
+        self.dirty = False
+
+    @property
+    def n(self) -> int:
+        return 0 if self.batch is None else self.batch.n
+
+    def append(self, batch: FeatureBatch):
+        self.batch = batch if self.batch is None else self.batch.concat(batch)
+        self.dirty = True
+
+    def delete(self, ids: set[str]):
+        if self.batch is None:
+            return
+        keep = ~np.isin(self.batch.ids.astype(str), list(ids))
+        self.batch = self.batch.take(np.flatnonzero(keep))
+        self.dirty = True
+
+    def ensure_index(self):
+        """(Re)build device arrays if writes happened."""
+        if not self.dirty and self.scan_data is not None:
+            return
+        if self.batch is None or self.batch.n == 0:
+            self.scan_data = None
+            self.dirty = False
+            return
+        geom = self.sft.geom_field
+        dtg = self.sft.dtg_field
+        col = self.batch.col(geom) if geom else None
+        if not isinstance(col, PointColumn):
+            # extent geometries scan via host bbox prefilter (device
+            # packed-geometry kernels come with the XZ scan work)
+            self.scan_data = None
+            self.dirty = False
+            return
+        x = col.x
+        y = col.y
+        if dtg is not None:
+            millis = self.batch.col(dtg).millis
+        else:
+            millis = np.zeros(len(x), dtype=np.int64)
+        self.scan_data = zscan.build_scan_data(x, y, millis)
+        self.host_xhi = np.asarray(self.scan_data.xhi)
+        self.host_yhi = np.asarray(self.scan_data.yhi)
+        self.dirty = False
+
+
+class InMemoryDataStore:
+    """A GeoTools-DataStore-shaped API over device-resident batches."""
+
+    def __init__(self):
+        self._types: dict[str, _TypeState] = {}
+
+    # -- schema management (MetadataBackedDataStore surface) --------------
+
+    def create_schema(self, sft: SimpleFeatureType | str,
+                      spec: str | None = None):
+        if isinstance(sft, str):
+            sft = parse_spec(sft, spec or "")
+        if sft.type_name in self._types:
+            raise ValueError(f"schema {sft.type_name!r} already exists")
+        self._types[sft.type_name] = _TypeState(sft)
+
+    def get_schema(self, type_name: str) -> SimpleFeatureType:
+        return self._state(type_name).sft
+
+    def get_type_names(self) -> list[str]:
+        return sorted(self._types)
+
+    def remove_schema(self, type_name: str):
+        self._types.pop(type_name, None)
+
+    def _state(self, type_name: str) -> _TypeState:
+        if type_name not in self._types:
+            raise KeyError(f"no such schema: {type_name}")
+        return self._types[type_name]
+
+    # -- writes ------------------------------------------------------------
+
+    def write(self, type_name: str, batch: FeatureBatch):
+        st = self._state(type_name)
+        if batch.sft != st.sft:
+            raise ValueError("batch schema does not match store schema")
+        st.append(batch)
+
+    def write_dict(self, type_name: str, ids, data: dict[str, Any]):
+        st = self._state(type_name)
+        self.write(type_name, FeatureBatch.from_dict(st.sft, ids, data))
+
+    def delete(self, type_name: str, ids):
+        self._state(type_name).delete(set(map(str, ids)))
+
+    def count(self, type_name: str) -> int:
+        return self._state(type_name).n
+
+    # -- queries -----------------------------------------------------------
+
+    def _indices(self, sft: SimpleFeatureType) -> list[str]:
+        out = []
+        if sft.geom_field is not None:
+            if sft.is_points:
+                if sft.dtg_field is not None:
+                    out.append("z3")
+                out.append("z2")
+            else:
+                if sft.dtg_field is not None:
+                    out.append("xz3")
+                out.append("xz2")
+        out.append("id")
+        for a in sft.attributes:
+            if a.indexed:
+                out.append(f"attr:{a.name}")
+        return out
+
+    def query(self, q: Query | str, type_name: str | None = None,
+              explain_out=None) -> QueryResult:
+        if isinstance(q, str):
+            if type_name is None:
+                raise ValueError("type_name required with a filter string")
+            q = Query(type_name, q)
+        st = self._state(q.type_name)
+        explain = Explainer(explain_out)
+        explain.push(f"Planning '{q.type_name}' "
+                     f"filter={q.filter}")
+        if st.batch is None or st.n == 0:
+            explain("Store is empty").pop()
+            return QueryResult(np.empty(0, dtype=object), None, explain,
+                               FilterStrategy("empty", None, None))
+
+        strategy = decide_strategy(st.sft, q, self._indices(st.sft), st.n,
+                                   explain=explain)
+        mask = self._execute(st, q, strategy, explain)
+
+        idx = np.flatnonzero(mask)
+        if q.sort_by is not None:
+            col = st.batch.col(q.sort_by)
+            keys = getattr(col, "values", getattr(col, "millis", None))
+            if keys is None:
+                raise ValueError(f"cannot sort by {q.sort_by}")
+            order = np.argsort(keys[idx], kind="stable")
+            if q.sort_desc:
+                order = order[::-1]
+            idx = idx[order]
+        if q.max_features is not None:
+            idx = idx[:q.max_features]
+
+        ids = st.batch.ids[idx]
+        batch = st.batch.take(idx)
+        if q.properties is not None:
+            cols = {p: batch.columns[p] for p in q.properties}
+            batch = FeatureBatch(
+                _project_sft(st.sft, q.properties), batch.ids, cols)
+        explain(f"Hits: {len(ids)}").pop()
+        return QueryResult(ids, batch, explain, strategy)
+
+    def _execute(self, st: _TypeState, q: Query, strategy: FilterStrategy,
+                 explain: Explainer) -> np.ndarray:
+        """Run the chosen strategy; returns a host bool[n] mask."""
+        sft = st.sft
+        n = st.n
+        batch = st.batch
+        if strategy.index == "empty":
+            return np.zeros(n, dtype=bool)
+
+        if strategy.index in ("z3", "z2", "xz3", "xz2"):
+            st.ensure_index()
+
+        if strategy.index in ("z3", "z2") and st.scan_data is not None:
+            mask = self._device_scan(st, q, strategy, explain)
+        elif strategy.index == "id" and strategy.primary is not None:
+            mask = np.isin(batch.ids.astype(str),
+                           np.asarray(strategy.primary.ids, dtype=str))
+        else:
+            # fullscan / attr / extent-geometry path: host evaluation of
+            # the primary (residual joins below)
+            explain(f"Executing host scan for {strategy.index}")
+            mask = (evaluate(strategy.primary, batch)
+                    if strategy.primary is not None
+                    else np.ones(n, dtype=bool))
+
+        if strategy.secondary is not None:
+            cand = np.flatnonzero(mask)
+            if len(cand):
+                sub = batch.take(cand)
+                keep = evaluate(strategy.secondary, sub)
+                out = np.zeros(n, dtype=bool)
+                out[cand[keep]] = True
+                mask = out
+            explain(f"Residual filter applied: {strategy.secondary}")
+        return mask
+
+    def _device_scan(self, st: _TypeState, q: Query,
+                     strategy: FilterStrategy, explain: Explainer) -> np.ndarray:
+        """The hot path: fused device kernel + exact boundary patch +
+        non-envelope geometry residual."""
+        sft = st.sft
+        batch = st.batch
+        geom = sft.geom_field
+        dtg = sft.dtg_field
+        primary = strategy.primary if strategy.primary is not None else ast.Include()
+
+        geoms = extract_geometries(primary, geom)
+        boxes = [g.envelope.as_tuple() for g in geoms] or \
+            [(-180.0, -90.0, 180.0, 90.0)]
+
+        intervals = []
+        if dtg is not None and strategy.index == "z3":
+            iv = extract_intervals(primary, dtg)
+            for b in iv:
+                lo = _to_millis(b.lower.value) if b.lower.is_bounded else 0
+                hi = _to_millis(b.upper.value) if b.upper.is_bounded else 2**62
+                if b.lower.is_bounded and not b.lower.inclusive:
+                    lo += 1
+                if b.upper.is_bounded and not b.upper.inclusive:
+                    hi -= 1
+                intervals.append((lo, hi))
+
+        sq = zscan.make_query(boxes, intervals)
+        explain(f"Device scan: {len(boxes)} box(es), "
+                f"{len(intervals)} interval(s), n={st.n}")
+        mask = np.asarray(zscan.scan_mask(st.scan_data, sq))
+
+        # exact f64 patch along query boundaries
+        cand = zscan.boundary_candidates(st.host_xhi, st.host_yhi, sq)
+        if len(cand):
+            col = batch.col(geom)
+            millis = (batch.col(dtg).millis if dtg is not None
+                      else np.zeros(st.n, dtype=np.int64))
+            mask = zscan.exact_patch(mask, cand, col.x, col.y, millis, sq)
+            explain(f"Boundary recheck: {len(cand)} candidate(s)")
+
+        # non-envelope query geometries need the exact predicate too
+        needs_exact = any(not _is_envelope(g) for g in geoms) or any(
+            isinstance(c, (ast.DWithin, ast.SpatialPredicate))
+            for c in _walk(primary))
+        if needs_exact:
+            candidates = np.flatnonzero(mask)
+            if len(candidates):
+                sub = batch.take(candidates)
+                spatial_f = _spatial_only(primary, geom)
+                if spatial_f is not None:
+                    keep = evaluate(spatial_f, sub)
+                    out = np.zeros(st.n, dtype=bool)
+                    out[candidates[keep]] = True
+                    mask = out
+            explain("Exact geometry predicate applied")
+        return mask
+
+
+def _to_millis(v) -> int:
+    """Interval bound -> epoch millis: ECQL quoted date strings arrive as
+    raw strings (only bare datetime tokens parse to millis in the lexer)."""
+    if isinstance(v, str):
+        return int(np.datetime64(v.strip().rstrip("Z").replace(" ", "T"),
+                                 "ms").astype(np.int64))
+    return int(v)
+
+
+def _is_envelope(g) -> bool:
+    from ..filters.helper import _is_box
+    from ..geometry import Polygon
+    return isinstance(g, Polygon) and not g.holes and _is_box(g)
+
+
+def _walk(f: ast.Filter):
+    yield f
+    for c in getattr(f, "children", ()) or ():
+        yield from _walk(c)
+    child = getattr(f, "child", None)
+    if child is not None:
+        yield from _walk(child)
+
+
+def _spatial_only(f: ast.Filter, geom: str) -> ast.Filter | None:
+    from ..index.splitter import spatial_part
+    spatial, _ = spatial_part(f, geom)
+    return spatial
+
+
+def _project_sft(sft: SimpleFeatureType, props: list[str]) -> SimpleFeatureType:
+    return SimpleFeatureType(
+        sft.type_name, [a for a in sft.attributes if a.name in props],
+        sft.user_data)

@@ -4,9 +4,18 @@ code paths without TPU hardware (SURVEY.md section 4 test strategy)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the environment points JAX at a TPU tunnel: tests
+# must be deterministic and exercise an 8-device mesh. The tunnel plugin
+# ('axon') ignores the JAX_PLATFORMS env var, so ALSO set the config flag
+# after import, before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "false")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8

@@ -1,0 +1,259 @@
+"""L3 filter tests: ECQL parsing, extraction semantics (FilterHelper
+parity scenarios), vectorized evaluation vs brute force."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.filters import (ast, evaluate, extract_attribute_bounds,
+                                 extract_geometries, extract_intervals,
+                                 is_filter_whole_world, parse_ecql, ECQLError)
+from geomesa_tpu.geometry import Point, parse_wkt
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+
+class TestEcqlParser:
+    def test_bbox(self):
+        f = parse_ecql("BBOX(geom, -80, 35, -70, 40)")
+        assert isinstance(f, ast.BBox)
+        assert (f.xmin, f.ymin, f.xmax, f.ymax) == (-80, 35, -70, 40)
+
+    def test_logical_nesting(self):
+        f = parse_ecql("(a = 1 OR b = 2) AND NOT c = 3")
+        assert isinstance(f, ast.And)
+        assert isinstance(f.children[0], ast.Or)
+        assert isinstance(f.children[1], ast.Not)
+
+    def test_and_flattening(self):
+        f = parse_ecql("a = 1 AND b = 2 AND c = 3")
+        assert isinstance(f, ast.And) and len(f.children) == 3
+
+    def test_comparisons(self):
+        for op, cls_op in [("=", "="), ("<>", "<>"), ("!=", "<>"),
+                           ("<", "<"), (">", ">"), ("<=", "<="), (">=", ">=")]:
+            f = parse_ecql(f"age {op} 21")
+            assert isinstance(f, ast.Compare) and f.op == cls_op
+
+    def test_string_literal_quoting(self):
+        f = parse_ecql("name = 'O''Brien'")
+        assert f.value == "O'Brien"
+
+    def test_between_like_null_in(self):
+        assert isinstance(parse_ecql("a BETWEEN 1 AND 10"), ast.Between)
+        assert isinstance(parse_ecql("name LIKE 'foo%'"), ast.Like)
+        f = parse_ecql("name ILIKE 'foo%'")
+        assert isinstance(f, ast.Like) and not f.case_sensitive
+        assert isinstance(parse_ecql("name IS NULL"), ast.IsNull)
+        f = parse_ecql("name IS NOT NULL")
+        assert isinstance(f, ast.Not)
+        f = parse_ecql("a IN (1, 2, 3)")
+        assert isinstance(f, ast.InList) and f.values == (1, 2, 3)
+
+    def test_fid_filter(self):
+        f = parse_ecql("IN ('f1', 'f2')")
+        assert isinstance(f, ast.FidFilter) and f.ids == ("f1", "f2")
+
+    def test_spatial_wkt(self):
+        f = parse_ecql("INTERSECTS(geom, POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0)))")
+        assert isinstance(f, ast.Intersects)
+        assert f.geom.area == 100.0
+
+    def test_dwithin_units(self):
+        f = parse_ecql("DWITHIN(geom, POINT (10 20), 5.5, kilometers)")
+        assert isinstance(f, ast.DWithin)
+        assert f.distance == 5.5 and f.units == "kilometers"
+
+    def test_temporal(self):
+        f = parse_ecql("dtg DURING 2017-01-01T00:00:00Z/2017-01-08T00:00:00Z")
+        assert isinstance(f, ast.During)
+        assert f.start == MS("2017-01-01T00:00:00")
+        assert f.end == MS("2017-01-08T00:00:00")
+        assert isinstance(parse_ecql("dtg BEFORE 2017-01-01T00:00:00Z"), ast.Before)
+        assert isinstance(parse_ecql("dtg AFTER 2017-01-01T00:00:00Z"), ast.After)
+
+    def test_date_comparison(self):
+        f = parse_ecql("dtg >= 2017-06-05T04:03:02Z")
+        assert isinstance(f, ast.Compare) and f.value == MS("2017-06-05T04:03:02")
+
+    def test_include_exclude_empty(self):
+        assert isinstance(parse_ecql("INCLUDE"), ast.Include)
+        assert isinstance(parse_ecql("EXCLUDE"), ast.Exclude)
+        assert isinstance(parse_ecql(""), ast.Include)
+
+    def test_errors(self):
+        for bad in ["BBOX(", "a = ", "DWITHIN(g, POINT (0 0), x, meters)",
+                    "a LIKES 'x'", "(a = 1"]:
+            with pytest.raises(ECQLError):
+                parse_ecql(bad)
+
+
+class TestExtraction:
+    def test_bbox_extraction(self):
+        f = parse_ecql("BBOX(geom, -80, 35, -70, 40)")
+        g = extract_geometries(f, "geom")
+        assert len(g) == 1
+        assert g.values[0].envelope.as_tuple() == (-80, 35, -70, 40)
+
+    def test_and_intersection(self):
+        f = parse_ecql("BBOX(geom, -80, 35, -70, 40) AND BBOX(geom, -75, 30, -65, 38)")
+        g = extract_geometries(f, "geom")
+        assert len(g) == 1
+        assert g.values[0].envelope.as_tuple() == (-75, 35, -70, 38)
+
+    def test_and_disjoint(self):
+        f = parse_ecql("BBOX(geom, 0, 0, 10, 10) AND BBOX(geom, 20, 20, 30, 30)")
+        g = extract_geometries(f, "geom")
+        assert g.disjoint
+
+    def test_or_union(self):
+        f = parse_ecql("BBOX(geom, 0, 0, 10, 10) OR BBOX(geom, 20, 20, 30, 30)")
+        g = extract_geometries(f, "geom")
+        assert len(g) == 2
+
+    def test_or_with_nonspatial_child_unbounded(self):
+        f = parse_ecql("BBOX(geom, 0, 0, 10, 10) OR name = 'x'")
+        g = extract_geometries(f, "geom")
+        assert g.is_empty  # spatially unconstrained
+
+    def test_world_clip(self):
+        f = parse_ecql("BBOX(geom, -200, -95, 200, 95)")
+        g = extract_geometries(f, "geom")
+        assert is_filter_whole_world(f)
+        env = g.values[0].envelope
+        assert env.as_tuple() == (-180, -90, 180, 90)
+
+    def test_dwithin_buffered(self):
+        f = parse_ecql("DWITHIN(geom, POINT (0 0), 100, kilometers)")
+        g = extract_geometries(f, "geom")
+        env = g.values[0].envelope
+        assert 0.8 < env.xmax < 1.0  # 100km ~ 0.9 deg at equator
+
+    def test_attribute_bounds(self):
+        f = parse_ecql("age >= 21 AND age < 65")
+        b = extract_attribute_bounds(f, "age")
+        assert len(b) == 1
+        bb = b.values[0]
+        assert bb.lower.value == 21 and bb.lower.inclusive
+        assert bb.upper.value == 65 and not bb.upper.inclusive
+
+    def test_attribute_bounds_or_merge(self):
+        f = parse_ecql("age < 30 OR age > 20")
+        b = extract_attribute_bounds(f, "age")
+        assert len(b) == 1
+        assert not b.values[0].lower.is_bounded
+        assert not b.values[0].upper.is_bounded
+
+    def test_attribute_disjoint(self):
+        f = parse_ecql("age > 65 AND age < 21")
+        b = extract_attribute_bounds(f, "age")
+        assert b.disjoint
+
+    def test_like_prefix_bounds(self):
+        f = parse_ecql("name LIKE 'abc%'")
+        b = extract_attribute_bounds(f, "name")
+        assert len(b) == 1
+        assert b.values[0].lower.value == "abc"
+        assert b.values[0].upper.value == "abd"
+
+    def test_intervals(self):
+        f = parse_ecql("dtg DURING 2017-01-01T00:00:00Z/2017-01-08T00:00:00Z")
+        iv = extract_intervals(f, "dtg")
+        assert len(iv) == 1
+        assert iv.values[0].lower.value == MS("2017-01-01T00:00:00")
+        assert not iv.values[0].lower.inclusive
+
+    def test_intervals_exclusive_rounding(self):
+        f = parse_ecql("dtg DURING 2017-01-01T00:00:00.500Z/2017-01-08T00:00:00Z")
+        iv = extract_intervals(f, "dtg", handle_exclusive=True)
+        b = iv.values[0]
+        assert b.lower.value == MS("2017-01-01T00:00:01") and b.lower.inclusive
+        assert b.upper.value == MS("2017-01-07T23:59:59") and b.upper.inclusive
+
+    def test_idl_split(self):
+        f = parse_ecql("BBOX(geom, 170, -10, 190, 10)")
+        g = extract_geometries(f, "geom")
+        assert len(g) == 2
+        envs = sorted(e.envelope.as_tuple() for e in g.values)
+        assert envs[0][0] == -180.0 and envs[1][2] == 180.0
+
+
+class TestEvaluation:
+    SFT = parse_spec("t", "name:String,age:Integer,score:Double,dtg:Date,"
+                          "*geom:Point:srid=4326")
+
+    @pytest.fixture(scope="class")
+    def batch(self):
+        rng = np.random.default_rng(42)
+        n = 20_000
+        return FeatureBatch.from_dict(
+            self.SFT, [f"f{i}" for i in range(n)],
+            {
+                "name": [f"n{i % 50}" if i % 13 else None for i in range(n)],
+                "age": rng.integers(0, 100, n),
+                "score": rng.uniform(0, 1, n),
+                "dtg": rng.integers(MS("2017-01-01"), MS("2017-03-01"), n),
+                "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+            })
+
+    def test_bbox_vs_brute(self, batch):
+        f = parse_ecql("BBOX(geom, -80, 35, -70, 40)")
+        m = evaluate(f, batch)
+        x, y = batch.col("geom").x, batch.col("geom").y
+        expect = (x >= -80) & (x <= -70) & (y >= 35) & (y <= 40)
+        assert np.array_equal(m, expect)
+
+    def test_combined_filter(self, batch):
+        f = parse_ecql("BBOX(geom, -100, 0, 0, 60) AND age >= 50 AND "
+                       "dtg DURING 2017-01-10T00:00:00Z/2017-02-01T00:00:00Z")
+        m = evaluate(f, batch)
+        x, y = batch.col("geom").x, batch.col("geom").y
+        age = batch.col("age").values
+        ms = batch.col("dtg").millis
+        expect = ((x >= -100) & (x <= 0) & (y >= 0) & (y <= 60)
+                  & (age >= 50) & (ms > MS("2017-01-10")) & (ms < MS("2017-02-01")))
+        assert np.array_equal(m, expect)
+
+    def test_string_predicates(self, batch):
+        m = evaluate(parse_ecql("name = 'n7'"), batch)
+        names = np.array([batch.col("name").value(i) for i in range(batch.n)])
+        assert np.array_equal(m, names == "n7")
+        m2 = evaluate(parse_ecql("name LIKE 'n1%'"), batch)
+        expect2 = np.array([bool(v) and v.startswith("n1") for v in names])
+        assert np.array_equal(m2, expect2)
+
+    def test_null_handling(self, batch):
+        m = evaluate(parse_ecql("name IS NULL"), batch)
+        assert m.sum() == sum(1 for i in range(batch.n) if i % 13 == 0)
+        # comparisons never match nulls
+        m2 = evaluate(parse_ecql("name = 'n0'"), batch)
+        assert not (m & m2).any()
+
+    def test_polygon_intersects(self, batch):
+        f = parse_ecql("INTERSECTS(geom, POLYGON ((0 0, 40 0, 40 40, 0 40, 0 0)))")
+        m = evaluate(f, batch)
+        x, y = batch.col("geom").x, batch.col("geom").y
+        expect = (x >= 0) & (x <= 40) & (y >= 0) & (y <= 40)
+        assert np.array_equal(m, expect)
+
+    def test_dwithin_point(self, batch):
+        f = parse_ecql("DWITHIN(geom, POINT (0 0), 500, kilometers)")
+        m = evaluate(f, batch)
+        assert 0 < m.sum() < batch.n
+        x, y = batch.col("geom").x, batch.col("geom").y
+        # all hits are within the degree radius
+        from geomesa_tpu.filters import distance_degrees
+        deg = distance_degrees(Point(0, 0), 500_000)
+        d2 = x ** 2 + y ** 2
+        assert np.array_equal(m, d2 <= deg * deg)
+
+    def test_fid_filter(self, batch):
+        m = evaluate(parse_ecql("IN ('f5', 'f100')"), batch)
+        assert m.sum() == 2 and m[5] and m[100]
+
+    def test_not_and_or(self, batch):
+        f = parse_ecql("NOT (age < 50) OR score <= 0.1")
+        m = evaluate(f, batch)
+        age = batch.col("age").values
+        score = batch.col("score").values
+        assert np.array_equal(m, ~(age < 50) | (score <= 0.1))

@@ -1,0 +1,193 @@
+"""End-to-end datastore tests: the black-box query-level harness the
+reference uses (AccumuloDataStoreQueryTest style — DataStore + ECQL in,
+feature IDs out), with brute-force numpy cross-checks."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.filters import evaluate, parse_ecql
+from geomesa_tpu.index.api import Query, QueryHints
+from geomesa_tpu.store import InMemoryDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+SPEC = "name:String:index=true,age:Integer,dtg:Date,*geom:Point:srid=4326"
+
+
+@pytest.fixture(scope="module")
+def store():
+    ds = InMemoryDataStore()
+    sft = parse_spec("people", SPEC)
+    ds.create_schema(sft)
+    rng = np.random.default_rng(99)
+    n = 50_000
+    ds.write_dict("people", [f"p{i}" for i in range(n)], {
+        "name": [f"name{i % 20}" for i in range(n)],
+        "age": rng.integers(0, 100, n),
+        "dtg": rng.integers(MS("2017-01-01"), MS("2017-06-01"), n),
+        "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+    })
+    return ds
+
+
+@pytest.fixture(scope="module")
+def oracle(store):
+    """Brute-force evaluator over the raw batch."""
+    batch = store._state("people").batch
+
+    def check(ecql: str):
+        return set(batch.ids[evaluate(parse_ecql(ecql), batch)].astype(str))
+    return check
+
+
+class TestStoreQueries:
+    def test_bbox_time_z3(self, store, oracle):
+        ecql = ("BBOX(geom, -80, 30, -60, 45) AND "
+                "dtg DURING 2017-02-01T00:00:00Z/2017-03-01T00:00:00Z")
+        res = store.query(ecql, "people")
+        assert res.plan.index == "z3"
+        assert set(res.ids.astype(str)) == oracle(ecql)
+        assert res.n > 0
+
+    def test_bbox_only_z2(self, store, oracle):
+        ecql = "BBOX(geom, 10, 10, 30, 30)"
+        res = store.query(ecql, "people")
+        assert res.plan.index == "z2"
+        assert set(res.ids.astype(str)) == oracle(ecql)
+
+    def test_polygon_intersects_exact(self, store, oracle):
+        ecql = "INTERSECTS(geom, POLYGON ((0 0, 30 0, 15 30, 0 0)))"
+        res = store.query(ecql, "people")
+        assert set(res.ids.astype(str)) == oracle(ecql)
+
+    def test_combined_residual(self, store, oracle):
+        ecql = ("BBOX(geom, -120, -60, 120, 60) AND age > 50 AND "
+                "name = 'name7'")
+        res = store.query(ecql, "people")
+        assert set(res.ids.astype(str)) == oracle(ecql)
+        assert res.plan.secondary is not None
+
+    def test_id_query(self, store):
+        res = store.query("IN ('p5', 'p17', 'nope')", "people")
+        assert res.plan.index == "id"
+        assert set(res.ids.astype(str)) == {"p5", "p17"}
+
+    def test_attribute_query(self, store, oracle):
+        ecql = "name = 'name3'"
+        res = store.query(ecql, "people")
+        assert res.plan.index == "attr:name"
+        assert set(res.ids.astype(str)) == oracle(ecql)
+
+    def test_fullscan_fallback(self, store, oracle):
+        ecql = "age BETWEEN 20 AND 30"
+        res = store.query(ecql, "people")
+        assert res.plan.index == "fullscan"
+        assert set(res.ids.astype(str)) == oracle(ecql)
+
+    def test_disjoint_short_circuit(self, store):
+        ecql = "BBOX(geom, 0, 0, 10, 10) AND BBOX(geom, 50, 50, 60, 60)"
+        res = store.query(ecql, "people")
+        assert res.plan.index == "empty"
+        assert res.n == 0
+
+    def test_dwithin(self, store, oracle):
+        ecql = "DWITHIN(geom, POINT (10 10), 300, kilometers)"
+        res = store.query(ecql, "people")
+        assert set(res.ids.astype(str)) == oracle(ecql)
+
+    def test_exclusive_boundary_exactness(self, store):
+        # query bounds exactly on data values: identical-IDs contract
+        batch = store._state("people").batch
+        x = batch.col("geom").x
+        # craft a bbox whose edges are exact data coordinates
+        xmin, xmax = (float(v) for v in np.sort(x)[[100, 40_000]])
+        ecql = f"BBOX(geom, {xmin!r}, -90, {xmax!r}, 90)"
+        res = store.query(ecql, "people")
+        expect = set(batch.ids[(x >= xmin) & (x <= xmax)].astype(str))
+        assert set(res.ids.astype(str)) == expect
+
+    def test_max_features_and_sort(self, store):
+        res = store.query(Query("people", "age >= 0", sort_by="age",
+                                sort_desc=True, max_features=10))
+        assert res.n == 10
+        ages = [f["age"] for f in res.features()]
+        assert ages == sorted(ages, reverse=True)
+        assert ages[0] == 99
+
+    def test_projection(self, store):
+        res = store.query(Query("people", "IN ('p1')", properties=["name"]))
+        f = next(res.features())
+        assert set(f.keys()) == {"id", "name"}
+
+    def test_explain(self, store):
+        res = store.query("BBOX(geom, 0, 0, 1, 1)", "people")
+        assert "Selected" in res.explain.text
+        assert "Device scan" in res.explain.text
+
+
+class TestStoreLifecycle:
+    def test_schema_management(self):
+        ds = InMemoryDataStore()
+        ds.create_schema("a", "x:Integer,*geom:Point")
+        ds.create_schema("b", "y:Double,*geom:Point")
+        assert ds.get_type_names() == ["a", "b"]
+        with pytest.raises(ValueError):
+            ds.create_schema("a", "z:Integer,*geom:Point")
+        ds.remove_schema("a")
+        assert ds.get_type_names() == ["b"]
+
+    def test_write_delete_requery(self):
+        ds = InMemoryDataStore()
+        ds.create_schema("t", "v:Integer,dtg:Date,*geom:Point")
+        ds.write_dict("t", ["a", "b", "c"], {
+            "v": [1, 2, 3],
+            "dtg": [MS("2017-01-01")] * 3,
+            "geom": ([0.0, 1.0, 2.0], [0.0, 1.0, 2.0]),
+        })
+        assert ds.count("t") == 3
+        res = ds.query("BBOX(geom, -1, -1, 3, 3)", "t")
+        assert res.n == 3
+        ds.delete("t", ["b"])
+        res = ds.query("BBOX(geom, -1, -1, 3, 3)", "t")
+        assert set(res.ids.astype(str)) == {"a", "c"}
+        # incremental write after index build
+        ds.write_dict("t", ["d"], {"v": [4], "dtg": [MS("2017-01-02")],
+                                   "geom": ([1.5], [1.5])})
+        res = ds.query("BBOX(geom, 1.2, 1.2, 3, 3)", "t")
+        assert set(res.ids.astype(str)) == {"c", "d"}
+
+    def test_empty_store_query(self):
+        ds = InMemoryDataStore()
+        ds.create_schema("t", "v:Integer,*geom:Point")
+        res = ds.query("BBOX(geom, 0, 0, 1, 1)", "t")
+        assert res.n == 0
+
+
+class TestReviewRegressions:
+    def test_quoted_date_string_on_z3_path(self):
+        ds = InMemoryDataStore()
+        ds.create_schema("t", "dtg:Date,*geom:Point")
+        rng = np.random.default_rng(1)
+        n = 2000
+        ds.write_dict("t", [f"f{i}" for i in range(n)], {
+            "dtg": rng.integers(MS("2020-01-01"), MS("2020-02-01"), n),
+            "geom": (rng.uniform(-90, -50, n), rng.uniform(20, 50, n)),
+        })
+        res = ds.query("BBOX(geom,-90,20,-50,50) AND "
+                       "dtg >= '2020-01-05T00:00:00Z' AND "
+                       "dtg <= '2020-01-06T00:00:00Z'", "t")
+        assert res.plan.index == "z3"
+        batch = ds._state("t").batch
+        ms = batch.col("dtg").millis
+        expect = set(batch.ids[(ms >= MS("2020-01-05"))
+                               & (ms <= MS("2020-01-06"))].astype(str))
+        assert set(res.ids.astype(str)) == expect
+
+    def test_multiple_fid_filters_intersect(self):
+        ds = InMemoryDataStore()
+        ds.create_schema("t", "v:Integer,*geom:Point")
+        ds.write_dict("t", ["f1", "f2", "f3"], {
+            "v": [1, 2, 3], "geom": ([0.0, 1.0, 2.0], [0.0, 1.0, 2.0])})
+        res = ds.query("IN ('f1','f2') AND IN ('f2','f3')", "t")
+        assert set(res.ids.astype(str)) == {"f2"}
