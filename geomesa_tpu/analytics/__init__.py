@@ -1,0 +1,13 @@
+"""L7 analytics: ST_* kernels, spatial joins, KNN, tube select,
+WPS-style processes (geomesa-spark-sql + geomesa-process analogs)."""
+
+from . import st_functions
+from .join import contains_join, dwithin_join, knn
+from .processes import (knn_process, minmax_process, proximity_process,
+                        tube_select_process, unique_process)
+from .tube import TubeBuilder, tube_select_mask
+
+__all__ = ["st_functions", "contains_join", "dwithin_join", "knn",
+           "knn_process", "minmax_process", "proximity_process",
+           "tube_select_process", "unique_process", "TubeBuilder",
+           "tube_select_mask"]

@@ -1,0 +1,94 @@
+"""Analytic processes over a datastore (geomesa-process analogs,
+SURVEY.md 2.3): KNN search, proximity search, unique values, min/max,
+tube select — each the WPS-process API shape minus GeoServer."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..features.batch import PointColumn
+from ..index.api import Query
+from ..stats import EnumerationStat, MinMax
+from .join import dwithin_join, knn
+from .tube import TubeBuilder, tube_select_mask
+
+__all__ = ["knn_process", "proximity_process", "unique_process",
+           "minmax_process", "tube_select_process"]
+
+
+def _point_cols(store, type_name):
+    st = store._state(type_name)
+    if st.batch is None or st.n == 0:
+        return st, None
+    col = st.batch.col(st.sft.geom_field)
+    if not isinstance(col, PointColumn):
+        raise TypeError("process requires a point geometry type")
+    return st, col
+
+
+def knn_process(store, type_name: str, qx: float, qy: float, k: int,
+                ecql=None):
+    """KNearestNeighborSearchProcess (knn/KNearestNeighborSearchProcess.scala:30):
+    k nearest features to the query point, optionally pre-filtered."""
+    st, col = _point_cols(store, type_name)
+    if col is None:
+        return np.empty(0, object), np.empty(0)
+    if ecql is not None:
+        res = store.query(Query(type_name, ecql))
+        sub = res.batch
+        if sub is None or sub.n == 0:
+            return np.empty(0, object), np.empty(0)
+        scol = sub.col(st.sft.geom_field)
+        d, idx = knn(scol.x, scol.y, qx, qy, min(k, sub.n))
+        return sub.ids[idx], d
+    d, idx = knn(col.x, col.y, qx, qy, min(k, st.n))
+    return st.batch.ids[idx], d
+
+
+def proximity_process(store, type_name: str, qx, qy,
+                      radius_deg: float, counts_only: bool = False):
+    """ProximitySearchProcess (query/ProximitySearchProcess.scala:32):
+    features within radius of any of the query points."""
+    st, col = _point_cols(store, type_name)
+    if col is None:
+        return (np.zeros(len(np.atleast_1d(qx)), np.int64), None)
+    counts, pairs = dwithin_join(col.x, col.y, np.atleast_1d(qx),
+                                 np.atleast_1d(qy), radius_deg,
+                                 counts_only=counts_only)
+    if counts_only:
+        return counts, None
+    ids = st.batch.ids[np.unique(pairs[:, 0])] if len(pairs) else \
+        np.empty(0, object)
+    return counts, ids
+
+
+def unique_process(store, type_name: str, attribute: str, ecql=None):
+    """UniqueProcess: distinct attribute values with counts."""
+    stat = store.stats_query(type_name, f"Enumeration({attribute})", ecql)
+    assert isinstance(stat, EnumerationStat)
+    return dict(stat.counts)
+
+
+def minmax_process(store, type_name: str, attribute: str, ecql=None):
+    """MinMaxProcess: attribute bounds over matching features."""
+    stat = store.stats_query(type_name, f"MinMax({attribute})", ecql)
+    assert isinstance(stat, MinMax)
+    return stat.min, stat.max
+
+
+def tube_select_process(store, type_name: str, track_x, track_y,
+                        track_millis, buffer_deg: float,
+                        bin_millis: int = 3_600_000, max_bins: int = 256):
+    """TubeSelectProcess: features inside the space-time tube around the
+    track. Returns matched feature ids."""
+    st, col = _point_cols(store, type_name)
+    if col is None:
+        return np.empty(0, object)
+    st.ensure_index()
+    if st.scan_data is None:
+        raise TypeError("tube select requires a point-indexed store")
+    boxes, intervals = TubeBuilder(buffer_deg, bin_millis,
+                                   max_bins).build(track_x, track_y,
+                                                   track_millis)
+    mask = tube_select_mask(st.scan_data, boxes, intervals)
+    return st.batch.ids[np.flatnonzero(mask)]

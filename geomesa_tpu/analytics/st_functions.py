@@ -1,0 +1,260 @@
+"""ST_* geometry function library.
+
+The vectorized analog of geomesa-spark-sql's UDF set
+(org/apache/spark/sql/SQLSpatialFunctions.scala:31-41 and the
+accessor/constructor/cast/output/processing modules): each function
+operates on scalars or numpy arrays of geometries/coordinates.
+
+Scalar-geometry functions delegate to the geometry engine; the hot
+point-column forms (st_contains over a PointColumn, st_distance
+point-to-points) are vectorized numpy/JAX.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..geometry import (Envelope, Geometry, LineString, MultiPoint, Point,
+                        Polygon, parse_wkt, to_wkt)
+from ..geometry.base import _point_segments_dist2
+
+__all__ = [
+    "st_contains", "st_covers", "st_crosses", "st_disjoint", "st_equals",
+    "st_intersects", "st_overlaps", "st_touches", "st_within", "st_dwithin",
+    "st_distance", "st_distance_sphere", "st_area", "st_length",
+    "st_centroid", "st_envelope", "st_buffer_envelope", "st_convex_hull",
+    "st_closest_point", "st_translate", "st_point", "st_make_bbox",
+    "st_geom_from_wkt", "st_as_text", "st_x", "st_y",
+    "contains_points", "distance_points",
+]
+
+EARTH_RADIUS_M = 6_371_008.8
+
+
+# -- constructors / accessors ---------------------------------------------
+
+def st_point(x: float, y: float) -> Point:
+    return Point(x, y)
+
+
+def st_make_bbox(xmin, ymin, xmax, ymax) -> Polygon:
+    return Envelope(xmin, ymin, xmax, ymax).to_polygon()
+
+
+def st_geom_from_wkt(wkt: str) -> Geometry:
+    return parse_wkt(wkt)
+
+
+def st_as_text(g: Geometry) -> str:
+    return to_wkt(g)
+
+
+def st_x(g: Point) -> float:
+    return g.x
+
+
+def st_y(g: Point) -> float:
+    return g.y
+
+
+def st_envelope(g: Geometry) -> Polygon:
+    return g.envelope.to_polygon()
+
+
+# -- predicates ------------------------------------------------------------
+
+def st_contains(a: Geometry, b: Geometry) -> bool:
+    return a.contains(b)
+
+
+def st_covers(a: Geometry, b: Geometry) -> bool:
+    return a.contains(b)  # boundary-inclusive contains == covers here
+
+
+def st_within(a: Geometry, b: Geometry) -> bool:
+    return b.contains(a)
+
+
+def st_intersects(a: Geometry, b: Geometry) -> bool:
+    return a.intersects(b)
+
+
+def st_disjoint(a: Geometry, b: Geometry) -> bool:
+    return not a.intersects(b)
+
+
+def st_equals(a: Geometry, b: Geometry) -> bool:
+    return a.envelope == b.envelope and a.contains(b) and b.contains(a)
+
+
+def st_crosses(a: Geometry, b: Geometry) -> bool:
+    return (a.intersects(b) and not a.contains(b) and not b.contains(a))
+
+
+def st_overlaps(a: Geometry, b: Geometry) -> bool:
+    return (a.geom_type == b.geom_type and a.intersects(b)
+            and not a.contains(b) and not b.contains(a))
+
+
+def st_touches(a: Geometry, b: Geometry) -> bool:
+    if not a.intersects(b):
+        return False
+    ca, cb = a.centroid, b.centroid
+    return not (a.contains(cb) or b.contains(ca))
+
+
+def st_dwithin(a: Geometry, b: Geometry, distance_deg: float) -> bool:
+    return a.dwithin(b, distance_deg)
+
+
+# -- measures --------------------------------------------------------------
+
+def st_distance(a: Geometry, b: Geometry) -> float:
+    return a.distance(b)
+
+
+def st_distance_sphere(a: Point, b: Point) -> float:
+    """Great-circle distance in meters (ST_DistanceSpheroid analog,
+    haversine on the mean sphere)."""
+    return float(haversine_m(a.x, a.y, b.x, b.y))
+
+
+def haversine_m(x1, y1, x2, y2):
+    """Vectorized haversine, meters."""
+    lon1, lat1, lon2, lat2 = (np.radians(np.asarray(v, np.float64))
+                              for v in (x1, y1, x2, y2))
+    dlat = lat2 - lat1
+    dlon = lon2 - lon1
+    a = (np.sin(dlat / 2) ** 2
+         + np.cos(lat1) * np.cos(lat2) * np.sin(dlon / 2) ** 2)
+    return 2 * EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+
+
+def st_area(g: Geometry) -> float:
+    return g.area
+
+
+def st_length(g: Geometry) -> float:
+    return g.length
+
+
+def st_centroid(g: Geometry) -> Point:
+    return g.centroid
+
+
+def st_buffer_envelope(g: Geometry, d: float) -> Polygon:
+    """Envelope-expansion buffer (planning-grade; exact round buffers are
+    not needed by any reference hot path)."""
+    return g.envelope.buffer(d).to_polygon()
+
+
+def st_convex_hull(g: Geometry) -> Geometry:
+    """Monotone-chain convex hull of all vertices."""
+    pts = np.vstack(g.coords_list())
+    pts = np.unique(pts, axis=0)
+    if len(pts) == 1:
+        return Point(*pts[0])
+    if len(pts) == 2:
+        return LineString(pts)
+    pts = pts[np.lexsort((pts[:, 1], pts[:, 0]))]
+
+    def cross2(o, a, b):
+        return (a[0] - o[0]) * (b[1] - o[1]) - (a[1] - o[1]) * (b[0] - o[0])
+
+    def half(points):
+        out: list[np.ndarray] = []
+        for p in points:
+            while len(out) >= 2 and cross2(out[-2], out[-1], p) <= 0:
+                out.pop()
+            out.append(p)
+        return out
+
+    lower = half(pts)
+    upper = half(pts[::-1])
+    hull = np.array(lower[:-1] + upper[:-1])
+    if len(hull) < 3:
+        return LineString(hull)
+    return Polygon(hull)
+
+
+def st_closest_point(a: Geometry, b: Point) -> Point:
+    """Closest point on a to point b (per ring/part — no phantom
+    segments bridging separate components)."""
+    if isinstance(a, Point):
+        return a
+    best = None
+    best_d2 = np.inf
+    for coords in a.coords_list():
+        if len(coords) < 2:
+            if len(coords) == 1:
+                d2 = (b.x - coords[0, 0]) ** 2 + (b.y - coords[0, 1]) ** 2
+                if d2 < best_d2:
+                    best_d2, best = d2, Point(*coords[0])
+            continue
+        x0, y0 = coords[:-1, 0], coords[:-1, 1]
+        dx, dy = np.diff(coords[:, 0]), np.diff(coords[:, 1])
+        len2 = dx * dx + dy * dy
+        with np.errstate(divide="ignore", invalid="ignore"):
+            t = ((b.x - x0) * dx + (b.y - y0) * dy) / len2
+        t = np.where(len2 == 0, 0.0, np.clip(t, 0, 1))
+        cx, cy = x0 + t * dx, y0 + t * dy
+        d2 = (b.x - cx) ** 2 + (b.y - cy) ** 2
+        i = int(np.argmin(d2))
+        if d2[i] < best_d2:
+            best_d2, best = float(d2[i]), Point(cx[i], cy[i])
+    return best
+
+
+def st_translate(g: Geometry, dx: float, dy: float) -> Geometry:
+    import copy
+    out = copy.deepcopy(g)
+
+    def shift(geom):
+        if isinstance(geom, Point):
+            geom.x += dx
+            geom.y += dy
+        elif isinstance(geom, LineString):
+            geom.coords = geom.coords + np.array([dx, dy])
+        elif isinstance(geom, Polygon):
+            geom.shell = geom.shell + np.array([dx, dy])
+            geom.holes = [h + np.array([dx, dy]) for h in geom.holes]
+        else:
+            for p in geom.parts:
+                shift(p)
+    shift(out)
+    return out
+
+
+# -- vectorized column forms ----------------------------------------------
+
+def contains_points(g: Geometry, x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """Vectorized geometry-contains-points (the ST_Contains hot form)."""
+    if hasattr(g, "contains_points"):
+        return g.contains_points(x, y)
+    env = g.envelope
+    out = (x >= env.xmin) & (x <= env.xmax) & (y >= env.ymin) & (y <= env.ymax)
+    for i in np.flatnonzero(out):
+        out[i] = g.contains(Point(x[i], y[i]))
+    return out
+
+
+def distance_points(g: Geometry, x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """Vectorized distance from geometry to each point (degrees).
+    Each ring/part measures separately — vstacking them would create
+    phantom bridging segments."""
+    if isinstance(g, Point):
+        return np.hypot(x - g.x, y - g.y)
+    d2 = np.full(np.shape(np.asarray(x, np.float64)), np.inf)
+    for coords in g.coords_list():
+        if len(coords) == 0:
+            continue
+        if len(coords) == 1:
+            d2 = np.minimum(d2, (x - coords[0, 0]) ** 2 + (y - coords[0, 1]) ** 2)
+        else:
+            d2 = np.minimum(d2, _point_segments_dist2(x, y, coords))
+    d = np.sqrt(d2)
+    if hasattr(g, "contains_points"):
+        d = np.where(g.contains_points(x, y), 0.0, d)
+    return d

@@ -1,0 +1,127 @@
+"""TubeSelect: find features within a space-time "tube" around a track
+(geomesa-process tube/TubeSelectProcess.scala:37).
+
+The reference buffers + time-bins the input track (TubeBuilder:36, with
+line-gap interpolation) and issues one spatio-temporal query per bin.
+Here the tube becomes a *paired* device kernel: K (box, time-interval)
+pairs evaluated in one program — a point matches if it falls in box_i
+AND interval_i for some i (contrast with the cross-product semantics of
+the plain scan kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..scan.zscan import MILLIS_PER_DAY, split_two_float
+
+__all__ = ["TubeBuilder", "tube_select_mask"]
+
+
+class TubeBuilder:
+    """Discretize a track into (bbox, time-interval) tube segments.
+
+    bin_gap interpolation: consecutive track points further apart than
+    max_bins get intermediate segments (LineGapFill analog).
+    """
+
+    def __init__(self, buffer_deg: float, bin_millis: int,
+                 max_bins: int = 256):
+        self.buffer = float(buffer_deg)
+        self.bin_millis = int(bin_millis)
+        self.max_bins = max_bins
+
+    def build(self, xs, ys, millis) -> tuple[np.ndarray, np.ndarray]:
+        """Track points -> (boxes (k,4) f64, intervals (k,2) i64).
+
+        Each time bin covered by the track gets a box around the track's
+        interpolated position(s) in that bin.
+        """
+        xs = np.asarray(xs, np.float64)
+        ys = np.asarray(ys, np.float64)
+        ms = np.asarray(millis, np.int64)
+        order = np.argsort(ms, kind="stable")
+        xs, ys, ms = xs[order], ys[order], ms[order]
+        bins: dict[int, list[tuple[float, float]]] = {}
+
+        def add(b, x, y):
+            bins.setdefault(int(b), []).append((float(x), float(y)))
+
+        for i in range(len(xs)):
+            add(ms[i] // self.bin_millis, xs[i], ys[i])
+            if i + 1 < len(xs):
+                b0 = ms[i] // self.bin_millis
+                b1 = ms[i + 1] // self.bin_millis
+                gap = int(b1 - b0)
+                if 1 < gap <= self.max_bins:
+                    # linear interpolation across the gap (LineGapFill)
+                    for s in range(1, gap):
+                        t = s / gap
+                        add(b0 + s, xs[i] + t * (xs[i + 1] - xs[i]),
+                            ys[i] + t * (ys[i + 1] - ys[i]))
+
+        boxes = []
+        intervals = []
+        for b in sorted(bins):
+            pts = np.array(bins[b])
+            boxes.append((pts[:, 0].min() - self.buffer,
+                          pts[:, 1].min() - self.buffer,
+                          pts[:, 0].max() + self.buffer,
+                          pts[:, 1].max() + self.buffer))
+            intervals.append((b * self.bin_millis,
+                              (b + 1) * self.bin_millis - 1))
+        return np.array(boxes, np.float64), np.array(intervals, np.int64)
+
+
+@jax.jit
+def _tube_kernel(xhi, xlo, yhi, ylo, tday, tms, boxes, times, valid):
+    """Paired (box_i AND interval_i) membership, OR over i."""
+    bx = boxes[None, :, :]
+    sx = (((xhi[:, None] > bx[..., 0]) | ((xhi[:, None] == bx[..., 0])
+                                          & (xlo[:, None] >= bx[..., 1])))
+          & ((xhi[:, None] < bx[..., 2]) | ((xhi[:, None] == bx[..., 2])
+                                            & (xlo[:, None] <= bx[..., 3])))
+          & ((yhi[:, None] > bx[..., 4]) | ((yhi[:, None] == bx[..., 4])
+                                            & (ylo[:, None] >= bx[..., 5])))
+          & ((yhi[:, None] < bx[..., 6]) | ((yhi[:, None] == bx[..., 6])
+                                            & (ylo[:, None] <= bx[..., 7]))))
+    tx = times[None, :, :]
+    tt = (((tday[:, None] > tx[..., 0]) | ((tday[:, None] == tx[..., 0])
+                                           & (tms[:, None] >= tx[..., 1])))
+          & ((tday[:, None] < tx[..., 2]) | ((tday[:, None] == tx[..., 2])
+                                             & (tms[:, None] <= tx[..., 3]))))
+    return jnp.any(sx & tt & valid[None, :], axis=1)
+
+
+def tube_select_mask(data, boxes: np.ndarray,
+                     intervals: np.ndarray) -> np.ndarray:
+    """Evaluate tube membership against DeviceScanData; returns host
+    bool mask. Boxes/intervals padded to a power of two for jit reuse."""
+    k = len(boxes)
+    if k == 0:
+        return np.zeros(data.n, dtype=bool)
+    p = 1
+    while p < k:
+        p *= 2
+    bx = np.zeros((p, 8), np.float32)
+    tm = np.zeros((p, 4), np.int32)
+    valid = np.zeros(p, bool)
+    for i, (xmin, ymin, xmax, ymax) in enumerate(boxes):
+        xmin_hi, xmin_lo = split_two_float(np.float64(xmin))
+        xmax_hi, xmax_lo = split_two_float(np.float64(xmax))
+        ymin_hi, ymin_lo = split_two_float(np.float64(ymin))
+        ymax_hi, ymax_lo = split_two_float(np.float64(ymax))
+        bx[i] = (xmin_hi, xmin_lo, xmax_hi, xmax_lo,
+                 ymin_hi, ymin_lo, ymax_hi, ymax_lo)
+        lo, hi = int(intervals[i][0]), int(intervals[i][1])
+        tm[i] = (lo // MILLIS_PER_DAY, lo % MILLIS_PER_DAY,
+                 hi // MILLIS_PER_DAY, hi % MILLIS_PER_DAY)
+        valid[i] = True
+    mask = _tube_kernel(data.xhi, data.xlo, data.yhi, data.ylo,
+                        data.tday, data.tms,
+                        jnp.asarray(bx), jnp.asarray(tm), jnp.asarray(valid))
+    return np.asarray(mask)

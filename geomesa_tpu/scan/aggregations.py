@@ -1,0 +1,130 @@
+"""Scan-time aggregation kernels: density, BIN encoding, sampling.
+
+Device/vectorized analogs of the reference's aggregating iterators
+(index/iterators/: DensityScan.scala:30, BinAggregatingScan.scala:22,
+SamplingIterator.scala:22). Each consumes a scan mask + columns and
+produces the compact aggregate the reference would stream back from
+tablet servers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["density_grid", "encode_bin_records", "decode_bin_records",
+           "sample_mask"]
+
+
+@functools.partial(jax.jit, static_argnames=("width", "height"))
+def _density_kernel(x, y, w, mask, xmin, ymin, sx, sy, width: int, height: int):
+    col = jnp.clip(((x - xmin) * sx).astype(jnp.int32), 0, width - 1)
+    row = jnp.clip(((y - ymin) * sy).astype(jnp.int32), 0, height - 1)
+    flat = row * width + col
+    grid = jnp.zeros((height * width,), dtype=jnp.float32)
+    return grid.at[flat].add(jnp.where(mask, w, 0.0)).reshape(height, width)
+
+
+def density_grid(x: np.ndarray, y: np.ndarray, mask: np.ndarray,
+                 bbox: tuple[float, float, float, float],
+                 width: int, height: int,
+                 weights: np.ndarray | None = None) -> np.ndarray:
+    """Weighted 2-D histogram over the pixel grid (DensityScan analog:
+    GridSnap pixel binning + weight accumulation)."""
+    xmin, ymin, xmax, ymax = (float(v) for v in bbox)
+    sx = width / (xmax - xmin) if xmax > xmin else 0.0
+    sy = height / (ymax - ymin) if ymax > ymin else 0.0
+    w = (np.ones(len(x), dtype=np.float32) if weights is None
+         else np.asarray(weights, dtype=np.float32))
+    out = _density_kernel(
+        jnp.asarray(np.asarray(x, np.float32)),
+        jnp.asarray(np.asarray(y, np.float32)),
+        jnp.asarray(w), jnp.asarray(np.asarray(mask, bool)),
+        np.float32(xmin), np.float32(ymin),
+        np.float32(sx), np.float32(sy), width, height)
+    return np.asarray(out)
+
+
+def _id_hashes(ids: np.ndarray) -> np.ndarray:
+    """Track-id hash codes, matching java String.hashCode semantics
+    (BinaryOutputEncoder uses id.hashCode, utils/bin/BinaryOutputEncoder.scala:58)."""
+    out = np.zeros(len(ids), dtype=np.int64)
+    for i, s in enumerate(ids):
+        h = 0
+        for ch in str(s):
+            h = (31 * h + ord(ch)) & 0xFFFFFFFF
+        out[i] = h if h < 0x80000000 else h - 0x100000000
+    return out.astype(np.int32)
+
+
+def encode_bin_records(ids: np.ndarray, x: np.ndarray, y: np.ndarray,
+                       millis: np.ndarray,
+                       labels: np.ndarray | None = None,
+                       track_values: np.ndarray | None = None,
+                       sort: bool = False) -> bytes:
+    """Encode the 16-byte (or 24-byte labeled) BIN format:
+    [track_hash:i32][seconds:i32][lat:f32][lon:f32]([label:8bytes]) —
+    little-endian, matching BinaryOutputEncoder's record layout.
+
+    track_values overrides the per-record track id source (the
+    BIN_TRACK hint attribute); default is the feature id.
+    """
+    n = len(ids)
+    track = _id_hashes(track_values if track_values is not None else ids)
+    secs = (np.asarray(millis, np.int64) // 1000).astype(np.int32)
+    if sort:
+        order = np.argsort(secs, kind="stable")
+        track, secs = track[order], secs[order]
+        x, y = np.asarray(x)[order], np.asarray(y)[order]
+        if labels is not None:
+            labels = np.asarray(labels)[order]
+    if labels is None:
+        rec = np.empty(n, dtype=[("track", "<i4"), ("secs", "<i4"),
+                                 ("lat", "<f4"), ("lon", "<f4")])
+    else:
+        rec = np.empty(n, dtype=[("track", "<i4"), ("secs", "<i4"),
+                                 ("lat", "<f4"), ("lon", "<f4"),
+                                 ("label", "S8")])
+        rec["label"] = np.asarray([str(l)[:8].encode() for l in labels])
+    rec["track"] = track
+    rec["secs"] = secs
+    rec["lat"] = np.asarray(y, np.float32)
+    rec["lon"] = np.asarray(x, np.float32)
+    return rec.tobytes()
+
+
+def decode_bin_records(data: bytes, labeled: bool = False) -> np.ndarray:
+    if labeled:
+        dt = [("track", "<i4"), ("secs", "<i4"), ("lat", "<f4"),
+              ("lon", "<f4"), ("label", "S8")]
+    else:
+        dt = [("track", "<i4"), ("secs", "<i4"), ("lat", "<f4"),
+              ("lon", "<f4")]
+    return np.frombuffer(data, dtype=dt)
+
+
+def sample_mask(n: int, rate: float, by: np.ndarray | None = None,
+                seed: int = 0) -> np.ndarray:
+    """1-in-k sampling mask (SamplingIterator): keeps every k-th feature
+    overall, or every k-th per `by` group (the SAMPLE_BY attribute)."""
+    if rate >= 1.0:
+        return np.ones(n, dtype=bool)
+    k = max(1, int(round(1.0 / max(rate, 1e-9))))
+    if by is None:
+        return (np.arange(n) % k) == 0
+    # per-group modulo: order within group via stable argsort
+    by = np.asarray(by)
+    order = np.argsort(by, kind="stable")
+    rank = np.empty(n, dtype=np.int64)
+    grp = by[order]
+    new_grp = np.empty(n, dtype=bool)
+    new_grp[0] = True
+    new_grp[1:] = grp[1:] != grp[:-1]
+    # position within each group
+    idx = np.arange(n)
+    start = np.maximum.accumulate(np.where(new_grp, idx, 0))
+    rank[order] = idx - start
+    return (rank % k) == 0

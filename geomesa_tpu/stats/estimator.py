@@ -1,0 +1,144 @@
+"""Stats-based selectivity estimation for the cost decider.
+
+Analog of StatsBasedEstimator (index/stats/StatsBasedEstimator.scala:27):
+estimate the number of features matching a filter from maintained
+sketches — Count for totals, Z3Histogram for spatio-temporal
+selectivity, Histogram/Enumeration for attribute selectivity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..curves import timebin, z3sfc
+from ..features.sft import SimpleFeatureType
+from ..filters import ast
+from ..filters.helper import extract_geometries, extract_intervals
+from .sketches import CountStat, Histogram, SeqStat, Stat, Z3Histogram
+
+__all__ = ["StatsEstimator", "DataStoreStats"]
+
+
+class StatsEstimator:
+    """Wraps maintained sketches; answers estimate_count(filter)."""
+
+    def __init__(self, sft: SimpleFeatureType):
+        self.sft = sft
+        self.count = CountStat()
+        self.z3: Z3Histogram | None = None
+        if sft.is_points and sft.dtg_field is not None:
+            self.z3 = Z3Histogram(sft.geom_field, sft.dtg_field,
+                                  sft.z3_interval)
+        self.attr_hist: dict[str, Histogram] = {}
+
+    def observe(self, batch) -> None:
+        self.count.observe(batch)
+        if self.z3 is not None:
+            self.z3.observe(batch)
+
+    def estimate_count(self, f: ast.Filter) -> int | None:
+        """Estimated matching features, or None if not estimable."""
+        total = self.count.count
+        if total == 0:
+            return 0
+        if isinstance(f, ast.Include):
+            return total
+        if isinstance(f, ast.Exclude):
+            return 0
+        sel = self._spatio_temporal_selectivity(f)
+        if sel is None:
+            return None
+        return int(round(sel * total))
+
+    def _spatio_temporal_selectivity(self, f: ast.Filter) -> float | None:
+        geom = self.sft.geom_field
+        dtg = self.sft.dtg_field
+        if geom is None:
+            return None
+        geoms = extract_geometries(f, geom)
+        if geoms.disjoint:
+            return 0.0
+        has_temporal = False
+        if dtg is not None:
+            iv = extract_intervals(f, dtg)
+            if iv.disjoint:
+                return 0.0
+            has_temporal = bool(iv) and any(
+                b.lower.is_bounded or b.upper.is_bounded for b in iv)
+        if geoms.is_empty and not has_temporal:
+            # no spatio-temporal constraint: not estimable here (attr/id
+            # strategies must fall back to their heuristic costs)
+            return None
+        if self.z3 is None or self.z3.is_empty:
+            # envelope-area fallback
+            if not geoms:
+                return None
+            area = sum((g.envelope.xmax - g.envelope.xmin)
+                       * (g.envelope.ymax - g.envelope.ymin) for g in geoms)
+            return min(1.0, area / (360.0 * 180.0))
+        # z3-histogram estimate: fraction of mass in covered (bin, cell)s
+        intervals = (extract_intervals(f, dtg) if dtg is not None
+                     else None)
+        boxes = [g.envelope for g in geoms] or None
+        hist = self.z3
+        total_mass = sum(int(a.sum()) for a in hist.bins.values())
+        if total_mass == 0:
+            return 0.0
+        period = hist.period
+        if intervals and not intervals.disjoint and len(intervals):
+            sel_bins = set()
+            for b in intervals:
+                if not (b.lower.is_bounded and b.upper.is_bounded):
+                    sel_bins = set(hist.bins)
+                    break
+                bins, _, _ = timebin.bins_of_interval(
+                    int(b.lower.value), int(b.upper.value), period)
+                sel_bins.update(bins.tolist())
+        else:
+            sel_bins = set(hist.bins)
+        mass = 0
+        sfc = z3sfc(period)
+        cells = (None if boxes is None
+                 else self._cells_for_boxes(sfc, hist, boxes))
+        for b in sel_bins:
+            arr = hist.bins.get(b)
+            if arr is None:
+                continue
+            mass += int(arr.sum() if cells is None else arr[cells].sum())
+        return mass / total_mass
+
+    def _cells_for_boxes(self, sfc, hist: Z3Histogram, boxes) -> np.ndarray:
+        """Indices of coarse z cells whose z-range intersects the boxes'
+        z-ranges over the whole period (cells are leading z bits)."""
+        shift = hist._shift
+        ranges = sfc.ranges([b.as_tuple() for b in boxes],
+                            [(0, int(sfc.time.max))], max_ranges=256)
+        lo_cells = (ranges[:, 0].astype(np.uint64) >> np.uint64(shift)).astype(np.int64)
+        hi_cells = (ranges[:, 1].astype(np.uint64) >> np.uint64(shift)).astype(np.int64)
+        mask = np.zeros(hist.length, dtype=bool)
+        for lo, hi in zip(lo_cells.tolist(), hi_cells.tolist()):
+            mask[lo:hi + 1] = True
+        return np.flatnonzero(mask)
+
+
+class DataStoreStats:
+    """Per-type stats registry for a datastore (GeoMesaStats analog,
+    index/stats/GeoMesaStats.scala:29): auto-maintained on write, used
+    for cost estimation and exposed for stats queries."""
+
+    def __init__(self):
+        self._by_type: dict[str, StatsEstimator] = {}
+
+    def ensure(self, sft: SimpleFeatureType) -> StatsEstimator:
+        if sft.type_name not in self._by_type:
+            self._by_type[sft.type_name] = StatsEstimator(sft)
+        return self._by_type[sft.type_name]
+
+    def get(self, type_name: str) -> StatsEstimator | None:
+        return self._by_type.get(type_name)
+
+    def observe(self, sft: SimpleFeatureType, batch) -> None:
+        self.ensure(sft).observe(batch)
+
+    def clear(self, type_name: str) -> None:
+        self._by_type.pop(type_name, None)

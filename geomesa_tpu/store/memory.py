@@ -34,6 +34,7 @@ from ..geometry import Envelope
 from ..index.api import Explainer, FilterStrategy, Query, QueryHints
 from ..index.planner import decide_strategy
 from ..scan import zscan
+from ..stats import DataStoreStats, parse_stat
 
 __all__ = ["InMemoryDataStore", "QueryResult"]
 
@@ -116,6 +117,7 @@ class InMemoryDataStore:
 
     def __init__(self):
         self._types: dict[str, _TypeState] = {}
+        self.stats = DataStoreStats()
 
     # -- schema management (MetadataBackedDataStore surface) --------------
 
@@ -148,6 +150,9 @@ class InMemoryDataStore:
         if batch.sft != st.sft:
             raise ValueError("batch schema does not match store schema")
         st.append(batch)
+        # auto-maintained stats, the write-side StatsCombiner analog
+        # (accumulo/data/stats/StatsCombiner.scala)
+        self.stats.observe(st.sft, batch)
 
     def write_dict(self, type_name: str, ids, data: dict[str, Any]):
         st = self._state(type_name)
@@ -158,6 +163,89 @@ class InMemoryDataStore:
 
     def count(self, type_name: str) -> int:
         return self._state(type_name).n
+
+    def analyze(self, type_name: str):
+        """Recompute stats from scratch (stats are additive on write and
+        go stale after deletes — the reference's `stats analyze` run)."""
+        st = self._state(type_name)
+        self.stats.clear(type_name)
+        if st.batch is not None and st.n:
+            self.stats.observe(st.sft, st.batch)
+        return self.stats.get(type_name)
+
+    def density(self, type_name: str, ecql, bbox, width: int, height: int,
+                weight_attr: str | None = None) -> np.ndarray:
+        """Density surface (DensityScan pushdown analog): heatmap grid of
+        matching features over bbox at width x height pixels."""
+        from ..scan.aggregations import density_grid
+        st = self._state(type_name)
+        if st.batch is None or st.n == 0:
+            return np.zeros((height, width), dtype=np.float32)
+        res = self.query(Query(type_name, ecql))
+        if res.batch is None or res.batch.n == 0:
+            return np.zeros((height, width), dtype=np.float32)
+        x, y, gvalid = _geom_centroids(res.batch, st.sft.geom_field)
+        mask = gvalid.copy()
+        w = None
+        if weight_attr is not None:
+            wcol = res.batch.col(weight_attr)
+            w = np.where(wcol.valid, wcol.values, 0.0).astype(np.float32)
+            mask &= wcol.valid
+        # NaN coords on invalid rows would clip into pixel (0,0): zero them
+        x = np.where(gvalid, x, bbox[0])
+        y = np.where(gvalid, y, bbox[1])
+        return density_grid(x, y, mask, bbox, width, height, w)
+
+    def bin_query(self, type_name: str, ecql, track: str | None = None,
+                  label: str | None = None, sort: bool = False) -> bytes:
+        """BIN-format results (BinAggregatingScan analog): compact
+        16/24-byte records for matching features."""
+        from ..scan.aggregations import encode_bin_records
+        st = self._state(type_name)
+        res = self.query(Query(type_name, ecql))
+        if res.batch is None or res.batch.n == 0:
+            return b""
+        x, y, _ = _geom_centroids(res.batch, st.sft.geom_field)
+        dtg = st.sft.dtg_field
+        millis = (res.batch.col(dtg).millis if dtg
+                  else np.zeros(res.batch.n, dtype=np.int64))
+        track_vals = None
+        if track is not None and track != "id":
+            tc = res.batch.col(track)
+            track_vals = np.array([tc.value(i) for i in range(res.batch.n)],
+                                  dtype=object)
+        labels = None
+        if label is not None:
+            lc = res.batch.col(label)
+            labels = np.array([lc.value(i) for i in range(res.batch.n)],
+                              dtype=object)
+        return encode_bin_records(res.ids, x, y, millis,
+                                  labels=labels, track_values=track_vals,
+                                  sort=sort)
+
+    def arrow_query(self, type_name: str, ecql):
+        """Arrow-encoded results (ArrowScan analog): a pyarrow
+        RecordBatch of matching features."""
+        res = self.query(Query(type_name, ecql))
+        if res.batch is None:
+            return None
+        return res.batch.to_arrow()
+
+    def stats_query(self, type_name: str, stat_spec: str,
+                    ecql: str | ast.Filter = None):
+        """Run a stat sketch over query results (StatsScan analog,
+        index/iterators/StatsScan.scala): returns the observed Stat."""
+        st = self._state(type_name)
+        stat = parse_stat(stat_spec)
+        if st.batch is None or st.n == 0:
+            return stat
+        if ecql is None or isinstance(ecql, ast.Include):
+            stat.observe(st.batch)
+            return stat
+        res = self.query(Query(type_name, ecql))
+        if res.batch is not None and res.batch.n:
+            stat.observe(res.batch)
+        return stat
 
     # -- queries -----------------------------------------------------------
 
@@ -194,10 +282,23 @@ class InMemoryDataStore:
                                FilterStrategy("empty", None, None))
 
         strategy = decide_strategy(st.sft, q, self._indices(st.sft), st.n,
+                                   stats=self.stats.get(q.type_name),
                                    explain=explain)
         mask = self._execute(st, q, strategy, explain)
 
         idx = np.flatnonzero(mask)
+        rate = q.hints.get(QueryHints.SAMPLING)
+        if rate is not None and len(idx):
+            from ..scan.aggregations import sample_mask
+            by_attr = q.hints.get(QueryHints.SAMPLE_BY)
+            by = None
+            if by_attr is not None:
+                col = st.batch.col(by_attr)
+                # nulls sort as empty string (argsort needs a total order)
+                by = np.array([col.value(int(i)) or "" for i in idx],
+                              dtype=object).astype(str)
+            idx = idx[sample_mask(len(idx), float(rate), by)]
+            explain(f"Sampling applied: rate={rate}")
         if q.sort_by is not None:
             col = st.batch.col(q.sort_by)
             keys = getattr(col, "values", getattr(col, "millis", None))
@@ -311,6 +412,18 @@ class InMemoryDataStore:
                     mask = out
             explain("Exact geometry predicate applied")
         return mask
+
+
+def _geom_centroids(batch: FeatureBatch, geom_field: str):
+    """(x, y, valid) for any geometry column: point coords, or envelope
+    centroids for extent geometries."""
+    col = batch.col(geom_field)
+    if isinstance(col, PointColumn):
+        return col.x, col.y, col.valid
+    bounds = col.bounds
+    x = (bounds[:, 0] + bounds[:, 2]) / 2
+    y = (bounds[:, 1] + bounds[:, 3]) / 2
+    return x, y, col.valid
 
 
 def _to_millis(v) -> int:

@@ -1,0 +1,177 @@
+"""Pushdown analytics tests: density / BIN / arrow / sampling / stats
+via the store (the reference's aggregating-iterator test intent)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.index.api import Query, QueryHints
+from geomesa_tpu.scan.aggregations import (decode_bin_records,
+                                           encode_bin_records, sample_mask)
+from geomesa_tpu.store import InMemoryDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+
+@pytest.fixture(scope="module")
+def store():
+    ds = InMemoryDataStore()
+    ds.create_schema("ships", "vessel:String,dtg:Date,*geom:Point")
+    rng = np.random.default_rng(3)
+    n = 20_000
+    ds.write_dict("ships", [f"s{i}" for i in range(n)], {
+        "vessel": [f"v{i % 40}" for i in range(n)],
+        "dtg": rng.integers(MS("2017-01-01"), MS("2017-02-01"), n),
+        "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n)),
+    })
+    return ds
+
+
+class TestDensity:
+    def test_density_mass_equals_hits(self, store):
+        grid = store.density("ships", "BBOX(geom, -10, -10, 10, 10)",
+                             (-10, -10, 10, 10), 32, 32)
+        assert grid.shape == (32, 32)
+        assert int(grid.sum()) == 20_000
+
+    def test_density_weighted(self, store):
+        ds = InMemoryDataStore()
+        ds.create_schema("w", "wt:Double,*geom:Point")
+        ds.write_dict("w", ["a", "b"], {"wt": [2.5, 4.0],
+                                        "geom": ([0.0, 5.0], [0.0, 5.0])})
+        grid = ds.density("w", "INCLUDE", (-10, -10, 10, 10), 4, 4,
+                          weight_attr="wt")
+        assert grid.sum() == pytest.approx(6.5)
+
+    def test_density_subset(self, store):
+        grid = store.density("ships", "BBOX(geom, 0, 0, 10, 10)",
+                             (-10, -10, 10, 10), 16, 16)
+        # all mass in the upper-right quadrant
+        assert grid[:8, :].sum() == 0
+        assert grid[:, :8].sum() == 0
+        assert grid[8:, 8:].sum() > 0
+
+
+class TestBin:
+    def test_bin_roundtrip(self, store):
+        data = store.bin_query("ships", "BBOX(geom, -1, -1, 1, 1)")
+        rec = decode_bin_records(data)
+        assert len(rec) > 0
+        assert np.all(np.abs(rec["lat"]) <= 1.0001)
+        assert np.all(np.abs(rec["lon"]) <= 1.0001)
+
+    def test_bin_sorted(self, store):
+        data = store.bin_query("ships", "BBOX(geom, -5, -5, 5, 5)", sort=True)
+        rec = decode_bin_records(data)
+        assert np.all(np.diff(rec["secs"].astype(np.int64)) >= 0)
+
+    def test_bin_label(self, store):
+        data = store.bin_query("ships", "BBOX(geom, -1, -1, 1, 1)",
+                               label="vessel")
+        rec = decode_bin_records(data, labeled=True)
+        assert rec.itemsize == 24
+        assert rec["label"][0].startswith(b"v")
+
+    def test_bin_track_attribute(self, store):
+        d1 = store.bin_query("ships", "BBOX(geom, -1, -1, 1, 1)",
+                             track="vessel")
+        d2 = store.bin_query("ships", "BBOX(geom, -1, -1, 1, 1)")
+        r1, r2 = decode_bin_records(d1), decode_bin_records(d2)
+        # same rows, different track hashes
+        assert len(r1) == len(r2)
+        assert not np.array_equal(r1["track"], r2["track"])
+
+    def test_java_hashcode_compat(self):
+        # BinaryOutputEncoder uses java String.hashCode; "test" -> 3556498
+        from geomesa_tpu.scan.aggregations import _id_hashes
+        assert int(_id_hashes(np.array(["test"], dtype=object))[0]) == 3556498
+
+
+class TestSamplingAndArrow:
+    def test_sampling_hint(self, store):
+        res = store.query(Query("ships", "BBOX(geom, -10, -10, 10, 10)",
+                                hints={QueryHints.SAMPLING: 0.1}))
+        assert res.n == 2000
+
+    def test_sampling_by_group(self, store):
+        res = store.query(Query("ships", "BBOX(geom, -10, -10, 10, 10)",
+                                hints={QueryHints.SAMPLING: 0.05,
+                                       QueryHints.SAMPLE_BY: "vessel"}))
+        # every vessel still represented
+        vessels = {f["vessel"] for f in res.features()}
+        assert len(vessels) == 40
+
+    def test_sample_mask_rate(self):
+        m = sample_mask(1000, 0.25)
+        assert m.sum() == 250
+
+    def test_arrow_query(self, store):
+        rb = store.arrow_query("ships", "BBOX(geom, -2, -2, 2, 2)")
+        assert rb.num_rows > 0
+        assert "vessel" in rb.schema.names
+
+
+class TestReviewRegressions:
+    def test_sampling_with_null_groups(self):
+        ds = InMemoryDataStore()
+        ds.create_schema("t", "name:String,*geom:Point")
+        ds.write_dict("t", ["a", "b", "c", "d"], {
+            "name": ["x", None, "y", None],
+            "geom": ([0.0, 1.0, 2.0, 3.0], [0.0] * 4)})
+        res = ds.query(Query("t", "INCLUDE",
+                             hints={QueryHints.SAMPLING: 0.5,
+                                    QueryHints.SAMPLE_BY: "name"}))
+        assert res.n >= 2  # no crash; at least one per group
+
+    def test_bin_query_polygon_geometry(self):
+        ds = InMemoryDataStore()
+        ds.create_schema("u", "*g:Polygon")
+        ds.write_dict("u", ["p1"], {
+            "g": ["POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))"]})
+        rec = decode_bin_records(ds.bin_query("u", "INCLUDE"))
+        assert len(rec) == 1
+        assert rec["lon"][0] == 1.0 and rec["lat"][0] == 1.0
+
+    def test_density_null_weight(self):
+        ds = InMemoryDataStore()
+        ds.create_schema("w2", "wt:Double,*geom:Point")
+        ds.write_dict("w2", ["a", "b"], {"wt": [2.0, None],
+                                         "geom": ([1.0, 5.0], [1.0, 5.0])})
+        grid = ds.density("w2", "INCLUDE", (0, 0, 10, 10), 4, 4,
+                          weight_attr="wt")
+        assert np.isfinite(grid).all()
+        assert grid.sum() == pytest.approx(2.0)
+
+    def test_frequency_float_values(self):
+        from geomesa_tpu.features import FeatureBatch, parse_spec
+        from geomesa_tpu.stats import Frequency
+        sft = parse_spec("f", "v:Double,*geom:Point")
+        b = FeatureBatch.from_dict(sft, [f"i{i}" for i in range(100)], {
+            "v": [2.1] * 50 + [2.9] * 50,
+            "geom": ([0.0] * 100, [0.0] * 100)})
+        s = Frequency("v", precision=10)
+        s.observe(b)
+        assert s.count(2.1) >= 50
+        assert s.count(2.9) >= 50
+
+    def test_multipart_distance_no_phantom_segments(self):
+        from geomesa_tpu.analytics.st_functions import distance_points
+        from geomesa_tpu.geometry import parse_wkt
+        mp = parse_wkt("MULTIPOLYGON (((0 0, 1 0, 1 1, 0 1, 0 0)),"
+                       " ((10 10, 11 10, 11 11, 10 11, 10 10)))")
+        d = distance_points(mp, np.array([5.5]), np.array([0.5]))
+        assert d[0] == pytest.approx(4.5)
+
+    def test_groupby_merge_no_aliasing(self):
+        from geomesa_tpu.features import FeatureBatch, parse_spec
+        from geomesa_tpu.stats import parse_stat
+        sft = parse_spec("g", "k:String,*geom:Point")
+        mk = lambda ks: FeatureBatch.from_dict(
+            sft, [f"i{j}" for j in range(len(ks))],
+            {"k": ks, "geom": ([0.0] * len(ks), [0.0] * len(ks))})
+        a = parse_stat("GroupBy(k,Count())")
+        b = parse_stat("GroupBy(k,Count())")
+        b.observe(mk(["x"]))
+        c = a + b
+        b.observe(mk(["x"]))
+        assert c.groups["x"].count == 1  # unchanged by later observe on b

@@ -1,0 +1,179 @@
+"""L7 analytics tests: ST_* functions, joins, KNN, tube select — all
+cross-checked against brute force."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.analytics import (TubeBuilder, contains_join, dwithin_join,
+                                   knn, knn_process, minmax_process,
+                                   proximity_process, tube_select_process,
+                                   unique_process)
+from geomesa_tpu.analytics.st_functions import (contains_points,
+                                                distance_points, haversine_m,
+                                                st_area, st_centroid,
+                                                st_closest_point,
+                                                st_contains, st_convex_hull,
+                                                st_distance,
+                                                st_distance_sphere,
+                                                st_dwithin, st_intersects,
+                                                st_point, st_translate)
+from geomesa_tpu.geometry import LineString, Point, Polygon, parse_wkt
+from geomesa_tpu.store import InMemoryDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+
+class TestStFunctions:
+    def test_predicates(self):
+        sq = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+        assert st_contains(sq, st_point(5, 5))
+        assert st_intersects(sq, parse_wkt("POLYGON ((5 5, 15 5, 15 15, 5 15, 5 5))"))
+        assert st_dwithin(st_point(0, 0), st_point(3, 4), 5.0)
+
+    def test_measures(self):
+        assert st_area(parse_wkt("POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))")) == 16
+        assert st_distance(st_point(0, 0), st_point(3, 4)) == 5
+        c = st_centroid(parse_wkt("POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))"))
+        assert (c.x, c.y) == (1, 1)
+
+    def test_haversine(self):
+        # London -> Paris ~ 343-344 km
+        d = st_distance_sphere(st_point(-0.1276, 51.5072),
+                               st_point(2.3522, 48.8566))
+        assert 330_000 < d < 355_000
+        # vectorized form agrees
+        dv = haversine_m(np.array([-0.1276]), np.array([51.5072]),
+                         np.array([2.3522]), np.array([48.8566]))
+        assert abs(float(dv[0]) - d) < 1
+
+    def test_convex_hull(self):
+        pts = parse_wkt("MULTIPOINT ((0 0), (10 0), (10 10), (0 10), (5 5))")
+        hull = st_convex_hull(pts)
+        assert isinstance(hull, Polygon)
+        assert hull.area == 100.0
+
+    def test_closest_point(self):
+        line = LineString([[0, 0], [10, 0]])
+        cp = st_closest_point(line, Point(5, 3))
+        assert (cp.x, cp.y) == (5, 0)
+
+    def test_translate(self):
+        g = st_translate(parse_wkt("POINT (1 2)"), 10, 20)
+        assert (g.x, g.y) == (11, 22)
+
+    def test_vectorized_distance(self):
+        tri = parse_wkt("POLYGON ((0 0, 10 0, 5 10, 0 0))")
+        xs = np.array([5.0, 20.0])
+        ys = np.array([2.0, 0.0])
+        d = distance_points(tri, xs, ys)
+        assert d[0] == 0.0  # inside
+        assert d[1] == 10.0
+
+
+class TestJoins:
+    def test_dwithin_join_exact(self):
+        rng = np.random.default_rng(17)
+        px = rng.uniform(-10, 10, 50_000)
+        py = rng.uniform(-10, 10, 50_000)
+        qx = rng.uniform(-10, 10, 100)
+        qy = rng.uniform(-10, 10, 100)
+        r = 0.5
+        counts, pairs = dwithin_join(px, py, qx, qy, r)
+        # brute force in f64
+        d2 = (px[:, None] - qx[None, :]) ** 2 + (py[:, None] - qy[None, :]) ** 2
+        expect = d2 <= r * r
+        assert np.array_equal(counts, expect.sum(axis=0))
+        got = set(map(tuple, pairs.tolist()))
+        want = set(zip(*np.nonzero(expect)))
+        assert got == want
+
+    def test_dwithin_threshold_boundary(self):
+        # points exactly at the radius boundary must be included
+        px = np.array([3.0, 3.000001])
+        py = np.array([4.0, 4.0])
+        counts, pairs = dwithin_join(px, py, np.array([0.0]), np.array([0.0]), 5.0)
+        assert counts[0] == 1  # (3,4) exactly at distance 5; the other beyond
+
+    def test_contains_join(self):
+        rng = np.random.default_rng(18)
+        px = rng.uniform(-50, 50, 20_000)
+        py = rng.uniform(-50, 50, 20_000)
+        polys = [parse_wkt("POLYGON ((0 0, 10 0, 5 10, 0 0))"),
+                 parse_wkt("POLYGON ((-40 -40, -20 -40, -20 -20, -40 -20, -40 -40))"),
+                 parse_wkt("POLYGON ((100 100, 101 100, 101 101, 100 101, 100 100))")]
+        counts, pairs = contains_join(polys, px, py)
+        for j, p in enumerate(polys):
+            expect = p.contains_points(px, py)
+            assert counts[j] == expect.sum()
+        assert counts[2] == 0
+
+    def test_knn_matches_brute_force(self):
+        rng = np.random.default_rng(19)
+        px = rng.uniform(-180, 180, 200_000)
+        py = rng.uniform(-90, 90, 200_000)
+        d, idx = knn(px, py, 12.3, 45.6, 100)
+        d2 = (px - 12.3) ** 2 + (py - 45.6) ** 2
+        want = np.sort(d2)[:100]
+        assert np.allclose(np.sort(d) ** 2, want, rtol=1e-12)
+        assert len(set(idx.tolist())) == 100
+
+
+class TestProcesses:
+    @pytest.fixture(scope="class")
+    def store(self):
+        ds = InMemoryDataStore()
+        ds.create_schema("pts", "kind:String,dtg:Date,*geom:Point")
+        rng = np.random.default_rng(20)
+        n = 30_000
+        ds.write_dict("pts", [f"x{i}" for i in range(n)], {
+            "kind": [f"k{i % 5}" for i in range(n)],
+            "dtg": rng.integers(MS("2017-01-01"), MS("2017-01-10"), n),
+            "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n)),
+        })
+        return ds
+
+    def test_knn_process(self, store):
+        ids, d = knn_process(store, "pts", 0.0, 0.0, 50)
+        assert len(ids) == 50
+        assert np.all(np.diff(d) >= 0)
+
+    def test_knn_process_filtered(self, store):
+        ids, d = knn_process(store, "pts", 0.0, 0.0, 10, ecql="kind = 'k1'")
+        assert len(ids) == 10
+
+    def test_proximity(self, store):
+        counts, ids = proximity_process(store, "pts", [0.0], [0.0], 1.0)
+        batch = store._state("pts").batch
+        x, y = batch.col("geom").x, batch.col("geom").y
+        expect = (x ** 2 + y ** 2) <= 1.0
+        assert counts[0] == expect.sum()
+        assert len(ids) == expect.sum()
+
+    def test_unique_and_minmax(self, store):
+        u = unique_process(store, "pts", "kind")
+        assert set(u) == {f"k{i}" for i in range(5)}
+        assert sum(u.values()) == 30_000
+        lo, hi = minmax_process(store, "pts", "dtg")
+        assert MS("2017-01-01") <= lo < hi < MS("2017-01-10")
+
+    def test_tube_select(self, store):
+        # track crossing the field west->east over 9 days
+        tx = np.linspace(-9, 9, 10)
+        ty = np.zeros(10)
+        tms = np.linspace(MS("2017-01-01"), MS("2017-01-09"), 10).astype(np.int64)
+        ids = tube_select_process(store, "pts", tx, ty, tms,
+                                  buffer_deg=1.0,
+                                  bin_millis=86_400_000)
+        assert len(ids) > 0
+        batch = store._state("pts").batch
+        sel = np.isin(batch.ids, ids)
+        x = batch.col("geom").x[sel]
+        y = batch.col("geom").y[sel]
+        ms = batch.col("dtg").millis[sel]
+        # every hit is within buffer+bin-box of the track's position range
+        assert np.all(np.abs(y) <= 1.0 + 1e-9)
+        # time-space correlation: early hits are west, late hits east
+        early = ms < MS("2017-01-03")
+        late = ms > MS("2017-01-08")
+        if early.any() and late.any():
+            assert x[early].mean() < x[late].mean()

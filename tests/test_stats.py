@@ -1,0 +1,163 @@
+"""Stat sketch tests (mirroring geomesa-utils stats test intent:
+observe/merge/json roundtrips, estimator sanity)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.stats import (CountStat, DescriptiveStats, EnumerationStat,
+                               Frequency, Histogram, MinMax, StatsEstimator,
+                               TopK, Z3Histogram, parse_stat)
+from geomesa_tpu.filters import parse_ecql
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+SFT = parse_spec("t", "name:String,age:Integer,score:Double,dtg:Date,"
+                      "*geom:Point:srid=4326")
+
+
+def make_batch(n=10_000, seed=0):
+    rng = np.random.default_rng(seed)
+    return FeatureBatch.from_dict(
+        SFT, [f"f{i}" for i in range(n)],
+        {
+            "name": [f"n{i % 10}" for i in range(n)],
+            "age": rng.integers(0, 100, n),
+            "score": rng.normal(50, 10, n),
+            "dtg": rng.integers(MS("2017-01-01"), MS("2017-03-01"), n),
+            "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+        })
+
+
+class TestSketches:
+    def test_count(self):
+        s = parse_stat("Count()")
+        s.observe(make_batch(100))
+        s.observe(make_batch(50))
+        assert s.count == 150
+
+    def test_minmax_numeric(self):
+        b = make_batch()
+        s = MinMax("age")
+        s.observe(b)
+        assert s.min == b.col("age").values.min()
+        assert s.max == b.col("age").values.max()
+
+    def test_minmax_merge(self):
+        a, b = MinMax("age"), MinMax("age")
+        a.observe(make_batch(seed=1))
+        b.observe(make_batch(seed=2))
+        direct = MinMax("age")
+        direct.observe(make_batch(seed=1))
+        direct.observe(make_batch(seed=2))
+        merged = a + b
+        assert merged.min == direct.min and merged.max == direct.max
+
+    def test_minmax_geometry_envelope(self):
+        s = MinMax("geom")
+        s.observe(make_batch())
+        assert -180 <= s.min[0] < s.max[0] <= 180
+
+    def test_enumeration(self):
+        s = EnumerationStat("name")
+        s.observe(make_batch(1000))
+        assert s.counts["n3"] == 100
+        assert sum(s.counts.values()) == 1000
+
+    def test_topk(self):
+        b = make_batch(1000)
+        s = TopK("name", k=3)
+        s.observe(b)
+        top = s.topk()
+        assert len(top) == 3 and all(c == 100 for _, c in top)
+
+    def test_frequency_counts(self):
+        s = Frequency("name", precision=10)
+        s.observe(make_batch(1000))
+        # count-min: overestimates only
+        assert s.count("n5") >= 100
+        assert s.count("n5") < 250
+
+    def test_histogram(self):
+        s = Histogram("age", 10, 0, 100)
+        b = make_batch()
+        s.observe(b)
+        assert s.total == b.n
+        assert abs(s.counts[3] - b.n / 10) < b.n * 0.05
+
+    def test_histogram_merge_mismatch(self):
+        with pytest.raises(ValueError):
+            Histogram("age", 10, 0, 100).merge(Histogram("age", 20, 0, 100))
+
+    def test_descriptive(self):
+        b = make_batch(50_000)
+        s = DescriptiveStats("score")
+        s.observe(b)
+        v = b.col("score").values
+        assert abs(s.mean - v.mean()) < 1e-9
+        assert abs(s.stddev - v.std(ddof=1)) < 1e-6
+        assert abs(s.skewness) < 0.1  # normal data
+        # chunked observe == single observe
+        s2 = DescriptiveStats("score")
+        half = b.take(np.arange(25_000))
+        rest = b.take(np.arange(25_000, 50_000))
+        s2.observe(half)
+        s2.observe(rest)
+        assert abs(s2.mean - s.mean) < 1e-9
+        assert abs(s2.variance - s.variance) < 1e-6
+
+    def test_groupby(self):
+        s = parse_stat("GroupBy(name,Count())")
+        s.observe(make_batch(1000))
+        assert len(s.groups) == 10
+        assert s.groups["n0"].count == 100
+
+    def test_seq_and_json(self):
+        s = parse_stat("Count();MinMax(age)")
+        s.observe(make_batch(100))
+        obj = json.loads(s.to_json())
+        assert obj[0]["count"] == 100
+        assert "min" in obj[1]
+
+    def test_z3_histogram(self):
+        s = Z3Histogram("geom", "dtg", "week", 1024)
+        b = make_batch()
+        s.observe(b)
+        assert not s.is_empty
+        total = sum(int(a.sum()) for a in s.bins.values())
+        assert total == b.n
+
+
+class TestEstimator:
+    def test_selectivity_tracks_area(self):
+        est = StatsEstimator(SFT)
+        b = make_batch(50_000)
+        est.observe(b)
+        full = est.estimate_count(parse_ecql(
+            "BBOX(geom, -180, -90, 180, 90)"))
+        small = est.estimate_count(parse_ecql("BBOX(geom, 0, 0, 18, 18)"))
+        assert full == pytest.approx(50_000, rel=0.05)
+        assert small is not None and small < full / 10
+
+    def test_temporal_selectivity(self):
+        est = StatsEstimator(SFT)
+        est.observe(make_batch(50_000))
+        jan = est.estimate_count(parse_ecql(
+            "BBOX(geom,-180,-90,180,90) AND "
+            "dtg DURING 2017-01-01T00:00:00Z/2017-01-15T00:00:00Z"))
+        assert jan == pytest.approx(50_000 / 4.2, rel=0.4)
+
+    def test_store_integration(self):
+        from geomesa_tpu.store import InMemoryDataStore
+        ds = InMemoryDataStore()
+        ds.create_schema(SFT)
+        ds.write("t", make_batch(5000))
+        est = ds.stats.get("t")
+        assert est is not None and est.count.count == 5000
+        stat = ds.stats_query("t", "MinMax(age)", "age < 50")
+        assert stat.max < 50
+        # explain shows stats-based costs
+        res = ds.query("BBOX(geom, 0, 0, 10, 10)", "t")
+        assert res.plan.index in ("z2", "z3")
