@@ -1,0 +1,8 @@
+"""Query auditing (index/audit/QueryEvent.scala:13 +
+AccumuloAuditService analog): every query records an event — type name,
+filter, hints, plan/scan timings, hit count — to a pluggable writer
+(in-memory ring, JSONL file)."""
+
+from .events import AuditLogger, QueryEvent
+
+__all__ = ["AuditLogger", "QueryEvent"]

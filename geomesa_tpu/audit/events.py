@@ -1,0 +1,61 @@
+"""Audit events + writers."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any
+
+__all__ = ["QueryEvent", "AuditLogger"]
+
+
+@dataclasses.dataclass
+class QueryEvent:
+    """One audited query (QueryEvent.scala:13 fields)."""
+    type_name: str
+    user: str
+    filter: str
+    hints: dict[str, Any]
+    date_ms: int
+    plan_time_ms: float
+    scan_time_ms: float
+    hits: int
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), default=str)
+
+
+class AuditLogger:
+    """Keeps a bounded in-memory ring and optionally appends JSONL to a
+    file (the async table writer of AccumuloAuditService, minus the
+    table)."""
+
+    def __init__(self, path: str | None = None, capacity: int = 10_000):
+        self.path = path
+        self.capacity = capacity
+        self.events: list[QueryEvent] = []
+
+    def write(self, event: QueryEvent):
+        self.events.append(event)
+        if len(self.events) > self.capacity:
+            self.events = self.events[-self.capacity:]
+        if self.path:
+            with open(self.path, "a") as fh:
+                fh.write(event.to_json() + "\n")
+
+    def record(self, type_name: str, filter_str: str, hints: dict,
+               plan_time_ms: float, scan_time_ms: float, hits: int,
+               user: str = "unknown"):
+        self.write(QueryEvent(type_name, user, filter_str, hints,
+                              int(time.time() * 1000), plan_time_ms,
+                              scan_time_ms, hits))
+
+    def query(self, type_name: str | None = None,
+              since_ms: int | None = None) -> list[QueryEvent]:
+        out = self.events
+        if type_name is not None:
+            out = [e for e in out if e.type_name == type_name]
+        if since_ms is not None:
+            out = [e for e in out if e.date_ms >= since_ms]
+        return list(out)

@@ -1,0 +1,9 @@
+"""L8 ingest converters (geomesa-convert analog, SURVEY.md 2.4)."""
+
+from .converter import (DelimitedTextConverter, JsonConverter,
+                        SimpleFeatureConverter, converter_for)
+from .dsl import EvaluationContext, compile_expression
+
+__all__ = ["DelimitedTextConverter", "JsonConverter",
+           "SimpleFeatureConverter", "converter_for",
+           "EvaluationContext", "compile_expression"]

@@ -1,0 +1,164 @@
+"""Ingest converters: raw records -> FeatureBatch.
+
+Mirrors geomesa-convert (SimpleFeatureConverterFactory.scala:194 +
+format modules): a converter config declares an id expression and per-
+attribute transform expressions over parsed input records. Formats:
+delimited text (CSV/TSV), JSON (with dotted paths), and an in-memory
+list-of-rows form.
+
+Config shape (the TypeSafe-config structure, as a dict):
+    {"type": "delimited-text", "format": "CSV",
+     "id-field": "md5($0)",
+     "fields": [{"name": "dtg", "transform": "isoDate($3)"},
+                {"name": "geom", "transform": "point($1::double, $2::double)"}]}
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+from typing import Any, Iterable
+
+from ..features.batch import FeatureBatch
+from ..features.sft import SimpleFeatureType
+from .dsl import EvaluationContext, compile_expression
+
+__all__ = ["SimpleFeatureConverter", "DelimitedTextConverter",
+           "JsonConverter", "converter_for"]
+
+# sentinel yielded by _records for unparseable inputs; process() counts
+# it as a failure without evaluating transforms
+_BAD_RECORD: list = []
+
+
+class SimpleFeatureConverter:
+    """Base: compile field transforms, process record streams."""
+
+    def __init__(self, sft: SimpleFeatureType, config: dict):
+        self.sft = sft
+        self.config = config
+        self.id_expr = compile_expression(config.get("id-field", "uuid()"))
+        self.field_exprs: dict[str, Any] = {}
+        # nameless entries are column bindings only (e.g. a bare JSON
+        # path that later transforms reference by column number)
+        declared = {f["name"]: f.get("transform") for f in
+                    config.get("fields", []) if "name" in f}
+        for attr in sft.attributes:
+            t = declared.get(attr.name)
+            if t is None:
+                raise ValueError(f"no transform for attribute {attr.name!r}")
+            self.field_exprs[attr.name] = compile_expression(t)
+
+    def _records(self, source) -> Iterable[list]:
+        """Yield column lists; cols[0] is the raw record."""
+        raise NotImplementedError
+
+    def process(self, source, ctx: EvaluationContext | None = None
+                ) -> tuple[FeatureBatch, EvaluationContext]:
+        ctx = ctx or EvaluationContext()
+        ids: list[str] = []
+        data: dict[str, list] = {a.name: [] for a in self.sft.attributes}
+        for cols in self._records(source):
+            ctx.line += 1
+            if cols is _BAD_RECORD:
+                ctx.failure += 1
+                continue
+            try:
+                fid = str(self.id_expr(cols))
+                values = {name: expr(cols)
+                          for name, expr in self.field_exprs.items()}
+            except Exception:
+                ctx.failure += 1
+                continue
+            ids.append(fid)
+            for name, v in values.items():
+                data[name].append(v)
+            ctx.success += 1
+        # point columns arrive as Point objects; from_dict handles them
+        batch = FeatureBatch.from_dict(self.sft, ids, data)
+        return batch, ctx
+
+
+class DelimitedTextConverter(SimpleFeatureConverter):
+    """CSV/TSV lines -> features ($1..$N are the delimited columns)."""
+
+    def __init__(self, sft: SimpleFeatureType, config: dict):
+        super().__init__(sft, config)
+        fmt = config.get("format", "CSV").upper()
+        self.delimiter = {"CSV": ",", "TSV": "\t"}.get(fmt, ",")
+        self.skip_lines = int(config.get("options", {}).get("skip-lines", 0))
+
+    def _records(self, source):
+        if isinstance(source, str):
+            source = io.StringIO(source)
+        reader = csv.reader(source, delimiter=self.delimiter)
+        for i, row in enumerate(reader):
+            if i < self.skip_lines or not row:
+                continue
+            yield [self.delimiter.join(row)] + row
+
+
+class JsonConverter(SimpleFeatureConverter):
+    """JSON objects (one per line, or a top-level array) -> features.
+
+    Field transforms use jsonPath('$.a.b') via the `$0` record: the
+    config's fields may use ``jsonPath`` expressions written as
+    ``path('a.b')`` which this converter resolves before transforms, so
+    `$1..$N` bind to the declared paths in order.
+    """
+
+    def __init__(self, sft: SimpleFeatureType, config: dict):
+        self.paths = [f["path"] for f in config.get("fields", [])
+                      if "path" in f]
+        # fields with a path but no transform default to the column ref
+        fields = []
+        col = 0
+        for f in config.get("fields", []):
+            f = dict(f)
+            if "path" in f:
+                col += 1
+                if "name" in f:
+                    f.setdefault("transform", f"${col}")
+            fields.append(f)
+        config = dict(config)
+        config["fields"] = fields
+        super().__init__(sft, config)
+
+    @staticmethod
+    def _resolve(obj: Any, path: str):
+        cur = obj
+        for part in path.replace("$.", "").split("."):
+            if isinstance(cur, dict):
+                cur = cur.get(part)
+            elif isinstance(cur, list) and part.isdigit():
+                cur = cur[int(part)]
+            else:
+                return None
+        return cur
+
+    def _records(self, source):
+        if isinstance(source, str):
+            stripped = source.strip()
+            if stripped.startswith("["):
+                objs = json.loads(stripped)
+            else:
+                objs = [json.loads(line) for line in stripped.splitlines()
+                        if line.strip()]
+        else:
+            objs = list(source)
+        for obj in objs:
+            try:
+                yield [obj] + [self._resolve(obj, p) for p in self.paths]
+            except Exception:
+                # a bad record must count as a failure, not kill the run
+                yield _BAD_RECORD
+
+
+def converter_for(sft: SimpleFeatureType, config: dict) -> SimpleFeatureConverter:
+    kind = config.get("type", "delimited-text")
+    if kind == "delimited-text":
+        return DelimitedTextConverter(sft, config)
+    if kind == "json":
+        return JsonConverter(sft, config)
+    raise ValueError(f"unknown converter type: {kind}")

@@ -1,0 +1,197 @@
+"""Converter transform-expression DSL.
+
+The reference's ingest converters evaluate a small expression language
+per field (convert/Transformers.scala — scala parser-combinators):
+column refs ``$1``, casts ``::int``, function calls, ``try(expr,
+fallback)``, string/date/geometry helpers. This is a from-scratch
+recursive-descent implementation of that grammar over Python values.
+
+Supported:
+    $0 .. $N                 raw input columns ($0 = whole record)
+    'literal'  123  4.5      literals
+    expr::int  ::long ::float ::double ::string ::boolean
+    concat(a, b, ...)        trim(s) lowercase(s) uppercase(s)
+    regexReplace('rx','rep',s)     substring(s, i, j)
+    date('fmt', s)           isoDate(s)  millisToDate(n)  (epoch millis)
+    point(x, y)              geometry(wkt)
+    md5(s)  uuid()           stringToBytes(s)
+    try(expr, fallback)
+    withDefault(expr, default)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import uuid as _uuid
+from typing import Any, Callable
+
+import numpy as np
+
+from ..geometry import Point, parse_wkt
+
+__all__ = ["compile_expression", "EvaluationContext"]
+
+
+class EvaluationContext:
+    """Per-ingest counters + caches (convert/EvaluationContext analog)."""
+
+    def __init__(self):
+        self.success = 0
+        self.failure = 0
+        self.line = 0
+
+    def counters(self) -> dict[str, int]:
+        return {"success": self.success, "failure": self.failure,
+                "line": self.line}
+
+
+class _P:
+    def __init__(self, s: str):
+        self.s = s
+        self.i = 0
+
+    def ws(self):
+        while self.i < len(self.s) and self.s[self.i].isspace():
+            self.i += 1
+
+    def peek(self) -> str:
+        self.ws()
+        return self.s[self.i] if self.i < len(self.s) else ""
+
+    def eat(self, ch: str):
+        self.ws()
+        if self.peek() != ch:
+            raise ValueError(f"expected {ch!r} at {self.i} in {self.s!r}")
+        self.i += 1
+
+    def match_re(self, rx: str):
+        self.ws()
+        m = re.match(rx, self.s[self.i:])
+        if m:
+            self.i += m.end()
+            return m
+        return None
+
+
+_CASTS: dict[str, Callable[[Any], Any]] = {
+    "int": lambda v: int(float(v)),
+    "integer": lambda v: int(float(v)),
+    "long": lambda v: int(float(v)),
+    "float": float,
+    "double": float,
+    "string": str,
+    "boolean": lambda v: str(v).strip().lower() in ("true", "1", "t", "yes"),
+}
+
+
+def _fn_date(fmt: str, s: str) -> int:
+    """Parse with a java-SimpleDateFormat-flavored pattern -> millis."""
+    py = (fmt.replace("yyyy", "%Y").replace("MM", "%m").replace("dd", "%d")
+          .replace("HH", "%H").replace("mm", "%M").replace("ss", "%S")
+          .replace("SSS", "%f").replace("'T'", "T").replace("'Z'", "Z"))
+    import datetime as _dt
+    dt = _dt.datetime.strptime(str(s).strip(), py)
+    return int(dt.replace(tzinfo=_dt.timezone.utc).timestamp() * 1000)
+
+
+def _fn_iso_date(s: str) -> int:
+    return int(np.datetime64(str(s).strip().rstrip("Z"), "ms").astype(np.int64))
+
+
+_FUNCTIONS: dict[str, Callable[..., Any]] = {
+    "concat": lambda *a: "".join(str(x) for x in a),
+    "trim": lambda s: str(s).strip(),
+    "lowercase": lambda s: str(s).lower(),
+    "uppercase": lambda s: str(s).upper(),
+    "regexReplace": lambda rx, rep, s: re.sub(rx, rep, str(s)),
+    "substring": lambda s, i, j: str(s)[int(i):int(j)],
+    "length": lambda s: len(str(s)),
+    "date": _fn_date,
+    "isoDate": _fn_iso_date,
+    "millisToDate": lambda n: int(n),
+    "secsToDate": lambda n: int(float(n) * 1000),
+    "point": lambda x, y: Point(float(x), float(y)),
+    "geometry": lambda wkt: parse_wkt(str(wkt)),
+    "md5": lambda s: hashlib.md5(str(s).encode()).hexdigest(),
+    "uuid": lambda: str(_uuid.uuid4()),
+    "stringToBytes": lambda s: str(s).encode(),
+    "toString": str,
+}
+
+
+def compile_expression(text: str) -> Callable[[list], Any]:
+    """Compile an expression to fn(columns) -> value. columns[0] is the
+    whole record; columns[1:] are fields."""
+    p = _P(text)
+    expr = _parse_expr(p)
+    p.ws()
+    if p.i != len(p.s):
+        raise ValueError(f"trailing input in expression: {text[p.i:]!r}")
+    return expr
+
+
+def _parse_expr(p: _P):
+    e = _parse_primary(p)
+    # postfix casts, possibly chained
+    while True:
+        m = p.match_re(r"::(\w+)")
+        if not m:
+            return e
+        cast = _CASTS.get(m.group(1).lower())
+        if cast is None:
+            raise ValueError(f"unknown cast ::{m.group(1)}")
+        inner = e
+        e = (lambda inner, cast: lambda cols: cast(inner(cols)))(inner, cast)
+
+
+def _parse_primary(p: _P):
+    m = p.match_re(r"\$(\d+)")
+    if m:
+        idx = int(m.group(1))
+        return lambda cols: cols[idx]
+    m = p.match_re(r"'((?:[^']|'')*)'")
+    if m:
+        lit = m.group(1).replace("''", "'")
+        return lambda cols: lit
+    m = p.match_re(r"[-+]?\d+\.\d+(?:[eE][-+]?\d+)?")
+    if m:
+        lit = float(m.group(0))
+        return lambda cols: lit
+    m = p.match_re(r"[-+]?\d+")
+    if m:
+        lit = int(m.group(0))
+        return lambda cols: lit
+    m = p.match_re(r"(\w+)\s*\(")
+    if m:
+        name = m.group(1)
+        args = []
+        if p.peek() != ")":
+            args.append(_parse_expr(p))
+            while p.peek() == ",":
+                p.eat(",")
+                args.append(_parse_expr(p))
+        p.eat(")")
+        if name == "try":
+            if len(args) != 2:
+                raise ValueError("try(expr, fallback) takes 2 args")
+            expr, fallback = args
+
+            def _try(cols, expr=expr, fallback=fallback):
+                try:
+                    return expr(cols)
+                except Exception:
+                    return fallback(cols)
+            return _try
+        if name == "withDefault":
+            expr, default = args
+
+            def _wd(cols, expr=expr, default=default):
+                v = expr(cols)
+                return default(cols) if v in (None, "") else v
+            return _wd
+        fn = _FUNCTIONS.get(name)
+        if fn is None:
+            raise ValueError(f"unknown function {name!r}")
+        return (lambda fn, args: lambda cols: fn(*(a(cols) for a in args)))(fn, args)
+    raise ValueError(f"cannot parse expression at {p.i} in {p.s!r}")

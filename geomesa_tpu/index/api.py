@@ -53,6 +53,7 @@ class Query:
     sort_by: str | None = None
     sort_desc: bool = False
     hints: dict[str, Any] = dataclasses.field(default_factory=dict)
+    auths: list[str] | None = None   # visibility authorizations
 
     def __post_init__(self):
         if isinstance(self.filter, str):

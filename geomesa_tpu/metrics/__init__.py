@@ -1,0 +1,6 @@
+"""Metrics registry (geomesa-metrics / Dropwizard analog): counters,
+timers and gauges with pluggable reporters."""
+
+from .registry import MetricsRegistry, metrics
+
+__all__ = ["MetricsRegistry", "metrics"]

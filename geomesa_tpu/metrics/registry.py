@@ -1,0 +1,85 @@
+"""Lightweight metrics registry (MetricsConfig analog,
+metrics/config/MetricsConfig.scala:26): counters/timers/gauges with a
+snapshot API and delimited-file reporting."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["MetricsRegistry", "metrics"]
+
+
+class _Timer:
+    __slots__ = ("count", "total_s", "max_s")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def update(self, seconds: float):
+        self.count += 1
+        self.total_s += seconds
+        self.max_s = max(self.max_s, seconds)
+
+    @property
+    def mean_ms(self) -> float:
+        return (self.total_s / self.count * 1000) if self.count else 0.0
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._timers: dict[str, _Timer] = {}
+        self._gauges: dict[str, float] = {}
+
+    def counter(self, name: str, inc: int = 1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + inc
+
+    def gauge(self, name: str, value: float):
+        with self._lock:
+            self._gauges[name] = value
+
+    def time(self, name: str):
+        reg = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+
+            def __exit__(self, *exc):
+                dt = time.perf_counter() - self.t0
+                with reg._lock:
+                    reg._timers.setdefault(name, _Timer()).update(dt)
+
+        return _Ctx()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "timers": {k: {"count": t.count,
+                               "mean_ms": round(t.mean_ms, 3),
+                               "max_ms": round(t.max_s * 1000, 3)}
+                           for k, t in self._timers.items()},
+            }
+
+    def report_delimited(self, path: str, delimiter: str = "\t"):
+        """DelimitedFileReporter analog: append a snapshot."""
+        snap = self.snapshot()
+        now = int(time.time() * 1000)
+        with open(path, "a") as fh:
+            for k, v in snap["counters"].items():
+                fh.write(f"{now}{delimiter}counter{delimiter}{k}{delimiter}{v}\n")
+            for k, t in snap["timers"].items():
+                fh.write(f"{now}{delimiter}timer{delimiter}{k}{delimiter}"
+                         f"{t['count']}{delimiter}{t['mean_ms']}\n")
+            for k, v in snap["gauges"].items():
+                fh.write(f"{now}{delimiter}gauge{delimiter}{k}{delimiter}{v}\n")
+
+
+metrics = MetricsRegistry()

@@ -1,0 +1,13 @@
+"""LX security: cell-level visibility (geomesa-security analog).
+
+VisibilityEvaluator (security/VisibilityEvaluator.scala:21) parses
+Accumulo-style boolean visibility expressions — ``A&B|(C&D)``, quoted
+terms — and evaluates them against a user's authorization set, enabling
+row-level security on stores without native cell visibility.
+"""
+
+from .visibility import (VisibilityExpression, evaluate_visibilities,
+                         parse_visibility)
+
+__all__ = ["VisibilityExpression", "evaluate_visibilities",
+           "parse_visibility"]

@@ -1,7 +1,15 @@
-"""L5 datastores. The in-memory TPU store is the flagship execution
-engine (geomesa-memory/CQEngine analog, but device-resident); fs/live
-tiers layer on top of it."""
+"""L5 datastores (SURVEY.md 2.2): the in-memory TPU store is the
+flagship execution engine; fs (Parquet + partition pruning), live
+(streaming bus) and lambda (two-tier) layer on top of it."""
 
 from .memory import InMemoryDataStore, QueryResult
+from .fs import FileSystemDataStore
+from .live import GeoMessage, LiveDataStore, MessageBus
+from .lambda_store import LambdaDataStore
+from .partitions import (AttributeScheme, CompositeScheme, DateTimeScheme,
+                         PartitionScheme, Z2Scheme, scheme_from_config)
 
-__all__ = ["InMemoryDataStore", "QueryResult"]
+__all__ = ["InMemoryDataStore", "QueryResult", "FileSystemDataStore",
+           "GeoMessage", "LiveDataStore", "MessageBus", "LambdaDataStore",
+           "AttributeScheme", "CompositeScheme", "DateTimeScheme",
+           "PartitionScheme", "Z2Scheme", "scheme_from_config"]

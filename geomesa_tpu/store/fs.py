@@ -1,0 +1,219 @@
+"""Filesystem datastore: Parquet persistence with partition pruning.
+
+The analog of geomesa-fs (FileSystemDataStore.scala:29 +
+ParquetFileSystemStorage.scala:63): features persist as Parquet files
+under partition directories; a JSON metadata catalog records the schema
+and partition-scheme config; query planning prunes partitions from the
+filter, loads the surviving files into the in-memory device store, and
+runs the normal TPU execution path (a per-pruned-set device cache makes
+repeated queries device-resident — the 'storage tier feeds the compute
+tier' shape of SURVEY.md section 7 step 8).
+
+Layout:
+    root/<type_name>/metadata.json
+    root/<type_name>/data/<partition...>/<uuid>.parquet
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from typing import Any
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.sft import SimpleFeatureType, parse_spec
+from ..filters import ast
+from ..index.api import Query
+from .memory import InMemoryDataStore, QueryResult
+from .partitions import (DateTimeScheme, PartitionScheme, Z2Scheme,
+                         scheme_from_config)
+
+__all__ = ["FileSystemDataStore"]
+
+
+class _FsTypeState:
+    def __init__(self, sft: SimpleFeatureType, scheme: PartitionScheme,
+                 root: str):
+        self.sft = sft
+        self.scheme = scheme
+        self.root = root
+        # cache: frozenset(partition files) -> loaded memory store
+        self.cache: dict[frozenset, InMemoryDataStore] = {}
+
+    @property
+    def data_dir(self) -> str:
+        return os.path.join(self.root, "data")
+
+
+class FileSystemDataStore:
+    """Parquet-backed datastore with the same query surface as the
+    in-memory store."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._types: dict[str, _FsTypeState] = {}
+        for name in os.listdir(root):
+            meta = os.path.join(root, name, "metadata.json")
+            if os.path.isfile(meta):
+                self._load_type(name)
+
+    # -- metadata catalog --------------------------------------------------
+
+    def _load_type(self, name: str):
+        with open(os.path.join(self.root, name, "metadata.json")) as fh:
+            meta = json.load(fh)
+        sft = parse_spec(name, meta["spec"])
+        scheme = scheme_from_config(meta["partition_scheme"])
+        self._types[name] = _FsTypeState(
+            sft, scheme, os.path.join(self.root, name))
+
+    def create_schema(self, sft: SimpleFeatureType | str,
+                      spec: str | None = None,
+                      scheme: PartitionScheme | None = None):
+        if isinstance(sft, str):
+            sft = parse_spec(sft, spec or "")
+        if sft.type_name in self._types:
+            raise ValueError(f"schema {sft.type_name!r} already exists")
+        if scheme is None:
+            # reference default: daily datetime + z2 when both axes exist
+            if sft.dtg_field is not None:
+                scheme = DateTimeScheme("daily")
+            elif sft.geom_field is not None:
+                scheme = Z2Scheme(4)
+            else:
+                raise ValueError("schema needs a dtg or geometry for "
+                                 "partitioning; pass an explicit scheme")
+        tdir = os.path.join(self.root, sft.type_name)
+        os.makedirs(os.path.join(tdir, "data"), exist_ok=True)
+        with open(os.path.join(tdir, "metadata.json"), "w") as fh:
+            json.dump({"spec": sft.to_spec(),
+                       "partition_scheme": scheme.to_config()}, fh, indent=2)
+        self._types[sft.type_name] = _FsTypeState(sft, scheme, tdir)
+
+    def get_schema(self, type_name: str) -> SimpleFeatureType:
+        return self._state(type_name).sft
+
+    def get_type_names(self) -> list[str]:
+        return sorted(self._types)
+
+    def _state(self, type_name: str) -> _FsTypeState:
+        if type_name not in self._types:
+            raise KeyError(f"no such schema: {type_name}")
+        return self._types[type_name]
+
+    # -- writes ------------------------------------------------------------
+
+    def write(self, type_name: str, batch: FeatureBatch):
+        import pyarrow.parquet as pq
+        st = self._state(type_name)
+        names = st.scheme.partition_for_rows(st.sft, batch)
+        for part in np.unique(names):
+            sel = np.flatnonzero(names == part)
+            sub = batch.take(sel)
+            pdir = os.path.join(st.data_dir, str(part))
+            os.makedirs(pdir, exist_ok=True)
+            path = os.path.join(pdir, f"{uuid.uuid4().hex[:12]}.parquet")
+            import pyarrow as pa
+            pq.write_table(pa.Table.from_batches([sub.to_arrow()]), path)
+        st.cache.clear()
+
+    def write_dict(self, type_name: str, ids, data: dict[str, Any]):
+        st = self._state(type_name)
+        self.write(type_name, FeatureBatch.from_dict(st.sft, ids, data))
+
+    # -- partitions --------------------------------------------------------
+
+    def partitions(self, type_name: str) -> list[str]:
+        st = self._state(type_name)
+        out = []
+        for dirpath, _dirs, files in os.walk(st.data_dir):
+            if any(f.endswith(".parquet") for f in files):
+                out.append(os.path.relpath(dirpath, st.data_dir)
+                           .replace(os.sep, "/"))
+        return sorted(out)
+
+    def _files_for(self, st: _FsTypeState,
+                   parts: list[str] | None) -> list[str]:
+        all_parts = None
+        if parts is None:
+            files = []
+            for dirpath, _d, fnames in os.walk(st.data_dir):
+                files.extend(os.path.join(dirpath, f) for f in fnames
+                             if f.endswith(".parquet"))
+            return sorted(files)
+        files = []
+        for p in parts:
+            pdir = os.path.join(st.data_dir, p)
+            if os.path.isdir(pdir):
+                files.extend(os.path.join(pdir, f)
+                             for f in sorted(os.listdir(pdir))
+                             if f.endswith(".parquet"))
+        return files
+
+    def _load(self, st: _FsTypeState, files: list[str]) -> InMemoryDataStore:
+        key = frozenset(files)
+        if key in st.cache:
+            return st.cache[key]
+        import pyarrow.parquet as pq
+        ds = InMemoryDataStore()
+        ds.create_schema(st.sft)
+        for path in files:
+            table = pq.read_table(path)
+            for rb in table.to_batches():
+                ds.write(st.sft.type_name,
+                         FeatureBatch.from_arrow(st.sft, rb))
+        # bound the cache: keep the latest two pruned sets per type
+        if len(st.cache) >= 2:
+            st.cache.pop(next(iter(st.cache)))
+        st.cache[key] = ds
+        return ds
+
+    # -- queries -----------------------------------------------------------
+
+    def query(self, q: Query | str, type_name: str | None = None,
+              explain_out=None) -> QueryResult:
+        if isinstance(q, str):
+            q = Query(type_name, q)
+        st = self._state(q.type_name)
+        parts = st.scheme.covering_partitions(st.sft, q.filter)
+        if parts == []:
+            from ..index.api import Explainer, FilterStrategy
+            ex = Explainer(explain_out)
+            ex("All partitions pruned")
+            return QueryResult(np.empty(0, dtype=object), None, ex,
+                               FilterStrategy("empty", None, None))
+        files = self._files_for(st, parts)
+        mem = self._load(st, files)
+        res = mem.query(q, explain_out=explain_out)
+        res.explain(f"Partitions scanned: "
+                    f"{'all' if parts is None else len(parts)}; "
+                    f"files: {len(files)}")
+        return res
+
+    def count(self, type_name: str) -> int:
+        st = self._state(type_name)
+        mem = self._load(st, self._files_for(st, None))
+        return mem.count(type_name)
+
+    def compact(self, type_name: str):
+        """Merge each partition's files into one (fs/tools/compact analog)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        st = self._state(type_name)
+        for part in self.partitions(type_name):
+            pdir = os.path.join(st.data_dir, part)
+            files = [os.path.join(pdir, f) for f in sorted(os.listdir(pdir))
+                     if f.endswith(".parquet")]
+            if len(files) <= 1:
+                continue
+            tables = [pq.read_table(f) for f in files]
+            merged = pa.concat_tables(tables)
+            out = os.path.join(pdir, f"{uuid.uuid4().hex[:12]}.parquet")
+            pq.write_table(merged, out)
+            for f in files:
+                os.remove(f)
+        st.cache.clear()

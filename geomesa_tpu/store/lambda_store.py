@@ -1,0 +1,118 @@
+"""Lambda-architecture store: transient live tier + long-term persistence.
+
+The analog of geomesa-lambda (lambda/data/LambdaDataStore.scala:38):
+writes land in the transient (live) tier; a background-style persistence
+step moves features older than an age threshold into the persistent
+store (DataStorePersistence analog); queries union both tiers with the
+transient winning on id collisions (LambdaQueryRunner). The
+LAMBDA_QUERY_PERSISTENT / LAMBDA_QUERY_TRANSIENT hints restrict to one
+tier (QueryHints.scala:60-61).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..features.sft import SimpleFeatureType, parse_spec
+from ..index.api import Query
+from .live import LiveDataStore, MessageBus
+from .memory import InMemoryDataStore, QueryResult
+
+__all__ = ["LambdaDataStore", "LAMBDA_QUERY_PERSISTENT",
+           "LAMBDA_QUERY_TRANSIENT"]
+
+LAMBDA_QUERY_PERSISTENT = "LAMBDA_QUERY_PERSISTENT"
+LAMBDA_QUERY_TRANSIENT = "LAMBDA_QUERY_TRANSIENT"
+
+
+class LambdaDataStore:
+    def __init__(self, persistent=None, bus: MessageBus | None = None,
+                 persist_after_millis: int = 3_600_000):
+        self.transient = LiveDataStore(bus)
+        self.persistent = persistent or InMemoryDataStore()
+        self.persist_after = persist_after_millis
+
+    def create_schema(self, sft: SimpleFeatureType | str,
+                      spec: str | None = None):
+        if isinstance(sft, str):
+            sft = parse_spec(sft, spec or "")
+        self.transient.create_schema(sft)
+        if sft.type_name not in self.persistent.get_type_names():
+            self.persistent.create_schema(sft)
+
+    def get_schema(self, type_name: str) -> SimpleFeatureType:
+        return self.transient.get_schema(type_name)
+
+    def write(self, type_name: str, batch, timestamp_ms=None):
+        self.transient.write(type_name, batch, timestamp_ms)
+
+    def write_dict(self, type_name: str, ids, data, timestamp_ms=None):
+        self.transient.write_dict(type_name, ids, data, timestamp_ms)
+
+    def delete(self, type_name: str, ids):
+        self.transient.delete(type_name, ids)
+        self.persistent.delete(type_name, ids)
+
+    def persist(self, type_name: str, now_ms: int | None = None) -> int:
+        """Move features older than the age threshold into the
+        persistent tier (DataStorePersistence run). Returns moved count."""
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        ids, batch = self.transient.features_older_than(
+            type_name, now - self.persist_after)
+        if batch is None or batch.n == 0:
+            return 0
+        # upsert into the persistent store
+        self.persistent.delete(type_name, ids)
+        self.persistent.write(type_name, batch)
+        self.transient.delete(type_name, ids)
+        return batch.n
+
+    def query(self, q: Query | str, type_name: str | None = None,
+              explain_out=None) -> QueryResult:
+        if isinstance(q, str):
+            q = Query(type_name, q)
+        if q.hints.get(LAMBDA_QUERY_TRANSIENT):
+            return self.transient.query(q, explain_out=explain_out)
+        if q.hints.get(LAMBDA_QUERY_PERSISTENT):
+            return self.persistent.query(q, explain_out=explain_out)
+        # run the tiers unsorted/unlimited; sort + limit re-apply on the
+        # union (per-tier limits would be wrong)
+        import dataclasses as _dc
+        tier_q = _dc.replace(q, max_features=None, sort_by=None)
+        rt = self.transient.query(tier_q, explain_out=explain_out)
+        rp = self.persistent.query(tier_q, explain_out=explain_out)
+        # a persistent row is stale if ANY transient version of the id
+        # exists (not just one matching this filter): transient holds
+        # the current version, which may no longer match
+        t_state = self.transient._mem._state(q.type_name)
+        all_t_ids = (t_state.batch.ids.astype(str)
+                     if t_state.batch is not None else np.empty(0, "U1"))
+        keep = ~np.isin(rp.ids.astype(str), all_t_ids)
+        ids = np.concatenate([rt.ids, rp.ids[keep]])
+        batch = rt.batch
+        if rp.batch is not None and keep.any():
+            sub = rp.batch.take(np.flatnonzero(keep))
+            batch = sub if batch is None else batch.concat(sub)
+        rt.explain(f"Lambda union: {rt.n} transient + "
+                   f"{int(keep.sum())} persistent")
+        if batch is not None and q.sort_by is not None:
+            col = batch.col(q.sort_by)
+            keys = getattr(col, "values", None)
+            if keys is None:
+                keys = getattr(col, "millis", None)
+            order = np.argsort(keys, kind="stable")
+            if q.sort_desc:
+                order = order[::-1]
+            ids = ids[order]
+            batch = batch.take(order)
+        if q.max_features is not None:
+            ids = ids[:q.max_features]
+            if batch is not None:
+                batch = batch.take(np.arange(min(q.max_features, batch.n)))
+        return QueryResult(ids, batch, rt.explain, rt.plan)
+
+    def count(self, type_name: str) -> int:
+        q = Query(type_name)
+        return self.query(q).n

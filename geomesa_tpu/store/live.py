@@ -1,0 +1,183 @@
+"""Live streaming tier: the Kafka datastore analog.
+
+The reference's KafkaDataStore (kafka/data/KafkaDataStore.scala:44)
+streams feature mutations as GeoMessages (Create/Delete/Clear,
+kafka/utils/GeoMessage.scala:14) through topics; consumers materialize
+an in-memory queryable cache with live listeners. Here:
+
+- ``MessageBus`` is the in-process topic fabric (multiple stores attach
+  to the same bus: producers publish, consumer stores apply);
+- ``LiveDataStore`` maintains an append-buffer + tombstone view over the
+  in-memory device store, re-indexing in batches (the cache the
+  KafkaCacheLoader builds, kafka/data/KafkaDataStore.scala:68-84);
+- listeners receive feature events (KafkaFeatureEvent analog);
+- optional age-off expiry drops features older than a ttl at
+  maintenance time (AgeOffIterator analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.sft import SimpleFeatureType, parse_spec
+from ..index.api import Query
+from .memory import InMemoryDataStore, QueryResult
+
+__all__ = ["GeoMessage", "MessageBus", "LiveDataStore"]
+
+
+@dataclasses.dataclass
+class GeoMessage:
+    """A feature mutation on the bus (GeoMessage.scala:14)."""
+    kind: str                       # "create" | "delete" | "clear"
+    type_name: str
+    batch: FeatureBatch | None = None   # for create
+    ids: tuple = ()                 # for delete
+    timestamp_ms: int = 0
+
+
+class MessageBus:
+    """In-process pub/sub topics: the Kafka stand-in. Subscribers are
+    called synchronously on publish (tests and single-process pipelines;
+    a networked bus slots in behind the same interface)."""
+
+    def __init__(self):
+        self._subs: dict[str, list[Callable[[GeoMessage], None]]] = {}
+
+    def subscribe(self, topic: str, fn: Callable[[GeoMessage], None]):
+        self._subs.setdefault(topic, []).append(fn)
+
+    def publish(self, topic: str, msg: GeoMessage):
+        for fn in self._subs.get(topic, []):
+            fn(msg)
+
+
+class LiveDataStore:
+    """Streaming store over a MessageBus: publish mutations, query the
+    live cache."""
+
+    def __init__(self, bus: MessageBus | None = None,
+                 ttl_millis: int | None = None):
+        self.bus = bus or MessageBus()
+        self.ttl_millis = ttl_millis
+        self._mem = InMemoryDataStore()
+        self._listeners: dict[str, list[Callable[[GeoMessage], None]]] = {}
+        self._arrival_ms: dict[str, np.ndarray] = {}
+
+    # -- schema ------------------------------------------------------------
+
+    def create_schema(self, sft: SimpleFeatureType | str,
+                      spec: str | None = None):
+        if isinstance(sft, str):
+            sft = parse_spec(sft, spec or "")
+        self._mem.create_schema(sft)
+        self._arrival_ms[sft.type_name] = np.empty(0, dtype=np.int64)
+        self.bus.subscribe(sft.type_name, self._on_message)
+
+    def get_schema(self, type_name: str) -> SimpleFeatureType:
+        return self._mem.get_schema(type_name)
+
+    def get_type_names(self) -> list[str]:
+        return self._mem.get_type_names()
+
+    # -- producer side -----------------------------------------------------
+
+    def write(self, type_name: str, batch: FeatureBatch,
+              timestamp_ms: int | None = None):
+        ts = timestamp_ms if timestamp_ms is not None else int(time.time() * 1000)
+        self.bus.publish(type_name, GeoMessage("create", type_name, batch,
+                                               timestamp_ms=ts))
+
+    def write_dict(self, type_name: str, ids, data: dict[str, Any],
+                   timestamp_ms: int | None = None):
+        sft = self._mem.get_schema(type_name)
+        self.write(type_name, FeatureBatch.from_dict(sft, ids, data),
+                   timestamp_ms)
+
+    def delete(self, type_name: str, ids):
+        self.bus.publish(type_name, GeoMessage(
+            "delete", type_name, ids=tuple(map(str, ids)),
+            timestamp_ms=int(time.time() * 1000)))
+
+    def clear(self, type_name: str):
+        self.bus.publish(type_name, GeoMessage(
+            "clear", type_name, timestamp_ms=int(time.time() * 1000)))
+
+    # -- consumer side -----------------------------------------------------
+
+    def _on_message(self, msg: GeoMessage):
+        t = msg.type_name
+        if msg.kind == "create":
+            # upsert semantics: replace existing ids (the cache keeps the
+            # latest version of each feature, as the reference's does)
+            existing = self._mem._state(t)
+            incoming = set(msg.batch.ids.astype(str))
+            if existing.batch is not None and existing.n:
+                dup = np.isin(existing.batch.ids.astype(str), list(incoming))
+                if dup.any():
+                    self._mem.delete(t, existing.batch.ids[dup])
+                    self._arrival_ms[t] = self._arrival_ms[t][~dup]
+            self._mem.write(t, msg.batch)
+            self._arrival_ms[t] = np.concatenate([
+                self._arrival_ms[t],
+                np.full(msg.batch.n, msg.timestamp_ms, dtype=np.int64)])
+        elif msg.kind == "delete":
+            st = self._mem._state(t)
+            if st.batch is not None and st.n:
+                keep = ~np.isin(st.batch.ids.astype(str), list(msg.ids))
+                self._arrival_ms[t] = self._arrival_ms[t][keep]
+            self._mem.delete(t, msg.ids)
+        elif msg.kind == "clear":
+            sft = self._mem.get_schema(t)
+            self._mem.remove_schema(t)
+            self._mem.create_schema(sft)
+            self._arrival_ms[t] = np.empty(0, dtype=np.int64)
+        for fn in self._listeners.get(t, []):
+            fn(msg)
+
+    def add_listener(self, type_name: str, fn: Callable[[GeoMessage], None]):
+        self._listeners.setdefault(type_name, []).append(fn)
+
+    # -- maintenance -------------------------------------------------------
+
+    def expire(self, type_name: str, now_ms: int | None = None) -> int:
+        """Drop features older than the ttl; returns the dropped count."""
+        if self.ttl_millis is None:
+            return 0
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        st = self._mem._state(type_name)
+        if st.batch is None or st.n == 0:
+            return 0
+        old = self._arrival_ms[type_name] < now - self.ttl_millis
+        if not old.any():
+            return 0
+        ids = st.batch.ids[old]
+        self._arrival_ms[type_name] = self._arrival_ms[type_name][~old]
+        self._mem.delete(type_name, ids)
+        return int(old.sum())
+
+    def features_older_than(self, type_name: str, cutoff_ms: int):
+        """(ids, batch) of features that arrived before the cutoff — the
+        Lambda tier's persistence feed."""
+        st = self._mem._state(type_name)
+        if st.batch is None or st.n == 0:
+            return np.empty(0, object), None
+        old = self._arrival_ms[type_name] < cutoff_ms
+        idx = np.flatnonzero(old)
+        if not len(idx):
+            return np.empty(0, object), None
+        return st.batch.ids[idx], st.batch.take(idx)
+
+    # -- queries -----------------------------------------------------------
+
+    def query(self, q: Query | str, type_name: str | None = None,
+              explain_out=None) -> QueryResult:
+        return self._mem.query(q, type_name, explain_out=explain_out)
+
+    def count(self, type_name: str) -> int:
+        return self._mem.count(type_name)

@@ -67,13 +67,26 @@ class _TypeState:
         self.host_xhi: np.ndarray | None = None
         self.host_yhi: np.ndarray | None = None
         self.dirty = False
+        # per-feature visibility expressions (None = world-readable)
+        self.vis: np.ndarray = np.empty(0, dtype=object)
 
     @property
     def n(self) -> int:
         return 0 if self.batch is None else self.batch.n
 
-    def append(self, batch: FeatureBatch):
+    def append(self, batch: FeatureBatch, visibilities=None):
+        # validate everything BEFORE mutating: a failed write must not
+        # leave batch/vis misaligned
+        vis = (np.asarray(visibilities, dtype=object)
+               if visibilities is not None
+               else np.full(batch.n, None, dtype=object))
+        if len(vis) != batch.n:
+            raise ValueError("visibilities length mismatch")
+        from ..security import parse_visibility
+        for e in set(v for v in vis.tolist() if v):
+            parse_visibility(str(e))  # raises on malformed expressions
         self.batch = batch if self.batch is None else self.batch.concat(batch)
+        self.vis = np.concatenate([self.vis, vis])
         self.dirty = True
 
     def delete(self, ids: set[str]):
@@ -81,6 +94,7 @@ class _TypeState:
             return
         keep = ~np.isin(self.batch.ids.astype(str), list(ids))
         self.batch = self.batch.take(np.flatnonzero(keep))
+        self.vis = self.vis[keep]
         self.dirty = True
 
     def ensure_index(self):
@@ -115,9 +129,10 @@ class _TypeState:
 class InMemoryDataStore:
     """A GeoTools-DataStore-shaped API over device-resident batches."""
 
-    def __init__(self):
+    def __init__(self, audit=None):
         self._types: dict[str, _TypeState] = {}
         self.stats = DataStoreStats()
+        self.audit = audit  # AuditLogger or None
 
     # -- schema management (MetadataBackedDataStore surface) --------------
 
@@ -145,18 +160,20 @@ class InMemoryDataStore:
 
     # -- writes ------------------------------------------------------------
 
-    def write(self, type_name: str, batch: FeatureBatch):
+    def write(self, type_name: str, batch: FeatureBatch, visibilities=None):
         st = self._state(type_name)
         if batch.sft != st.sft:
             raise ValueError("batch schema does not match store schema")
-        st.append(batch)
+        st.append(batch, visibilities)
         # auto-maintained stats, the write-side StatsCombiner analog
         # (accumulo/data/stats/StatsCombiner.scala)
         self.stats.observe(st.sft, batch)
 
-    def write_dict(self, type_name: str, ids, data: dict[str, Any]):
+    def write_dict(self, type_name: str, ids, data: dict[str, Any],
+                   visibilities=None):
         st = self._state(type_name)
-        self.write(type_name, FeatureBatch.from_dict(st.sft, ids, data))
+        self.write(type_name, FeatureBatch.from_dict(st.sft, ids, data),
+                   visibilities)
 
     def delete(self, type_name: str, ids):
         self._state(type_name).delete(set(map(str, ids)))
@@ -281,10 +298,24 @@ class InMemoryDataStore:
             return QueryResult(np.empty(0, dtype=object), None, explain,
                                FilterStrategy("empty", None, None))
 
+        import time as _time
+        t_plan0 = _time.perf_counter()
         strategy = decide_strategy(st.sft, q, self._indices(st.sft), st.n,
                                    stats=self.stats.get(q.type_name),
                                    explain=explain)
+        t_plan = _time.perf_counter() - t_plan0
+        t_scan0 = _time.perf_counter()
         mask = self._execute(st, q, strategy, explain)
+
+        if q.auths is not None or (st.vis != None).any():  # noqa: E711
+            from ..security import evaluate_visibilities
+            auths = q.auths or []
+            # evaluate only the rows that survived the scan mask
+            hit = np.flatnonzero(mask)
+            vis_ok = evaluate_visibilities(st.vis[hit], auths)
+            mask = mask.copy()
+            mask[hit[~vis_ok]] = False
+            explain(f"Visibility filter applied ({len(auths)} auths)")
 
         idx = np.flatnonzero(mask)
         rate = q.hints.get(QueryHints.SAMPLING)
@@ -318,6 +349,11 @@ class InMemoryDataStore:
             batch = FeatureBatch(
                 _project_sft(st.sft, q.properties), batch.ids, cols)
         explain(f"Hits: {len(ids)}").pop()
+        if self.audit is not None:
+            self.audit.record(q.type_name, str(q.filter), q.hints,
+                              round(t_plan * 1000, 3),
+                              round((_time.perf_counter() - t_scan0) * 1000, 3),
+                              len(ids))
         return QueryResult(ids, batch, explain, strategy)
 
     def _execute(self, st: _TypeState, q: Query, strategy: FilterStrategy,

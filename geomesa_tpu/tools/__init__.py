@@ -1,0 +1,5 @@
+"""L9 CLI (geomesa-tools analog, SURVEY.md 2.4)."""
+
+from .cli import main
+
+__all__ = ["main"]

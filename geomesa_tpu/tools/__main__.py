@@ -1,0 +1,12 @@
+"""CLI entry: ``python -m geomesa_tpu.tools <command> ...``
+
+The geomesa-tools analog (tools/Runner.scala:21): schema management,
+ingest via converters, query/export, stats, explain — against a
+filesystem datastore rooted at ``--path``.
+"""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

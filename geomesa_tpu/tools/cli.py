@@ -1,0 +1,243 @@
+"""Command implementations for the geomesa-tpu CLI.
+
+Subcommands (mirroring the reference's tools/ command set):
+
+    create-schema   --path R --name T --spec S [--partition-scheme ...]
+    describe-schema --path R --name T
+    delete-schema   --path R --name T
+    list-schemas    --path R
+    ingest          --path R --name T --converter conf.json FILES...
+    export          --path R --name T [--cql F] [--format csv|geojson|bin]
+    count           --path R --name T [--cql F]
+    explain         --path R --name T --cql F
+    stats           --path R --name T --stat-spec 'MinMax(a)' [--cql F]
+    density         --path R --name T --bbox x1,y1,x2,y2 --size WxH [--cql F]
+    version / env
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+__all__ = ["main"]
+
+
+def _store(args):
+    from ..store import FileSystemDataStore
+    return FileSystemDataStore(args.path)
+
+
+def cmd_create_schema(args) -> int:
+    ds = _store(args)
+    scheme = None
+    if args.partition_scheme:
+        from ..store.partitions import scheme_from_config
+        scheme = scheme_from_config(json.loads(args.partition_scheme))
+    ds.create_schema(args.name, args.spec, scheme=scheme)
+    print(f"created schema {args.name!r}")
+    return 0
+
+
+def cmd_describe_schema(args) -> int:
+    sft = _store(args).get_schema(args.name)
+    print(f"{sft.type_name}:")
+    for a in sft.attributes:
+        flags = []
+        if a.default_geom:
+            flags.append("default-geom")
+        if a.indexed:
+            flags.append("indexed")
+        print(f"  {a.name}: {a.type}" + (f" ({', '.join(flags)})" if flags else ""))
+    if sft.user_data:
+        print("  user-data:", json.dumps(sft.user_data))
+    return 0
+
+
+def cmd_delete_schema(args) -> int:
+    import shutil
+    import os
+    ds = _store(args)
+    ds._state(args.name)  # validate
+    shutil.rmtree(os.path.join(args.path, args.name))
+    print(f"deleted schema {args.name!r}")
+    return 0
+
+
+def cmd_list_schemas(args) -> int:
+    for name in _store(args).get_type_names():
+        print(name)
+    return 0
+
+
+def cmd_ingest(args) -> int:
+    from ..convert import converter_for
+    ds = _store(args)
+    sft = ds.get_schema(args.name)
+    with open(args.converter) as fh:
+        conf = json.load(fh)
+    conv = converter_for(sft, conf)
+    total_ok = total_bad = 0
+    for path in args.files:
+        with open(path) as fh:
+            batch, ctx = conv.process(fh)
+        if batch.n:
+            ds.write(args.name, batch)
+        total_ok += ctx.success
+        total_bad += ctx.failure
+        print(f"{path}: ingested {ctx.success}, failed {ctx.failure}")
+    print(f"total: {total_ok} ingested, {total_bad} failed")
+    return 0 if total_bad == 0 else 1
+
+
+def _query(args):
+    from ..index.api import Query
+    ds = _store(args)
+    q = Query(args.name, args.cql or "INCLUDE")
+    if getattr(args, "max_features", None):
+        q.max_features = args.max_features
+    return ds, ds.query(q)
+
+
+def cmd_export(args) -> int:
+    ds, res = _query(args)
+    fmt = args.format
+    out = sys.stdout
+    if res.batch is None or res.n == 0:
+        print("0 features", file=sys.stderr)
+        return 0
+    if fmt == "csv":
+        names = [a.name for a in res.batch.sft.attributes]
+        out.write("id," + ",".join(names) + "\n")
+        for f in res.features():
+            out.write(",".join([str(f["id"])] + [
+                "" if f[n] is None else str(f[n]) for n in names]) + "\n")
+    elif fmt == "geojson":
+        from ..geometry import Point
+        feats = []
+        geom_field = res.batch.sft.geom_field
+        for f in res.features():
+            g = f.get(geom_field)
+            gj = None
+            if isinstance(g, Point):
+                gj = {"type": "Point", "coordinates": [g.x, g.y]}
+            elif g is not None:
+                gj = {"type": g.geom_type,
+                      "wkt": repr(g)}
+            props = {k: v for k, v in f.items()
+                     if k not in ("id", geom_field)}
+            feats.append({"type": "Feature", "id": f["id"],
+                          "geometry": gj, "properties": props})
+        json.dump({"type": "FeatureCollection", "features": feats}, out,
+                  default=str)
+        out.write("\n")
+    elif fmt == "bin":
+        mem = ds._load(ds._state(args.name),
+                       ds._files_for(ds._state(args.name), None))
+        data = mem.bin_query(args.name, args.cql or "INCLUDE")
+        sys.stdout.buffer.write(data)
+    else:
+        print(f"unknown format {fmt!r}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def cmd_count(args) -> int:
+    _, res = _query(args)
+    print(res.n)
+    return 0
+
+
+def cmd_explain(args) -> int:
+    from ..index.api import Query
+    ds = _store(args)
+    ds.query(Query(args.name, args.cql), explain_out=print)
+    return 0
+
+
+def cmd_stats(args) -> int:
+    ds = _store(args)
+    # load everything through the fs store's cache then run the sketch
+    mem = ds._load(ds._state(args.name),
+                   ds._files_for(ds._state(args.name), None))
+    stat = mem.stats_query(args.name, args.stat_spec, args.cql)
+    print(stat.to_json())
+    return 0
+
+
+def cmd_density(args) -> int:
+    ds = _store(args)
+    x1, y1, x2, y2 = (float(v) for v in args.bbox.split(","))
+    w, h = (int(v) for v in args.size.split("x"))
+    mem = ds._load(ds._state(args.name),
+                   ds._files_for(ds._state(args.name), None))
+    grid = mem.density(args.name, args.cql or "INCLUDE",
+                       (x1, y1, x2, y2), w, h)
+    json.dump({"width": w, "height": h, "bbox": [x1, y1, x2, y2],
+               "grid": grid.tolist()}, sys.stdout)
+    print()
+    return 0
+
+
+def cmd_version(args) -> int:
+    from .. import __version__
+    print(f"geomesa-tpu {__version__}")
+    return 0
+
+
+def cmd_env(args) -> int:
+    import jax
+    print(f"devices: {jax.devices()}")
+    print(f"backend: {jax.default_backend()}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="geomesa-tpu",
+                                description="TPU-native spatio-temporal "
+                                            "analytics CLI")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add(name, fn, *specs, needs_store=True):
+        sp = sub.add_parser(name)
+        if needs_store:
+            sp.add_argument("--path", required=True,
+                            help="datastore root directory")
+        for spec in specs:
+            sp.add_argument(*spec[0], **spec[1])
+        sp.set_defaults(fn=fn)
+        return sp
+
+    name_arg = (["--name"], {"required": True})
+    cql_arg = (["--cql"], {"default": None})
+
+    add("create-schema", cmd_create_schema, name_arg,
+        (["--spec"], {"required": True}),
+        (["--partition-scheme"], {"default": None,
+                                  "help": "scheme config JSON"}))
+    add("describe-schema", cmd_describe_schema, name_arg)
+    add("delete-schema", cmd_delete_schema, name_arg)
+    add("list-schemas", cmd_list_schemas)
+    add("ingest", cmd_ingest, name_arg,
+        (["--converter"], {"required": True}),
+        (["files"], {"nargs": "+"}))
+    add("export", cmd_export, name_arg, cql_arg,
+        (["--format"], {"default": "csv"}),
+        (["--max-features"], {"type": int, "default": None,
+                              "dest": "max_features"}))
+    add("count", cmd_count, name_arg, cql_arg)
+    add("explain", cmd_explain, name_arg,
+        (["--cql"], {"required": True}))
+    add("stats", cmd_stats, name_arg, cql_arg,
+        (["--stat-spec"], {"required": True}))
+    add("density", cmd_density, name_arg, cql_arg,
+        (["--bbox"], {"required": True}),
+        (["--size"], {"required": True}))
+    add("version", cmd_version, needs_store=False)
+    add("env", cmd_env, needs_store=False)
+
+    args = p.parse_args(argv)
+    return args.fn(args)

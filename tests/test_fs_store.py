@@ -1,0 +1,254 @@
+"""Filesystem / live / lambda store tests (geomesa-fs, geomesa-kafka,
+geomesa-lambda test intent)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.index.api import Query
+from geomesa_tpu.store import (CompositeScheme, DateTimeScheme,
+                               FileSystemDataStore, LambdaDataStore,
+                               LiveDataStore, MessageBus, Z2Scheme)
+from geomesa_tpu.store.lambda_store import (LAMBDA_QUERY_PERSISTENT,
+                                            LAMBDA_QUERY_TRANSIENT)
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+
+def write_sample(ds, n=5000, seed=0, type_name="events"):
+    rng = np.random.default_rng(seed)
+    ds.write_dict(type_name, [f"e{seed}_{i}" for i in range(n)], {
+        "kind": [f"k{i % 4}" for i in range(n)],
+        "dtg": rng.integers(MS("2017-01-01"), MS("2017-01-20"), n),
+        "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+    })
+
+
+class TestFsStore:
+    def test_write_query_roundtrip(self, tmp_path):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("events", "kind:String,dtg:Date,*geom:Point")
+        write_sample(ds)
+        assert ds.count("events") == 5000
+        res = ds.query("BBOX(geom, -50, -30, 50, 30) AND "
+                       "dtg DURING 2017-01-05T00:00:00Z/2017-01-10T00:00:00Z",
+                       "events")
+        assert res.n > 0
+        for f in list(res.features())[:5]:
+            assert -50 <= f["geom"].x <= 50
+
+    def test_datetime_partition_pruning(self, tmp_path):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("events", "kind:String,dtg:Date,*geom:Point",
+                         scheme=DateTimeScheme("daily"))
+        write_sample(ds)
+        parts = ds.partitions("events")
+        assert len(parts) == 19  # 19 days of data
+        assert parts[0] == "2017/01/01"
+        out = []
+        res = ds.query(Query(
+            "events",
+            "dtg DURING 2017-01-05T00:00:00Z/2017-01-07T00:00:00Z"),
+            explain_out=out.append)
+        txt = "\n".join(out)
+        assert "Partitions scanned: 3" in txt
+
+    def test_z2_partition_pruning(self, tmp_path):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("pts", "kind:String,dtg:Date,*geom:Point",
+                         scheme=Z2Scheme(2))
+        write_sample(ds, type_name="pts")
+        out = []
+        res = ds.query(Query("pts", "BBOX(geom, 100, 40, 110, 50)"),
+                       explain_out=out.append)
+        # brute-force correctness despite pruning
+        full = ds.query(Query("pts", "INCLUDE"))
+        batch = None
+        for f in []:
+            pass
+        x = np.array([f["geom"].x for f in full.features()])
+        y = np.array([f["geom"].y for f in full.features()])
+        expect = int(((x >= 100) & (x <= 110) & (y >= 40) & (y <= 50)).sum())
+        assert res.n == expect
+
+    def test_composite_scheme(self, tmp_path):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("c", "kind:String,dtg:Date,*geom:Point",
+                         scheme=CompositeScheme([DateTimeScheme("monthly"),
+                                                 Z2Scheme(1)]))
+        write_sample(ds, type_name="c", n=500)
+        parts = ds.partitions("c")
+        assert all("/" in p and len(p.split("/")) == 3 for p in parts)
+        res = ds.query("BBOX(geom, -10, -10, 10, 10)", "c")
+        assert res.n >= 0  # correctness checked below vs full scan
+        full = ds.query("INCLUDE", "c")
+        x = np.array([f["geom"].x for f in full.features()])
+        y = np.array([f["geom"].y for f in full.features()])
+        expect = int(((x >= -10) & (x <= 10) & (y >= -10) & (y <= 10)).sum())
+        assert res.n == expect
+
+    def test_reopen_from_disk(self, tmp_path):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("events", "kind:String,dtg:Date,*geom:Point")
+        write_sample(ds, n=100)
+        ds2 = FileSystemDataStore(str(tmp_path))
+        assert ds2.get_type_names() == ["events"]
+        assert ds2.count("events") == 100
+
+    def test_compact(self, tmp_path):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("events", "kind:String,dtg:Date,*geom:Point")
+        write_sample(ds, n=200, seed=1)
+        write_sample(ds, n=200, seed=2)
+        before = sum(len(ds._files_for(ds._state("events"), [p]))
+                     for p in ds.partitions("events"))
+        ds.compact("events")
+        after = sum(len(ds._files_for(ds._state("events"), [p]))
+                    for p in ds.partitions("events"))
+        assert after < before
+        assert ds.count("events") == 400
+
+
+class TestLiveStore:
+    def test_stream_and_query(self):
+        ds = LiveDataStore()
+        ds.create_schema("live", "kind:String,dtg:Date,*geom:Point")
+        write_sample(ds, n=1000, type_name="live")
+        assert ds.count("live") == 1000
+        res = ds.query("BBOX(geom, -90, -45, 90, 45)", "live")
+        assert 0 < res.n < 1000
+
+    def test_upsert_semantics(self):
+        ds = LiveDataStore()
+        ds.create_schema("u", "v:Integer,*geom:Point")
+        ds.write_dict("u", ["a"], {"v": [1], "geom": ([0.0], [0.0])})
+        ds.write_dict("u", ["a"], {"v": [2], "geom": ([1.0], [1.0])})
+        assert ds.count("u") == 1
+        f = next(ds.query("IN ('a')", "u").features())
+        assert f["v"] == 2
+
+    def test_delete_clear_listeners(self):
+        bus = MessageBus()
+        ds = LiveDataStore(bus)
+        ds.create_schema("l", "v:Integer,*geom:Point")
+        events = []
+        ds.add_listener("l", lambda m: events.append(m.kind))
+        ds.write_dict("l", ["x", "y"], {"v": [1, 2], "geom": ([0.0, 1.0], [0.0, 1.0])})
+        ds.delete("l", ["x"])
+        assert ds.count("l") == 1
+        ds.clear("l")
+        assert ds.count("l") == 0
+        assert events == ["create", "delete", "clear"]
+
+    def test_two_stores_one_bus(self):
+        bus = MessageBus()
+        producer = LiveDataStore(bus)
+        consumer = LiveDataStore(bus)
+        producer.create_schema("t", "v:Integer,*geom:Point")
+        consumer.create_schema("t", "v:Integer,*geom:Point")
+        producer.write_dict("t", ["m"], {"v": [7], "geom": ([2.0], [2.0])})
+        assert consumer.count("t") == 1
+
+    def test_expiry(self):
+        ds = LiveDataStore(ttl_millis=1000)
+        ds.create_schema("e", "v:Integer,*geom:Point")
+        ds.write_dict("e", ["old"], {"v": [1], "geom": ([0.0], [0.0])},
+                      timestamp_ms=1_000_000)
+        ds.write_dict("e", ["new"], {"v": [2], "geom": ([1.0], [1.0])},
+                      timestamp_ms=1_002_000)
+        dropped = ds.expire("e", now_ms=1_002_500)
+        assert dropped == 1
+        assert set(ds.query("INCLUDE", "e").ids.astype(str)) == {"new"}
+
+
+class TestLambdaStore:
+    def test_two_tier_union_and_persist(self):
+        ds = LambdaDataStore(persist_after_millis=1000)
+        ds.create_schema("lam", "v:Integer,dtg:Date,*geom:Point")
+        ds.write_dict("lam", ["a"], {"v": [1], "dtg": [MS("2017-01-01")],
+                                     "geom": ([0.0], [0.0])},
+                      timestamp_ms=1_000_000)
+        ds.write_dict("lam", ["b"], {"v": [2], "dtg": [MS("2017-01-02")],
+                                     "geom": ([1.0], [1.0])},
+                      timestamp_ms=1_005_000)
+        assert ds.count("lam") == 2
+        moved = ds.persist("lam", now_ms=1_004_000)
+        assert moved == 1  # only 'a' is old enough
+        # union still complete, each tier holds its part
+        assert ds.count("lam") == 2
+        rt = ds.query(Query("lam", "INCLUDE",
+                            hints={LAMBDA_QUERY_TRANSIENT: True}))
+        rp = ds.query(Query("lam", "INCLUDE",
+                            hints={LAMBDA_QUERY_PERSISTENT: True}))
+        assert set(rt.ids.astype(str)) == {"b"}
+        assert set(rp.ids.astype(str)) == {"a"}
+
+    def test_transient_wins_collisions(self):
+        ds = LambdaDataStore(persist_after_millis=10)
+        ds.create_schema("c", "v:Integer,*geom:Point")
+        ds.persistent.write_dict("c", ["x"], {"v": [1], "geom": ([0.0], [0.0])})
+        ds.write_dict("c", ["x"], {"v": [99], "geom": ([5.0], [5.0])})
+        res = ds.query("INCLUDE", "c")
+        assert res.n == 1
+        assert next(res.features())["v"] == 99
+
+
+class TestReviewRegressions:
+    def test_vis_length_mismatch_leaves_store_intact(self):
+        from geomesa_tpu.store import InMemoryDataStore
+        ds = InMemoryDataStore()
+        ds.create_schema("t", "v:Integer,*geom:Point")
+        with pytest.raises(ValueError):
+            ds.write_dict("t", ["a", "b"], {"v": [1, 2],
+                                            "geom": ([0.0, 1.0], [0.0, 1.0])},
+                          visibilities=["x"])
+        assert ds.count("t") == 0  # nothing half-written
+        ds.write_dict("t", ["a"], {"v": [1], "geom": ([0.0], [0.0])})
+        assert ds.query("INCLUDE", "t").n == 1
+
+    def test_malformed_visibility_rejected_at_write(self):
+        from geomesa_tpu.store import InMemoryDataStore
+        ds = InMemoryDataStore()
+        ds.create_schema("t", "v:Integer,*geom:Point")
+        with pytest.raises(ValueError):
+            ds.write_dict("t", ["a"], {"v": [1], "geom": ([0.0], [0.0])},
+                          visibilities=["admin&&bad"])
+        assert ds.count("t") == 0
+
+    def test_lambda_stale_persistent_version_hidden(self):
+        ds = LambdaDataStore(persist_after_millis=10)
+        ds.create_schema("s", "status:String,*geom:Point")
+        ds.persistent.write_dict("s", ["f1"], {"status": ["open"],
+                                               "geom": ([0.0], [0.0])})
+        # current version in transient no longer matches 'open'
+        ds.write_dict("s", ["f1"], {"status": ["closed"],
+                                    "geom": ([0.0], [0.0])})
+        res = ds.query("status = 'open'", "s")
+        assert res.n == 0
+
+    def test_lambda_union_sort_and_limit(self):
+        ds = LambdaDataStore(persist_after_millis=10)
+        ds.create_schema("s2", "v:Integer,*geom:Point")
+        ds.persistent.write_dict("s2", ["p1", "p2"], {
+            "v": [5, 1], "geom": ([0.0, 1.0], [0.0, 1.0])})
+        ds.write_dict("s2", ["t1", "t2"], {
+            "v": [3, 9], "geom": ([2.0, 3.0], [2.0, 3.0])})
+        res = ds.query(Query("s2", "INCLUDE", sort_by="v", max_features=3))
+        vals = [f["v"] for f in res.features()]
+        assert vals == [1, 3, 5]
+
+    def test_json_bad_record_counts_as_failure(self):
+        import json as _json
+        from geomesa_tpu.convert import converter_for
+        from geomesa_tpu.features import parse_spec
+        sft = parse_spec("j", "v:Integer,*geom:Point")
+        conv = converter_for(sft, {
+            "type": "json", "id-field": "md5($0)",
+            "fields": [
+                {"name": "v", "path": "$.items.2"},
+                {"name": "geom", "transform": "point(0.0::double, 0.0::double)"},
+            ],
+        })
+        lines = "\n".join([_json.dumps({"items": [1, 2, 3]}),
+                           _json.dumps({"items": [1]})])
+        batch, ctx = conv.process(lines)
+        assert ctx.success >= 1

@@ -1,0 +1,213 @@
+"""Converter DSL, CLI, security, audit, metrics tests (L8/L9/LX)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert import (EvaluationContext, compile_expression,
+                                 converter_for)
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.index.api import Query
+from geomesa_tpu.audit import AuditLogger
+from geomesa_tpu.metrics import MetricsRegistry
+from geomesa_tpu.security import evaluate_visibilities, parse_visibility
+from geomesa_tpu.store import InMemoryDataStore
+from geomesa_tpu.tools.cli import main as cli_main
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+
+class TestExpressionDsl:
+    def test_columns_and_casts(self):
+        f = compile_expression("$2::int")
+        assert f(["raw", "a", "42"]) == 42
+        assert compile_expression("$1::double")(["r", "3.5"]) == 3.5
+
+    def test_functions(self):
+        assert compile_expression("concat($1, '-', $2)")(["r", "a", "b"]) == "a-b"
+        assert compile_expression("trim(lowercase($1))")(["r", "  ABC "]) == "abc"
+        assert compile_expression(
+            "regexReplace('a+', 'X', $1)")(["r", "baaanana"]) == "bXnXnX"[0:6]
+
+    def test_dates(self):
+        ms = compile_expression("isoDate($1)")(["r", "2017-03-01T12:00:00Z"])
+        assert ms == MS("2017-03-01T12:00:00")
+        ms2 = compile_expression("date('yyyy-MM-dd HH:mm:ss', $1)")(
+            ["r", "2017-03-01 12:00:00"])
+        assert ms2 == ms
+
+    def test_geometry(self):
+        p = compile_expression("point($1::double, $2::double)")(["r", "1", "2"])
+        assert (p.x, p.y) == (1.0, 2.0)
+        g = compile_expression("geometry($1)")(["r", "POINT (3 4)"])
+        assert (g.x, g.y) == (3.0, 4.0)
+
+    def test_try_fallback(self):
+        f = compile_expression("try($1::int, -1)")
+        assert f(["r", "5"]) == 5
+        assert f(["r", "oops"]) == -1
+
+    def test_md5_stable(self):
+        f = compile_expression("md5($0)")
+        assert f(["abc"]) == f(["abc"])
+
+
+class TestConverters:
+    SFT = parse_spec("gdelt", "name:String,count:Integer,dtg:Date,*geom:Point")
+    CONF = {
+        "type": "delimited-text", "format": "CSV",
+        "id-field": "md5($0)",
+        "fields": [
+            {"name": "name", "transform": "trim($1)"},
+            {"name": "count", "transform": "try($2::int, 0)"},
+            {"name": "dtg", "transform": "isoDate($3)"},
+            {"name": "geom", "transform": "point($4::double, $5::double)"},
+        ],
+    }
+
+    def test_csv_conversion(self):
+        conv = converter_for(self.SFT, self.CONF)
+        csv_data = ("alpha,5,2017-01-01T00:00:00Z,-75.1,38.2\n"
+                    "beta,bad,2017-01-02T00:00:00Z,10.0,20.0\n"
+                    "gamma,7,not-a-date,1.0,2.0\n")
+        batch, ctx = conv.process(csv_data)
+        assert ctx.success == 2 and ctx.failure == 1  # bad date fails
+        f = batch.feature(0)
+        assert f["name"] == "alpha" and f["count"] == 5
+        assert batch.feature(1)["count"] == 0  # try() fallback
+
+    def test_json_conversion(self):
+        # extra path-only entries bind columns ($5 = lat) without being
+        # schema attributes — the declared-paths-in-order contract
+        sft = parse_spec("j", "name:String,count:Integer,dtg:Date,*geom:Point")
+        conv = converter_for(sft, {
+            "type": "json", "id-field": "md5($0)",
+            "fields": [
+                {"name": "name", "path": "$.props.name"},
+                {"name": "count", "path": "$.props.n"},
+                {"name": "dtg", "path": "$.time", "transform": "isoDate($3)"},
+                {"name": "geom", "path": "$.lon",
+                 "transform": "point($4::double, $5::double)"},
+                {"path": "$.lat"},
+            ],
+        })
+        lines = "\n".join(json.dumps(o) for o in [
+            {"props": {"name": "a", "n": 1}, "time": "2017-01-01T00:00:00",
+             "lon": 1.5, "lat": 2.5},
+            {"props": {"name": "b", "n": 2}, "time": "2017-01-02T00:00:00",
+             "lon": 3.5, "lat": 4.5},
+        ])
+        batch, ctx = conv.process(lines)
+        assert ctx.success == 2
+        assert batch.feature(0)["name"] == "a"
+        assert batch.feature(1)["geom"].x == 3.5
+
+
+class TestVisibility:
+    def test_parse_and_eval(self):
+        e = parse_visibility("admin&(user|ops)")
+        assert e.evaluate({"admin", "user"})
+        assert e.evaluate({"admin", "ops"})
+        assert not e.evaluate({"admin"})
+        assert not e.evaluate({"user", "ops"})
+
+    def test_mixing_requires_parens(self):
+        with pytest.raises(ValueError):
+            parse_visibility("a&b|c")
+
+    def test_quoted_terms(self):
+        e = parse_visibility('"a b"&c')
+        assert e.evaluate({"a b", "c"})
+
+    def test_store_integration(self):
+        ds = InMemoryDataStore()
+        ds.create_schema("s", "v:Integer,*geom:Point")
+        ds.write_dict("s", ["open", "secret"], {
+            "v": [1, 2], "geom": ([0.0, 1.0], [0.0, 1.0])},
+            visibilities=[None, "admin"])
+        public = ds.query(Query("s", "INCLUDE", auths=[]))
+        assert set(public.ids.astype(str)) == {"open"}
+        admin = ds.query(Query("s", "INCLUDE", auths=["admin"]))
+        assert set(admin.ids.astype(str)) == {"open", "secret"}
+        # no auths arg at all: same as empty auths when vis present
+        none = ds.query(Query("s", "INCLUDE"))
+        assert set(none.ids.astype(str)) == {"open"}
+
+
+class TestAuditMetrics:
+    def test_audit_records_queries(self):
+        ds = InMemoryDataStore(audit=AuditLogger())
+        ds.create_schema("a", "v:Integer,*geom:Point")
+        ds.write_dict("a", ["x"], {"v": [1], "geom": ([0.0], [0.0])})
+        ds.query("BBOX(geom, -1, -1, 1, 1)", "a")
+        ds.query("v = 1", "a")
+        events = ds.audit.query("a")
+        assert len(events) == 2
+        assert events[0].hits == 1
+        assert "BBOX" in events[0].filter
+        assert events[0].scan_time_ms >= 0
+
+    def test_metrics_registry(self, tmp_path):
+        m = MetricsRegistry()
+        m.counter("queries")
+        m.counter("queries", 2)
+        with m.time("scan"):
+            pass
+        m.gauge("features", 100)
+        snap = m.snapshot()
+        assert snap["counters"]["queries"] == 3
+        assert snap["timers"]["scan"]["count"] == 1
+        path = str(tmp_path / "metrics.tsv")
+        m.report_delimited(path)
+        assert "queries" in open(path).read()
+
+
+class TestCli:
+    def _setup(self, tmp_path):
+        root = str(tmp_path / "store")
+        rc = cli_main(["create-schema", "--path", root, "--name", "t",
+                       "--spec", "name:String,count:Integer,dtg:Date,*geom:Point"])
+        assert rc == 0
+        conf = tmp_path / "conv.json"
+        conf.write_text(json.dumps(TestConverters.CONF))
+        data = tmp_path / "data.csv"
+        data.write_text("alpha,5,2017-01-01T00:00:00Z,-75.1,38.2\n"
+                        "beta,6,2017-01-02T00:00:00Z,10.0,20.0\n")
+        rc = cli_main(["ingest", "--path", root, "--name", "t",
+                       "--converter", str(conf), str(data)])
+        assert rc == 0
+        return root
+
+    def test_full_workflow(self, tmp_path, capsys):
+        root = self._setup(tmp_path)
+        rc = cli_main(["count", "--path", root, "--name", "t"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.strip().endswith("2")
+        rc = cli_main(["export", "--path", root, "--name", "t",
+                       "--cql", "count = 5", "--format", "csv"])
+        out = capsys.readouterr().out
+        assert "alpha" in out and "beta" not in out
+        rc = cli_main(["describe-schema", "--path", root, "--name", "t"])
+        out = capsys.readouterr().out
+        assert "geom: Point (default-geom)" in out
+        rc = cli_main(["stats", "--path", root, "--name", "t",
+                       "--stat-spec", "MinMax(count)"])
+        out = capsys.readouterr().out
+        assert json.loads(out)["min"] == 5
+        rc = cli_main(["explain", "--path", root, "--name", "t",
+                       "--cql", "BBOX(geom, -80, 30, -70, 40)"])
+        out = capsys.readouterr().out
+        assert "Selected" in out
+
+    def test_geojson_export(self, tmp_path, capsys):
+        root = self._setup(tmp_path)
+        capsys.readouterr()  # drain setup output
+        rc = cli_main(["export", "--path", root, "--name", "t",
+                       "--format", "geojson"])
+        out = capsys.readouterr().out
+        fc = json.loads(out)
+        assert fc["type"] == "FeatureCollection"
+        assert len(fc["features"]) == 2
+        assert fc["features"][0]["geometry"]["type"] == "Point"
