@@ -1,0 +1,478 @@
+"""SFB (simple-feature-binary) codec: versioned row serialization with a
+per-row offset table for lazy attribute access.
+
+This is the analog of the reference's Kryo feature serializer
+(geomesa-features/.../kryo/KryoFeatureSerializer.scala:19) and its lazy
+buffer feature (kryo/KryoBufferSimpleFeature.scala — attribute offsets
+array + ``setBuffer``): a serialized row can serve a single attribute
+read without decoding the rest. Batch encode/decode is the host-side
+hot path and runs in C++ (native/src/feature_codec.cpp) when the
+toolchain is available, with a numpy/python fallback.
+
+Row layout (little-endian, version 1) — see feature_codec.cpp header.
+
+Wire encodings per SFT type:
+  Integer        i32            Float          f32
+  Long           i64            Double         f64
+  Boolean        u8             Date           i64 epoch-millis
+  Point          f64 x, f64 y   String/UUID    utf-8 bytes
+  Bytes          raw bytes      other geometry WKB
+  List/Map       recursive (count + elements), single-feature API only
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import struct
+from typing import Any
+
+import numpy as np
+
+from ..geometry import Geometry, Point
+from ..geometry.wkb import from_wkb, to_wkb
+from .batch import (BoolColumn, DateColumn, FeatureBatch, GeometryColumn,
+                    NumericColumn, PointColumn, StringColumn)
+from .sft import SimpleFeatureType
+
+__all__ = ["FeatureCodec", "EncodedBatch", "LazyFeature"]
+
+_FIXED_WIDTH = {"Integer": 4, "Long": 8, "Float": 4, "Double": 8,
+                "Boolean": 1, "Date": 8, "Point": 16}
+_FIXED_DTYPE = {"Integer": "<i4", "Long": "<i8", "Float": "<f4",
+                "Double": "<f8", "Boolean": "u1", "Date": "<i8"}
+
+
+@dataclasses.dataclass
+class EncodedBatch:
+    """A batch of SFB rows: one contiguous blob + row offsets + ids."""
+    blob: bytes
+    row_offsets: np.ndarray   # int64[n+1]
+    ids: np.ndarray           # object[n]
+
+    @property
+    def n(self) -> int:
+        return len(self.row_offsets) - 1
+
+    def row(self, i: int) -> bytes:
+        return self.blob[self.row_offsets[i]:self.row_offsets[i + 1]]
+
+
+def _cell_inputs(codec: "FeatureCodec", batch: FeatureBatch):
+    """Normalize columns into (kind, width, fixed_bytes, var_bytes,
+    var_offsets, valid) per attribute."""
+    out = []
+    for a in codec.sft.attributes:
+        col = batch.columns[a.name]
+        t = a.type.name
+        valid = np.ascontiguousarray(col.valid, dtype=np.uint8)
+        if t == "Point":
+            assert isinstance(col, PointColumn)
+            xy = np.empty((col.n, 2), dtype="<f8")
+            xy[:, 0] = col.x
+            xy[:, 1] = col.y
+            out.append((0, 16, np.ascontiguousarray(xy).view(np.uint8),
+                        None, None, valid))
+        elif t in _FIXED_DTYPE:
+            if isinstance(col, DateColumn):
+                vals = col.millis
+            else:
+                vals = col.values  # type: ignore[union-attr]
+            arr = np.ascontiguousarray(vals.astype(_FIXED_DTYPE[t]))
+            out.append((0, _FIXED_WIDTH[t], arr.view(np.uint8).reshape(col.n, -1),
+                        None, None, valid))
+        elif t in ("String", "UUID"):
+            assert isinstance(col, StringColumn)
+            vocab_bytes = [s.encode("utf-8") for s in col.vocab]
+            lens = np.array([len(b) for b in vocab_bytes], dtype=np.int64)
+            row_lens = np.where(col.codes >= 0, lens[np.maximum(col.codes, 0)], 0)
+            offsets = np.zeros(col.n + 1, dtype=np.int64)
+            np.cumsum(row_lens, out=offsets[1:])
+            buf = bytearray(int(offsets[-1]))
+            for i, c in enumerate(col.codes):
+                if c >= 0:
+                    buf[offsets[i]:offsets[i + 1]] = vocab_bytes[c]
+            out.append((1, 0, None, np.frombuffer(bytes(buf), dtype=np.uint8),
+                        offsets, valid))
+        else:  # geometry (non-point) / Bytes
+            if isinstance(col, GeometryColumn):
+                cells = [to_wkb(g) if g is not None else b"" for g in col.geoms]
+            else:
+                cells = [bytes(v) if v is not None else b""
+                         for v in (col.value(i) for i in range(col.n))]
+            lens = np.array([len(b) for b in cells], dtype=np.int64)
+            offsets = np.zeros(len(cells) + 1, dtype=np.int64)
+            np.cumsum(lens, out=offsets[1:])
+            out.append((1, 0, None,
+                        np.frombuffer(b"".join(cells), dtype=np.uint8)
+                        if offsets[-1] else np.empty(0, dtype=np.uint8),
+                        offsets, valid))
+    return out
+
+
+class FeatureCodec:
+    """Batch-oriented SFB serializer for one SimpleFeatureType."""
+
+    def __init__(self, sft: SimpleFeatureType, use_native: bool = True):
+        self.sft = sft
+        self.n_attrs = len(sft.attributes)
+        self._bitmap_len = (self.n_attrs + 7) // 8
+        self._header = 1 + self._bitmap_len + 4 * self.n_attrs
+        self._lib = None
+        if use_native:
+            from .. import native
+            self._lib = native.load()
+
+    # -- batch encode -----------------------------------------------------
+
+    def encode_batch(self, batch: FeatureBatch) -> EncodedBatch:
+        cells = _cell_inputs(self, batch)
+        n = batch.n
+        if self._lib is not None and n > 0:
+            enc = self._encode_native(cells, n)
+        else:
+            enc = self._encode_python(cells, n)
+        blob, row_offsets = enc
+        return EncodedBatch(blob, row_offsets, np.asarray(batch.ids, dtype=object))
+
+    def _encode_native(self, cells, n):
+        lib = self._lib
+        na = self.n_attrs
+        kinds = np.array([c[0] for c in cells], dtype=np.uint8)
+        widths = np.array([c[1] for c in cells], dtype=np.int32)
+        PP = ctypes.POINTER(ctypes.c_uint8) * na
+        LP = ctypes.POINTER(ctypes.c_int64) * na
+
+        def u8p(a):
+            return (a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+                    if a is not None else None)
+
+        fixed = PP(*[u8p(c[2]) for c in cells])
+        var = PP(*[u8p(c[3]) for c in cells])
+        voff = LP(*[(c[4].ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+                     if c[4] is not None else None) for c in cells])
+        valids = PP(*[u8p(c[5]) for c in cells])
+
+        lib.sfb_encoded_size.restype = ctypes.c_int64
+        size = lib.sfb_encoded_size(
+            ctypes.c_int32(n), ctypes.c_int32(na),
+            kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            widths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), voff, valids)
+        out = np.zeros(int(size), dtype=np.uint8)
+        row_offsets = np.zeros(n + 1, dtype=np.int64)
+        lib.sfb_encode_batch.restype = ctypes.c_int64
+        written = lib.sfb_encode_batch(
+            ctypes.c_int32(n), ctypes.c_int32(na),
+            kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            widths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            fixed, var, voff, valids,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(size),
+            row_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if written != size:
+            raise RuntimeError(f"native encode wrote {written}, expected {size}")
+        return out.tobytes(), row_offsets
+
+    def _encode_python(self, cells, n):
+        rows = []
+        pos = 0
+        row_offsets = np.zeros(n + 1, dtype=np.int64)
+        for r in range(n):
+            bm = bytearray(self._bitmap_len)
+            offs = np.zeros(self.n_attrs, dtype="<u4")
+            payload = bytearray()
+            for a, (kind, width, fixed, var, voff, valid) in enumerate(cells):
+                offs[a] = len(payload)
+                if not valid[r]:
+                    continue
+                bm[a >> 3] |= 1 << (a & 7)
+                if kind == 0:
+                    payload += fixed[r * width:(r + 1) * width].tobytes() \
+                        if fixed.ndim == 1 else fixed[r].tobytes()
+                else:
+                    payload += var[voff[r]:voff[r + 1]].tobytes()
+            row = b"\x01" + bytes(bm) + offs.tobytes() + bytes(payload)
+            rows.append(row)
+            pos += len(row)
+            row_offsets[r + 1] = pos
+        return b"".join(rows), row_offsets
+
+    # -- batch decode -----------------------------------------------------
+
+    def decode_batch(self, enc: EncodedBatch) -> FeatureBatch:
+        cols: dict[str, Any] = {}
+        for a in self.sft.attributes:
+            cols[a.name] = self.decode_attribute(enc, a.name)
+        return FeatureBatch(self.sft, enc.ids, cols)
+
+    def decode_attribute(self, enc: EncodedBatch, name: str):
+        """Lazily extract ONE attribute column from the blob."""
+        attr = self.sft.index_of(name)
+        spec = self.sft.attributes[attr]
+        t = spec.type.name
+        n = enc.n
+        blob = np.frombuffer(enc.blob, dtype=np.uint8)
+        if t in _FIXED_WIDTH:
+            width = _FIXED_WIDTH[t]
+            vals = np.zeros(n * width, dtype=np.uint8)
+            valid = np.zeros(n, dtype=np.uint8)
+            if self._lib is not None and n > 0:
+                self._lib.sfb_decode_fixed.restype = ctypes.c_int64
+                rc = self._lib.sfb_decode_fixed(
+                    blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    enc.row_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    ctypes.c_int32(n), ctypes.c_int32(self.n_attrs),
+                    ctypes.c_int32(attr), ctypes.c_int32(width),
+                    vals.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+                if rc != n:
+                    raise ValueError("corrupt SFB blob (width mismatch)")
+            else:
+                self._decode_fixed_py(blob, enc.row_offsets, attr, width,
+                                      vals, valid)
+            vmask = valid.astype(bool)
+            if t == "Point":
+                xy = vals.view("<f8").reshape(n, 2)
+                x = np.where(vmask, xy[:, 0], np.nan)
+                y = np.where(vmask, xy[:, 1], np.nan)
+                return PointColumn(name, x, y, vmask)
+            arr = vals.view(_FIXED_DTYPE[t]).copy()
+            if t == "Date":
+                return DateColumn(name, arr.astype(np.int64), vmask)
+            if t == "Boolean":
+                return BoolColumn(name, arr.astype(bool), vmask)
+            if t in ("Double", "Float"):
+                return NumericColumn(name, arr.astype(np.float64), vmask)
+            return NumericColumn(name, arr.astype(np.int64), vmask)
+        # var-width
+        cells, vmask = self._decode_var(blob, enc.row_offsets, attr)
+        if t in ("String", "UUID"):
+            vals = [c.tobytes().decode("utf-8") if v else None
+                    for c, v in zip(cells, vmask)]
+            return StringColumn.from_strings(name, vals)
+        if t == "Bytes":
+            lst = [c.tobytes() if v else None for c, v in zip(cells, vmask)]
+            return _BytesColumn(name, lst)
+        geoms = [from_wkb(c.tobytes()) if v else None
+                 for c, v in zip(cells, vmask)]
+        return GeometryColumn.from_geoms(name, geoms)
+
+    def _decode_fixed_py(self, blob, row_offsets, attr, width, vals, valid):
+        n = len(row_offsets) - 1
+        for r in range(n):
+            base = int(row_offsets[r])
+            s, e, ok, pstart = self._cell_span(blob, base,
+                                               int(row_offsets[r + 1]), attr)
+            valid[r] = 1 if ok else 0
+            if ok:
+                if e - s != width:
+                    raise ValueError("corrupt SFB blob (width mismatch)")
+                vals[r * width:(r + 1) * width] = blob[pstart + s:pstart + e]
+
+    def _cell_span(self, blob, base, end, attr):
+        bm = blob[base + 1:base + 1 + self._bitmap_len]
+        ok = bool((bm[attr >> 3] >> (attr & 7)) & 1)
+        offs = blob[base + 1 + self._bitmap_len:base + self._header].view("<u4")
+        s = int(offs[attr])
+        e = int(offs[attr + 1]) if attr + 1 < self.n_attrs \
+            else end - base - self._header
+        return s, e, ok, base + self._header
+
+    def _decode_var(self, blob, row_offsets, attr):
+        n = len(row_offsets) - 1
+        if self._lib is not None and n > 0:
+            lens = np.zeros(n, dtype=np.int64)
+            valid = np.zeros(n, dtype=np.uint8)
+            self._lib.sfb_decode_varlen_sizes.restype = ctypes.c_int64
+            total = self._lib.sfb_decode_varlen_sizes(
+                blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                row_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                ctypes.c_int32(n), ctypes.c_int32(self.n_attrs),
+                ctypes.c_int32(attr),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+            offsets = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum(lens, out=offsets[1:])
+            out = np.zeros(int(total), dtype=np.uint8)
+            self._lib.sfb_decode_varlen.restype = ctypes.c_int64
+            self._lib.sfb_decode_varlen(
+                blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                row_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                ctypes.c_int32(n), ctypes.c_int32(self.n_attrs),
+                ctypes.c_int32(attr),
+                offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+            cells = [out[offsets[r]:offsets[r + 1]] for r in range(n)]
+            return cells, valid.astype(bool)
+        cells, valid = [], np.zeros(n, dtype=bool)
+        for r in range(n):
+            base = int(row_offsets[r])
+            s, e, ok, pstart = self._cell_span(blob, base,
+                                               int(row_offsets[r + 1]), attr)
+            valid[r] = ok
+            cells.append(blob[pstart + s:pstart + e] if ok
+                         else np.empty(0, dtype=np.uint8))
+        return cells, valid
+
+    # -- single features ----------------------------------------------------
+
+    def serialize(self, values: dict[str, Any]) -> bytes:
+        """Serialize one feature (dict of attribute values) to SFB bytes."""
+        bm = bytearray(self._bitmap_len)
+        offs = np.zeros(self.n_attrs, dtype="<u4")
+        payload = bytearray()
+        for a, spec in enumerate(self.sft.attributes):
+            offs[a] = len(payload)
+            v = values.get(spec.name)
+            if v is None:
+                continue
+            bm[a >> 3] |= 1 << (a & 7)
+            payload += _encode_value(spec.type, v)
+        return b"\x01" + bytes(bm) + offs.tobytes() + bytes(payload)
+
+    def deserialize(self, buf: bytes) -> "LazyFeature":
+        return LazyFeature(self, buf)
+
+
+@dataclasses.dataclass
+class _BytesColumn:
+    """Object column of raw bytes values (Bytes attribute type)."""
+    name: str
+    data: list
+
+    @property
+    def n(self) -> int:
+        return len(self.data)
+
+    @property
+    def valid(self) -> np.ndarray:
+        return np.array([v is not None for v in self.data])
+
+    def take(self, idx):
+        idx = np.asarray(idx)
+        if idx.dtype == bool:
+            idx = np.flatnonzero(idx)
+        return _BytesColumn(self.name, [self.data[i] for i in idx])
+
+    def value(self, i: int):
+        return self.data[i]
+
+
+def _encode_value(atype, v) -> bytes:
+    t = atype.name
+    if t == "Integer":
+        return struct.pack("<i", int(v))
+    if t in ("Long", "Date"):
+        return struct.pack("<q", int(v))
+    if t == "Float":
+        return struct.pack("<f", float(v))
+    if t == "Double":
+        return struct.pack("<d", float(v))
+    if t == "Boolean":
+        return struct.pack("B", 1 if v else 0)
+    if t in ("String", "UUID"):
+        return str(v).encode("utf-8")
+    if t == "Bytes":
+        return bytes(v)
+    if t == "Point":
+        if isinstance(v, Point):
+            return struct.pack("<dd", v.x, v.y)
+        return struct.pack("<dd", float(v[0]), float(v[1]))
+    if t == "List":
+        elems = [_encode_value(_elem_type(atype.value_type), e) for e in v]
+        return struct.pack("<I", len(elems)) + b"".join(
+            struct.pack("<I", len(e)) + e for e in elems)
+    if t == "Map":
+        items = list(v.items())
+        out = [struct.pack("<I", len(items))]
+        for k, val in items:
+            ke = _encode_value(_elem_type(atype.key_type), k)
+            ve = _encode_value(_elem_type(atype.value_type), val)
+            out.append(struct.pack("<I", len(ke)) + ke)
+            out.append(struct.pack("<I", len(ve)) + ve)
+        return b"".join(out)
+    if isinstance(v, Geometry):
+        return to_wkb(v)
+    raise TypeError(f"cannot encode {t}")
+
+
+def _decode_value(atype, buf: bytes):
+    t = atype.name
+    if t == "Integer":
+        return struct.unpack("<i", buf)[0]
+    if t in ("Long", "Date"):
+        return struct.unpack("<q", buf)[0]
+    if t == "Float":
+        return struct.unpack("<f", buf)[0]
+    if t == "Double":
+        return struct.unpack("<d", buf)[0]
+    if t == "Boolean":
+        return bool(buf[0])
+    if t in ("String", "UUID"):
+        return buf.decode("utf-8")
+    if t == "Bytes":
+        return bytes(buf)
+    if t == "Point":
+        return Point(*struct.unpack("<dd", buf))
+    if t == "List":
+        n = struct.unpack_from("<I", buf, 0)[0]
+        pos, out = 4, []
+        et = _elem_type(atype.value_type)
+        for _ in range(n):
+            ln = struct.unpack_from("<I", buf, pos)[0]
+            out.append(_decode_value(et, buf[pos + 4:pos + 4 + ln]))
+            pos += 4 + ln
+        return out
+    if t == "Map":
+        n = struct.unpack_from("<I", buf, 0)[0]
+        pos, out = 4, {}
+        kt, vt = _elem_type(atype.key_type), _elem_type(atype.value_type)
+        for _ in range(n):
+            ln = struct.unpack_from("<I", buf, pos)[0]
+            k = _decode_value(kt, buf[pos + 4:pos + 4 + ln])
+            pos += 4 + ln
+            ln = struct.unpack_from("<I", buf, pos)[0]
+            out[k] = _decode_value(vt, buf[pos + 4:pos + 4 + ln])
+            pos += 4 + ln
+        return out
+    return from_wkb(bytes(buf))
+
+
+class _ET:
+    def __init__(self, name):
+        self.name = name
+
+
+def _elem_type(name: str):
+    return _ET(name)
+
+
+class LazyFeature:
+    """Offset-table view over one SFB row: attribute reads decode only
+    the requested cell (KryoBufferSimpleFeature.scala semantics)."""
+
+    def __init__(self, codec: FeatureCodec, buf: bytes):
+        if not buf or buf[0] != 1:
+            raise ValueError("bad SFB version")
+        self._codec = codec
+        self._buf = buf
+
+    def get(self, i: int):
+        codec = self._codec
+        bm = self._buf[1:1 + codec._bitmap_len]
+        if not (bm[i >> 3] >> (i & 7)) & 1:
+            return None
+        offs = np.frombuffer(self._buf, dtype="<u4", count=codec.n_attrs,
+                             offset=1 + codec._bitmap_len)
+        start = codec._header + int(offs[i])
+        end = (codec._header + int(offs[i + 1]) if i + 1 < codec.n_attrs
+               else len(self._buf))
+        return _decode_value(codec.sft.attributes[i].type,
+                             self._buf[start:end])
+
+    def get_by_name(self, name: str):
+        return self.get(self._codec.sft.index_of(name))
+
+    def as_dict(self) -> dict[str, Any]:
+        return {a.name: self.get(i)
+                for i, a in enumerate(self._codec.sft.attributes)}

@@ -1,0 +1,139 @@
+"""SFB codec + WKB tests: native/python parity, lazy access, roundtrip.
+
+Mirrors the reference's serializer test style
+(geomesa-features/.../kryo/KryoFeatureSerializerTest.scala): roundtrip
+every type, nulls, lazy single-attribute reads.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.codec import EncodedBatch, FeatureCodec, LazyFeature
+from geomesa_tpu.features.sft import parse_spec
+from geomesa_tpu.geometry import LineString, Point, Polygon, parse_wkt
+from geomesa_tpu.geometry.wkb import from_wkb, to_wkb
+
+SPEC = ("name:String,age:Integer,weight:Double,seen:Long,ok:Boolean,"
+        "dtg:Date,*geom:Point:srid=4326")
+
+
+def make_batch(n=10, seed=0):
+    rng = np.random.default_rng(seed)
+    sft = parse_spec("t", SPEC)
+    names = [None if i % 4 == 3 else f"name{i % 3}" for i in range(n)]
+    return sft, FeatureBatch.from_dict(
+        sft, [f"fid{i}" for i in range(n)],
+        {"name": names,
+         "age": list(range(n)),
+         "weight": rng.uniform(0, 100, n),
+         "seen": rng.integers(0, 2**40, n),
+         "ok": [bool(i % 2) for i in range(n)],
+         "dtg": rng.integers(0, 10**12, n),
+         "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n))})
+
+
+class TestWkb:
+    def test_roundtrip(self):
+        for wkt in ["POINT (1.5 -2.25)",
+                    "LINESTRING (0 0, 1 1, 2 0.5)",
+                    "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), (2 2, 3 2, 3 3, 2 2))",
+                    "MULTIPOINT (1 1, 2 2)",
+                    "MULTILINESTRING ((0 0, 1 1), (2 2, 3 3))",
+                    "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 0)))",
+                    "GEOMETRYCOLLECTION (POINT (1 1), LINESTRING (0 0, 1 1))"]:
+            g = parse_wkt(wkt)
+            g2 = from_wkb(to_wkb(g))
+            assert type(g2) is type(g)
+            assert g2.envelope == g.envelope
+
+
+class TestCodec:
+    @pytest.mark.parametrize("use_native", [True, False])
+    def test_batch_roundtrip(self, use_native):
+        sft, batch = make_batch(25)
+        codec = FeatureCodec(sft, use_native=use_native)
+        enc = codec.encode_batch(batch)
+        if use_native and codec._lib is None:
+            pytest.skip("native toolchain unavailable")
+        out = codec.decode_batch(enc)
+        for i in range(batch.n):
+            a, b = out.feature(i), batch.feature(i)
+            assert set(a) == set(b)
+            for k, v in b.items():
+                if isinstance(v, Point):
+                    assert a[k].x == v.x and a[k].y == v.y
+                elif isinstance(v, float):
+                    assert a[k] == pytest.approx(v)
+                else:
+                    assert a[k] == v
+
+    def test_native_python_identical_bytes(self):
+        sft, batch = make_batch(17, seed=3)
+        c_native = FeatureCodec(sft, use_native=True)
+        c_py = FeatureCodec(sft, use_native=False)
+        if c_native._lib is None:
+            pytest.skip("native toolchain unavailable")
+        e1 = c_native.encode_batch(batch)
+        e2 = c_py.encode_batch(batch)
+        assert e1.blob == e2.blob
+        assert np.array_equal(e1.row_offsets, e2.row_offsets)
+
+    def test_lazy_single_attribute(self):
+        sft, batch = make_batch(8)
+        codec = FeatureCodec(sft)
+        enc = codec.encode_batch(batch)
+        col = codec.decode_attribute(enc, "age")
+        assert [col.value(i) for i in range(8)] == list(range(8))
+        names = codec.decode_attribute(enc, "name")
+        assert names.value(3) is None
+        assert names.value(1) == "name1"
+
+    def test_lazy_feature_view(self):
+        sft, batch = make_batch(5)
+        codec = FeatureCodec(sft)
+        enc = codec.encode_batch(batch)
+        f = LazyFeature(codec, enc.row(2))
+        assert f.get_by_name("age") == 2
+        g = f.get_by_name("geom")
+        assert isinstance(g, Point)
+        assert g.x == pytest.approx(batch.col("geom").x[2])
+        assert f.as_dict()["ok"] == batch.feature(2)["ok"]
+
+    def test_single_feature_all_types(self):
+        sft = parse_spec("u", "s:String,l:List[Integer],m:Map[String,Double],"
+                              "b:Bytes,u:UUID,ln:LineString,*geom:Point")
+        codec = FeatureCodec(sft)
+        vals = {"s": "héllo", "l": [1, 2, 3], "m": {"a": 1.5, "b": -2.0},
+                "b": b"\x00\x01\xff", "u": "123e4567-e89b-12d3-a456-426614174000",
+                "ln": LineString([(0, 0), (1, 1)]), "geom": Point(3.5, -4.5)}
+        buf = codec.serialize(vals)
+        f = codec.deserialize(buf)
+        assert f.get_by_name("s") == "héllo"
+        assert f.get_by_name("l") == [1, 2, 3]
+        assert f.get_by_name("m") == {"a": 1.5, "b": -2.0}
+        assert f.get_by_name("b") == b"\x00\x01\xff"
+        assert f.get_by_name("u") == vals["u"]
+        assert f.get_by_name("ln").envelope == vals["ln"].envelope
+        assert f.get_by_name("geom").x == 3.5
+
+    def test_nulls(self):
+        sft = parse_spec("v", "a:Integer,b:String,*geom:Point")
+        codec = FeatureCodec(sft)
+        buf = codec.serialize({"a": None, "b": None, "geom": None})
+        f = codec.deserialize(buf)
+        assert f.get(0) is None and f.get(1) is None and f.get(2) is None
+
+    def test_geometry_column_roundtrip(self):
+        sft = parse_spec("w", "name:String,*geom:Polygon")
+        polys = [Polygon([(0, 0), (i + 1, 0), (i + 1, i + 1), (0, 0)])
+                 for i in range(4)] + [None]
+        batch = FeatureBatch.from_dict(
+            sft, [f"f{i}" for i in range(5)],
+            {"name": ["a", "b", "c", "d", "e"], "geom": polys})
+        codec = FeatureCodec(sft)
+        enc = codec.encode_batch(batch)
+        out = codec.decode_batch(enc)
+        gc = out.col("geom")
+        assert gc.value(4) is None
+        assert gc.value(2).envelope == polys[2].envelope
