@@ -32,14 +32,14 @@ class AuditLogger:
     table)."""
 
     def __init__(self, path: str | None = None, capacity: int = 10_000):
+        import collections
         self.path = path
         self.capacity = capacity
-        self.events: list[QueryEvent] = []
+        self.events: "collections.deque[QueryEvent]" = \
+            collections.deque(maxlen=capacity)
 
     def write(self, event: QueryEvent):
         self.events.append(event)
-        if len(self.events) > self.capacity:
-            self.events = self.events[-self.capacity:]
         if self.path:
             with open(self.path, "a") as fh:
                 fh.write(event.to_json() + "\n")

@@ -138,16 +138,28 @@ class JsonConverter(SimpleFeatureConverter):
         return cur
 
     def _records(self, source):
-        if isinstance(source, str):
-            stripped = source.strip()
-            if stripped.startswith("["):
+        if not isinstance(source, str):
+            source = source.read()  # file-like: parse the whole stream
+        stripped = source.strip()
+        if stripped.startswith("["):
+            try:
                 objs = json.loads(stripped)
-            else:
-                objs = [json.loads(line) for line in stripped.splitlines()
-                        if line.strip()]
+            except ValueError:
+                yield _BAD_RECORD
+                return
         else:
-            objs = list(source)
+            objs = []
+            for line in stripped.splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    objs.append(json.loads(line))
+                except ValueError:
+                    objs.append(_BAD_RECORD)
         for obj in objs:
+            if obj is _BAD_RECORD:
+                yield _BAD_RECORD
+                continue
             try:
                 yield [obj] + [self._resolve(obj, p) for p in self.paths]
             except Exception:

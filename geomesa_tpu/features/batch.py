@@ -132,7 +132,7 @@ class StringColumn(Column):
     @classmethod
     def from_strings(cls, name: str, values: Iterable) -> "StringColumn":
         arr = np.asarray(list(values), dtype=object)
-        mask = np.array([v is not None for v in arr])
+        mask = np.array([v is not None for v in arr], dtype=bool)
         filled = np.where(mask, arr, "")
         vocab, codes = np.unique(filled.astype(str), return_inverse=True)
         codes = codes.astype(np.int32)
@@ -231,7 +231,7 @@ def _column_for(spec_type: str, name: str, data) -> Column:
             millis = arr.astype("datetime64[ms]").astype(np.int64)
             valid = ~np.isnat(arr)
         elif arr.dtype == object:
-            valid = np.array([v is not None for v in arr])
+            valid = np.array([v is not None for v in arr], dtype=bool)
             millis = np.array(
                 [int(np.datetime64(v, "ms").astype(np.int64)) if v is not None
                  else 0 for v in arr], dtype=np.int64)
@@ -242,8 +242,8 @@ def _column_for(spec_type: str, name: str, data) -> Column:
     if spec_type == "Boolean":
         arr = np.asarray(data)
         if arr.dtype == object:
-            valid = np.array([v is not None for v in arr])
-            vals = np.array([bool(v) for v in np.where(valid, arr, False)])
+            valid = np.array([v is not None for v in arr], dtype=bool)
+            vals = np.array([bool(v) for v in np.where(valid, arr, False)], dtype=bool)
         else:
             vals = arr.astype(bool)
             valid = np.ones(n, dtype=bool)
@@ -252,7 +252,7 @@ def _column_for(spec_type: str, name: str, data) -> Column:
     dtype = np.float64 if spec_type in ("Double", "Float") else np.int64
     arr = np.asarray(data)
     if arr.dtype == object:
-        valid = np.array([v is not None for v in arr])
+        valid = np.array([v is not None for v in arr], dtype=bool)
         vals = np.array([v if v is not None else 0 for v in arr], dtype=dtype)
     else:
         vals = arr.astype(dtype)

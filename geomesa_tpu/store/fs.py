@@ -34,6 +34,19 @@ from .partitions import (DateTimeScheme, PartitionScheme, Z2Scheme,
 __all__ = ["FileSystemDataStore"]
 
 
+def _safe_partition(name) -> str:
+    """Sanitize a scheme-produced partition name into a relative path:
+    attribute-derived names must not traverse outside the data dir."""
+    from urllib.parse import quote
+    segs = []
+    for seg in str(name).split("/"):
+        q = quote(seg, safe="")
+        if q in ("", ".", ".."):
+            q = "%" + q
+        segs.append(q)
+    return "/".join(segs)
+
+
 class _FsTypeState:
     def __init__(self, sft: SimpleFeatureType, scheme: PartitionScheme,
                  root: str):
@@ -114,7 +127,7 @@ class FileSystemDataStore:
         for part in np.unique(names):
             sel = np.flatnonzero(names == part)
             sub = batch.take(sel)
-            pdir = os.path.join(st.data_dir, str(part))
+            pdir = os.path.join(st.data_dir, _safe_partition(part))
             os.makedirs(pdir, exist_ok=True)
             path = os.path.join(pdir, f"{uuid.uuid4().hex[:12]}.parquet")
             import pyarrow as pa
@@ -138,7 +151,6 @@ class FileSystemDataStore:
 
     def _files_for(self, st: _FsTypeState,
                    parts: list[str] | None) -> list[str]:
-        all_parts = None
         if parts is None:
             files = []
             for dirpath, _d, fnames in os.walk(st.data_dir):
@@ -147,7 +159,7 @@ class FileSystemDataStore:
             return sorted(files)
         files = []
         for p in parts:
-            pdir = os.path.join(st.data_dir, p)
+            pdir = os.path.join(st.data_dir, _safe_partition(p))
             if os.path.isdir(pdir):
                 files.extend(os.path.join(pdir, f)
                              for f in sorted(os.listdir(pdir))

@@ -230,10 +230,7 @@ class CompositeScheme(PartitionScheme):
         if any(p == [] for p in per):
             return []
         if any(p is None for p in per):
-            # cannot enumerate the product when one side is unpruned;
-            # prefix-match on the first pruned scheme instead
-            if per[0] is not None:
-                return None  # store falls back to prefix filtering
+            # cannot enumerate the product when one side is unpruned
             return None
         return ["/".join(combo) for combo in itertools.product(*per)]
 

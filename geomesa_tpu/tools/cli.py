@@ -116,17 +116,12 @@ def cmd_export(args) -> int:
             out.write(",".join([str(f["id"])] + [
                 "" if f[n] is None else str(f[n]) for n in names]) + "\n")
     elif fmt == "geojson":
-        from ..geometry import Point
+        from ..geometry.geojson import to_geojson
         feats = []
         geom_field = res.batch.sft.geom_field
         for f in res.features():
             g = f.get(geom_field)
-            gj = None
-            if isinstance(g, Point):
-                gj = {"type": "Point", "coordinates": [g.x, g.y]}
-            elif g is not None:
-                gj = {"type": g.geom_type,
-                      "wkt": repr(g)}
+            gj = to_geojson(g) if g is not None else None
             props = {k: v for k, v in f.items()
                      if k not in ("id", geom_field)}
             feats.append({"type": "Feature", "id": f["id"],

@@ -1,0 +1,113 @@
+"""Regression tests for review findings (round 1)."""
+
+import io
+import json
+import os
+
+import numpy as np
+
+from geomesa_tpu.convert.converter import converter_for
+from geomesa_tpu.features.sft import parse_spec
+from geomesa_tpu.geometry import parse_wkt
+from geomesa_tpu.geometry.geojson import from_geojson, to_geojson
+from geomesa_tpu.store.fs import FileSystemDataStore, _safe_partition
+from geomesa_tpu.store.partitions import AttributeScheme
+
+
+def test_json_converter_accepts_file_object():
+    sft = parse_spec("t", "name:String,*geom:Point")
+    conv = converter_for(sft, {
+        "type": "json", "id-field": "$1",
+        "fields": [
+            {"path": "$.id"},
+            {"name": "name", "path": "$.name"},
+            {"name": "geom", "path": "$.x",
+             "transform": "point($3::double, $4::double)"},
+            {"path": "$.y"},
+        ]})
+    fh = io.StringIO('{"id": "a", "name": "n1", "x": 1.0, "y": 2.0}\n'
+                     '{"id": "b", "name": "n2", "x": 3.0, "y": 4.0}\n')
+    batch, ctx = conv.process(fh)
+    assert ctx.success == 2
+    assert batch.col("name").value(1) == "n2"
+    assert batch.col("geom").x[0] == 1.0
+
+
+def test_json_converter_bad_lines_counted_not_fatal():
+    sft = parse_spec("t", "name:String,*geom:Point")
+    conv = converter_for(sft, {
+        "type": "json", "id-field": "$1",
+        "fields": [
+            {"path": "$.id"},
+            {"name": "name", "path": "$.name"},
+            {"name": "geom", "path": "$.x",
+             "transform": "point($3::double, $4::double)"},
+            {"path": "$.y"},
+        ]})
+    batch, ctx = conv.process('{"id":"a","name":"n","x":1,"y":2}\nnot json\n')
+    assert ctx.success == 1 and ctx.failure == 1
+
+
+def test_all_failed_records_returns_empty_batch():
+    sft = parse_spec("t", "name:String,dtg:Date,*geom:Point")
+    conv = converter_for(sft, {
+        "type": "delimited-text", "id-field": "$1",
+        "fields": [
+            {"name": "name", "transform": "$1"},
+            {"name": "dtg", "transform": "isoDate($2)"},
+            {"name": "geom", "transform": "point($3::double, $4::double)"},
+        ]})
+    batch, ctx = conv.process("a,not-a-date,1.0,2.0\n")
+    assert ctx.failure == 1
+    assert batch.n == 0
+
+
+def test_fs_attribute_partition_traversal_blocked(tmp_path):
+    root = str(tmp_path / "store")
+    ds = FileSystemDataStore(root)
+    sft = parse_spec("evil", "kind:String,*geom:Point")
+    ds.create_schema(sft, scheme=AttributeScheme("kind"))
+    ds.write_dict("evil", ["f1"], {"kind": ["../../escape"],
+                                   "geom": ([1.0], [2.0])})
+    # nothing outside the store root
+    assert not os.path.exists(str(tmp_path / "escape"))
+    inside = []
+    for dirpath, _d, files in os.walk(root):
+        inside += [os.path.join(dirpath, f) for f in files
+                   if f.endswith(".parquet")]
+    assert len(inside) == 1
+    # and the row is still queryable (write/read use the same sanitizer)
+    res = ds.query("kind = '../../escape'", type_name="evil")
+    assert list(res.ids) == ["f1"]
+
+
+def test_safe_partition_segments():
+    assert _safe_partition("2017/05/03") == "2017/05/03"
+    assert "/" not in _safe_partition("a/../b").split("/")[1]
+    assert _safe_partition("..") == "%.."
+    assert _safe_partition("a b") == "a%20b"
+
+
+def test_geojson_all_geometry_types():
+    for wkt in ["POINT (1 2)", "LINESTRING (0 0, 1 1)",
+                "POLYGON ((0 0, 4 0, 4 4, 0 0), (1 1, 2 1, 2 2, 1 1))",
+                "MULTIPOINT (1 1, 2 2)",
+                "MULTILINESTRING ((0 0, 1 1), (2 2, 3 3))",
+                "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 0)))",
+                "GEOMETRYCOLLECTION (POINT (5 6))"]:
+        g = parse_wkt(wkt)
+        gj = to_geojson(g)
+        # valid RFC-7946 structure: coordinates (or geometries) present
+        assert "coordinates" in gj or "geometries" in gj
+        json.dumps(gj)
+        g2 = from_geojson(gj)
+        assert g2.envelope == g.envelope
+
+
+def test_audit_ring_bounded():
+    from geomesa_tpu.audit import AuditLogger
+    log = AuditLogger(capacity=5)
+    for i in range(12):
+        log.record("t", f"f{i}", {}, 1.0, 2.0, i)
+    assert len(log.events) == 5
+    assert log.query("t")[-1].hits == 11
