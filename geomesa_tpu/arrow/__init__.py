@@ -1,0 +1,20 @@
+"""Arrow columnar interchange (geomesa-arrow analog, SURVEY.md 2.3).
+
+Arrow is the host interchange format between the TPU store and external
+consumers: query results stream out as dictionary-encoded IPC batches
+(SimpleFeatureVector.scala:35 semantics), shard-level partial results
+merge with dictionary deltas (io/DeltaWriter.scala:47,203), and Arrow
+files are directly queryable (ArrowDataStore).
+"""
+
+from .io import (DEFAULT_BATCH_SIZE, FeatureArrowFileReader,
+                 FeatureArrowFileWriter, merge_sorted_ipc, read_ipc_batches,
+                 sort_batches, write_ipc)
+from .scan import ArrowScan, merge_deltas
+from .data import ArrowDataStore
+from .feature import ArrowFeature
+
+__all__ = ["DEFAULT_BATCH_SIZE", "FeatureArrowFileWriter",
+           "FeatureArrowFileReader", "write_ipc", "read_ipc_batches",
+           "sort_batches", "merge_sorted_ipc", "ArrowScan", "merge_deltas",
+           "ArrowDataStore", "ArrowFeature"]

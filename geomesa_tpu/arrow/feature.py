@@ -1,0 +1,45 @@
+"""ArrowFeature: zero-copy feature facade over a pyarrow RecordBatch row
+(arrow/vector/ArrowSimpleFeature analog) — attribute reads index the
+Arrow vectors directly without materializing python rows.
+"""
+
+from __future__ import annotations
+
+from ..features.sft import SimpleFeatureType
+from ..geometry import Point
+
+__all__ = ["ArrowFeature"]
+
+
+class ArrowFeature:
+    def __init__(self, sft: SimpleFeatureType, rb, row: int):
+        self._sft = sft
+        self._rb = rb
+        self._row = row
+
+    @property
+    def id(self) -> str:
+        return self._rb.column("__fid__")[self._row].as_py()
+
+    def get(self, name: str):
+        a = self._sft.attr(name)
+        col = self._rb.column(name)
+        v = col[self._row]
+        if not v.is_valid:
+            return None
+        if a.type.name == "Point":
+            d = v.as_py()
+            return Point(d["x"], d["y"])
+        if a.type.is_geometry:
+            from ..geometry.wkt import parse_wkt
+            return parse_wkt(v.as_py())
+        if a.type.name == "Date":
+            import numpy as np
+            return int(np.datetime64(v.as_py(), "ms").astype(np.int64))
+        return v.as_py()
+
+    def as_dict(self) -> dict:
+        out = {"id": self.id}
+        for a in self._sft.attributes:
+            out[a.name] = self.get(a.name)
+        return out

@@ -1,0 +1,179 @@
+"""Arrow IPC file IO for feature batches.
+
+Analog of the reference's SimpleFeatureArrowFileWriter/Reader and
+SimpleFeatureArrowIO sort/merge (geomesa-arrow/.../io/): feature batches
+stream to the Arrow IPC file format in fixed-capacity vectors
+(SimpleFeatureVector.scala:98 defaults to 8,096 features per batch),
+and sorted batch streams merge k-way on a sort attribute.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.sft import SimpleFeatureType
+
+DEFAULT_BATCH_SIZE = 8096  # SimpleFeatureVector.scala:98
+
+__all__ = ["DEFAULT_BATCH_SIZE", "FeatureArrowFileWriter",
+           "FeatureArrowFileReader", "write_ipc", "read_ipc_batches",
+           "sort_batches", "merge_sorted_ipc"]
+
+
+def _schema_meta(sft: SimpleFeatureType) -> dict:
+    return {b"geomesa.sft.name": sft.type_name.encode(),
+            b"geomesa.sft.spec": sft.to_spec().encode()}
+
+
+class FeatureArrowFileWriter:
+    """Stream FeatureBatches to an Arrow IPC file, re-chunked to a fixed
+    vector capacity; SFT name/spec ride in the schema metadata so the
+    file is self-describing."""
+
+    def __init__(self, sink, sft: SimpleFeatureType,
+                 batch_size: int = DEFAULT_BATCH_SIZE):
+        import pyarrow as pa
+        self.sft = sft
+        self.batch_size = batch_size
+        self._pending: FeatureBatch | None = None
+        probe = FeatureBatch.from_dict(
+            sft, np.empty(0, dtype=object),
+            {a.name: _empty_col(a) for a in sft.attributes})
+        schema = probe.to_arrow().schema.with_metadata(_schema_meta(sft))
+        self._writer = pa.ipc.new_file(sink, schema)
+        self._schema = schema
+
+    def write(self, batch: FeatureBatch):
+        self._pending = (batch if self._pending is None
+                         else self._pending.concat(batch))
+        while self._pending.n >= self.batch_size:
+            head = self._pending.take(np.arange(self.batch_size))
+            self._pending = self._pending.take(
+                np.arange(self.batch_size, self._pending.n))
+            self._flush(head)
+
+    def _flush(self, batch: FeatureBatch):
+        import pyarrow as pa
+        rb = batch.to_arrow()
+        # unify dictionaries with the declared schema by casting
+        table = pa.Table.from_batches([rb]).cast(pa.schema(
+            [self._schema.field(i) for i in range(len(self._schema.names))]))
+        for rb2 in table.to_batches():
+            self._writer.write_batch(rb2)
+
+    def close(self):
+        if self._pending is not None and self._pending.n:
+            self._flush(self._pending)
+            self._pending = None
+        self._writer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _empty_col(a):
+    if a.type.name == "Point":
+        return (np.empty(0), np.empty(0))
+    return []
+
+
+class FeatureArrowFileReader:
+    """Read an IPC feature file; recovers the SFT from metadata."""
+
+    def __init__(self, source, sft: SimpleFeatureType | None = None):
+        import pyarrow as pa
+        self._reader = pa.ipc.open_file(source)
+        meta = self._reader.schema.metadata or {}
+        if sft is None:
+            from ..features.sft import parse_spec
+            name = meta.get(b"geomesa.sft.name", b"features").decode()
+            spec = meta.get(b"geomesa.sft.spec")
+            if spec is None:
+                raise ValueError("no SFT metadata in arrow file; pass sft=")
+            sft = parse_spec(name, spec.decode())
+        self.sft = sft
+
+    @property
+    def num_batches(self) -> int:
+        return self._reader.num_record_batches
+
+    def batches(self) -> Iterator[FeatureBatch]:
+        for i in range(self._reader.num_record_batches):
+            yield FeatureBatch.from_arrow(self.sft,
+                                          self._reader.get_batch(i))
+
+    def read_all(self) -> FeatureBatch:
+        out = None
+        for b in self.batches():
+            out = b if out is None else out.concat(b)
+        if out is None:
+            raise ValueError("empty arrow file")
+        return out
+
+
+def write_ipc(sft: SimpleFeatureType, batch: FeatureBatch,
+              batch_size: int = DEFAULT_BATCH_SIZE) -> bytes:
+    """Encode one batch as Arrow IPC file bytes."""
+    import io as _io
+    sink = _io.BytesIO()
+    with FeatureArrowFileWriter(sink, sft, batch_size) as w:
+        if batch.n:
+            w.write(batch)
+    return sink.getvalue()
+
+
+def read_ipc_batches(data: bytes,
+                     sft: SimpleFeatureType | None = None):
+    """Decode IPC file bytes -> (sft, FeatureBatch or None)."""
+    import io as _io
+    r = FeatureArrowFileReader(_io.BytesIO(data), sft)
+    out = None
+    for b in r.batches():
+        out = b if out is None else out.concat(b)
+    return r.sft, out
+
+
+def sort_batches(batch: FeatureBatch, sort_by: str,
+                 reverse: bool = False) -> FeatureBatch:
+    """Sort a batch by an attribute (SimpleFeatureArrowIO sort)."""
+    col = batch.columns[sort_by]
+    if hasattr(col, "millis"):
+        keys = col.millis
+    elif hasattr(col, "codes"):
+        keys = col.codes
+    else:
+        keys = col.values  # type: ignore[union-attr]
+    order = np.argsort(keys, kind="stable")
+    if reverse:
+        order = order[::-1]
+    return batch.take(order)
+
+
+def merge_sorted_ipc(payloads: Iterable[bytes], sort_by: str,
+                     reverse: bool = False,
+                     sft: SimpleFeatureType | None = None) -> bytes:
+    """K-way merge of sorted shard payloads into one sorted IPC file
+    (the reduce step of ArrowScan / SimpleFeatureArrowIO.sort)."""
+    merged = None
+    out_sft = sft
+    for p in payloads:
+        s, b = read_ipc_batches(p, sft)
+        out_sft = out_sft or s
+        if b is None:
+            continue
+        merged = b if merged is None else merged.concat(b)
+    if out_sft is None:
+        raise ValueError("no payloads to merge")
+    if merged is None:
+        return write_ipc(out_sft,
+                         FeatureBatch.from_dict(
+                             out_sft, np.empty(0, dtype=object),
+                             {a.name: _empty_col(a)
+                              for a in out_sft.attributes}))
+    return write_ipc(out_sft, sort_batches(merged, sort_by, reverse))

@@ -1,0 +1,86 @@
+"""ArrowScan: Arrow-encoded query results + distributed dictionary-delta
+merge.
+
+The reference runs ArrowScan inside the database servers — each
+tablet/region emits dictionary-encoded record batches whose dictionaries
+are *local deltas*, merged client-side (index-api ArrowScan:34 +
+arrow/io/DeltaWriter.scala:47,203). Here each mesh shard produces an
+IPC payload with shard-local dictionaries; ``merge_deltas`` unifies the
+dictionaries and re-encodes codes — pure host-side numpy (planner-time
+cost, not scan-time).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from ..features.batch import FeatureBatch, StringColumn
+from ..features.sft import SimpleFeatureType
+from .io import read_ipc_batches, sort_batches, write_ipc
+
+__all__ = ["ArrowScan", "merge_deltas"]
+
+
+class ArrowScan:
+    """Produce Arrow IPC bytes from a query over a datastore.
+
+    Usage mirrors the ARROW_ENCODE query-hint path
+    (AccumuloIndexAdapter.scanConfig arrow branch):
+
+        payload = ArrowScan(store).execute(type_name, ecql,
+                                           sort_by="dtg")
+    """
+
+    def __init__(self, store):
+        self.store = store
+
+    def execute(self, type_name: str, ecql: str = "INCLUDE",
+                sort_by: str | None = None, reverse: bool = False,
+                batch_size: int | None = None) -> bytes:
+        from ..index.api import Query
+        res = self.store.query(Query(type_name, ecql))
+        sft = self.store.get_schema(type_name)
+        batch = res.batch
+        if batch is None:
+            batch = FeatureBatch.from_dict(
+                sft, np.empty(0, dtype=object),
+                {a.name: ((np.empty(0), np.empty(0))
+                          if a.type.name == "Point" else [])
+                 for a in sft.attributes})
+        if sort_by:
+            batch = sort_batches(batch, sort_by, reverse)
+        kw = {} if batch_size is None else {"batch_size": batch_size}
+        return write_ipc(sft, batch, **kw)
+
+
+def merge_deltas(payloads: Sequence[bytes],
+                 sft: SimpleFeatureType | None = None,
+                 sort_by: str | None = None) -> bytes:
+    """Merge shard-local IPC payloads into one payload with unified
+    dictionaries (DeltaWriter.reduce analog).
+
+    Each payload's string columns carry their own vocab; FeatureBatch
+    decoding re-dictionary-encodes on concat, so the merged file has one
+    global dictionary per column.
+    """
+    merged = None
+    out_sft = sft
+    for p in payloads:
+        s, b = read_ipc_batches(p, sft)
+        out_sft = out_sft or s
+        if b is None:
+            continue
+        merged = b if merged is None else merged.concat(b)
+    if out_sft is None:
+        raise ValueError("no payloads")
+    if merged is None:
+        return write_ipc(out_sft, FeatureBatch.from_dict(
+            out_sft, np.empty(0, dtype=object),
+            {a.name: ((np.empty(0), np.empty(0))
+                      if a.type.name == "Point" else [])
+             for a in out_sft.attributes}))
+    if sort_by:
+        merged = sort_batches(merged, sort_by)
+    return write_ipc(out_sft, merged)

@@ -7,7 +7,7 @@ Subcommands (mirroring the reference's tools/ command set):
     delete-schema   --path R --name T
     list-schemas    --path R
     ingest          --path R --name T --converter conf.json FILES...
-    export          --path R --name T [--cql F] [--format csv|geojson|bin]
+    export          --path R --name T [--cql F] [--format csv|geojson|bin|arrow]
     count           --path R --name T [--cql F]
     explain         --path R --name T --cql F
     stats           --path R --name T --stat-spec 'MinMax(a)' [--cql F]
@@ -129,6 +129,9 @@ def cmd_export(args) -> int:
         json.dump({"type": "FeatureCollection", "features": feats}, out,
                   default=str)
         out.write("\n")
+    elif fmt == "arrow":
+        from ..arrow.io import write_ipc
+        sys.stdout.buffer.write(write_ipc(res.batch.sft, res.batch))
     elif fmt == "bin":
         mem = ds._load(ds._state(args.name),
                        ds._files_for(ds._state(args.name), None))

@@ -1,0 +1,129 @@
+"""Arrow subsystem tests: IPC roundtrip, chunking, dictionary merge,
+sorted merge, ArrowDataStore, ArrowFeature (geomesa-arrow test style:
+ArrowFileTest / DeltaWriterTest semantics)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.arrow import (ArrowDataStore, ArrowFeature, ArrowScan,
+                               FeatureArrowFileReader, FeatureArrowFileWriter,
+                               merge_deltas, merge_sorted_ipc,
+                               read_ipc_batches, sort_batches, write_ipc)
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import parse_spec
+from geomesa_tpu.store.memory import InMemoryDataStore
+
+SPEC = "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326"
+
+
+def make_batch(n, seed=0, names=("alpha", "beta", "gamma")):
+    rng = np.random.default_rng(seed)
+    sft = parse_spec("t", SPEC)
+    return sft, FeatureBatch.from_dict(
+        sft, [f"f{seed}_{i}" for i in range(n)],
+        {"name": [names[i % len(names)] for i in range(n)],
+         "age": np.arange(n),
+         "dtg": rng.integers(0, 10**12, n),
+         "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n))})
+
+
+class TestIpc:
+    def test_roundtrip(self):
+        sft, batch = make_batch(100)
+        data = write_ipc(sft, batch)
+        sft2, out = read_ipc_batches(data)
+        assert sft2.to_spec() == sft.to_spec()
+        assert out.n == 100
+        assert out.feature(7)["name"] == batch.feature(7)["name"]
+        assert np.allclose(out.col("geom").x, batch.col("geom").x)
+
+    def test_chunking(self):
+        sft, batch = make_batch(25)
+        sink = io.BytesIO()
+        with FeatureArrowFileWriter(sink, sft, batch_size=10) as w:
+            w.write(batch)
+        r = FeatureArrowFileReader(io.BytesIO(sink.getvalue()))
+        assert r.num_batches == 3  # 10 + 10 + 5
+        assert r.read_all().n == 25
+
+    def test_empty(self):
+        sft, _ = make_batch(1)
+        data = write_ipc(sft, FeatureBatch.from_dict(
+            sft, np.empty(0, dtype=object),
+            {"name": [], "age": [], "dtg": [],
+             "geom": (np.empty(0), np.empty(0))}))
+        sft2, out = read_ipc_batches(data)
+        assert sft2.type_name == "t"
+
+
+class TestMerge:
+    def test_dictionary_delta_merge(self):
+        # shard payloads with disjoint vocabularies -> unified dictionary
+        sft, b1 = make_batch(10, seed=1, names=("aa", "bb"))
+        _, b2 = make_batch(10, seed=2, names=("cc", "dd"))
+        p1, p2 = write_ipc(sft, b1), write_ipc(sft, b2)
+        merged = merge_deltas([p1, p2])
+        _, out = read_ipc_batches(merged)
+        assert out.n == 20
+        vals = {out.col("name").value(i) for i in range(20)}
+        assert vals == {"aa", "bb", "cc", "dd"}
+
+    def test_merge_sorted(self):
+        sft, b1 = make_batch(10, seed=1)
+        _, b2 = make_batch(10, seed=2)
+        p1 = write_ipc(sft, sort_batches(b1, "dtg"))
+        p2 = write_ipc(sft, sort_batches(b2, "dtg"))
+        merged = merge_sorted_ipc([p1, p2], "dtg")
+        _, out = read_ipc_batches(merged)
+        dtg = out.col("dtg").millis
+        assert np.all(np.diff(dtg) >= 0)
+        assert out.n == 20
+
+
+class TestArrowScan:
+    def test_scan_from_store(self):
+        sft, batch = make_batch(50)
+        ds = InMemoryDataStore()
+        ds.create_schema(sft)
+        ds.write("t", batch)
+        payload = ArrowScan(ds).execute("t", "age < 10", sort_by="age")
+        _, out = read_ipc_batches(payload)
+        assert out.n == 10
+        assert np.array_equal(out.col("age").values, np.arange(10))
+
+
+class TestArrowDataStore:
+    def test_file_store(self, tmp_path):
+        sft, batch = make_batch(30)
+        path = str(tmp_path / "feats.arrow")
+        store = ArrowDataStore(path)
+        store.create_schema(sft)
+        store.write(batch)
+        store2 = ArrowDataStore(path)
+        assert store2.count() == 30
+        res = store2.query("age >= 20")
+        assert res.n == 10
+
+    def test_append(self, tmp_path):
+        sft, b1 = make_batch(10, seed=1)
+        _, b2 = make_batch(5, seed=2)
+        path = str(tmp_path / "a.arrow")
+        store = ArrowDataStore(path)
+        store.create_schema(sft)
+        store.write(b1)
+        store.write(b2)
+        assert ArrowDataStore(path).count() == 15
+
+
+class TestArrowFeature:
+    def test_zero_copy_view(self):
+        sft, batch = make_batch(5)
+        rb = batch.to_arrow()
+        f = ArrowFeature(sft, rb, 3)
+        assert f.id == "f0_3"
+        assert f.get("age") == 3
+        g = f.get("geom")
+        assert g.x == pytest.approx(batch.col("geom").x[3])
+        assert f.as_dict()["name"] == batch.feature(3)["name"]
