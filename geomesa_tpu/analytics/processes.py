@@ -12,8 +12,8 @@ from ..stats import EnumerationStat, MinMax
 from .join import dwithin_join, knn
 from .tube import TubeBuilder, tube_select_mask
 
-__all__ = ["knn_process", "proximity_process", "unique_process",
-           "minmax_process", "tube_select_process"]
+__all__ = ["knn_process", "knn_spiral_process", "proximity_process",
+           "unique_process", "minmax_process", "tube_select_process"]
 
 
 def _point_cols(store, type_name):
@@ -43,6 +43,41 @@ def knn_process(store, type_name: str, qx: float, qy: float, k: int,
         return sub.ids[idx], d
     d, idx = knn(col.x, col.y, qx, qy, min(k, st.n))
     return st.batch.ids[idx], d
+
+
+def knn_spiral_process(store, type_name: str, qx: float, qy: float, k: int,
+                       estimated_distance: float = 1.0):
+    """Geohash-spiral KNN (knn/KNNQuery.scala:27,34-81): iterate cells
+    outward from the query point in distance order, run a bbox query per
+    cell, keep a bounded PQ, and cut the spiral at the kth distance.
+
+    The device-kernel ``knn_process`` scans the whole column in one
+    fused top-k — usually faster on-chip; the spiral bounds work when
+    the store is huge and the query local (the reference's reason too).
+    """
+    from ..geohash import (BoundedNearestNeighbors, GeoHashSpiral,
+                           decode_bbox, precision_for_radius)
+    st, col = _point_cols(store, type_name)
+    if col is None:
+        return np.empty(0, object), np.empty(0)
+    prec = precision_for_radius(estimated_distance)
+    spiral = GeoHashSpiral(qx, qy, prec)
+    nn = BoundedNearestNeighbors(k)
+    for cell in spiral:
+        xmin, ymin, xmax, ymax = decode_bbox(cell)
+        res = store.query(Query(
+            type_name, f"BBOX({st.sft.geom_field}, "
+                       f"{xmin}, {ymin}, {xmax}, {ymax})"))
+        if res.batch is not None and res.batch.n:
+            c = res.batch.col(st.sft.geom_field)
+            d = np.hypot(c.x - qx, c.y - qy)
+            for dist, fid in zip(d, res.batch.ids):
+                nn.offer(float(dist), fid)
+        if nn.full:
+            spiral.update_max_distance(nn.max_distance)
+    pairs = nn.result()
+    return (np.array([p[1] for p in pairs], dtype=object),
+            np.array([p[0] for p in pairs]))
 
 
 def proximity_process(store, type_name: str, qx, qy,

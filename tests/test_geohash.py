@@ -1,0 +1,131 @@
+"""GeoHash tests: known values (GeoHash.scala/geohash.org test vectors),
+bbox/neighbor invariants, spiral KNN vs brute force."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geohash import (BoundedNearestNeighbors, GeoHashSpiral,
+                                 covering, decode, decode_bbox, encode,
+                                 neighbors, precision_for_radius)
+
+
+class TestEncode:
+    def test_known_values(self):
+        # canonical geohash.org vectors (lon, lat, hash)
+        assert encode(-5.6, 42.6, 5) == "ezs42"
+        assert encode(-0.1262, 51.5001, 9)[:6] == "gcpuvp"
+        assert encode(13.361389, 38.115556, 9)[:5] == "sqc8b"
+        assert encode(0.0, 0.0, 1) == "s"
+
+    def test_vectorized_matches_scalar(self):
+        rng = np.random.default_rng(0)
+        lon = rng.uniform(-180, 180, 100)
+        lat = rng.uniform(-90, 90, 100)
+        vec = encode(lon, lat, 7)
+        for i in range(0, 100, 17):
+            assert vec[i] == encode(float(lon[i]), float(lat[i]), 7)
+
+    def test_decode_inverts(self):
+        rng = np.random.default_rng(1)
+        for _ in range(50):
+            lon = float(rng.uniform(-180, 180))
+            lat = float(rng.uniform(-90, 90))
+            gh = encode(lon, lat, 9)
+            xmin, ymin, xmax, ymax = decode_bbox(gh)
+            assert xmin <= lon <= xmax
+            assert ymin <= lat <= ymax
+        cx, cy = decode("ezs42")
+        assert cx == pytest.approx(-5.6, abs=0.03)
+        assert cy == pytest.approx(42.6, abs=0.03)
+
+    def test_prefix_nesting(self):
+        gh = encode(-75.3, 38.2, 8)
+        for p in range(1, 8):
+            assert gh[:p] == encode(-75.3, 38.2, p)
+            b_out = decode_bbox(gh[:p])
+            b_in = decode_bbox(gh[:p + 1])
+            assert (b_out[0] <= b_in[0] and b_out[1] <= b_in[1]
+                    and b_out[2] >= b_in[2] and b_out[3] >= b_in[3])
+
+
+class TestNeighbors:
+    def test_eight_touching(self):
+        nb = neighbors("ezs42")
+        assert len(nb) == 8
+        x0, y0, x1, y1 = decode_bbox("ezs42")
+        for h in nb:
+            a0, b0, a1, b1 = decode_bbox(h)
+            # touching: envelopes intersect but not equal
+            assert a0 <= x1 + 1e-9 and a1 >= x0 - 1e-9
+            assert b0 <= y1 + 1e-9 and b1 >= y0 - 1e-9
+
+    def test_antimeridian_wrap(self):
+        gh = encode(179.9, 0.0, 4)
+        nb = neighbors(gh)
+        assert any(decode_bbox(h)[0] < -179 for h in nb)
+
+    def test_pole_clip(self):
+        gh = encode(0.0, 89.9, 4)
+        assert len(neighbors(gh)) == 5  # no cells above the pole
+
+
+class TestCovering:
+    def test_covers_bbox(self):
+        cells = covering(-80, 30, -79, 31, 4)
+        rng = np.random.default_rng(2)
+        for _ in range(100):
+            x = float(rng.uniform(-80, -79))
+            y = float(rng.uniform(30, 31))
+            assert encode(x, y, 4) in cells
+
+
+class TestSpiral:
+    def test_distance_ordered(self):
+        spiral = GeoHashSpiral(10.0, 20.0, 4)
+        spiral.update_max_distance(2.0)
+        cells = list(spiral)
+        assert len(cells) > 1
+        from geomesa_tpu.geohash import _dist2_to_bbox
+        dists = [_dist2_to_bbox(10.0, 20.0, decode_bbox(c)) for c in cells]
+        assert dists == sorted(dists)
+        assert cells[0] == encode(10.0, 20.0, 4)
+
+    def test_bounded_nn(self):
+        nn = BoundedNearestNeighbors(3)
+        for d, i in [(5.0, "a"), (1.0, "b"), (3.0, "c"), (0.5, "d"),
+                     (9.0, "e")]:
+            nn.offer(d, i)
+        res = nn.result()
+        assert [i for _, i in res] == ["d", "b", "c"]
+        assert nn.max_distance == 3.0
+
+    def test_spiral_knn_matches_brute_force(self):
+        from geomesa_tpu.analytics.processes import (knn_process,
+                                                     knn_spiral_process)
+        from geomesa_tpu.features.batch import FeatureBatch
+        from geomesa_tpu.features.sft import parse_spec
+        from geomesa_tpu.store.memory import InMemoryDataStore
+        rng = np.random.default_rng(3)
+        n = 5000
+        sft = parse_spec("pts", "name:String,*geom:Point")
+        ds = InMemoryDataStore()
+        ds.create_schema(sft)
+        ds.write("pts", FeatureBatch.from_dict(
+            sft, [f"p{i}" for i in range(n)],
+            {"name": ["x"] * n,
+             "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))}))
+        ids_a, d_a = knn_process(ds, "pts", 1.0, 2.0, 10)
+        ids_b, d_b = knn_spiral_process(ds, "pts", 1.0, 2.0, 10,
+                                        estimated_distance=0.5)
+        assert set(ids_a.tolist()) == set(ids_b.tolist())
+        assert np.allclose(sorted(d_a), d_b)
+
+
+def test_precision_for_radius():
+    assert precision_for_radius(50.0) <= 2
+    assert precision_for_radius(0.001) >= 6
+    # cell at chosen precision is at least radius wide
+    import math
+    for r in (10.0, 1.0, 0.1, 0.01):
+        p = precision_for_radius(r)
+        assert 360.0 / (1 << math.ceil(5 * p / 2)) >= r
