@@ -1,0 +1,126 @@
+"""Blobstore: binary payloads indexed by geo/time metadata
+(geomesa-blobstore analog: blob/accumulo/AccumuloBlobStoreImpl.scala:24 —
+a blob table + an SFT-indexed metadata table; FileHandler SPI extracts
+geometry from the input).
+
+Blobs live in a directory (or in-memory dict); metadata rows go through
+the normal indexed store so spatial/temporal queries find blob ids.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.sft import parse_spec
+from ..index.api import Query
+from ..store.memory import InMemoryDataStore
+
+__all__ = ["BlobStore", "FileHandler", "WktFileHandler"]
+
+_SPEC = ("filename:String,thumbnail:String,dtg:Date,"
+         "*geom:Point:srid=4326;geomesa.index.dtg='dtg'")
+
+
+class FileHandler:
+    """SPI: can this handler extract (x, y, dtg) metadata for an input?
+    (blob/core/handlers/FileHandler analog)."""
+
+    def can_process(self, filename: str, params: dict) -> bool:
+        raise NotImplementedError
+
+    def extract(self, data: bytes, filename: str,
+                params: dict) -> dict[str, Any]:
+        raise NotImplementedError
+
+
+class WktFileHandler(FileHandler):
+    """Metadata passed explicitly via params (wkt/x/y/dtg) — the
+    WKTFileHandler of the reference."""
+
+    def can_process(self, filename: str, params: dict) -> bool:
+        return "wkt" in params or ("x" in params and "y" in params)
+
+    def extract(self, data, filename, params):
+        if "wkt" in params:
+            from ..geometry.wkt import parse_wkt
+            g = parse_wkt(params["wkt"])
+            c = g.centroid if hasattr(g, "centroid") else g
+            x, y = float(c.x), float(c.y)
+        else:
+            x, y = float(params["x"]), float(params["y"])
+        return {"x": x, "y": y, "dtg": int(params.get("dtg", 0)),
+                "filename": filename}
+
+
+class BlobStore:
+    def __init__(self, directory: str | None = None,
+                 handlers: list[FileHandler] | None = None):
+        self.directory = directory
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        self._blobs: dict[str, bytes] = {}
+        self.handlers = handlers or [WktFileHandler()]
+        self._store = InMemoryDataStore()
+        self._store.create_schema(parse_spec("blobs", _SPEC))
+
+    # -- io ---------------------------------------------------------------
+
+    def put(self, data: bytes, filename: str = "",
+            **params) -> str:
+        """Store a blob; a FileHandler extracts geo metadata. Returns id."""
+        for h in self.handlers:
+            if h.can_process(filename, params):
+                meta = h.extract(data, filename, params)
+                break
+        else:
+            raise ValueError(f"no handler for {filename!r} with "
+                             f"params {sorted(params)}")
+        blob_id = uuid.uuid4().hex
+        if self.directory:
+            with open(os.path.join(self.directory, blob_id), "wb") as fh:
+                fh.write(data)
+        else:
+            self._blobs[blob_id] = data
+        self._store.write("blobs", FeatureBatch.from_dict(
+            self._store.get_schema("blobs"), [blob_id],
+            {"filename": [meta.get("filename") or filename],
+             "thumbnail": [None],
+             "dtg": np.array([meta.get("dtg", 0)], dtype=np.int64),
+             "geom": (np.array([meta["x"]]), np.array([meta["y"]]))}))
+        return blob_id
+
+    def get(self, blob_id: str) -> tuple[bytes, str] | None:
+        """(payload, filename) or None."""
+        res = self._store.query(Query("blobs", f"IN ('{blob_id}')"))
+        if res.batch is None or res.batch.n == 0:
+            return None
+        fname = res.batch.col("filename").value(0) or ""
+        if self.directory:
+            path = os.path.join(self.directory, blob_id)
+            if not os.path.exists(path):
+                return None
+            with open(path, "rb") as fh:
+                return fh.read(), fname
+        data = self._blobs.get(blob_id)
+        return None if data is None else (data, fname)
+
+    def delete(self, blob_id: str):
+        self._store.delete("blobs", [blob_id])
+        if self.directory:
+            path = os.path.join(self.directory, blob_id)
+            if os.path.exists(path):
+                os.remove(path)
+        else:
+            self._blobs.pop(blob_id, None)
+
+    # -- queries -----------------------------------------------------------
+
+    def query_ids(self, ecql: str) -> list[str]:
+        """Blob ids whose metadata matches (BlobstoreServlet query)."""
+        res = self._store.query(Query("blobs", ecql))
+        return [] if res.batch is None else [str(i) for i in res.batch.ids]

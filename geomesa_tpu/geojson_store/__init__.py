@@ -1,0 +1,178 @@
+"""Schema-less GeoJSON store (geomesa-geojson analog:
+geojson/GeoJsonGtIndex.scala:42 — arbitrary GeoJSON features indexed
+without a declared schema, queried with dot-notation property paths).
+
+Properties flatten to dot-notation keys; an SFT is inferred (and
+widened) from observed values, so the store keeps the columnar device
+execution path underneath. Queries accept either a mongo-ish property
+dict ({"properties.name": "x", "geo.bbox": [..]}) or raw ECQL over the
+flattened attribute names (dots become '$').
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.sft import AttributeSpec, AttributeType, SimpleFeatureType
+from ..geometry.geojson import from_geojson, to_geojson
+from ..index.api import Query
+from ..store.memory import InMemoryDataStore
+
+__all__ = ["GeoJsonIndex"]
+
+
+def _flatten(obj: Any, prefix: str = "") -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            if isinstance(v, dict):
+                out.update(_flatten(v, key))
+            else:
+                out[key] = v
+    return out
+
+
+def _attr_name(path: str) -> str:
+    """Dot paths are legal SFT attribute names and ECQL identifiers, so
+    they pass through unchanged (the 'dot notation' of GeoJsonQuery)."""
+    return path
+
+
+def _infer_type(values: list) -> str:
+    kinds = {type(v) for v in values if v is not None}
+    if kinds <= {bool}:
+        return "Boolean"
+    if kinds <= {int, bool}:
+        return "Long"
+    if kinds <= {int, float, bool}:
+        return "Double"
+    return "String"
+
+
+class GeoJsonIndex:
+    """Index GeoJSON features; ids auto-assigned unless present."""
+
+    def __init__(self, name: str = "geojson"):
+        self.name = name
+        self._store = InMemoryDataStore()
+        self._attrs: dict[str, str] = {}   # attr name -> type
+        self._counter = 0
+        self._rows: list[dict] = []        # raw rows (re-typed on schema growth)
+        self._geoms: list = []
+        self._ids: list[str] = []
+
+    # -- writes ------------------------------------------------------------
+
+    def put(self, features) -> list[str]:
+        """Add GeoJSON: a Feature, FeatureCollection, or iterable."""
+        feats = self._normalize(features)
+        ids = []
+        for f in feats:
+            fid = str(f.get("id") or f"gj{self._counter}")
+            self._counter += 1
+            props = _flatten(f.get("properties") or {})
+            row = {_attr_name(k): v for k, v in props.items()}
+            geom = f.get("geometry")
+            self._ids.append(fid)
+            self._rows.append(row)
+            self._geoms.append(from_geojson(geom) if geom else None)
+            ids.append(fid)
+        self._rebuild()
+        return ids
+
+    def _normalize(self, features) -> list[dict]:
+        if isinstance(features, str):
+            features = json.loads(features)
+        if isinstance(features, dict):
+            if features.get("type") == "FeatureCollection":
+                return list(features.get("features") or [])
+            return [features]
+        return list(features)
+
+    def _rebuild(self):
+        # widen schema to cover all observed keys
+        cols: dict[str, list] = {}
+        for key in {k for r in self._rows for k in r}:
+            cols[key] = [r.get(key) for r in self._rows]
+        attrs = [AttributeSpec(k, AttributeType(_infer_type(v)))
+                 for k, v in sorted(cols.items())]
+        attrs.append(AttributeSpec("geom", AttributeType("Geometry"),
+                                   default_geom=True))
+        sft = SimpleFeatureType(self.name, attrs)
+        store = InMemoryDataStore()
+        store.create_schema(sft)
+        data: dict[str, Any] = {k: v for k, v in cols.items()}
+        data["geom"] = self._geoms
+        if self._ids:
+            store.write(self.name, FeatureBatch.from_dict(
+                sft, np.asarray(self._ids, dtype=object), data))
+        self._store = store
+        self._sft = sft
+
+    # -- queries -----------------------------------------------------------
+
+    def query(self, q: "dict | str" = "INCLUDE") -> list[dict]:
+        """Return GeoJSON features. Dict queries: {"properties.a.b": value}
+        for equality, {"bbox": [x0,y0,x1,y1]} for spatial."""
+        ecql = q if isinstance(q, str) else self._dict_to_ecql(q)
+        res = self._store.query(Query(self.name, ecql))
+        out = []
+        if res.batch is not None:
+            gcol = res.batch.columns["geom"]
+            for i in range(res.batch.n):
+                props: dict[str, Any] = {}
+                for a in self._sft.attributes:
+                    if a.name == "geom":
+                        continue
+                    v = res.batch.columns[a.name].value(i)
+                    if v is not None:
+                        _set_path(props, a.name.split("."), v)
+                g = gcol.value(i)
+                out.append({"type": "Feature",
+                            "id": str(res.batch.ids[i]),
+                            "geometry": to_geojson(g) if g is not None
+                            else None,
+                            "properties": props})
+        return out
+
+    def _dict_to_ecql(self, q: dict) -> str:
+        clauses = []
+        for k, v in q.items():
+            if k == "bbox":
+                clauses.append(f"BBOX(geom, {v[0]}, {v[1]}, {v[2]}, {v[3]})")
+            else:
+                attr = _attr_name(k)
+                if attr not in {a.name for a in self._sft.attributes}:
+                    return "EXCLUDE"
+                if isinstance(v, str):
+                    clauses.append(f"{attr} = '{v}'")
+                else:
+                    clauses.append(f"{attr} = {v}")
+        return " AND ".join(clauses) if clauses else "INCLUDE"
+
+    def get(self, fid: str) -> dict | None:
+        hits = self.query(f"IN ('{fid}')")
+        return hits[0] if hits else None
+
+    def delete(self, fids: Iterable[str]):
+        drop = set(fids)
+        keep = [i for i, f in enumerate(self._ids) if f not in drop]
+        self._ids = [self._ids[i] for i in keep]
+        self._rows = [self._rows[i] for i in keep]
+        self._geoms = [self._geoms[i] for i in keep]
+        self._rebuild()
+
+    @property
+    def size(self) -> int:
+        return len(self._ids)
+
+
+def _set_path(d: dict, parts: list[str], value):
+    for p in parts[:-1]:
+        d = d.setdefault(p, {})
+    d[parts[-1]] = value

@@ -1,0 +1,5 @@
+"""Notebook visualization (geomesa-jupyter analog)."""
+
+from .leaflet import L
+
+__all__ = ["L"]

@@ -1,0 +1,151 @@
+"""Leaflet rendering DSL (geomesa-jupyter analog:
+jupyter/Leaflet.scala:11 — the `L` object renders features/layers as a
+self-contained HTML/JS snippet for notebook display).
+
+    html = L.render([
+        L.GeoJsonLayer(features, style={"color": "#2266cc"}),
+        L.HeatmapLayer(grid, bbox),
+        L.Circle(-75.1, 38.2, 5000),
+    ], center=(-75, 38), zoom=6)
+
+The output embeds data inline and references the Leaflet CDN, matching
+the reference's notebook workflow (rendering happens client-side).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+import numpy as np
+
+__all__ = ["L"]
+
+_PAGE = """<div id="{div_id}" style="height:{height}px"></div>
+<link rel="stylesheet" href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
+<script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
+<script>
+(function() {{
+  var map = L.map('{div_id}').setView([{lat}, {lon}], {zoom});
+  L.tileLayer('https://tile.openstreetmap.org/{{z}}/{{x}}/{{y}}.png',
+              {{maxZoom: 19}}).addTo(map);
+{layers}
+}})();
+</script>"""
+
+
+class _Layer:
+    def to_js(self, var: str) -> str:
+        raise NotImplementedError
+
+
+class GeoJsonLayer(_Layer):
+    def __init__(self, features, style: dict | None = None):
+        from ..geometry import Geometry
+        from ..geometry.geojson import to_geojson
+        feats = []
+        for f in features:
+            if isinstance(f, Geometry):
+                feats.append({"type": "Feature",
+                              "geometry": to_geojson(f), "properties": {}})
+            elif isinstance(f, dict) and "geometry" in f:
+                feats.append(f)
+            else:
+                raise TypeError("GeoJsonLayer wants geometries or features")
+        self.collection = {"type": "FeatureCollection", "features": feats}
+        self.style = style or {}
+
+    def to_js(self, var: str) -> str:
+        return (f"  var {var} = L.geoJSON({json.dumps(self.collection)}, "
+                f"{{style: function() {{ return "
+                f"{json.dumps(self.style)}; }}}}).addTo(map);")
+
+
+class PointsLayer(_Layer):
+    """Circle markers from coordinate arrays (fast path for big batches)."""
+
+    def __init__(self, x, y, radius: int = 3, color: str = "#cc3311",
+                 max_points: int = 10000):
+        x = np.asarray(x, dtype=float)
+        y = np.asarray(y, dtype=float)
+        if len(x) > max_points:  # thin for the browser
+            step = int(np.ceil(len(x) / max_points))
+            x, y = x[::step], y[::step]
+        self.coords = np.stack([y, x], axis=1).round(6).tolist()
+        self.radius = radius
+        self.color = color
+
+    def to_js(self, var: str) -> str:
+        return (f"  var {var}_pts = {json.dumps(self.coords)};\n"
+                f"  var {var} = L.layerGroup({var}_pts.map(function(c) {{\n"
+                f"    return L.circleMarker(c, {{radius: {self.radius}, "
+                f"color: {json.dumps(self.color)}, weight: 1}});\n"
+                f"  }})).addTo(map);")
+
+
+class HeatmapLayer(_Layer):
+    """Density grid -> translucent colored rectangles (the DensityProcess
+    output rendered without plugin dependencies)."""
+
+    def __init__(self, grid, bbox, color: str = "#cc3311",
+                 opacity_max: float = 0.8):
+        self.grid = np.asarray(grid, dtype=float)
+        self.bbox = tuple(float(v) for v in bbox)
+        self.color = color
+        self.opacity_max = opacity_max
+
+    def to_js(self, var: str) -> str:
+        h, w = self.grid.shape
+        x0, y0, x1, y1 = self.bbox
+        top = float(self.grid.max()) or 1.0
+        cells = []
+        sx, sy = (x1 - x0) / w, (y1 - y0) / h
+        for r, c in zip(*np.nonzero(self.grid)):
+            cells.append([round(y0 + r * sy, 6), round(x0 + c * sx, 6),
+                          round(float(self.grid[r, c]) / top, 4)])
+        return (f"  var {var}_cells = {json.dumps(cells)};\n"
+                f"  var {var} = L.layerGroup({var}_cells.map(function(e) {{\n"
+                f"    return L.rectangle([[e[0], e[1]], "
+                f"[e[0] + {sy:.8f}, e[1] + {sx:.8f}]], "
+                f"{{stroke: false, fillColor: {json.dumps(self.color)}, "
+                f"fillOpacity: e[2] * {self.opacity_max}}});\n"
+                f"  }})).addTo(map);")
+
+
+class Circle(_Layer):
+    def __init__(self, x: float, y: float, radius_m: float,
+                 color: str = "#2266cc"):
+        self.x, self.y, self.radius_m, self.color = x, y, radius_m, color
+
+    def to_js(self, var: str) -> str:
+        return (f"  var {var} = L.circle([{self.y}, {self.x}], "
+                f"{{radius: {self.radius_m}, "
+                f"color: {json.dumps(self.color)}}}).addTo(map);")
+
+
+class _LDsl:
+    """The `L` entry point (mirrors the reference's `L` object)."""
+
+    GeoJsonLayer = GeoJsonLayer
+    PointsLayer = PointsLayer
+    HeatmapLayer = HeatmapLayer
+    Circle = Circle
+
+    _counter = 0
+
+    def render(self, layers: Iterable[_Layer], center=(0.0, 0.0),
+               zoom: int = 3, height: int = 500) -> str:
+        _LDsl._counter += 1
+        div_id = f"geomesa_map_{_LDsl._counter}"
+        js = "\n".join(layer.to_js(f"lyr{i}")
+                       for i, layer in enumerate(layers))
+        return _PAGE.format(div_id=div_id, height=height,
+                            lon=float(center[0]), lat=float(center[1]),
+                            zoom=zoom, layers=js)
+
+    def display(self, layers, **kw):  # pragma: no cover - notebook only
+        from IPython.display import HTML
+        return HTML(self.render(layers, **kw))
+
+
+L = _LDsl()

@@ -12,6 +12,7 @@ Subcommands (mirroring the reference's tools/ command set):
     explain         --path R --name T --cql F
     stats           --path R --name T --stat-spec 'MinMax(a)' [--cql F]
     density         --path R --name T --bbox x1,y1,x2,y2 --size WxH [--cql F]
+    serve           --path R [--host H] [--port P]
     version / env
 """
 
@@ -180,6 +181,18 @@ def cmd_density(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """REST endpoints over the store (geomesa-web analog)."""
+    from ..web import GeoMesaWebServer
+    srv = GeoMesaWebServer(_store(args), host=args.host, port=args.port)
+    print(f"serving on http://{args.host}:{srv.port}/rest/", file=sys.stderr)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
 def cmd_version(args) -> int:
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -234,6 +247,9 @@ def main(argv=None) -> int:
     add("density", cmd_density, name_arg, cql_arg,
         (["--bbox"], {"required": True}),
         (["--size"], {"required": True}))
+    add("serve", cmd_serve,
+        (["--host"], {"default": "127.0.0.1"}),
+        (["--port"], {"type": int, "default": 8080}))
     add("version", cmd_version, needs_store=False)
     add("env", cmd_env, needs_store=False)
 

@@ -1,0 +1,5 @@
+"""REST surface (geomesa-web analog)."""
+
+from .server import GeoMesaWebServer
+
+__all__ = ["GeoMesaWebServer"]

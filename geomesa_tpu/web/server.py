@@ -1,0 +1,208 @@
+"""REST endpoints over a datastore (geomesa-web analog).
+
+Mirrors the reference's servlet surface (geomesa-web-core
+SpringScalatraBootstrap.scala:69, DataEndpoint, GeoMesaStatsEndpoint
+web/stats/GeoMesaStatsEndpoint.scala:30, QueryAuditEndpoint) on the
+stdlib http server — no framework dependency:
+
+    GET  /rest/version
+    GET  /rest/schemas                      -> ["type", ...]
+    POST /rest/schemas/{type}   body=spec   -> create schema
+    GET  /rest/schemas/{type}               -> {"name":..., "spec":...}
+    DELETE /rest/schemas/{type}
+    GET  /rest/query/{type}?cql=&maxFeatures=&format=json|geojson|arrow
+    GET  /rest/stats/{type}?stat=MinMax(attr)&cql=
+    GET  /rest/density/{type}?bbox=x0,y0,x1,y1&width=&height=&cql=
+    GET  /rest/audit?type=&since=
+
+Queries run the normal planner/scan path; arrow responses stream IPC
+bytes (content-type application/vnd.apache.arrow.file).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+import numpy as np
+
+from .. import __version__ as _version
+from ..index.api import Query
+
+__all__ = ["GeoMesaWebServer"]
+
+
+class GeoMesaWebServer:
+    """Bind a datastore to an HTTP port. ``start()`` serves on a daemon
+    thread (tests/notebooks); ``serve_forever()`` blocks (CLI)."""
+
+    def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
+                 audit=None):
+        self.store = store
+        self.audit = audit if audit is not None \
+            else getattr(store, "audit", None)
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "GeoMesaWebServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self._httpd.serve_forever()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- request handling (called from the handler) -----------------------
+
+    def handle(self, method: str, path: str, params: dict, body: bytes):
+        """Route -> (status, content_type, payload bytes)."""
+        parts = [unquote(p) for p in path.strip("/").split("/") if p]
+        if not parts or parts[0] != "rest":
+            return 404, "application/json", _j({"error": "not found"})
+        parts = parts[1:]
+        try:
+            return self._route(method, parts, params, body)
+        except KeyError as e:
+            return 404, "application/json", _j({"error": str(e)})
+        except Exception as e:  # surface planner/parse errors as 400s
+            return 400, "application/json", _j({"error": repr(e)})
+
+    def _route(self, method, parts, params, body):
+        if parts == ["version"]:
+            return 200, "application/json", _j({"version": _version})
+        if parts == ["schemas"]:
+            return 200, "application/json", _j(self.store.get_type_names())
+        if len(parts) == 2 and parts[0] == "schemas":
+            name = parts[1]
+            if method == "POST":
+                self.store.create_schema(name, body.decode())
+                return 201, "application/json", _j({"created": name})
+            if method == "DELETE":
+                self.store.remove_schema(name)
+                return 200, "application/json", _j({"removed": name})
+            sft = self.store.get_schema(name)
+            return 200, "application/json", _j(
+                {"name": name, "spec": sft.to_spec(),
+                 "attributes": [{"name": a.name, "type": str(a.type)}
+                                for a in sft.attributes]})
+        if len(parts) == 2 and parts[0] == "query":
+            return self._query(parts[1], params)
+        if len(parts) == 2 and parts[0] == "stats":
+            stat = self.store.stats_query(
+                parts[1], params.get("stat", ["Count()"])[0],
+                params.get("cql", [None])[0])
+            return 200, "application/json", _j(stat.to_json_object())
+        if len(parts) == 2 and parts[0] == "density":
+            return self._density(parts[1], params)
+        if parts == ["audit"]:
+            if self.audit is None:
+                return 200, "application/json", _j([])
+            evs = self.audit.query(
+                params.get("type", [None])[0],
+                int(params["since"][0]) if "since" in params else None)
+            return 200, "application/json", _j(
+                [json.loads(e.to_json()) for e in evs])
+        return 404, "application/json", _j({"error": "not found"})
+
+    def _query(self, name, params):
+        cql = params.get("cql", ["INCLUDE"])[0]
+        fmt = params.get("format", ["json"])[0]
+        q = Query(name, cql)
+        if "maxFeatures" in params:
+            q.max_features = int(params["maxFeatures"][0])
+        if fmt == "arrow":
+            from ..arrow.io import write_ipc
+            res = self.store.query(q)
+            sft = self.store.get_schema(name)
+            batch = res.batch
+            if batch is None:
+                from ..features.batch import FeatureBatch
+                batch = FeatureBatch.from_dict(
+                    sft, np.empty(0, dtype=object),
+                    {a.name: ((np.empty(0), np.empty(0))
+                              if a.type.name == "Point" else [])
+                     for a in sft.attributes})
+            return (200, "application/vnd.apache.arrow.file",
+                    write_ipc(sft, batch))
+        res = self.store.query(q)
+        sft = self.store.get_schema(name)
+        if fmt == "geojson":
+            from ..geometry.geojson import to_geojson
+            feats = []
+            if res.batch is not None:
+                gf = sft.geom_field
+                for f in res.features():
+                    g = f.get(gf)
+                    feats.append({
+                        "type": "Feature", "id": f["id"],
+                        "geometry": to_geojson(g) if g is not None else None,
+                        "properties": {k: v for k, v in f.items()
+                                       if k not in ("id", gf)}})
+            return 200, "application/geo+json", _j(
+                {"type": "FeatureCollection", "features": feats})
+        rows = list(res.features()) if res.batch is not None else []
+        return 200, "application/json", _j({"count": len(rows),
+                                            "features": rows})
+
+    def _density(self, name, params):
+        bbox = tuple(float(v) for v in params["bbox"][0].split(","))
+        width = int(params.get("width", ["256"])[0])
+        height = int(params.get("height", ["256"])[0])
+        cql = params.get("cql", ["INCLUDE"])[0]
+        grid = self.store.density(name, cql, bbox, width, height)
+        return 200, "application/json", _j(
+            {"bbox": bbox, "width": width, "height": height,
+             "grid": np.asarray(grid).tolist()})
+
+
+def _j(obj) -> bytes:
+    return json.dumps(obj, default=_default).encode()
+
+
+def _default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    from ..geometry import Geometry
+    if isinstance(o, Geometry):
+        from ..geometry.wkt import to_wkt
+        return to_wkt(o)
+    return str(o)
+
+
+def _make_handler(server: GeoMesaWebServer):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _respond(self):
+            u = urlparse(self.path)
+            params = parse_qs(u.query)
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            status, ctype, payload = server.handle(
+                self.command, u.path, params, body)
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        do_GET = do_POST = do_DELETE = _respond
+
+    return Handler

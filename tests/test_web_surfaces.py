@@ -1,0 +1,211 @@
+"""Surface tests: REST server, native API, GeoJSON store, blobstore,
+leaflet rendering."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.api import GeoMesaIndex, JsonSerializer, PickleSerializer
+from geomesa_tpu.blob import BlobStore
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import parse_spec
+from geomesa_tpu.geojson_store import GeoJsonIndex
+from geomesa_tpu.jupyter import L
+from geomesa_tpu.store.memory import InMemoryDataStore
+from geomesa_tpu.web import GeoMesaWebServer
+
+SPEC = "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326"
+
+
+def seeded_store(n=100):
+    rng = np.random.default_rng(5)
+    sft = parse_spec("people", SPEC)
+    ds = InMemoryDataStore()
+    ds.create_schema(sft)
+    ds.write("people", FeatureBatch.from_dict(
+        sft, [f"p{i}" for i in range(n)],
+        {"name": [f"n{i % 7}" for i in range(n)],
+         "age": np.arange(n),
+         "dtg": rng.integers(0, 10**12, n),
+         "geom": (rng.uniform(-100, -60, n), rng.uniform(25, 50, n))}))
+    return ds
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = GeoMesaWebServer(seeded_store()).start()
+    yield srv
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}") as r:
+        return r.status, r.headers.get_content_type(), r.read()
+
+
+class TestRest:
+    def test_version_and_schemas(self, server):
+        st, _, body = _get(server, "/rest/version")
+        assert st == 200 and "version" in json.loads(body)
+        st, _, body = _get(server, "/rest/schemas")
+        assert json.loads(body) == ["people"]
+        st, _, body = _get(server, "/rest/schemas/people")
+        d = json.loads(body)
+        assert d["attributes"][0] == {"name": "name", "type": "String"}
+
+    def test_query_json(self, server):
+        st, _, body = _get(server, "/rest/query/people?cql=age%20%3C%205")
+        d = json.loads(body)
+        assert st == 200 and d["count"] == 5
+
+    def test_query_geojson(self, server):
+        st, ct, body = _get(server,
+                            "/rest/query/people?cql=age%3D3&format=geojson")
+        assert ct == "application/geo+json"
+        d = json.loads(body)
+        f = d["features"][0]
+        assert f["properties"]["age"] == 3
+        assert f["geometry"]["type"] == "Point"
+
+    def test_query_arrow(self, server):
+        from geomesa_tpu.arrow import read_ipc_batches
+        st, ct, body = _get(server,
+                            "/rest/query/people?cql=age%20%3C%2010&format=arrow")
+        assert ct == "application/vnd.apache.arrow.file"
+        sft, batch = read_ipc_batches(body)
+        assert batch.n == 10
+
+    def test_stats(self, server):
+        st, _, body = _get(server,
+                           "/rest/stats/people?stat=MinMax(age)")
+        d = json.loads(body)
+        assert d["min"] == 0 and d["max"] == 99
+
+    def test_density(self, server):
+        st, _, body = _get(server, "/rest/density/people?"
+                                   "bbox=-100,25,-60,50&width=16&height=8")
+        d = json.loads(body)
+        total = sum(sum(r) for r in d["grid"])
+        assert total == 100
+
+    def test_create_and_delete_schema(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/rest/schemas/tmp",
+            data=b"a:Integer,*geom:Point", method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 201
+        st, _, body = _get(server, "/rest/schemas")
+        assert "tmp" in json.loads(body)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/rest/schemas/tmp",
+            method="DELETE")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+
+    def test_bad_cql_is_400(self, server):
+        try:
+            _get(server, "/rest/query/people?cql=%3C%3C%3C")
+            assert False, "should raise"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
+class TestNativeApi:
+    def test_insert_query(self):
+        idx = GeoMesaIndex.memory(PickleSerializer())
+        idx.insert("a", {"v": 1}, -75.0, 38.0, dtg=1000)
+        idx.insert("b", {"v": 2}, -75.1, 38.1, dtg=2000)
+        idx.insert("c", {"v": 3}, 10.0, 50.0, dtg=3000)
+        vals = idx.query(bbox=(-80, 35, -70, 40))
+        assert sorted(v["v"] for v in vals) == [1, 2]
+        vals = idx.query(bbox=(-80, 35, -70, 40), interval=(1500, 2500))
+        assert [v["v"] for v in vals] == [2]
+        assert idx.get("c") == {"v": 3}
+        idx.delete("a")
+        assert idx.size() == 2
+
+    def test_json_serializer_batch(self):
+        idx = GeoMesaIndex.memory(JsonSerializer())
+        idx.insert_batch([f"i{k}" for k in range(10)],
+                         [{"k": k} for k in range(10)],
+                         np.linspace(-10, 10, 10), np.zeros(10),
+                         np.arange(10) * 1000)
+        out = idx.query(bbox=(-5, -1, 5, 1), with_ids=True)
+        assert all(isinstance(i, str) for i, _ in out)
+        assert len(out) == 4
+
+
+class TestGeoJsonStore:
+    def test_put_query_dotpath(self):
+        idx = GeoJsonIndex()
+        ids = idx.put({"type": "FeatureCollection", "features": [
+            {"type": "Feature", "id": "x1",
+             "geometry": {"type": "Point", "coordinates": [10, 20]},
+             "properties": {"name": "n1", "meta": {"depth": 5}}},
+            {"type": "Feature",
+             "geometry": {"type": "Point", "coordinates": [11, 21]},
+             "properties": {"name": "n2", "meta": {"depth": 9}}},
+        ]})
+        assert ids[0] == "x1"
+        hits = idx.query({"name": "n2"})
+        assert len(hits) == 1
+        assert hits[0]["properties"]["meta"]["depth"] == 9
+        hits = idx.query({"meta.depth": 5})
+        assert hits[0]["id"] == "x1"
+        hits = idx.query({"bbox": [9, 19, 10.5, 20.5]})
+        assert len(hits) == 1 and hits[0]["id"] == "x1"
+        assert idx.get("x1")["properties"]["name"] == "n1"
+        idx.delete(["x1"])
+        assert idx.size == 1
+
+    def test_schema_widens(self):
+        idx = GeoJsonIndex()
+        idx.put({"type": "Feature",
+                 "geometry": {"type": "Point", "coordinates": [0, 0]},
+                 "properties": {"a": 1}})
+        idx.put({"type": "Feature",
+                 "geometry": {"type": "Point", "coordinates": [1, 1]},
+                 "properties": {"b": "two"}})
+        assert len(idx.query({"b": "two"})) == 1
+        assert len(idx.query({"a": 1})) == 1
+
+
+class TestBlobStore:
+    def test_roundtrip_memory(self):
+        bs = BlobStore()
+        bid = bs.put(b"payload-bytes", "f.bin", x=-75.0, y=38.0, dtg=123)
+        data, fname = bs.get(bid)
+        assert data == b"payload-bytes" and fname == "f.bin"
+        assert bs.query_ids("BBOX(geom, -80, 35, -70, 40)") == [bid]
+        assert bs.query_ids("BBOX(geom, 0, 0, 1, 1)") == []
+        bs.delete(bid)
+        assert bs.get(bid) is None
+
+    def test_directory_and_wkt(self, tmp_path):
+        bs = BlobStore(directory=str(tmp_path / "blobs"))
+        bid = bs.put(b"\x01\x02", "poly.bin",
+                     wkt="POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))")
+        data, _ = bs.get(bid)
+        assert data == b"\x01\x02"
+        assert bs.query_ids("BBOX(geom, 0.5, 0.5, 1.5, 1.5)") == [bid]
+
+
+class TestLeaflet:
+    def test_render_layers(self):
+        html = L.render([
+            L.PointsLayer([1.0, 2.0], [3.0, 4.0]),
+            L.Circle(-75.0, 38.0, 1000),
+            L.HeatmapLayer(np.array([[0, 1.0], [2.0, 0]]), (0, 0, 2, 2)),
+        ], center=(-75, 38), zoom=7)
+        assert "leaflet" in html
+        assert "circleMarker" in html and "L.circle(" in html
+        assert "L.rectangle" in html
+        assert "[38.0, -75.0]" in html or "38.0" in html
+
+    def test_geojson_layer(self):
+        from geomesa_tpu.geometry import parse_wkt
+        html = L.render([L.GeoJsonLayer([parse_wkt("POINT (1 2)")])])
+        assert "geoJSON" in html
