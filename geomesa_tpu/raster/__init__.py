@@ -1,0 +1,213 @@
+"""Raster/coverage store (geomesa-accumulo-raster analog).
+
+The reference stores raster chunks in Accumulo keyed by
+[resolution-lexicode][geohash] (raster/data/AccumuloRasterStore.scala:37,
+RasterIndexSchema), picks the closest available resolution at query time
+(AccumuloRasterQueryPlanner), filters chunks by bbox with a server-side
+iterator (RasterFilteringIterator), and mosaics client-side for WCS
+(GeoMesaCoverageReader).
+
+TPU-native shape: tiles are dense float32 arrays keyed by
+(resolution-level, geohash); query = geohash covering of the bbox at the
+level's precision (an index lookup, not a scan); the mosaic resample is
+one jitted gather kernel on device — the "client mosaic" becomes an XLA
+program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import math
+import os
+
+import numpy as np
+
+from ..geohash import covering, decode_bbox, encode
+
+__all__ = ["RasterStore", "RasterTile"]
+
+
+@dataclasses.dataclass
+class RasterTile:
+    """One stored chunk: data over the geohash cell's bbox."""
+    geohash: str
+    level: int          # resolution level (higher = finer)
+    data: np.ndarray    # (h, w) float32, row 0 = south edge
+
+    @property
+    def bbox(self) -> tuple[float, float, float, float]:
+        return decode_bbox(self.geohash)
+
+
+def _level_precision(level: int) -> int:
+    """Geohash precision for a resolution level: level n tiles cover
+    precision-n cells (reference: geohash length keys the chunk size)."""
+    return max(1, min(9, level))
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("out_h", "out_w"))
+def _resample_kernel(tile_stack, tile_x0, tile_y0, tile_sx, tile_sy,
+                     tile_valid, xs, ys, out_h: int, out_w: int):
+    """Nearest-neighbor mosaic: for each output pixel, find the first
+    valid tile containing it and gather the pixel. tile_stack is
+    (n_tiles, th, tw); xs/ys are output pixel centers."""
+    import jax.numpy as jnp
+    n, th, tw = tile_stack.shape
+    gx = xs[None, :]                      # (1, W)
+    gy = ys[:, None]                      # (H, 1)
+    # per-tile fractional indices
+    fx = (gx[None] - tile_x0[:, None, None]) / tile_sx[:, None, None]
+    fy = (gy[None] - tile_y0[:, None, None]) / tile_sy[:, None, None]
+    ix = jnp.floor(fx).astype(jnp.int32)
+    iy = jnp.floor(fy).astype(jnp.int32)
+    inside = ((ix >= 0) & (ix < tw) & (iy >= 0) & (iy < th)
+              & tile_valid[:, None, None])
+    ixc = jnp.clip(ix, 0, tw - 1)
+    iyc = jnp.clip(iy, 0, th - 1)
+    vals = jnp.take_along_axis(
+        tile_stack.reshape(n, -1),
+        (iyc * tw + ixc).reshape(n, -1), axis=1).reshape(n, out_h, out_w)
+    # first valid tile wins
+    first = jnp.argmax(inside, axis=0)
+    any_valid = jnp.any(inside, axis=0)
+    picked = jnp.take_along_axis(vals, first[None], axis=0)[0]
+    return jnp.where(any_valid, picked, jnp.nan)
+
+
+class RasterStore:
+    """In-memory (optionally directory-persisted) pyramid of raster
+    tiles with bbox query + device mosaic."""
+
+    def __init__(self, directory: str | None = None):
+        self.directory = directory
+        self._tiles: dict[tuple[int, str], np.ndarray] = {}
+        if directory and os.path.isdir(directory):
+            self._load_catalog()
+
+    # -- ingest ------------------------------------------------------------
+
+    def put_raster(self, data: np.ndarray, bbox, level: int,
+                   tile_size: int = 256):
+        """Chop a georeferenced grid into geohash tiles at `level`.
+
+        data is (h, w), row 0 at the south edge, spanning bbox
+        (xmin, ymin, xmax, ymax).
+        """
+        data = np.asarray(data, dtype=np.float32)
+        h, w = data.shape
+        xmin, ymin, xmax, ymax = (float(v) for v in bbox)
+        sx = (xmax - xmin) / w
+        sy = (ymax - ymin) / h
+        prec = _level_precision(level)
+        for gh in covering(xmin, ymin, xmax, ymax, prec):
+            gx0, gy0, gx1, gy1 = decode_bbox(gh)
+            # source index range overlapping this cell
+            c0 = max(0, int(math.floor((gx0 - xmin) / sx)))
+            c1 = min(w, int(math.ceil((gx1 - xmin) / sx)))
+            r0 = max(0, int(math.floor((gy0 - ymin) / sy)))
+            r1 = min(h, int(math.ceil((gy1 - ymin) / sy)))
+            if c1 <= c0 or r1 <= r0:
+                continue
+            # resample the overlap onto the tile grid (nearest)
+            tile = np.full((tile_size, tile_size), np.nan, dtype=np.float32)
+            tx = (np.arange(tile_size) + 0.5) / tile_size * (gx1 - gx0) + gx0
+            ty = (np.arange(tile_size) + 0.5) / tile_size * (gy1 - gy0) + gy0
+            ci = np.floor((tx - xmin) / sx).astype(int)
+            ri = np.floor((ty - ymin) / sy).astype(int)
+            okc = (ci >= 0) & (ci < w)
+            okr = (ri >= 0) & (ri < h)
+            sub = data[np.clip(ri, 0, h - 1)[:, None],
+                       np.clip(ci, 0, w - 1)[None, :]]
+            sub = np.where(okr[:, None] & okc[None, :], sub, np.nan)
+            key = (level, gh)
+            if key in self._tiles:  # merge: new data wins where non-nan
+                old = self._tiles[key]
+                sub = np.where(np.isnan(sub), old, sub)
+            self._tiles[key] = sub
+            self._persist(key, sub)
+
+    # -- query -------------------------------------------------------------
+
+    @property
+    def levels(self) -> list[int]:
+        return sorted({lv for lv, _ in self._tiles})
+
+    def closest_level(self, level: int) -> int | None:
+        """The available level closest to the request (the reference's
+        closest-resolution pick, AccumuloRasterQueryPlanner)."""
+        lvls = self.levels
+        if not lvls:
+            return None
+        return min(lvls, key=lambda lv: (abs(lv - level), -lv))
+
+    def query_tiles(self, bbox, level: int) -> list[RasterTile]:
+        lv = self.closest_level(level)
+        if lv is None:
+            return []
+        prec = _level_precision(lv)
+        out = []
+        for gh in covering(*(float(v) for v in bbox), prec):
+            t = self._tiles.get((lv, gh))
+            if t is not None:
+                out.append(RasterTile(gh, lv, t))
+        return out
+
+    def mosaic(self, bbox, width: int, height: int,
+               level: int | None = None) -> np.ndarray:
+        """Assemble a (height, width) grid over bbox on device; NaN where
+        no coverage."""
+        xmin, ymin, xmax, ymax = (float(v) for v in bbox)
+        if level is None:
+            # pick the level whose tile pixel pitch best matches the output
+            level = 9
+            for lv in self.levels:
+                gh = next(g for (l2, g) in self._tiles if l2 == lv)
+                x0, y0, x1, y1 = decode_bbox(gh)
+                if (x1 - x0) / self._tiles[(lv, gh)].shape[1] <= \
+                        (xmax - xmin) / width:
+                    level = lv
+                    break
+        tiles = self.query_tiles(bbox, level)
+        if not tiles:
+            return np.full((height, width), np.nan, dtype=np.float32)
+        stack = np.stack([t.data for t in tiles])
+        x0 = np.array([t.bbox[0] for t in tiles], dtype=np.float32)
+        y0 = np.array([t.bbox[1] for t in tiles], dtype=np.float32)
+        sxv = np.array([(t.bbox[2] - t.bbox[0]) / t.data.shape[1]
+                        for t in tiles], dtype=np.float32)
+        syv = np.array([(t.bbox[3] - t.bbox[1]) / t.data.shape[0]
+                        for t in tiles], dtype=np.float32)
+        valid = np.ones(len(tiles), dtype=bool)
+        xs = (np.arange(width, dtype=np.float32) + 0.5) \
+            * (xmax - xmin) / width + xmin
+        ys = (np.arange(height, dtype=np.float32) + 0.5) \
+            * (ymax - ymin) / height + ymin
+        out = _resample_kernel(stack, x0, y0, sxv, syv, valid, xs, ys,
+                               height, width)
+        return np.asarray(out)
+
+    # -- persistence -------------------------------------------------------
+
+    def _persist(self, key, tile):
+        if not self.directory:
+            return
+        lv, gh = key
+        d = os.path.join(self.directory, str(lv))
+        os.makedirs(d, exist_ok=True)
+        np.save(os.path.join(d, f"{gh}.npy"), tile)
+
+    def _load_catalog(self):
+        for lv_name in os.listdir(self.directory):
+            d = os.path.join(self.directory, lv_name)
+            if not (os.path.isdir(d) and lv_name.isdigit()):
+                continue
+            for f in os.listdir(d):
+                if f.endswith(".npy"):
+                    self._tiles[(int(lv_name), f[:-4])] = \
+                        np.load(os.path.join(d, f))
+
+    @property
+    def num_tiles(self) -> int:
+        return len(self._tiles)

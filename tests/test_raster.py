@@ -1,0 +1,80 @@
+"""Raster store tests: tiling, level selection, mosaic correctness,
+persistence."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.raster import RasterStore
+
+
+def gradient(h, w, bbox):
+    """f(x, y) = x + 2y sampled at pixel centers (analytic ground truth)."""
+    xmin, ymin, xmax, ymax = bbox
+    xs = (np.arange(w) + 0.5) / w * (xmax - xmin) + xmin
+    ys = (np.arange(h) + 0.5) / h * (ymax - ymin) + ymin
+    return (xs[None, :] + 2 * ys[:, None]).astype(np.float32)
+
+
+class TestRaster:
+    def test_put_and_query_tiles(self):
+        rs = RasterStore()
+        bbox = (-10.0, 40.0, 10.0, 50.0)
+        rs.put_raster(gradient(100, 200, bbox), bbox, level=2)
+        assert rs.num_tiles > 0
+        tiles = rs.query_tiles((-5, 42, 5, 48), level=2)
+        assert tiles
+        for t in tiles:
+            b = t.bbox
+            assert b[2] > -5 and b[0] < 5 and b[3] > 42 and b[1] < 48
+
+    def test_mosaic_matches_function(self):
+        rs = RasterStore()
+        bbox = (-10.0, 40.0, 10.0, 50.0)
+        rs.put_raster(gradient(200, 400, bbox), bbox, level=3)
+        out = rs.mosaic((-8, 41, 8, 49), 64, 32, level=3)
+        assert out.shape == (32, 64)
+        truth = gradient(32, 64, (-8, 41, 8, 49))
+        ok = ~np.isnan(out)
+        assert ok.mean() > 0.99
+        # nearest-neighbor resample: tolerance = source pixel pitch
+        assert np.nanmax(np.abs(out - truth)) < 0.15
+
+    def test_nan_outside_coverage(self):
+        rs = RasterStore()
+        bbox = (0.0, 0.0, 5.0, 5.0)
+        rs.put_raster(gradient(50, 50, bbox), bbox, level=3)
+        out = rs.mosaic((0, 0, 20, 20), 40, 40, level=3)
+        assert np.isnan(out[-1, -1])      # beyond data
+        assert not np.isnan(out[2, 2])    # inside data
+
+    def test_closest_level(self):
+        rs = RasterStore()
+        bbox = (0.0, 0.0, 10.0, 10.0)
+        rs.put_raster(gradient(40, 40, bbox), bbox, level=2)
+        rs.put_raster(gradient(160, 160, bbox), bbox, level=4)
+        assert rs.closest_level(1) == 2
+        assert rs.closest_level(4) == 4
+        assert rs.closest_level(9) == 4
+        # tie prefers finer
+        assert rs.closest_level(3) == 4
+
+    def test_multi_raster_merge(self):
+        rs = RasterStore()
+        rs.put_raster(gradient(50, 50, (0, 0, 5, 5)), (0, 0, 5, 5), level=3)
+        rs.put_raster(gradient(50, 50, (5, 0, 10, 5)), (5, 0, 10, 5), level=3)
+        out = rs.mosaic((0, 0, 10, 5), 100, 50, level=3)
+        truth = gradient(50, 100, (0, 0, 10, 5))
+        ok = ~np.isnan(out)
+        assert ok.mean() > 0.98
+        assert np.nanmax(np.abs(out - truth)) < 0.25
+
+    def test_persistence(self, tmp_path):
+        d = str(tmp_path / "raster")
+        rs = RasterStore(directory=d)
+        bbox = (0.0, 0.0, 5.0, 5.0)
+        rs.put_raster(gradient(50, 50, bbox), bbox, level=3)
+        rs2 = RasterStore(directory=d)
+        assert rs2.num_tiles == rs.num_tiles
+        a = rs.mosaic(bbox, 20, 20, level=3)
+        b = rs2.mosaic(bbox, 20, 20, level=3)
+        assert np.array_equal(a, b, equal_nan=True)
