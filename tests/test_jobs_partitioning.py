@@ -1,0 +1,155 @@
+"""Spatial partitioning + jobs tests."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.analytics.join import dwithin_join
+from geomesa_tpu.analytics.partitioning import (IndexPartitioner,
+                                                assign_partitions,
+                                                grid_partitions,
+                                                partitioned_dwithin_join,
+                                                quadtree_partitions)
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import parse_spec
+from geomesa_tpu.jobs import (AttributeIndexJob, ConverterIngestJob,
+                              SchemaCopyJob, fs_partition_splits,
+                              query_splits, run_job)
+from geomesa_tpu.store.memory import InMemoryDataStore
+
+SPEC = "name:String,age:Integer,*geom:Point:srid=4326"
+
+
+def seeded(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    sft = parse_spec("pts", SPEC)
+    ds = InMemoryDataStore()
+    ds.create_schema(sft)
+    ds.write("pts", FeatureBatch.from_dict(
+        sft, [f"p{i}" for i in range(n)],
+        {"name": [f"n{i % 5}" for i in range(n)],
+         "age": np.arange(n),
+         "geom": (rng.uniform(-50, 50, n), rng.uniform(-30, 30, n))}))
+    return ds
+
+
+class TestPartitioning:
+    def test_grid(self):
+        cells = grid_partitions((-10, -10, 10, 10), 4, 2)
+        assert cells.shape == (8, 4)
+        assert cells[:, 0].min() == -10 and cells[:, 2].max() == 10
+
+    def test_assign_unique_total(self):
+        rng = np.random.default_rng(1)
+        x = rng.uniform(-10, 10, 5000)
+        y = rng.uniform(-10, 10, 5000)
+        cells = grid_partitions((-10, -10, 10.001, 10.001), 5, 5)
+        p = assign_partitions(x, y, cells)
+        assert (p >= 0).all()
+        # each point in exactly the cell containing it
+        for i in range(0, 5000, 997):
+            c = cells[p[i]]
+            assert c[0] <= x[i] < c[2] and c[1] <= y[i] < c[3]
+
+    def test_quadtree_refines_dense_areas(self):
+        rng = np.random.default_rng(2)
+        # dense cluster + sparse background
+        x = np.concatenate([rng.normal(0, 0.1, 20000),
+                            rng.uniform(-50, 50, 1000)])
+        y = np.concatenate([rng.normal(0, 0.1, 20000),
+                            rng.uniform(-50, 50, 1000)])
+        cells = quadtree_partitions(x, y, target_per_cell=2000)
+        assert len(cells) > 4
+        # cells near the cluster are smaller than outer cells
+        w = cells[:, 2] - cells[:, 0]
+        near = ((cells[:, 0] < 0.2) & (cells[:, 2] > -0.2)
+                & (cells[:, 1] < 0.2) & (cells[:, 3] > -0.2))
+        assert w[near].min() < w.max() / 4
+        p = assign_partitions(x, y, cells)
+        assert (p >= 0).all()
+        counts = np.bincount(p, minlength=len(cells))
+        # roughly bounded by target (sampled refinement is approximate)
+        assert counts.max() <= 4000
+
+    def test_partitioned_join_matches_brute(self):
+        rng = np.random.default_rng(3)
+        xa, ya = rng.uniform(-5, 5, 2000), rng.uniform(-5, 5, 2000)
+        xb, yb = rng.uniform(-5, 5, 300), rng.uniform(-5, 5, 300)
+        r = 0.3
+        pairs = partitioned_dwithin_join(xa, ya, xb, yb, r,
+                                         target_per_cell=500)
+        _, brute = dwithin_join(xa, ya, xb, yb, r)
+        brute = brute[np.lexsort((brute[:, 1], brute[:, 0]))]
+        assert np.array_equal(pairs, brute)
+
+    def test_index_partitioner(self):
+        p = IndexPartitioner(4)
+        assert p.partition(2) == 2
+        with pytest.raises(KeyError):
+            p.partition(4)
+
+
+class TestJobs:
+    def test_query_splits_cover_all(self):
+        ds = seeded(100)
+        splits = query_splits(ds, "pts", "age < 50", n_splits=4)
+        total = sum(hi - lo for _, lo, hi in (s.payload for s in splits))
+        assert total == 50 and len(splits) == 4
+
+    def test_run_job_reduce(self):
+        ds = seeded(100)
+        splits = query_splits(ds, "pts", n_splits=7)
+
+        def count(split):
+            b, lo, hi = split.payload
+            return hi - lo
+
+        assert run_job(count, splits, reduce_fn=sum) == 100
+
+    def test_schema_copy(self):
+        src = seeded(200)
+        dst = InMemoryDataStore()
+        n = SchemaCopyJob(src, dst).run("pts", "age < 120")
+        assert n == 120
+        assert dst.count("pts") == 120
+
+    def test_converter_ingest_parallel(self, tmp_path):
+        files = []
+        for k in range(6):
+            f = tmp_path / f"in{k}.csv"
+            f.write_text("".join(f"name{k},{k * 10 + j},{j}.0,{k}.0\n"
+                                 for j in range(10)))
+            files.append(str(f))
+        sft = parse_spec("ing", SPEC)
+        conf = {"type": "delimited-text", "id-field": "$2",
+                "fields": [
+                    {"name": "name", "transform": "$1"},
+                    {"name": "age", "transform": "$2::int"},
+                    {"name": "geom",
+                     "transform": "point($3::double, $4::double)"}]}
+        ds = InMemoryDataStore()
+        counts = ConverterIngestJob(ds, sft, conf, n_workers=3).run(files)
+        assert counts == {"success": 60, "failure": 0, "files": 6}
+        assert ds.count("ing") == 60
+
+    def test_fs_partition_splits(self, tmp_path):
+        from geomesa_tpu.store.fs import FileSystemDataStore
+        from geomesa_tpu.store.partitions import Z2Scheme
+        ds = FileSystemDataStore(str(tmp_path / "fs"))
+        sft = parse_spec("pts", SPEC)
+        ds.create_schema(sft, scheme=Z2Scheme(2))
+        rng = np.random.default_rng(4)
+        ds.write_dict("pts", [f"f{i}" for i in range(50)],
+                      {"name": ["a"] * 50, "age": np.arange(50),
+                       "geom": (rng.uniform(-170, 170, 50),
+                                rng.uniform(-80, 80, 50))})
+        splits = fs_partition_splits(ds, "pts")
+        assert len(splits) >= 2
+        assert all(s.kind == "partition" for s in splits)
+
+    def test_attribute_index_job(self):
+        ds = seeded(50)
+        n = AttributeIndexJob(ds).run("pts", "name")
+        assert n == 50
+        assert ds.get_schema("pts").attr("name").indexed
+        res = ds.query("name = 'n1'", type_name="pts")
+        assert res.n == 10
