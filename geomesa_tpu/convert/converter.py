@@ -49,6 +49,9 @@ class SimpleFeatureConverter:
             if t is None:
                 raise ValueError(f"no transform for attribute {attr.name!r}")
             self.field_exprs[attr.name] = compile_expression(t)
+        from .validators import build_validators
+        self.validators = build_validators(
+            config.get("options", {}).get("validators", []), sft)
 
     def _records(self, source) -> Iterable[list]:
         """Yield column lists; cols[0] is the raw record."""
@@ -71,6 +74,11 @@ class SimpleFeatureConverter:
             except Exception:
                 ctx.failure += 1
                 continue
+            if self.validators:
+                from .validators import validate
+                if validate(self.validators, values) is not None:
+                    ctx.failure += 1
+                    continue
             ids.append(fid)
             for name, v in values.items():
                 data[name].append(v)
@@ -167,10 +175,16 @@ class JsonConverter(SimpleFeatureConverter):
                 yield _BAD_RECORD
 
 
-def converter_for(sft: SimpleFeatureType, config: dict) -> SimpleFeatureConverter:
+def converter_for(sft: SimpleFeatureType, config: dict):
     kind = config.get("type", "delimited-text")
     if kind == "delimited-text":
         return DelimitedTextConverter(sft, config)
     if kind == "json":
         return JsonConverter(sft, config)
+    if kind in ("xml", "fixed-width", "avro", "composite"):
+        from .formats import (AvroConverter, CompositeConverter,
+                              FixedWidthConverter, XmlConverter)
+        cls = {"xml": XmlConverter, "fixed-width": FixedWidthConverter,
+               "avro": AvroConverter, "composite": CompositeConverter}[kind]
+        return cls(sft, config)
     raise ValueError(f"unknown converter type: {kind}")

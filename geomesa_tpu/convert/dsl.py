@@ -117,6 +117,9 @@ _FUNCTIONS: dict[str, Callable[..., Any]] = {
     "uuid": lambda: str(_uuid.uuid4()),
     "stringToBytes": lambda s: str(s).encode(),
     "toString": str,
+    "cacheLookup": lambda name, key, field=None: __import__(
+        "geomesa_tpu.convert.enrichment", fromlist=["cache_lookup"]
+    ).cache_lookup(name, key, field),
 }
 
 
@@ -162,6 +165,9 @@ def _parse_primary(p: _P):
     if m:
         lit = int(m.group(0))
         return lambda cols: lit
+    m = p.match_re(r"null\b")
+    if m:
+        return lambda cols: None
     m = p.match_re(r"(\w+)\s*\(")
     if m:
         name = m.group(1)

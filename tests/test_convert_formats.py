@@ -1,0 +1,197 @@
+"""Converter format breadth: XML, Avro, fixed-width, composite,
+validators, enrichment caches."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert.avro_reader import AvroFileReader, write_avro
+from geomesa_tpu.convert.converter import converter_for
+from geomesa_tpu.convert.enrichment import clear_caches, register_cache
+from geomesa_tpu.features.sft import parse_spec
+
+SFT = parse_spec("t", "name:String,age:Integer,*geom:Point")
+
+
+class TestXml:
+    CONF = {
+        "type": "xml", "feature-path": ".//entry", "id-field": "$1",
+        "fields": [
+            {"path": "@id"},
+            {"name": "name", "path": "name"},
+            {"name": "age", "path": "age", "transform": "$3::int"},
+            {"name": "geom", "path": "lon",
+             "transform": "point($4::double, $5::double)"},
+            {"path": "lat"},
+        ]}
+
+    XML = """<root>
+      <entry id="a"><name>alpha</name><age>5</age>
+        <lon>1.5</lon><lat>2.5</lat></entry>
+      <entry id="b"><name>beta</name><age>7</age>
+        <lon>3.5</lon><lat>4.5</lat></entry>
+    </root>"""
+
+    def test_parse(self):
+        conv = converter_for(SFT, self.CONF)
+        batch, ctx = conv.process(self.XML)
+        assert ctx.success == 2
+        assert batch.feature(0)["name"] == "alpha"
+        assert batch.feature(1)["age"] == 7
+        assert batch.col("geom").x[1] == 3.5
+
+    def test_attribute_path(self):
+        conf = dict(self.CONF, **{"id-field": "concat('x', $1)"})
+        conv = converter_for(SFT, conf)
+        batch, _ = conv.process(self.XML)
+        assert list(batch.ids) == ["xa", "xb"]
+
+    def test_bad_xml(self):
+        conv = converter_for(SFT, self.CONF)
+        batch, ctx = conv.process("<not-closed>")
+        assert ctx.failure == 1 and batch.n == 0
+
+
+class TestAvro:
+    SCHEMA = {"type": "record", "name": "obs", "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "age", "type": "long"},
+        {"name": "pos", "type": {"type": "record", "name": "p", "fields": [
+            {"name": "lon", "type": "double"},
+            {"name": "lat", "type": "double"}]}},
+        {"name": "tag", "type": ["null", "string"]},
+    ]}
+    RECORDS = [
+        {"name": "alpha", "age": 5, "pos": {"lon": 1.5, "lat": 2.5},
+         "tag": "x"},
+        {"name": "beta", "age": -7, "pos": {"lon": 3.5, "lat": 4.5},
+         "tag": None},
+    ]
+
+    @pytest.mark.parametrize("codec", ["null", "deflate"])
+    def test_reader_roundtrip(self, codec):
+        data = write_avro(self.SCHEMA, self.RECORDS, codec=codec)
+        r = AvroFileReader(data)
+        out = list(r)
+        assert out == self.RECORDS
+
+    def test_avro_converter(self):
+        data = write_avro(self.SCHEMA, self.RECORDS)
+        conv = converter_for(SFT, {
+            "type": "avro", "id-field": "$1",
+            "fields": [
+                {"path": "name"},
+                {"name": "name", "path": "name"},
+                {"name": "age", "path": "age", "transform": "$3::int"},
+                {"name": "geom", "path": "pos.lon",
+                 "transform": "point($4::double, $5::double)"},
+                {"path": "pos.lat"},
+            ]})
+        batch, ctx = conv.process(data)
+        assert ctx.success == 2
+        assert batch.feature(1)["age"] == -7
+        assert batch.col("geom").y[0] == 2.5
+
+    def test_zigzag_longs(self):
+        schema = {"type": "record", "name": "r", "fields": [
+            {"name": "v", "type": "long"}]}
+        vals = [{"v": v} for v in (0, -1, 1, -2**40, 2**40, 2**62)]
+        assert list(AvroFileReader(write_avro(schema, vals))) == vals
+
+
+class TestFixedWidth:
+    def test_parse(self):
+        conv = converter_for(SFT, {
+            "type": "fixed-width", "id-field": "$1",
+            "fields": [
+                {"name": "name", "start": 0, "width": 6},
+                {"name": "age", "start": 6, "width": 4,
+                 "transform": "$2::int"},
+                {"name": "geom", "start": 10, "width": 8,
+                 "transform": "point($3::double, $4::double)"},
+                {"start": 18, "width": 8},
+            ]})
+        text = ("alpha 5   1.50    2.50\n"
+                "beta  7   3.50    4.50\n")
+        batch, ctx = conv.process(text)
+        assert ctx.success == 2
+        assert batch.feature(0)["name"] == "alpha"
+        assert batch.col("geom").x[1] == 3.5
+
+
+class TestComposite:
+    def test_dispatch(self):
+        conf = {"type": "composite", "converters": [
+            {"predicate": "^J", "type": "delimited-text", "id-field": "$2",
+             "fields": [
+                 {"name": "name", "transform": "$3"},
+                 {"name": "age", "transform": "$4::int"},
+                 {"name": "geom",
+                  "transform": "point($5::double, $6::double)"}]},
+            {"predicate": ".*", "type": "delimited-text", "id-field": "$1",
+             "fields": [
+                 {"name": "name", "transform": "$2"},
+                 {"name": "age", "transform": "$3::int"},
+                 {"name": "geom",
+                  "transform": "point($4::double, $5::double)"}]},
+        ]}
+        conv = converter_for(SFT, conf)
+        text = ("J,j1,alpha,5,1.0,2.0\n"
+                "p1,beta,7,3.0,4.0\n")
+        batch, ctx = conv.process(text)
+        assert ctx.success == 2
+        assert set(batch.ids) == {"j1", "p1"}
+
+
+class TestValidators:
+    def test_has_geo_drops_null(self):
+        conv = converter_for(SFT, {
+            "type": "delimited-text", "id-field": "$1",
+            "options": {"validators": ["has-geo"]},
+            "fields": [
+                {"name": "name", "transform": "$1"},
+                {"name": "age", "transform": "$2::int"},
+                {"name": "geom",
+                 "transform": "try(point($3::double, $4::double), null)"}]})
+        batch, ctx = conv.process("a,1,1.0,2.0\nb,2,,\n")
+        assert ctx.success == 1 and ctx.failure == 1
+        assert list(batch.ids) == ["a"]
+
+    def test_index_validator_bounds(self):
+        conv = converter_for(SFT, {
+            "type": "delimited-text", "id-field": "$1",
+            "options": {"validators": ["bounds-geo"]},
+            "fields": [
+                {"name": "name", "transform": "$1"},
+                {"name": "age", "transform": "$2::int"},
+                {"name": "geom",
+                 "transform": "point($3::double, $4::double)"}]})
+        batch, ctx = conv.process("a,1,1.0,2.0\nb,2,500.0,2.0\n")
+        assert ctx.success == 1 and ctx.failure == 1
+
+    def test_unknown_validator(self):
+        with pytest.raises(ValueError):
+            converter_for(SFT, {
+                "type": "delimited-text", "id-field": "$1",
+                "options": {"validators": ["bogus"]},
+                "fields": [
+                    {"name": "name", "transform": "$1"},
+                    {"name": "age", "transform": "$2::int"},
+                    {"name": "geom",
+                     "transform": "point($3::double, $4::double)"}]})
+
+
+class TestEnrichment:
+    def test_cache_lookup_in_transform(self):
+        clear_caches()
+        register_cache("vessels", {"alpha": {"flag": "US"},
+                                   "beta": {"flag": "NO"}})
+        conv = converter_for(SFT, {
+            "type": "delimited-text", "id-field": "$1",
+            "fields": [
+                {"name": "name",
+                 "transform": "cacheLookup('vessels', $1, 'flag')"},
+                {"name": "age", "transform": "$2::int"},
+                {"name": "geom",
+                 "transform": "point($3::double, $4::double)"}]})
+        batch, ctx = conv.process("alpha,1,1.0,2.0\nbeta,2,3.0,4.0\n")
+        assert [batch.col("name").value(i) for i in range(2)] == ["US", "NO"]
