@@ -79,6 +79,11 @@ class FileSystemDataStore:
     def _load_type(self, name: str):
         with open(os.path.join(self.root, name, "metadata.json")) as fh:
             meta = json.load(fh)
+        # version-skew check (GeoMesaDataStore.checkProjectVersion analog)
+        recorded = meta.get("version")
+        if recorded is not None:
+            from ..utils.version import check_version_string
+            check_version_string(recorded, name)
         sft = parse_spec(name, meta["spec"])
         scheme = scheme_from_config(meta["partition_scheme"])
         self._types[name] = _FsTypeState(
@@ -102,9 +107,11 @@ class FileSystemDataStore:
                                  "partitioning; pass an explicit scheme")
         tdir = os.path.join(self.root, sft.type_name)
         os.makedirs(os.path.join(tdir, "data"), exist_ok=True)
+        from .. import __version__
         with open(os.path.join(tdir, "metadata.json"), "w") as fh:
             json.dump({"spec": sft.to_spec(),
-                       "partition_scheme": scheme.to_config()}, fh, indent=2)
+                       "partition_scheme": scheme.to_config(),
+                       "version": __version__}, fh, indent=2)
         self._types[sft.type_name] = _FsTypeState(sft, scheme, tdir)
 
     def get_schema(self, type_name: str) -> SimpleFeatureType:

@@ -35,6 +35,10 @@ from ..index.api import Explainer, FilterStrategy, Query, QueryHints
 from ..index.planner import decide_strategy
 from ..scan import zscan
 from ..stats import DataStoreStats, parse_stat
+from ..utils.threads import ThreadManagement
+
+# process-wide query reaper (ThreadManagement.scala's 5s sweep)
+_REAPER = ThreadManagement()
 
 __all__ = ["InMemoryDataStore", "QueryResult"]
 
@@ -298,14 +302,29 @@ class InMemoryDataStore:
             return QueryResult(np.empty(0, dtype=object), None, explain,
                                FilterStrategy("empty", None, None))
 
+        # query timeout enforcement at stage boundaries
+        # (ThreadManagement analog; geomesa.query.timeout property)
+        from ..utils.properties import QUERY_TIMEOUT
+        managed = None
+        timeout_s = q.hints.get("TIMEOUT") or QUERY_TIMEOUT.as_seconds()
+        if timeout_s:
+            from ..utils.threads import ManagedQuery
+            managed = _REAPER.register(
+                ManagedQuery(q.type_name, str(q.filter), float(timeout_s)))
+
         import time as _time
         t_plan0 = _time.perf_counter()
         strategy = decide_strategy(st.sft, q, self._indices(st.sft), st.n,
                                    stats=self.stats.get(q.type_name),
                                    explain=explain)
         t_plan = _time.perf_counter() - t_plan0
+        if managed is not None:
+            managed.check()
         t_scan0 = _time.perf_counter()
         mask = self._execute(st, q, strategy, explain)
+        if managed is not None:
+            managed.check()
+            _REAPER.complete(managed)
 
         if q.auths is not None or (st.vis != None).any():  # noqa: E711
             from ..security import evaluate_visibilities

@@ -1,0 +1,11 @@
+"""Cross-cutting runtime utilities (metadata catalog, locking, query
+timeout management, age-off, config properties)."""
+
+from .metadata import FileMetadata, InMemoryMetadata, MetadataCatalog
+from .locking import FileLock, LocalLock, with_lock
+from .threads import ManagedQuery, ThreadManagement
+from .properties import SystemProperty
+
+__all__ = ["MetadataCatalog", "InMemoryMetadata", "FileMetadata",
+           "LocalLock", "FileLock", "with_lock", "ThreadManagement",
+           "ManagedQuery", "SystemProperty"]

@@ -1,0 +1,83 @@
+"""Distributed-style locking for schema mutations.
+
+Analog of DistributedLocking / ZookeeperLocking (geomesa-index-api/
+.../utils/DistributedLocking.scala:14, geomesa-zk-utils) — the
+reference guards schema create/delete with ZK locks; here the two
+deployment shapes are in-process (LocalLock) and cross-process via
+O_EXCL lock files with stale-lock breaking (FileLock)."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+__all__ = ["LocalLock", "FileLock", "with_lock"]
+
+
+class LocalLock:
+    """Named in-process locks (LocalLocking analog)."""
+
+    _locks: dict[str, threading.RLock] = {}
+    _guard = threading.Lock()
+
+    def __init__(self, key: str):
+        with LocalLock._guard:
+            self._lock = LocalLock._locks.setdefault(key, threading.RLock())
+
+    def acquire(self, timeout_s: float = 60.0) -> bool:
+        return self._lock.acquire(timeout=timeout_s)
+
+    def release(self):
+        self._lock.release()
+
+
+class FileLock:
+    """Cross-process lock file created with O_EXCL; the holder writes
+    its pid + timestamp, and locks older than `stale_s` are broken
+    (a crash analog of ZK ephemeral-node expiry)."""
+
+    def __init__(self, path: str, stale_s: float = 300.0):
+        self.path = path
+        self.stale_s = stale_s
+        self._held = False
+
+    def acquire(self, timeout_s: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, f"{os.getpid()} {time.time()}".encode())
+                os.close(fd)
+                self._held = True
+                return True
+            except FileExistsError:
+                self._break_if_stale()
+                if time.monotonic() >= deadline:
+                    return False
+                time.sleep(0.02)
+
+    def _break_if_stale(self):
+        try:
+            age = time.time() - os.path.getmtime(self.path)
+            if age > self.stale_s:
+                os.remove(self.path)
+        except OSError:
+            pass
+
+    def release(self):
+        if self._held:
+            self._held = False
+            with contextlib.suppress(OSError):
+                os.remove(self.path)
+
+
+@contextlib.contextmanager
+def with_lock(lock, timeout_s: float = 60.0):
+    if not lock.acquire(timeout_s):
+        raise TimeoutError(f"could not acquire lock within {timeout_s}s")
+    try:
+        yield
+    finally:
+        lock.release()

@@ -1,0 +1,92 @@
+"""System properties: layered config flags.
+
+Analog of GeoMesaSystemProperties.SystemProperty (geomesa-utils/.../conf/
+GeoMesaSystemProperties.scala:17-60): a named flag resolved, in order,
+from (1) a thread-local override, (2) the process environment
+(dots become underscores, uppercased), (3) a global override map,
+(4) the declared default. Typed accessors mirror the reference
+(`.toInt/.toBoolean/.toDuration` -> as_int/as_bool/as_seconds)."""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+
+__all__ = ["SystemProperty"]
+
+_overrides: dict[str, str] = {}
+_tls = threading.local()
+
+
+class SystemProperty:
+    def __init__(self, name: str, default: str | None = None):
+        self.name = name
+        self.default = default
+
+    # -- resolution --------------------------------------------------------
+
+    def get(self) -> str | None:
+        tl = getattr(_tls, "values", {})
+        if self.name in tl:
+            return tl[self.name]
+        env = self.name.replace(".", "_").upper()
+        if env in os.environ:
+            return os.environ[env]
+        if self.name in _overrides:
+            return _overrides[self.name]
+        return self.default
+
+    def set(self, value: str | None):
+        """Process-wide override (None clears)."""
+        if value is None:
+            _overrides.pop(self.name, None)
+        else:
+            _overrides[self.name] = str(value)
+
+    def thread_local_set(self, value: str | None):
+        tl = getattr(_tls, "values", None)
+        if tl is None:
+            tl = _tls.values = {}
+        if value is None:
+            tl.pop(self.name, None)
+        else:
+            tl[self.name] = str(value)
+
+    # -- typed accessors ---------------------------------------------------
+
+    def as_int(self) -> int | None:
+        v = self.get()
+        return None if v is None else int(v)
+
+    def as_float(self) -> float | None:
+        v = self.get()
+        return None if v is None else float(v)
+
+    def as_bool(self) -> bool | None:
+        v = self.get()
+        return None if v is None else v.strip().lower() in ("true", "1", "yes")
+
+    def as_seconds(self) -> float | None:
+        """Duration strings: '10s', '5 minutes', '100ms', bare seconds."""
+        v = self.get()
+        if v is None:
+            return None
+        m = re.match(r"^\s*([\d.]+)\s*([a-zA-Z]*)\s*$", v)
+        if not m:
+            raise ValueError(f"bad duration {v!r}")
+        n = float(m.group(1))
+        unit = m.group(2).lower()
+        mult = {"": 1.0, "s": 1.0, "sec": 1.0, "second": 1.0, "seconds": 1.0,
+                "ms": 1e-3, "millis": 1e-3, "milliseconds": 1e-3,
+                "m": 60.0, "min": 60.0, "minute": 60.0, "minutes": 60.0,
+                "h": 3600.0, "hour": 3600.0, "hours": 3600.0}.get(unit)
+        if mult is None:
+            raise ValueError(f"bad duration unit {unit!r}")
+        return n * mult
+
+
+# the reference's headline tuning flags (QueryProperties.scala:14-18)
+SCAN_RANGES_TARGET = SystemProperty("geomesa.scan.ranges.target", "2000")
+QUERY_TIMEOUT = SystemProperty("geomesa.query.timeout", None)
+FORCE_COUNT = SystemProperty("geomesa.force.count", "false")
