@@ -7,13 +7,24 @@ reference's CPU in-memory scan (geomesa-memory/CQEngine; the JVM stack
 is unavailable here, and vectorized numpy is a *stronger* CPU baseline
 than CQEngine's per-object iterator evaluation).
 
+Timing methodology: the device is reached through a tunnel whose
+round-trip latency (~70ms) dwarfs a single scan, and async dispatch
+makes per-call `block_until_ready` timings unreliable. So the kernel is
+run REPS times inside ONE jitted `lax.fori_loop` with a data dependency
+between iterations (per-iteration query perturbation + accumulated hit
+count), the whole chain is timed, and per-scan time = (total - rtt) /
+(REPS - 1) — the rtt probe itself runs one scan. Several trials are
+taken and the best used (tunnel hiccups only ever add time). This
+measures true device throughput, not dispatch rate.
+
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "features/sec/chip", "vs_baseline": N}
 
 Environment knobs: GEOMESA_TPU_BENCH_N (default 10_000_000),
-GEOMESA_TPU_BENCH_REPS (default 20).
+GEOMESA_TPU_BENCH_REPS (default 512), GEOMESA_TPU_BENCH_TRIALS (3).
 """
 
+import functools
 import json
 import os
 import sys
@@ -24,12 +35,17 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
-REPS = int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 20))
+# rtt-subtraction math needs >= 2 (the rtt probe itself includes one scan)
+REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
+TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 MS_DAY = 86_400_000
 
 
 def main():
     import jax
+    import jax.numpy as jnp
+    from jax import lax
+
     from geomesa_tpu.scan import zscan
 
     rng = np.random.default_rng(1234)
@@ -54,20 +70,41 @@ def main():
     data = zscan.build_scan_data(x, y, ms)
     q = zscan.make_query([box], [(t_lo, t_hi - 1)])  # inclusive hi
 
-    # warmup + compile
-    mask = zscan.scan_mask(data, q)
-    mask.block_until_ready()
+    @functools.partial(jax.jit, static_argnames=("reps", "time_any"))
+    def chained(xhi, xlo, yhi, ylo, tday, tms,
+                boxes, bvalid, times, tvalid, reps, time_any):
+        def body(i, acc):
+            # tiny per-iteration bound perturbation (orders of magnitude
+            # below any coordinate ulp) defeats CSE across iterations
+            b = boxes.at[0, 1].add(jnp.float32(i) * jnp.float32(1e-30))
+            m = zscan._scan_mask(xhi, xlo, yhi, ylo, tday, tms,
+                                 b, bvalid, times, tvalid, time_any)
+            return acc + jnp.sum(m, dtype=jnp.int32)
+        return lax.fori_loop(0, reps, body, jnp.int32(0))
 
-    times = []
-    for _ in range(REPS):
+    args = (data.xhi, data.xlo, data.yhi, data.ylo, data.tday, data.tms,
+            q.boxes, q.box_valid, q.times, q.time_valid)
+    int(chained(*args, REPS, q.time_any))  # compile + execute once
+
+    # `block_until_ready` does not reliably block through the device
+    # tunnel; a host fetch of the scalar result does. Measure the fetch
+    # round-trip separately and subtract it from the chain timings.
+    rtt = float("inf")
+    for _ in range(TRIALS + 2):
         t0 = time.perf_counter()
-        mask = zscan.scan_mask(data, q)
-        mask.block_until_ready()
-        times.append(time.perf_counter() - t0)
-    p50 = float(np.median(times))
-    rate = N / p50
+        int(chained(*args, 1, q.time_any))
+        rtt = min(rtt, time.perf_counter() - t0)
+
+    best = float("inf")
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        int(chained(*args, REPS, q.time_any))
+        best = min(best, time.perf_counter() - t0)
+    per_scan = max(best - rtt, 1e-9) / (REPS - 1)
+    rate = N / per_scan
 
     # correctness: identical feature indices (boundary-exact contract)
+    mask = zscan.scan_mask(data, q)
     host_mask = np.asarray(mask)
     xhi = np.asarray(data.xhi)
     yhi = np.asarray(data.yhi)
@@ -84,9 +121,10 @@ def main():
         "value": round(rate, 1),
         "unit": "features/sec/chip",
         "vs_baseline": round(rate / cpu_rate, 2),
-        "p50_scan_ms": round(p50 * 1e3, 3),
+        "best_scan_ms": round(per_scan * 1e3, 3),
         "cpu_baseline_rate": round(cpu_rate, 1),
         "n": N,
+        "reps": REPS,
         "hits": int(host_mask.sum()),
         "ids_exact": bool(ok),
         "device": str(jax.devices()[0]),

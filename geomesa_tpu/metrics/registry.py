@@ -69,17 +69,10 @@ class MetricsRegistry:
             }
 
     def report_delimited(self, path: str, delimiter: str = "\t"):
-        """DelimitedFileReporter analog: append a snapshot."""
-        snap = self.snapshot()
-        now = int(time.time() * 1000)
-        with open(path, "a") as fh:
-            for k, v in snap["counters"].items():
-                fh.write(f"{now}{delimiter}counter{delimiter}{k}{delimiter}{v}\n")
-            for k, t in snap["timers"].items():
-                fh.write(f"{now}{delimiter}timer{delimiter}{k}{delimiter}"
-                         f"{t['count']}{delimiter}{t['mean_ms']}\n")
-            for k, v in snap["gauges"].items():
-                fh.write(f"{now}{delimiter}gauge{delimiter}{k}{delimiter}{v}\n")
+        """Append a snapshot via DelimitedFileReporter (single row
+        format owner; see reporters.py)."""
+        from .reporters import DelimitedFileReporter
+        DelimitedFileReporter(path, delimiter).report(self.snapshot())
 
 
 metrics = MetricsRegistry()

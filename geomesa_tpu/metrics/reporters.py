@@ -87,5 +87,8 @@ class PeriodicReporter:
 
     def stop(self, final_report: bool = True):
         self._stop.set()
+        if self._thread is not None:
+            self._thread.join()  # let an in-flight report finish first
+            self._thread = None
         if final_report:
             self.reporter.report(self.registry.snapshot())

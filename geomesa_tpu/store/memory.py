@@ -313,18 +313,22 @@ class InMemoryDataStore:
                 ManagedQuery(q.type_name, str(q.filter), float(timeout_s)))
 
         import time as _time
-        t_plan0 = _time.perf_counter()
-        strategy = decide_strategy(st.sft, q, self._indices(st.sft), st.n,
-                                   stats=self.stats.get(q.type_name),
-                                   explain=explain)
-        t_plan = _time.perf_counter() - t_plan0
-        if managed is not None:
-            managed.check()
-        t_scan0 = _time.perf_counter()
-        mask = self._execute(st, q, strategy, explain)
-        if managed is not None:
-            managed.check()
-            _REAPER.complete(managed)
+        try:
+            t_plan0 = _time.perf_counter()
+            strategy = decide_strategy(st.sft, q, self._indices(st.sft),
+                                       st.n,
+                                       stats=self.stats.get(q.type_name),
+                                       explain=explain)
+            t_plan = _time.perf_counter() - t_plan0
+            if managed is not None:
+                managed.check()
+            t_scan0 = _time.perf_counter()
+            mask = self._execute(st, q, strategy, explain)
+            if managed is not None:
+                managed.check()
+        finally:
+            if managed is not None:
+                _REAPER.complete(managed)
 
         if q.auths is not None or (st.vis != None).any():  # noqa: E711
             from ..security import evaluate_visibilities

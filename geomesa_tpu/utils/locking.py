@@ -4,11 +4,14 @@ Analog of DistributedLocking / ZookeeperLocking (geomesa-index-api/
 .../utils/DistributedLocking.scala:14, geomesa-zk-utils) — the
 reference guards schema create/delete with ZK locks; here the two
 deployment shapes are in-process (LocalLock) and cross-process via
-O_EXCL lock files with stale-lock breaking (FileLock)."""
+kernel-arbitrated flock(2) lock files (FileLock) — like ZK ephemeral
+nodes, the kernel releases the lock when the holder dies, so no
+stale-lock heuristics (and none of their TOCTOU races) are needed."""
 
 from __future__ import annotations
 
 import contextlib
+import fcntl
 import os
 import threading
 import time
@@ -34,43 +37,42 @@ class LocalLock:
 
 
 class FileLock:
-    """Cross-process lock file created with O_EXCL; the holder writes
-    its pid + timestamp, and locks older than `stale_s` are broken
-    (a crash analog of ZK ephemeral-node expiry)."""
+    """Cross-process lock via flock(2) on a lock file. The kernel owns
+    the lock state: a crashed holder's lock is released automatically
+    (the ZK ephemeral-node analog), so there is no staleness window and
+    no lock-breaking race. The file itself is never deleted.
+
+    `stale_s` is accepted for API compatibility but unused — crash
+    recovery is immediate under flock.
+    """
 
     def __init__(self, path: str, stale_s: float = 300.0):
         self.path = path
         self.stale_s = stale_s
-        self._held = False
+        self._fd: int | None = None
 
     def acquire(self, timeout_s: float = 60.0) -> bool:
         deadline = time.monotonic() + timeout_s
+        fd = os.open(self.path, os.O_CREAT | os.O_WRONLY, 0o644)
         while True:
             try:
-                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                os.truncate(fd, 0)
                 os.write(fd, f"{os.getpid()} {time.time()}".encode())
-                os.close(fd)
-                self._held = True
+                self._fd = fd
                 return True
-            except FileExistsError:
-                self._break_if_stale()
+            except OSError:
                 if time.monotonic() >= deadline:
+                    os.close(fd)
                     return False
                 time.sleep(0.02)
 
-    def _break_if_stale(self):
-        try:
-            age = time.time() - os.path.getmtime(self.path)
-            if age > self.stale_s:
-                os.remove(self.path)
-        except OSError:
-            pass
-
     def release(self):
-        if self._held:
-            self._held = False
+        if self._fd is not None:
+            fd, self._fd = self._fd, None
             with contextlib.suppress(OSError):
-                os.remove(self.path)
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
 
 
 @contextlib.contextmanager

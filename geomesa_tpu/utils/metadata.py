@@ -93,7 +93,7 @@ class FileMetadata(MetadataCatalog):
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
-        self._cache: dict[str, tuple[float, dict]] = {}
+        self._cache: dict[str, tuple[tuple[int, int, int], dict]] = {}
         self._lock = threading.Lock()
 
     def _path(self, type_name: str) -> str:
@@ -103,15 +103,19 @@ class FileMetadata(MetadataCatalog):
 
     def _load(self, type_name: str) -> dict:
         path = self._path(type_name)
-        if not os.path.exists(path):
+        try:
+            st = os.stat(path)
+        except OSError:
             return {}
-        mtime = os.path.getmtime(path)
+        # ns mtime + size + inode: a same-tick cross-process replace
+        # (os.replace swaps in a new inode) still invalidates the cache
+        stamp = (st.st_mtime_ns, st.st_size, st.st_ino)
         cached = self._cache.get(type_name)
-        if cached and cached[0] == mtime:
+        if cached and cached[0] == stamp:
             return cached[1]
         with open(path) as fh:
             data = json.load(fh)
-        self._cache[type_name] = (mtime, data)
+        self._cache[type_name] = (stamp, data)
         return data
 
     def _store(self, type_name: str, data: dict):
