@@ -162,7 +162,14 @@ def contains_join(polygons, px: np.ndarray, py: np.ndarray,
             rows = np.flatnonzero(cand[:, j])
             if len(rows) == 0:
                 continue
-            hit = contains_points(polygons[start + j], px[rows], py[rows])
+            poly = polygons[start + j]
+            if len(rows) >= 4096:
+                # dense case: device crossing-number kernel with exact
+                # host recheck only in the edge band (scan/gscan.py)
+                from ..scan.gscan import points_in_polygon
+                hit = points_in_polygon(px[rows], py[rows], poly)
+            else:
+                hit = contains_points(poly, px[rows], py[rows])
             rows = rows[hit]
             counts[start + j] = len(rows)
             if not counts_only and len(rows):

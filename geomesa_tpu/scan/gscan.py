@@ -1,0 +1,300 @@
+"""Device extent-geometry scan: the XZ-index scan analog.
+
+The reference stores non-point geometries in XZ2/XZ3 indexes
+(geomesa-z3 curve/XZ2SFC.scala:24, XZ3SFC.scala:26) whose ranges give
+*candidate* features, then evaluates the exact JTS predicate per
+candidate on the tablet server. Here the whole geometry column's
+bounding boxes live on device and one fused kernel classifies every
+feature into a tristate:
+
+- OUT  — bbox definitely disjoint from every query envelope
+- IN   — bbox definitely inside a query envelope (a geometry is always
+         somewhere inside its own bbox, so it definitely intersects)
+- MAYBE— overlapping the envelope boundary; only these few go to the
+         exact host f64 predicate (the per-candidate JTS analog)
+
+f32 rounding is handled conservatively: data bboxes are rounded
+*outward* at build time, and each query envelope is evaluated at both
+an outward-rounded (for OUT) and inward-rounded (for IN) f32 version,
+so the tristate is correct in exact-f64 terms by construction.
+
+Also here: a device point-in-polygon (crossing-number) kernel over
+padded edge buffers with an epsilon uncertainty band — points inside
+the band are re-checked on host, making point-vs-polygon predicates
+exact while the dense inner loop stays on the VPU. This is the hot
+loop of ST_Contains / ST_Intersects residuals and of the
+points-vs-polygons join (BASELINE config #5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .zscan import MILLIS_PER_DAY, _next_pow2
+
+__all__ = ["ExtentScanData", "build_extent_data", "extent_query",
+           "extent_tristate", "PackedPolygon", "pack_polygon",
+           "points_in_polygon_device", "points_in_polygon", "EDGE_EPS"]
+
+# uncertainty half-band (degrees) for the f32 point-in-polygon kernel;
+# ~11m at the equator — generous vs f32 ulp (~1.5e-5 deg at lon 180)
+EDGE_EPS = 1e-4
+
+_OUT, _MAYBE, _IN = np.int8(0), np.int8(1), np.int8(2)
+
+
+def _round_out(lo: np.ndarray, hi: np.ndarray):
+    """Round [lo, hi] f64 bounds outward to f32."""
+    lo32 = lo.astype(np.float32)
+    hi32 = hi.astype(np.float32)
+    lo32 = np.where(lo32.astype(np.float64) > lo,
+                    np.nextafter(lo32, np.float32(-np.inf)), lo32)
+    hi32 = np.where(hi32.astype(np.float64) < hi,
+                    np.nextafter(hi32, np.float32(np.inf)), hi32)
+    return lo32, hi32
+
+
+def _round_in(lo: np.ndarray, hi: np.ndarray):
+    """Round [lo, hi] f64 bounds inward to f32 (may become empty)."""
+    lo32 = lo.astype(np.float32)
+    hi32 = hi.astype(np.float32)
+    lo32 = np.where(lo32.astype(np.float64) < lo,
+                    np.nextafter(lo32, np.float32(np.inf)), lo32)
+    hi32 = np.where(hi32.astype(np.float64) > hi,
+                    np.nextafter(hi32, np.float32(-np.inf)), hi32)
+    return lo32, hi32
+
+
+@dataclasses.dataclass
+class ExtentScanData:
+    """Device-resident per-feature bboxes (outward-rounded f32) and
+    optional (day, ms) time columns for the XZ3 analog."""
+    bxmin: jax.Array
+    bymin: jax.Array
+    bxmax: jax.Array
+    bymax: jax.Array
+    tday: jax.Array | None
+    tms: jax.Array | None
+    valid: jax.Array      # false for null/empty geometries
+    n: int
+
+
+def build_extent_data(bounds: np.ndarray, millis: np.ndarray | None = None,
+                      device=None) -> ExtentScanData:
+    """bounds: (n, 4) f64 [xmin ymin xmax ymax], NaN rows for nulls."""
+    bounds = np.asarray(bounds, np.float64)
+    valid = ~np.isnan(bounds[:, 0])
+    safe = np.where(valid[:, None], bounds, 0.0)
+    xmin, xmax = _round_out(safe[:, 0], safe[:, 2])
+    ymin, ymax = _round_out(safe[:, 1], safe[:, 3])
+    put = functools.partial(jax.device_put, device=device)
+    tday = tms = None
+    if millis is not None:
+        millis = np.asarray(millis, np.int64)
+        d = (millis // MILLIS_PER_DAY).astype(np.int32)
+        tday = put(d)
+        tms = put((millis - d.astype(np.int64) * MILLIS_PER_DAY)
+                  .astype(np.int32))
+    return ExtentScanData(put(xmin), put(ymin), put(xmax), put(ymax),
+                          tday, tms, put(valid), len(bounds))
+
+
+@dataclasses.dataclass
+class ExtentQuery:
+    """Padded query envelopes at outer/inner f32 rounding + optional
+    inclusive time intervals as (day, ms) int32 bounds."""
+    outer: jax.Array       # (K, 4) xmin ymin xmax ymax, outward
+    inner: jax.Array       # (K, 4) inward (possibly empty boxes)
+    box_valid: jax.Array   # (K,)
+    times: jax.Array       # (B, 4) day_lo ms_lo day_hi ms_hi
+    time_valid: jax.Array
+    time_any: bool
+
+
+def extent_query(boxes_f64, intervals_ms=None) -> ExtentQuery:
+    boxes_f64 = list(boxes_f64)
+    k = _next_pow2(max(len(boxes_f64), 1))
+    outer = np.zeros((k, 4), np.float32)
+    inner = np.zeros((k, 4), np.float32)
+    valid = np.zeros(k, dtype=bool)
+    for i, (xmin, ymin, xmax, ymax) in enumerate(boxes_f64):
+        xlo, xhi = _round_out(np.float64(xmin), np.float64(xmax))
+        ylo, yhi = _round_out(np.float64(ymin), np.float64(ymax))
+        outer[i] = (xlo, ylo, xhi, yhi)
+        xlo, xhi = _round_in(np.float64(xmin), np.float64(xmax))
+        ylo, yhi = _round_in(np.float64(ymin), np.float64(ymax))
+        inner[i] = (xlo, ylo, xhi, yhi)
+        valid[i] = True
+
+    intervals_ms = list(intervals_ms or [])
+    time_any = not intervals_ms
+    b = _next_pow2(max(len(intervals_ms), 1))
+    times = np.zeros((b, 4), np.int32)
+    tvalid = np.zeros(b, dtype=bool)
+    for i, (lo, hi) in enumerate(intervals_ms):
+        lo, hi = int(lo), int(hi)
+        times[i] = (lo // MILLIS_PER_DAY, lo % MILLIS_PER_DAY,
+                    hi // MILLIS_PER_DAY, hi % MILLIS_PER_DAY)
+        tvalid[i] = True
+    return ExtentQuery(jnp.asarray(outer), jnp.asarray(inner),
+                       jnp.asarray(valid), jnp.asarray(times),
+                       jnp.asarray(tvalid), time_any)
+
+
+@functools.partial(jax.jit, static_argnames=("time_any", "has_time"))
+def _tristate_kernel(bxmin, bymin, bxmax, bymax, valid, tday, tms,
+                     outer, inner, box_valid, times, time_valid,
+                     time_any: bool, has_time: bool):
+    ob = outer[None, :, :]
+    # overlap with outward-rounded envelope: false => definitely disjoint
+    overlap = ((bxmax[:, None] >= ob[..., 0]) & (bxmin[:, None] <= ob[..., 2])
+               & (bymax[:, None] >= ob[..., 1]) & (bymin[:, None] <= ob[..., 3]))
+    overlap &= box_valid[None, :]
+    ib = inner[None, :, :]
+    # containment in inward-rounded envelope: true => definitely inside
+    inside = ((bxmin[:, None] >= ib[..., 0]) & (bxmax[:, None] <= ib[..., 2])
+              & (bymin[:, None] >= ib[..., 1]) & (bymax[:, None] <= ib[..., 3]))
+    inside &= box_valid[None, :]
+    any_overlap = jnp.any(overlap, axis=1)
+    any_inside = jnp.any(inside, axis=1)
+    state = jnp.where(any_inside, _IN,
+                      jnp.where(any_overlap, _MAYBE, _OUT))
+    state = jnp.where(valid, state, _OUT)
+    if time_any or not has_time:
+        return state
+    tx = times[None, :, :]
+    after = ((tday[:, None] > tx[..., 0])
+             | ((tday[:, None] == tx[..., 0]) & (tms[:, None] >= tx[..., 1])))
+    before = ((tday[:, None] < tx[..., 2])
+              | ((tday[:, None] == tx[..., 2]) & (tms[:, None] <= tx[..., 3])))
+    t_ok = jnp.any(after & before & time_valid[None, :], axis=1)
+    return jnp.where(t_ok, state, _OUT)
+
+
+def extent_tristate(data: ExtentScanData, q: ExtentQuery) -> np.ndarray:
+    """Returns int8[n]: 0=OUT, 1=MAYBE (host exact check), 2=IN.
+
+    Time intervals are exact (int compares), so they never force MAYBE.
+    """
+    has_time = data.tday is not None
+    tday = data.tday if has_time else jnp.zeros((data.n,), jnp.int32)
+    tms = data.tms if has_time else jnp.zeros((data.n,), jnp.int32)
+    out = _tristate_kernel(data.bxmin, data.bymin, data.bxmax, data.bymax,
+                           data.valid, tday, tms,
+                           q.outer, q.inner, q.box_valid,
+                           q.times, q.time_valid, q.time_any, has_time)
+    return np.asarray(out)
+
+
+# -- point-in-polygon device kernel ---------------------------------------
+
+@dataclasses.dataclass
+class PackedPolygon:
+    """One polygon's rings as a padded edge list on device.
+
+    edges: (E, 4) f32 [x0 y0 x1 y1]; edge_valid: (E,) bool. Holes are
+    included — crossing-number parity handles them uniformly. `host`
+    keeps the original geometry for the exact band recheck.
+    """
+    edges: jax.Array
+    edge_valid: jax.Array
+    host: object
+
+
+def pack_polygon(poly) -> PackedPolygon:
+    """Pack a Polygon/MultiPolygon's rings into an edge buffer."""
+    rings: list[np.ndarray] = []
+    polys = getattr(poly, "parts", [poly])
+    for p in polys:
+        rings.append(np.asarray(p.shell, np.float64))
+        for h in getattr(p, "holes", []):
+            rings.append(np.asarray(h, np.float64))
+    segs = []
+    for ring in rings:
+        a = ring[:-1] if np.allclose(ring[0], ring[-1]) else ring
+        b = np.roll(a, -1, axis=0)
+        segs.append(np.concatenate([a, b], axis=1))
+    e = np.concatenate(segs, axis=0) if segs else np.zeros((0, 4))
+    ne = _next_pow2(max(len(e), 1))
+    edges = np.zeros((ne, 4), np.float32)
+    edges[: len(e)] = e.astype(np.float32)
+    valid = np.zeros(ne, dtype=bool)
+    valid[: len(e)] = True
+    return PackedPolygon(jnp.asarray(edges), jnp.asarray(valid), poly)
+
+
+@jax.jit
+def _pip_kernel(px, py, edges, edge_valid):
+    """Crossing-number parity + uncertainty band.
+
+    Returns (inside, band): inside via +x ray cast; band flags points
+    within EDGE_EPS of any edge (f32 result untrustworthy there).
+    """
+    x0 = edges[None, :, 0]
+    y0 = edges[None, :, 1]
+    x1 = edges[None, :, 2]
+    y1 = edges[None, :, 3]
+    pxc = px[:, None]
+    pyc = py[:, None]
+    cond = (y0 > pyc) != (y1 > pyc)
+    dy = jnp.where(y1 == y0, jnp.float32(1e-30), y1 - y0)
+    xint = x0 + (pyc - y0) * (x1 - x0) / dy
+    cross = cond & (pxc < xint) & edge_valid[None, :]
+    inside = (jnp.sum(cross, axis=1) % 2) == 1
+
+    # distance-to-segment (squared, planar degrees) for the band test
+    ex = x1 - x0
+    ey = y1 - y0
+    len2 = ex * ex + ey * ey
+    t = jnp.clip(((pxc - x0) * ex + (pyc - y0) * ey)
+                 / jnp.where(len2 == 0, jnp.float32(1.0), len2), 0.0, 1.0)
+    dx = pxc - (x0 + t * ex)
+    dyv = pyc - (y0 + t * ey)
+    d2 = dx * dx + dyv * dyv
+    d2 = jnp.where(edge_valid[None, :], d2, jnp.float32(np.inf))
+    band = jnp.min(d2, axis=1) < jnp.float32(EDGE_EPS * EDGE_EPS)
+    return inside, band
+
+
+def points_in_polygon_device(px: np.ndarray, py: np.ndarray,
+                             packed: PackedPolygon
+                             ) -> tuple[np.ndarray, np.ndarray]:
+    """Device crossing-number test; returns (inside, band_idx).
+
+    px/py: host f64 coords. `inside` is trustworthy except at the rows
+    in `band_idx` (within EDGE_EPS of an edge) — the caller re-evaluates
+    those with its exact host predicate (so open/closed boundary
+    semantics are decided by the caller, not this kernel).
+    """
+    n = len(px)
+    # pad points to the next power of two so candidate-count jitter
+    # doesn't retrace/recompile the kernel (same reason edges/query
+    # boxes are padded); the fill point is far outside any geometry so
+    # it lands inside=False, band=False and is sliced away below
+    np_pad = _next_pow2(max(n, 1))
+    px32 = np.full(np_pad, 1e9, np.float32)
+    py32 = np.full(np_pad, 1e9, np.float32)
+    px32[:n] = np.asarray(px, np.float64).astype(np.float32)
+    py32[:n] = np.asarray(py, np.float64).astype(np.float32)
+    inside, band = _pip_kernel(jnp.asarray(px32), jnp.asarray(py32),
+                               packed.edges, packed.edge_valid)
+    # np.array (not asarray): device buffers are read-only views and the
+    # caller patches band rows in place
+    return np.array(inside[:n]), np.flatnonzero(np.asarray(band[:n]))
+
+
+def points_in_polygon(px: np.ndarray, py: np.ndarray, poly) -> np.ndarray:
+    """Exact closed-boundary point-in-polygon via the device kernel +
+    host band recheck (contains_points semantics)."""
+    from ..analytics.st_functions import contains_points
+    packed = pack_polygon(poly)
+    inside, band_idx = points_in_polygon_device(px, py, packed)
+    if len(band_idx):
+        inside[band_idx] = contains_points(poly, np.asarray(px)[band_idx],
+                                           np.asarray(py)[band_idx])
+    return inside

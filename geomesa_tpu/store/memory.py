@@ -33,7 +33,7 @@ from ..filters.helper import extract_geometries, extract_intervals
 from ..geometry import Envelope
 from ..index.api import Explainer, FilterStrategy, Query, QueryHints
 from ..index.planner import decide_strategy
-from ..scan import zscan
+from ..scan import gscan, zscan
 from ..stats import DataStoreStats, parse_stat
 from ..utils.threads import ThreadManagement
 
@@ -68,6 +68,7 @@ class _TypeState:
         self.sft = sft
         self.batch: FeatureBatch | None = None
         self.scan_data: zscan.DeviceScanData | None = None
+        self.extent_data = None  # gscan.ExtentScanData for non-points
         self.host_xhi: np.ndarray | None = None
         self.host_yhi: np.ndarray | None = None
         self.dirty = False
@@ -103,19 +104,25 @@ class _TypeState:
 
     def ensure_index(self):
         """(Re)build device arrays if writes happened."""
-        if not self.dirty and self.scan_data is not None:
+        if not self.dirty and (self.scan_data is not None
+                               or self.extent_data is not None):
             return
         if self.batch is None or self.batch.n == 0:
             self.scan_data = None
+            self.extent_data = None
             self.dirty = False
             return
         geom = self.sft.geom_field
         dtg = self.sft.dtg_field
         col = self.batch.col(geom) if geom else None
         if not isinstance(col, PointColumn):
-            # extent geometries scan via host bbox prefilter (device
-            # packed-geometry kernels come with the XZ scan work)
+            # extent geometries: device bbox tristate scan (XZ analog)
             self.scan_data = None
+            if col is not None:
+                millis = (self.batch.col(dtg).millis
+                          if dtg is not None else None)
+                self.extent_data = gscan.build_extent_data(
+                    col.bounds, millis)
             self.dirty = False
             return
         x = col.x
@@ -393,6 +400,8 @@ class InMemoryDataStore:
 
         if strategy.index in ("z3", "z2") and st.scan_data is not None:
             mask = self._device_scan(st, q, strategy, explain)
+        elif strategy.index in ("xz3", "xz2") and st.extent_data is not None:
+            mask = self._device_extent_scan(st, q, strategy, explain)
         elif strategy.index == "id" and strategy.primary is not None:
             mask = np.isin(batch.ids.astype(str),
                            np.asarray(strategy.primary.ids, dtype=str))
@@ -429,17 +438,8 @@ class InMemoryDataStore:
         boxes = [g.envelope.as_tuple() for g in geoms] or \
             [(-180.0, -90.0, 180.0, 90.0)]
 
-        intervals = []
-        if dtg is not None and strategy.index == "z3":
-            iv = extract_intervals(primary, dtg)
-            for b in iv:
-                lo = _to_millis(b.lower.value) if b.lower.is_bounded else 0
-                hi = _to_millis(b.upper.value) if b.upper.is_bounded else 2**62
-                if b.lower.is_bounded and not b.lower.inclusive:
-                    lo += 1
-                if b.upper.is_bounded and not b.upper.inclusive:
-                    hi -= 1
-                intervals.append((lo, hi))
+        intervals = (_intervals_ms(primary, dtg)
+                     if dtg is not None and strategy.index == "z3" else [])
 
         sq = zscan.make_query(boxes, intervals)
         explain(f"Device scan: {len(boxes)} box(es), "
@@ -456,21 +456,106 @@ class InMemoryDataStore:
             explain(f"Boundary recheck: {len(cand)} candidate(s)")
 
         # non-envelope query geometries need the exact predicate too
-        needs_exact = any(not _is_envelope(g) for g in geoms) or any(
-            isinstance(c, (ast.DWithin, ast.SpatialPredicate))
-            for c in _walk(primary))
-        if needs_exact:
+        if _needs_exact(geoms, primary):
             candidates = np.flatnonzero(mask)
             if len(candidates):
-                sub = batch.take(candidates)
                 spatial_f = _spatial_only(primary, geom)
                 if spatial_f is not None:
-                    keep = evaluate(spatial_f, sub)
+                    col = batch.col(geom)
+                    keep = self._pip_residual(spatial_f, col, candidates,
+                                              explain)
+                    if keep is None:
+                        keep = evaluate(spatial_f, batch.take(candidates))
                     out = np.zeros(st.n, dtype=bool)
                     out[candidates[keep]] = True
                     mask = out
             explain("Exact geometry predicate applied")
         return mask
+
+    def _device_extent_scan(self, st: _TypeState, q: Query,
+                            strategy: FilterStrategy,
+                            explain: Explainer) -> np.ndarray:
+        """XZ-index analog for extent geometries: device bbox tristate
+        (definite in / definite out / boundary band), exact host
+        predicate only on the band — the per-candidate JTS evaluation
+        of the reference's XZ scans (curve/XZ2SFC.scala:146-252 ranges
+        + server-side exact filter)."""
+        sft = st.sft
+        batch = st.batch
+        geom = sft.geom_field
+        dtg = sft.dtg_field
+        primary = (strategy.primary if strategy.primary is not None
+                   else ast.Include())
+
+        geoms = extract_geometries(primary, geom)
+        boxes = [g.envelope.as_tuple() for g in geoms] or \
+            [(-180.0, -90.0, 180.0, 90.0)]
+        intervals = (_intervals_ms(primary, dtg)
+                     if dtg is not None and strategy.index == "xz3" else [])
+
+        eq = gscan.extent_query(boxes, intervals)
+        state = gscan.extent_tristate(st.extent_data, eq)
+        explain(f"Device extent scan: {len(boxes)} box(es), "
+                f"{len(intervals)} interval(s), n={st.n}")
+
+        mask = state == 2  # definite IN
+        needs_exact = _needs_exact(geoms, primary)
+        spatial_f = _spatial_only(primary, geom)
+        if needs_exact:
+            # envelope containment only proves envelope intersection;
+            # the true predicate needs every surviving candidate checked
+            check = np.flatnonzero(state >= 1)
+        else:
+            check = np.flatnonzero(state == 1)  # MAYBE band only
+        if spatial_f is not None and len(check):
+            keep = evaluate(spatial_f, batch.take(check))
+            if needs_exact:
+                mask = np.zeros(st.n, dtype=bool)
+            mask = mask.copy()
+            mask[check[keep]] = True
+            explain(f"Exact predicate on {len(check)} candidate(s)")
+        elif spatial_f is None:
+            # no spatial constraint (pure time query on xz3): every
+            # non-OUT row matches
+            mask = state >= 1
+        return mask
+
+    def _pip_residual(self, spatial_f, col, candidates: np.ndarray,
+                      explain: Explainer):
+        """Device point-in-polygon for the exact residual when the data
+        are points and the query is a single polygon intersects/within
+        (the ST_Contains hot loop; SURVEY §7 hard part (b)). Returns a
+        bool[len(candidates)] keep mask, or None if not applicable."""
+        from ..geometry.base import MultiPolygon, Polygon
+        if not isinstance(col, PointColumn):
+            return None
+        if not isinstance(spatial_f, (ast.Intersects, ast.Within)):
+            return None
+        g = spatial_f.geom
+        if not isinstance(g, (Polygon, MultiPolygon)):
+            return None
+        px = col.x[candidates]
+        py = col.y[candidates]
+        inside, band_idx = gscan.points_in_polygon_device(
+            px, py, gscan.pack_polygon(g))
+        if len(band_idx):
+            # exact open/closed boundary semantics via the reference
+            # evaluator on just the band rows
+            sub = self._batch_rows_for(col, px[band_idx], py[band_idx])
+            inside[band_idx] = evaluate(spatial_f, sub)
+        explain(f"Device point-in-polygon residual "
+                f"({len(candidates)} candidates, {len(band_idx)} band)")
+        return inside
+
+    @staticmethod
+    def _batch_rows_for(col: PointColumn, x: np.ndarray, y: np.ndarray):
+        """A minimal single-column FeatureBatch view for band rechecks."""
+        sft = parse_spec("band", f"*{col.name}:Point:srid=4326")
+        ids = np.array([str(i) for i in range(len(x))], dtype=object)
+        return FeatureBatch(sft, ids,
+                            {col.name: PointColumn(
+                                col.name, x, y,
+                                np.ones(len(x), dtype=bool))})
 
 
 def _geom_centroids(batch: FeatureBatch, geom_field: str):
@@ -483,6 +568,30 @@ def _geom_centroids(batch: FeatureBatch, geom_field: str):
     x = (bounds[:, 0] + bounds[:, 2]) / 2
     y = (bounds[:, 1] + bounds[:, 3]) / 2
     return x, y, col.valid
+
+
+def _intervals_ms(primary: ast.Filter, dtg: str) -> list[tuple[int, int]]:
+    """Extract inclusive [lo, hi] epoch-millis intervals for the device
+    kernels, applying the reference's exclusive-bound adjustment
+    (FilterHelper.scala:267-307 rounding semantics)."""
+    out = []
+    for b in extract_intervals(primary, dtg):
+        lo = _to_millis(b.lower.value) if b.lower.is_bounded else 0
+        hi = _to_millis(b.upper.value) if b.upper.is_bounded else 2**62
+        if b.lower.is_bounded and not b.lower.inclusive:
+            lo += 1
+        if b.upper.is_bounded and not b.upper.inclusive:
+            hi -= 1
+        out.append((lo, hi))
+    return out
+
+
+def _needs_exact(geoms, primary: ast.Filter) -> bool:
+    """True when the bbox prefilter is insufficient and the exact
+    geometry predicate must run on surviving candidates."""
+    return any(not _is_envelope(g) for g in geoms) or any(
+        isinstance(c, (ast.DWithin, ast.SpatialPredicate))
+        for c in _walk(primary))
 
 
 def _to_millis(v) -> int:

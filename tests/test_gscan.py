@@ -1,0 +1,168 @@
+"""Device extent-geometry scan (XZ analog) + point-in-polygon kernel:
+differential tests against the host f64 reference evaluator, mirroring
+the reference's XZ2SFCTest / black-box query tests."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.filters import evaluate, parse_ecql
+from geomesa_tpu.geometry import parse_wkt
+from geomesa_tpu.scan import gscan
+from geomesa_tpu.store import InMemoryDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+
+def _rect_wkt(x, y, w, h):
+    return (f"POLYGON (({x} {y}, {x + w} {y}, {x + w} {y + h}, "
+            f"{x} {y + h}, {x} {y}))")
+
+
+@pytest.fixture(scope="module")
+def extent_store():
+    ds = InMemoryDataStore()
+    ds.create_schema(parse_spec(
+        "zones", "name:String,dtg:Date,*geom:Polygon:srid=4326"))
+    rng = np.random.default_rng(7)
+    n = 20_000
+    x = rng.uniform(-170, 160, n)
+    y = rng.uniform(-80, 70, n)
+    w = rng.uniform(0.01, 5.0, n)
+    h = rng.uniform(0.01, 5.0, n)
+    ds.write_dict("zones", [f"z{i}" for i in range(n)], {
+        "name": [f"n{i % 7}" for i in range(n)],
+        "dtg": rng.integers(MS("2020-01-01"), MS("2020-03-01"), n),
+        "geom": [_rect_wkt(*a) for a in zip(x, y, w, h)],
+    })
+    return ds
+
+
+@pytest.fixture(scope="module")
+def extent_oracle(extent_store):
+    batch = extent_store._state("zones").batch
+
+    def check(ecql):
+        return set(batch.ids[evaluate(parse_ecql(ecql), batch)].astype(str))
+    return check
+
+
+class TestExtentScan:
+    def test_xz2_bbox(self, extent_store, extent_oracle):
+        q = "BBOX(geom, -20, -15, 31.5, 42.25)"
+        res = extent_store.query(q, "zones")
+        assert res.plan.index == "xz2"
+        assert set(res.ids.astype(str)) == extent_oracle(q)
+
+    def test_xz3_bbox_time(self, extent_store, extent_oracle):
+        q = ("BBOX(geom, 10, 10, 60, 55) AND "
+             "dtg DURING 2020-01-10T00:00:00Z/2020-02-01T00:00:00Z")
+        res = extent_store.query(q, "zones")
+        assert res.plan.index == "xz3"
+        assert set(res.ids.astype(str)) == extent_oracle(q)
+
+    def test_xz2_polygon_intersects(self, extent_store, extent_oracle):
+        q = ("INTERSECTS(geom, POLYGON ((0 0, 40 5, 35 45, -5 30, 0 0)))")
+        res = extent_store.query(q, "zones")
+        assert res.plan.index == "xz2"
+        assert set(res.ids.astype(str)) == extent_oracle(q)
+
+    def test_boundary_exactness(self):
+        """Features whose bbox touches the query boundary exactly must
+        match host f64 semantics (the MAYBE band recheck)."""
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("b", "*geom:Polygon:srid=4326"))
+        # rectangle exactly abutting the query edge at x=10
+        ds.write_dict("b", ["touch", "inside", "outside"], {
+            "geom": [_rect_wkt(10.0, 0.0, 5.0, 5.0),
+                     _rect_wkt(2.0, 2.0, 1.0, 1.0),
+                     _rect_wkt(10.0000001, 0.0, 5.0, 5.0)],
+        })
+        res = ds.query("BBOX(geom, 0, 0, 10, 10)", "b")
+        assert set(res.ids.astype(str)) == {"touch", "inside"}
+
+    def test_null_geometry_rows(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("n", "*geom:Polygon:srid=4326"))
+        ds.write_dict("n", ["a", "b"], {
+            "geom": [_rect_wkt(0, 0, 1, 1), None],
+        })
+        res = ds.query("BBOX(geom, -5, -5, 5, 5)", "n")
+        assert set(res.ids.astype(str)) == {"a"}
+
+
+class TestTristate:
+    def test_states_vs_bruteforce(self):
+        rng = np.random.default_rng(3)
+        n = 5000
+        x0 = rng.uniform(-100, 90, n)
+        y0 = rng.uniform(-60, 50, n)
+        bounds = np.stack([x0, y0, x0 + rng.uniform(0, 8, n),
+                           y0 + rng.uniform(0, 8, n)], axis=1)
+        data = gscan.build_extent_data(bounds)
+        box = (-30.0, -20.0, 45.0, 33.0)
+        state = gscan.extent_tristate(data, gscan.extent_query([box]))
+        # exact host truth
+        inter = ((bounds[:, 2] >= box[0]) & (bounds[:, 0] <= box[2])
+                 & (bounds[:, 3] >= box[1]) & (bounds[:, 1] <= box[3]))
+        inside = ((bounds[:, 0] >= box[0]) & (bounds[:, 2] <= box[2])
+                  & (bounds[:, 1] >= box[1]) & (bounds[:, 3] <= box[3]))
+        # IN implies truly inside; OUT implies truly disjoint
+        assert not np.any((state == 2) & ~inside)
+        assert not np.any((state == 0) & inter)
+        # MAYBE band is small for random data
+        assert np.mean(state == 1) < 0.2
+
+    def test_time_filter_exact(self):
+        bounds = np.tile([0.0, 0.0, 1.0, 1.0], (4, 1))
+        millis = np.array([0, 10_000, 20_000, 30_000], dtype=np.int64)
+        data = gscan.build_extent_data(bounds, millis)
+        st = gscan.extent_tristate(
+            data, gscan.extent_query([(-5, -5, 5, 5)], [(10_000, 20_000)]))
+        assert (st > 0).tolist() == [False, True, True, False]
+
+
+class TestPointInPolygon:
+    def test_vs_host_reference(self):
+        rng = np.random.default_rng(11)
+        # concave polygon with a hole
+        wkt = ("POLYGON ((0 0, 10 0, 10 10, 5 5, 0 10, 0 0), "
+               "(2 2, 4 2, 4 4, 2 4, 2 2))")
+        poly = parse_wkt(wkt)
+        px = rng.uniform(-2, 12, 20_000)
+        py = rng.uniform(-2, 12, 20_000)
+        got = gscan.points_in_polygon(px, py, poly)
+        from geomesa_tpu.analytics.st_functions import contains_points
+        want = contains_points(poly, px, py)
+        assert np.array_equal(got, want)
+
+    def test_multipolygon(self):
+        rng = np.random.default_rng(12)
+        wkt = ("MULTIPOLYGON (((0 0, 4 0, 4 4, 0 4, 0 0)), "
+               "((6 6, 9 6, 9 9, 6 9, 6 6)))")
+        poly = parse_wkt(wkt)
+        px = rng.uniform(-1, 10, 5000)
+        py = rng.uniform(-1, 10, 5000)
+        got = gscan.points_in_polygon(px, py, poly)
+        from geomesa_tpu.analytics.st_functions import contains_points
+        want = contains_points(poly, px, py)
+        assert np.array_equal(got, want)
+
+    def test_store_pip_residual_path(self):
+        """Point data + polygon INTERSECTS goes through the device
+        point-in-polygon residual and matches the host oracle."""
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("pts", "dtg:Date,*geom:Point:srid=4326"))
+        rng = np.random.default_rng(13)
+        n = 30_000
+        x = rng.uniform(-20, 60, n)
+        y = rng.uniform(-20, 60, n)
+        ds.write_dict("pts", [f"p{i}" for i in range(n)], {
+            "dtg": rng.integers(MS("2021-01-01"), MS("2021-02-01"), n),
+            "geom": (x, y),
+        })
+        q = "INTERSECTS(geom, POLYGON ((0 0, 40 5, 35 45, -5 30, 0 0)))"
+        res = ds.query(q, "pts")
+        batch = ds._state("pts").batch
+        want = set(batch.ids[evaluate(parse_ecql(q), batch)].astype(str))
+        assert set(res.ids.astype(str)) == want
