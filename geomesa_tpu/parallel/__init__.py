@@ -4,13 +4,18 @@ The reference distributes scans across database tablet/region servers
 and reduces partial aggregates client-side (SURVEY.md 2.5/2.6); here the
 "servers" are mesh devices holding column shards, the "iterator stack"
 is a shard_map'd kernel, and the "client reduce" is a psum/all_gather
-over ICI.
+over ICI. Ring pipelines (ring.py) cover the join/KNN shapes the
+reference runs on Spark executors.
 """
 
 from .mesh import (DistributedScanData, data_mesh, distributed_count,
-                   distributed_density, distributed_scan_mask,
+                   distributed_density, distributed_histogram,
+                   distributed_minmax, distributed_scan_mask,
                    exact_host_mask, shard_scan_data)
+from .ring import distributed_knn, ring_dwithin_counts, shard_points
 
 __all__ = ["DistributedScanData", "data_mesh", "distributed_count",
-           "distributed_density", "distributed_scan_mask",
-           "exact_host_mask", "shard_scan_data"]
+           "distributed_density", "distributed_histogram",
+           "distributed_minmax", "distributed_scan_mask",
+           "exact_host_mask", "shard_scan_data",
+           "distributed_knn", "ring_dwithin_counts", "shard_points"]

@@ -25,7 +25,8 @@ from ..scan import zscan
 
 __all__ = ["data_mesh", "DistributedScanData", "shard_scan_data",
            "distributed_scan_mask", "distributed_count",
-           "distributed_density"]
+           "distributed_density", "distributed_histogram",
+           "distributed_minmax"]
 
 
 def data_mesh(n_devices: int | None = None) -> Mesh:
@@ -204,6 +205,51 @@ def _density_fn(mesh: Mesh, time_any: bool,
 
     return jax.jit(jax.shard_map(density, mesh=mesh,
                                  in_specs=_SPECS_IN, out_specs=P()))
+
+
+@functools.lru_cache(maxsize=32)
+def _hist_fn(mesh: Mesh, nbins: int, lo: float, hi: float):
+    scale = nbins / (hi - lo) if hi > lo else 0.0
+
+    def body(values, mask):
+        b = jnp.clip(((values - lo) * scale).astype(jnp.int32), 0, nbins - 1)
+        h = jnp.zeros((nbins,), jnp.int32)
+        h = h.at[b].add(mask.astype(jnp.int32))
+        return jax.lax.psum(h, "data")
+
+    return jax.jit(jax.shard_map(body, mesh=mesh,
+                                 in_specs=(P("data"), P("data")),
+                                 out_specs=P()))
+
+
+def distributed_histogram(values: jax.Array, mask: jax.Array, mesh: Mesh,
+                          nbins: int, lo: float, hi: float) -> np.ndarray:
+    """Shard-local scatter-add histogram merged over ICI with psum —
+    the StatsCombiner server-side merge analog
+    (accumulo/data/stats/StatsCombiner.scala; Histogram/BinnedArray,
+    utils/stats/). `values`/`mask` are 'data'-sharded f32/bool arrays."""
+    fn = _hist_fn(mesh, int(nbins), float(lo), float(hi))
+    return np.asarray(fn(values, mask))
+
+
+@functools.lru_cache(maxsize=32)
+def _minmax_fn(mesh: Mesh):
+    def body(values, mask):
+        vmin = jnp.min(jnp.where(mask, values, jnp.float32(np.inf)))
+        vmax = jnp.max(jnp.where(mask, values, jnp.float32(-np.inf)))
+        return (jax.lax.pmin(vmin, "data"), jax.lax.pmax(vmax, "data"))
+
+    return jax.jit(jax.shard_map(body, mesh=mesh,
+                                 in_specs=(P("data"), P("data")),
+                                 out_specs=(P(), P())))
+
+
+def distributed_minmax(values: jax.Array, mask: jax.Array,
+                       mesh: Mesh) -> tuple[float, float]:
+    """Global (min, max) of masked sharded values via pmin/pmax
+    (MinMax sketch merge, utils/stats/MinMax.scala analog)."""
+    vmin, vmax = _minmax_fn(mesh)(values, mask)
+    return float(vmin), float(vmax)
 
 
 def distributed_density(data: DistributedScanData, q: zscan.ScanQuery,

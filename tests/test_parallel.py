@@ -69,3 +69,80 @@ class TestDistributedScan:
         expect = int((((x >= -20) & (x <= 0) & (y >= -20) & (y <= 0))
                       | ((x >= 50) & (x <= 70) & (y >= 50) & (y <= 60))).sum())
         assert n == expect
+
+
+class TestRingCollectives:
+    def test_ring_dwithin_counts_vs_brute_force(self, setup):
+        from geomesa_tpu.parallel import ring_dwithin_counts, shard_points
+        mesh, _, _, _, _ = setup
+        rng = np.random.default_rng(21)
+        nl, nr = 4_001, 2_003  # not divisible by 8
+        lx = rng.uniform(0, 10, nl)
+        ly = rng.uniform(0, 10, nl)
+        rx = rng.uniform(0, 10, nr)
+        ry = rng.uniform(0, 10, nr)
+        r = 0.5
+        lxj, lyj, lvalid, _ = shard_points(lx, ly, mesh)
+        rxj, ryj, rvalid, _ = shard_points(rx, ry, mesh)
+        sure, band = ring_dwithin_counts(lxj, lyj, lvalid, rxj, ryj, rvalid,
+                                         mesh, r, coord_span=10.0)
+        d2 = (lx[:, None] - rx[None, :]) ** 2 + (ly[:, None] - ry[None, :]) ** 2
+        want = (d2 <= r * r).sum(axis=1)
+        got = sure[:nl].astype(np.int64)
+        # exact totals after host band resolution
+        need = np.flatnonzero(band[:nl])
+        for i in need:
+            got[i] = int((d2[i] <= r * r).sum())
+        assert np.array_equal(got, want)
+        # device-sure counts are a lower bound and the band is small
+        assert np.all(sure[:nl] <= want)
+        assert len(need) < nl * 0.05
+
+    def test_distributed_knn_exact(self, setup):
+        from geomesa_tpu.parallel import distributed_knn, shard_points
+        mesh, _, _, _, _ = setup
+        rng = np.random.default_rng(22)
+        n = 50_007
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        xj, yj, valid, _ = shard_points(x, y, mesh)
+        qx, qy, k = 12.3, -45.6, 100
+        got = distributed_knn(xj, yj, valid, mesh, n, qx, qy, k,
+                              host_x=x, host_y=y)
+        d2 = (x - qx) ** 2 + (y - qy) ** 2
+        want = np.argsort(d2, kind="stable")[:k]
+        assert np.array_equal(np.sort(got), np.sort(want))
+
+    def test_distributed_histogram_and_minmax(self, setup):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from geomesa_tpu.parallel import (distributed_histogram,
+                                          distributed_minmax)
+        mesh, _, _, _, _ = setup
+        rng = np.random.default_rng(23)
+        n = 80_000  # divisible by 8
+        v = rng.uniform(0, 100, n).astype(np.float32)
+        m = rng.random(n) < 0.5
+        sh = NamedSharding(mesh, P("data"))
+        vj = jax.device_put(jnp.asarray(v), sh)
+        mj = jax.device_put(jnp.asarray(m), sh)
+        hist = distributed_histogram(vj, mj, mesh, 20, 0.0, 100.0)
+        want, _ = np.histogram(v[m], bins=20, range=(0.0, 100.0))
+        assert np.array_equal(hist, want)
+        vmin, vmax = distributed_minmax(vj, mj, mesh)
+        assert vmin == pytest.approx(v[m].min())
+        assert vmax == pytest.approx(v[m].max())
+
+    def test_distributed_knn_k_exceeds_shard_size(self, setup):
+        from geomesa_tpu.parallel import distributed_knn, shard_points
+        mesh, _, _, _, _ = setup
+        rng = np.random.default_rng(24)
+        n = 100  # shard size 13 on 8 devices, k = 50 > 13
+        x = rng.uniform(-10, 10, n)
+        y = rng.uniform(-10, 10, n)
+        xj, yj, valid, _ = shard_points(x, y, mesh)
+        got = distributed_knn(xj, yj, valid, mesh, n, 0.0, 0.0, 50,
+                              host_x=x, host_y=y)
+        d2 = x ** 2 + y ** 2
+        want = np.argsort(d2, kind="stable")[:50]
+        assert np.array_equal(np.sort(got), np.sort(want))
