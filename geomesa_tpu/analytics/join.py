@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.fp import f32_band as _f32_band
+
 __all__ = ["dwithin_join", "contains_join", "knn"]
 
 
@@ -44,14 +46,6 @@ def _dwithin_count_reduce(px, py, qx, qy, qvalid, r2_hi, r2_lo):
     definite, maybe = _dwithin_matrices(px, py, qx, qy, qvalid, r2_hi, r2_lo)
     return (jnp.sum(definite, axis=0, dtype=jnp.int32),
             jnp.sum(maybe, axis=0, dtype=jnp.int32))
-
-
-def _f32_band(r_deg: float, coord_span: float) -> tuple[float, float]:
-    """Conservative f32 error band for d2 = dx^2+dy^2 around r^2."""
-    r2 = r_deg * r_deg
-    # relative error of the f32 computation ~ 4 ulp on terms of size span^2
-    err = 8.0 * np.finfo(np.float32).eps * max(coord_span * coord_span, r2)
-    return r2 + err, max(r2 - err, 0.0)
 
 
 def dwithin_join(px: np.ndarray, py: np.ndarray,

@@ -209,9 +209,12 @@ def _density_fn(mesh: Mesh, time_any: bool,
 
 @functools.lru_cache(maxsize=32)
 def _hist_fn(mesh: Mesh, nbins: int, lo: float, hi: float):
-    scale = nbins / (hi - lo) if hi > lo else 0.0
+    scale = nbins / (hi - lo)
 
     def body(values, mask):
+        # np.histogram semantics: values outside [lo, hi] are dropped,
+        # the last bin is closed at hi
+        mask = mask & (values >= lo) & (values <= hi)
         b = jnp.clip(((values - lo) * scale).astype(jnp.int32), 0, nbins - 1)
         h = jnp.zeros((nbins,), jnp.int32)
         h = h.at[b].add(mask.astype(jnp.int32))
@@ -227,7 +230,11 @@ def distributed_histogram(values: jax.Array, mask: jax.Array, mesh: Mesh,
     """Shard-local scatter-add histogram merged over ICI with psum —
     the StatsCombiner server-side merge analog
     (accumulo/data/stats/StatsCombiner.scala; Histogram/BinnedArray,
-    utils/stats/). `values`/`mask` are 'data'-sharded f32/bool arrays."""
+    utils/stats/). `values`/`mask` are 'data'-sharded f32/bool arrays.
+    np.histogram semantics: out-of-range values are dropped."""
+    if nbins <= 0 or not hi > lo:
+        raise ValueError(f"invalid histogram range: nbins={nbins}, "
+                         f"lo={lo}, hi={hi}")
     fn = _hist_fn(mesh, int(nbins), float(lo), float(hi))
     return np.asarray(fn(values, mask))
 

@@ -60,8 +60,7 @@ def _ring_dwithin_fn(mesh: Mesh, r_in2: float, r_out2: float):
     perm = [(i, (i + 1) % k) for i in range(k)]
 
     def body(lx, ly, lvalid, rx, ry, rvalid):
-        def step(_, carry):
-            rx, ry, rvalid, sure, band = carry
+        def block(rx, ry, rvalid, sure, band):
             d2 = ((lx[:, None] - rx[None, :]) ** 2
                   + (ly[:, None] - ry[None, :]) ** 2)
             ok = rvalid[None, :]
@@ -69,6 +68,11 @@ def _ring_dwithin_fn(mesh: Mesh, r_in2: float, r_out2: float):
                                   dtype=jnp.int32)
             band = band + jnp.sum((d2 > r_in2) & (d2 <= r_out2) & ok,
                                   axis=1, dtype=jnp.int32)
+            return sure, band
+
+        def step(_, carry):
+            rx, ry, rvalid, sure, band = carry
+            sure, band = block(rx, ry, rvalid, sure, band)
             rx = lax.ppermute(rx, "data", perm)
             ry = lax.ppermute(ry, "data", perm)
             rvalid = lax.ppermute(rvalid, "data", perm)
@@ -82,8 +86,11 @@ def _ring_dwithin_fn(mesh: Mesh, r_in2: float, r_out2: float):
             zeros = pcast(zeros, "data", to="varying")
         else:  # older jax
             zeros = lax.pvary(zeros, ("data",))
-        *_, sure, band = lax.fori_loop(0, k, step,
-                                       (rx, ry, rvalid, zeros, zeros))
+        # k-1 [compute, rotate] steps, then the final block without the
+        # rotation (its permuted output would be discarded)
+        rx, ry, rvalid, sure, band = lax.fori_loop(
+            0, k - 1, step, (rx, ry, rvalid, zeros, zeros))
+        sure, band = block(rx, ry, rvalid, sure, band)
         return jnp.where(lvalid, sure, 0), jnp.where(lvalid, band, 0)
 
     specs = (P("data"),) * 6
@@ -105,8 +112,8 @@ def ring_dwithin_counts(lx, ly, lvalid, rx, ry, rvalid, mesh: Mesh,
     projected coordinates) via the same rule as
     analytics/join._f32_band, so the contract holds at any scale.
     """
-    from ..analytics.join import _f32_band
-    r2_hi, r2_lo = _f32_band(radius_deg, coord_span)
+    from ..utils.fp import f32_band
+    r2_hi, r2_lo = f32_band(radius_deg, coord_span)
     fn = _ring_dwithin_fn(mesh, float(r2_lo), float(r2_hi))
     sure, bandc = fn(lx, ly, lvalid, rx, ry, rvalid)
     return np.asarray(sure), np.asarray(bandc)

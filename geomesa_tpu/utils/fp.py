@@ -1,0 +1,26 @@
+"""Floating-point error-band helpers shared by the f32 device kernels.
+
+Device kernels compute in f32 and stay exact in f64 terms by pairing a
+conservative error band with a host recheck of in-band rows (the
+two-tier contract used by analytics/join, parallel/ring, scan/gscan).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["f32_band"]
+
+
+def f32_band(r: float, coord_span: float) -> tuple[float, float]:
+    """Conservative f32 error band for d2 = dx^2 + dy^2 around r^2.
+
+    Returns (r2_hi, r2_lo): pairs with f32 d2 <= r2_lo are definitely
+    within r in f64 terms; pairs with f32 d2 > r2_hi are definitely
+    outside; the rest need a host f64 recheck. `coord_span` bounds the
+    coordinate magnitudes (360 for degrees).
+    """
+    r2 = r * r
+    # relative error of the f32 computation ~ 4 ulp on terms of size span^2
+    err = 8.0 * float(np.finfo(np.float32).eps) * max(coord_span * coord_span, r2)
+    return r2 + err, max(r2 - err, 0.0)

@@ -1,0 +1,54 @@
+"""Pallas fused-scan kernel: differential tests against the XLA scan
+(interpret mode on CPU; the same code compiles via Mosaic on TPU,
+where it was measured at XLA parity ~32 Gpts/s)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.scan import (build_pallas_data, build_scan_data, make_query,
+                              pallas_scan_count, pallas_scan_mask, scan_mask)
+
+MS_DAY = 86_400_000
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(5)
+    n = 300_001  # force padding
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    ms = rng.integers(0, 100 * MS_DAY, n).astype(np.int64)
+    return x, y, ms, build_pallas_data(x, y, ms), build_scan_data(x, y, ms)
+
+
+QUERIES = [
+    ([(-80.0, 30.0, -60.0, 45.0)], [(20 * MS_DAY, 50 * MS_DAY)]),
+    ([(-10.0, -10.0, 10.0, 10.0)], []),                      # no time
+    ([(-80.0, 30.0, -60.0, 45.0), (0.0, 0.0, 30.0, 20.0),
+      (100.0, -50.0, 140.0, -10.0)],                         # 3 boxes -> pad 4
+     [(0, 10 * MS_DAY), (90 * MS_DAY, 99 * MS_DAY)]),
+    ([(-180.0, -90.0, 180.0, 90.0)], [(0, 100 * MS_DAY)]),   # whole world
+]
+
+
+class TestPallasParity:
+    @pytest.mark.parametrize("boxes,intervals", QUERIES)
+    def test_mask_matches_xla(self, data, boxes, intervals):
+        x, y, ms, pdata, zdata = data
+        q = make_query(boxes, intervals)
+        got = pallas_scan_mask(pdata, q)
+        want = np.asarray(scan_mask(zdata, q))
+        assert np.array_equal(got, want)
+
+    @pytest.mark.parametrize("boxes,intervals", QUERIES)
+    def test_count_matches_mask(self, data, boxes, intervals):
+        x, y, ms, pdata, zdata = data
+        q = make_query(boxes, intervals)
+        assert pallas_scan_count(pdata, q) == int(
+            np.asarray(scan_mask(zdata, q)).sum())
+
+    def test_padding_rows_never_match(self, data):
+        _, _, _, pdata, _ = data
+        q = make_query([(-180.0, -90.0, 180.0, 90.0)], [])
+        # whole-world query: every real row matches, no pad row does
+        assert pallas_scan_count(pdata, q) == pdata.n
