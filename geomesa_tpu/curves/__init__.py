@@ -12,8 +12,10 @@ from .zorder import (Z2_BITS, Z3_BITS, z2_combine, z2_decode, z2_encode, z2_spli
                      z3_combine, z3_decode, z3_encode, z3_split)
 from .zranges import DEFAULT_MAX_RANGES, merge_ranges, zranges
 from .sfc import Z2SFC, Z3SFC, z2sfc, z3sfc
+from .legacy import LegacyZ3SFC, legacy_z3sfc
 
 __all__ = [
+    "LegacyZ3SFC", "legacy_z3sfc",
     "NormalizedDimension", "normalized_lat", "normalized_lon", "normalized_time",
     "BinnedTime", "TimePeriod", "bins_of_interval", "from_binned", "max_offset",
     "to_binned", "Z2_BITS", "Z3_BITS", "z2_combine", "z2_decode", "z2_encode",

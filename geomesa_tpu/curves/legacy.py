@@ -1,0 +1,90 @@
+"""Legacy Z3 curve for back-compat index decode/migration.
+
+The reference keeps LegacyZ3SFC (curve/LegacyZ3SFC.scala:16) so stores
+written by old versions can still be read and deleted: it differs from
+the current Z3SFC by *semi-normalized* dimensions — ceil-based
+normalization over precision 2^21-1 for lon/lat and 2^20-1 for time
+(NormalizedDimension.scala:83-97 SemiNormalizedDimension: ceil((x-min)/
+(max-min) * precision)) — versus the current floor-based bit
+normalization. Schema-evolution parity: versioned indices are retained
+as legacy classes (SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import zorder
+from .timebin import TimePeriod, max_offset
+
+__all__ = ["SemiNormalizedDimension", "LegacyZ3SFC", "legacy_z3sfc"]
+
+
+class SemiNormalizedDimension:
+    """ceil-based normalization (SemiNormalizedDimension analog)."""
+
+    def __init__(self, lo: float, hi: float, precision: int):
+        self.lo = lo
+        self.hi = hi
+        self.precision = precision  # max index, NOT a bit count
+
+    def normalize(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        i = np.ceil((x - self.lo) / (self.hi - self.lo) * self.precision)
+        return np.maximum(i, 0).astype(np.int64)
+
+    def denormalize(self, i) -> np.ndarray:
+        i = np.asarray(i, np.float64)
+        return self.lo + i / self.precision * (self.hi - self.lo)
+
+
+class LegacyZ3SFC:
+    """Old z3 index scheme: 21-bit semi-normalized lon/lat, 20-bit
+    semi-normalized time (LegacyZ3SFC.scala:16-22). `index` matches the
+    old lenient write path so legacy rows can be located for deletion
+    or migration; `invert` decodes legacy z values."""
+
+    def __init__(self, period: TimePeriod | str = TimePeriod.WEEK):
+        self.period = TimePeriod.parse(period)
+        self.lon = SemiNormalizedDimension(-180.0, 180.0, 2 ** 21 - 1)
+        self.lat = SemiNormalizedDimension(-90.0, 90.0, 2 ** 21 - 1)
+        self.time = SemiNormalizedDimension(
+            0.0, float(max_offset(self.period)), 2 ** 20 - 1)
+
+    def index(self, x, y, t, lenient: bool = False) -> np.ndarray:
+        """x/y doubles, t = offset in the time bin.
+
+        Default: validates bounds (out-of-range values would silently
+        alias through the 21-bit mask). lenient=True skips validation
+        and reproduces the old lenientIndex arithmetic exactly —
+        including its aliasing — which is the point: it finds whatever
+        cell the old writer actually used (LegacyZ3SFC.scala:24-29).
+        """
+        if not lenient:
+            x = np.asarray(x, np.float64)
+            y = np.asarray(y, np.float64)
+            t = np.asarray(t, np.float64)
+            if (np.any(x < -180) or np.any(x > 180) or np.any(y < -90)
+                    or np.any(y > 90) or np.any(t < 0)
+                    or np.any(t > self.time.hi)):
+                raise ValueError("value(s) out of bounds for legacy z3 "
+                                 "index (pass lenient=True to reproduce "
+                                 "the old aliasing write path)")
+        return zorder.z3_encode(self.lon.normalize(x),
+                                self.lat.normalize(y),
+                                self.time.normalize(t))
+
+    def invert(self, z):
+        xi, yi, ti = zorder.z3_decode(z)
+        return (self.lon.denormalize(xi), self.lat.denormalize(yi),
+                self.time.denormalize(ti).astype(np.int64))
+
+
+_CACHE: dict[TimePeriod, LegacyZ3SFC] = {}
+
+
+def legacy_z3sfc(period: TimePeriod | str) -> LegacyZ3SFC:
+    period = TimePeriod.parse(period)
+    if period not in _CACHE:
+        _CACHE[period] = LegacyZ3SFC(period)
+    return _CACHE[period]

@@ -3,6 +3,10 @@
 from .api import Explainer, FilterStrategy, Query, QueryHints, QueryPlan
 from .planner import decide_strategy, heuristic_cost
 from .splitter import split_filter
+from .splitters import (AlphaNumericSplitter, DigitSplitter, HexSplitter,
+                        NoSplitter, splitter_for)
 
 __all__ = ["Explainer", "FilterStrategy", "Query", "QueryHints", "QueryPlan",
-           "decide_strategy", "heuristic_cost", "split_filter"]
+           "decide_strategy", "heuristic_cost", "split_filter",
+           "AlphaNumericSplitter", "DigitSplitter", "HexSplitter",
+           "NoSplitter", "splitter_for"]

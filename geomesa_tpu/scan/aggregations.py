@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["density_grid", "encode_bin_records", "decode_bin_records",
+           "merge_sorted_bin_chunks",
            "sample_mask"]
 
 
@@ -94,6 +95,21 @@ def encode_bin_records(ids: np.ndarray, x: np.ndarray, y: np.ndarray,
     rec["lat"] = np.asarray(y, np.float32)
     rec["lon"] = np.asarray(x, np.float32)
     return rec.tobytes()
+
+
+def merge_sorted_bin_chunks(chunks: list[bytes],
+                            labeled: bool = False) -> bytes:
+    """Merge per-shard time-sorted BIN chunks into one sorted stream —
+    the BinSorter client reduce (index/utils/bin/BinSorter.scala:16
+    merge-sorts the per-tablet chunks by the seconds field). Columnar
+    twist: a single stable argsort over the concatenated seconds column
+    replaces the heap of chunk cursors (k-way merge degenerates to a
+    sort because chunks arrive fully materialized here)."""
+    if not chunks:
+        return b""
+    recs = [decode_bin_records(c, labeled) for c in chunks]
+    allr = np.concatenate(recs)
+    return allr[np.argsort(allr["secs"], kind="stable")].tobytes()
 
 
 def decode_bin_records(data: bytes, labeled: bool = False) -> np.ndarray:

@@ -3,9 +3,10 @@ geomesa-index-api stats, SURVEY.md 2.1)."""
 
 from .sketches import (CountStat, DescriptiveStats, EnumerationStat,
                        Frequency, GroupBy, Histogram, MinMax, SeqStat,
-                       Stat, TopK, Z3Histogram, parse_stat)
+                       Stat, TopK, Z3Frequency, Z3Histogram, parse_stat)
 from .estimator import DataStoreStats, StatsEstimator
 
 __all__ = ["CountStat", "DescriptiveStats", "EnumerationStat", "Frequency",
            "GroupBy", "Histogram", "MinMax", "SeqStat", "Stat", "TopK",
-           "Z3Histogram", "parse_stat", "DataStoreStats", "StatsEstimator"]
+           "Z3Frequency", "Z3Histogram", "parse_stat", "DataStoreStats",
+           "StatsEstimator"]

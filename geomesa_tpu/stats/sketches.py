@@ -27,7 +27,7 @@ from ..features.batch import (DateColumn, FeatureBatch, NumericColumn,
 
 __all__ = ["Stat", "CountStat", "MinMax", "EnumerationStat", "TopK",
            "Frequency", "Histogram", "DescriptiveStats", "GroupBy",
-           "SeqStat", "Z3Histogram", "parse_stat"]
+           "SeqStat", "Z3Histogram", "Z3Frequency", "parse_stat"]
 
 
 def _col_values(batch: FeatureBatch, attr: str):
@@ -273,7 +273,11 @@ class Frequency(Stat):
 
     def observe(self, batch: FeatureBatch) -> None:
         vals, valid = _col_values(batch, self.attribute)
-        vals = np.asarray(vals)[valid]
+        self.observe_values(np.asarray(vals)[valid])
+
+    def observe_values(self, vals: np.ndarray) -> None:
+        """Value-level update (also the hook for key-derived sketches
+        like Z3Frequency)."""
         if len(vals) == 0:
             return
         idx = self._hash(vals)
@@ -293,6 +297,11 @@ class Frequency(Stat):
 
     def count(self, value) -> int:
         idx = self._hash(np.array([value], dtype=object))
+        return int(min(self.table[d, idx[d, 0]] for d in range(self.D)))
+
+    def count_value(self, value: np.int64) -> int:
+        """count() for an exact-typed (non-object) scalar key."""
+        idx = self._hash(np.array([value], dtype=np.int64))
         return int(min(self.table[d, idx[d, 0]] for d in range(self.D)))
 
     def merge(self, other: "Frequency") -> "Frequency":
@@ -572,6 +581,65 @@ class Z3Histogram(Stat):
         return {str(b): int(a.sum()) for b, a in sorted(self.bins.items())}
 
 
+class Z3Frequency(Stat):
+    """Count-min sketch over (time bin, coarse z3 cell) keys
+    (utils/stats/Z3Frequency.scala) — approximate per-cell counts with
+    bounded memory where Z3Histogram keeps exact per-bin arrays."""
+
+    def __init__(self, geom: str, dtg: str,
+                 period: TimePeriod | str = TimePeriod.WEEK,
+                 precision: int = 12):
+        self.geom = geom
+        self.dtg = dtg
+        self.period = TimePeriod.parse(period)
+        self.precision = precision
+        self._freq = Frequency("__z3__", precision)
+        # coarse cell = leading bits of z3 (same resolution rule as
+        # Z3Histogram's 1024 cells)
+        self._shift = 63 - 10
+
+    def _keys(self, batch: FeatureBatch) -> np.ndarray:
+        gcol = batch.col(self.geom)
+        if not isinstance(gcol, PointColumn):
+            raise TypeError("Z3Frequency requires a point geometry")
+        ms = batch.col(self.dtg).millis
+        valid = gcol.valid & batch.col(self.dtg).valid
+        x, y, ms = gcol.x[valid], gcol.y[valid], ms[valid]
+        tbins, offs = timebin.to_binned(ms, self.period, lenient=True)
+        sfc = z3sfc(self.period)
+        z = sfc.index(x, y, np.minimum(offs, int(sfc.time.max)),
+                      lenient=True)
+        cell = (z >> np.uint64(self._shift)).astype(np.int64)
+        # bin lives in the LOW 16 bits: the multiply-shift hash folds
+        # high bits only once, so keys differing near the top would
+        # collide into identical buckets
+        return (cell << np.int64(16)) | (tbins.astype(np.int64) & 0xFFFF)
+
+    def observe(self, batch: FeatureBatch) -> None:
+        self._freq.observe_values(self._keys(batch))
+
+    def count(self, time_bin: int, cell: int) -> int:
+        key = np.int64((int(cell) << 16) | (int(time_bin) & 0xFFFF))
+        return self._freq.count_value(key)
+
+    def merge(self, other: "Z3Frequency") -> "Z3Frequency":
+        if (other.period != self.period
+                or other.precision != self.precision):
+            raise ValueError(
+                f"cannot merge Z3Frequency({other.period},"
+                f"{other.precision}) into ({self.period},{self.precision})"
+                " - different keyspaces")
+        self._freq.merge(other._freq)
+        return self
+
+    @property
+    def is_empty(self) -> bool:
+        return self._freq.is_empty
+
+    def to_json_object(self):
+        return {"precision": self.precision, "total": self._freq.total}
+
+
 # -- DSL parser ------------------------------------------------------------
 
 _STAT_RE = re.compile(r"^\s*(\w+)\((.*)\)\s*$")
@@ -633,4 +701,8 @@ def parse_stat(spec: str) -> Stat:
         period = args[2] if len(args) > 2 else "week"
         length = int(args[3]) if len(args) > 3 else 1024
         return Z3Histogram(args[0], args[1], period, length)
+    if name == "Z3Frequency":
+        period = args[2] if len(args) > 2 else "week"
+        precision = int(args[3]) if len(args) > 3 else 12
+        return Z3Frequency(args[0], args[1], period, precision)
     raise ValueError(f"unknown stat: {name}")

@@ -213,3 +213,27 @@ class TestReporters:
         JsonLineReporter(path).report(self._registry().snapshot())
         d = json.loads(open(path).read())
         assert d["counters"]["queries"] == 3
+
+
+class TestSplitters:
+    def test_digit(self):
+        from geomesa_tpu.index import DigitSplitter
+        s = DigitSplitter().get_splits({"fmt": "%02d", "min": 1, "max": 3})
+        assert s == [b"01", b"02", b"03"]
+
+    def test_hex_no_zero(self):
+        from geomesa_tpu.index import HexSplitter
+        s = HexSplitter().get_splits()
+        assert len(s) == 21 and b"0" not in s and s[0] == b"1"
+
+    def test_alphanumeric(self):
+        from geomesa_tpu.index import AlphaNumericSplitter
+        s = AlphaNumericSplitter().get_splits()
+        assert len(s) == 9 + 26 + 26 and s[0] == b"1" and b"0" not in s
+
+    def test_registry(self):
+        from geomesa_tpu.index import NoSplitter, splitter_for
+        assert isinstance(splitter_for("none"), NoSplitter)
+        import pytest
+        with pytest.raises(ValueError):
+            splitter_for("bogus")

@@ -268,3 +268,43 @@ class TestSFCEndToEnd:
         # 31-bit grid: ~1.7e-7 deg lon resolution
         assert np.all(np.abs(bx - xs) < 2e-7)
         assert np.all(np.abs(by - ys) < 1e-7)
+
+
+class TestLegacyZ3:
+    def test_semi_normalized_vs_current(self):
+        """Legacy ceil-based normalization differs from current floor
+        bit-normalization (LegacyZ3SFC.scala:16-29) but decodes back
+        within one cell width."""
+        from geomesa_tpu.curves import LegacyZ3SFC, Z3SFC, legacy_z3sfc
+        import numpy as np
+        sfc = legacy_z3sfc("week")
+        assert sfc is legacy_z3sfc("week")  # cached per period
+        x = np.array([-180.0, -1.5, 0.0, 77.77, 180.0])
+        y = np.array([-90.0, 42.0, 0.0, -33.3, 90.0])
+        t = np.array([0, 1000, 604799, 12345, 100])
+        z = sfc.index(x, y, t)
+        # out-of-bounds raises by default; lenient reproduces the old
+        # aliasing arithmetic
+        import pytest
+        with pytest.raises(ValueError):
+            sfc.index(np.array([0.0]), np.array([0.0]),
+                      np.array([604800 * 500]))
+        sfc.index(np.array([0.0]), np.array([0.0]),
+                  np.array([604800 * 500]), lenient=True)
+        xd, yd, td = sfc.invert(z)
+        assert np.all(np.abs(xd - x) <= 360 / (2 ** 21 - 1) + 1e-9)
+        assert np.all(np.abs(yd - y) <= 180 / (2 ** 21 - 1) + 1e-9)
+        # ceil vs floor: interior values generally encode differently
+        cur = Z3SFC("week")
+        zc = cur.index(np.array([77.77]), np.array([-33.3]),
+                       np.array([12345]))
+        assert z[3] != zc[0]
+
+    def test_legacy_known_ceil_behavior(self):
+        from geomesa_tpu.curves.legacy import SemiNormalizedDimension
+        import numpy as np
+        d = SemiNormalizedDimension(-180.0, 180.0, 2 ** 21 - 1)
+        # exactly the scala expression: ceil((x-min)/(max-min)*precision)
+        x = np.array([-179.999, 0.0, 179.999])
+        want = np.ceil((x + 180.0) / 360.0 * (2 ** 21 - 1)).astype(np.int64)
+        assert np.array_equal(d.normalize(x), want)

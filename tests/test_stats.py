@@ -161,3 +161,56 @@ class TestEstimator:
         # explain shows stats-based costs
         res = ds.query("BBOX(geom, 0, 0, 10, 10)", "t")
         assert res.plan.index in ("z2", "z3")
+
+
+class TestZ3Frequency:
+    def test_observe_count_merge(self):
+        import numpy as np
+        from geomesa_tpu.features import FeatureBatch, parse_spec
+        from geomesa_tpu.stats import parse_stat
+        from geomesa_tpu.stats.sketches import Z3Frequency
+        sft = parse_spec("t", "dtg:Date,*geom:Point:srid=4326")
+        rng = np.random.default_rng(1)
+        n = 5000
+        # all points in one small cell + one hot timestamp cluster
+        batch = FeatureBatch.from_dict(sft, [f"f{i}" for i in range(n)], {
+            "dtg": np.full(n, 1_600_000_000_000, dtype=np.int64),
+            "geom": (np.full(n, 10.0), np.full(n, 20.0)),
+        })
+        f = parse_stat("Z3Frequency(geom,dtg,week,12)")
+        assert isinstance(f, Z3Frequency)
+        f.observe(batch)
+        assert not f.is_empty
+        # recover the (bin, cell) key for the observed point
+        keys = f._keys(batch)
+        tb = int(keys[0] & np.int64(0xFFFF))
+        cell = int(keys[0] >> np.int64(16))
+        assert f.count(tb, cell) >= n  # count-min overestimates only
+        assert f.count(tb + 1, cell) < n  # other bin ~ unpopulated
+        g = Z3Frequency("geom", "dtg", "week", 12)
+        g.observe(batch)
+        f.merge(g)
+        assert f.count(tb, cell) >= 2 * n
+
+
+class TestBinMerge:
+    def test_merge_sorted_chunks(self):
+        import numpy as np
+        from geomesa_tpu.scan.aggregations import (decode_bin_records,
+                                                   encode_bin_records,
+                                                   merge_sorted_bin_chunks)
+        rng = np.random.default_rng(2)
+        chunks = []
+        all_secs = []
+        for c in range(5):
+            n = rng.integers(1, 50)
+            ms = np.sort(rng.integers(0, 10**9, n)) * 1000
+            ids = np.array([f"c{c}_{i}" for i in range(n)], dtype=object)
+            chunks.append(encode_bin_records(
+                ids, rng.uniform(-180, 180, n), rng.uniform(-90, 90, n), ms))
+            all_secs.append(ms // 1000)
+        merged = merge_sorted_bin_chunks(chunks)
+        rec = decode_bin_records(merged)
+        assert len(rec) == sum(len(s) for s in all_secs)
+        assert np.all(np.diff(rec["secs"]) >= 0)
+        assert merge_sorted_bin_chunks([]) == b""
