@@ -13,7 +13,11 @@ from .join import dwithin_join, knn
 from .tube import TubeBuilder, tube_select_mask
 
 __all__ = ["knn_process", "knn_spiral_process", "proximity_process",
-           "unique_process", "minmax_process", "tube_select_process"]
+           "unique_process", "minmax_process", "tube_select_process",
+           "sampling_process", "query_process", "join_process",
+           "point2point_process", "track_label_process",
+           "route_search_process", "hash_attribute_process",
+           "arrow_conversion_process", "bin_conversion_process"]
 
 
 def _point_cols(store, type_name):
@@ -127,3 +131,197 @@ def tube_select_process(store, type_name: str, track_x, track_y,
                                                    track_millis)
     mask = tube_select_mask(st.scan_data, boxes, intervals)
     return st.batch.ids[np.flatnonzero(mask)]
+
+
+def sampling_process(store, type_name: str, ecql=None, rate: float = 0.1,
+                     by: str | None = None):
+    """SamplingProcess (process/vector/SamplingProcess): thin the result
+    set to ~rate, optionally per `by`-attribute group."""
+    from ..index.api import QueryHints
+    q = Query(type_name, ecql or "INCLUDE")
+    q.hints[QueryHints.SAMPLING] = rate
+    if by is not None:
+        q.hints[QueryHints.SAMPLE_BY] = by
+    return store.query(q)
+
+
+def query_process(store, type_name: str, ecql):
+    """QueryProcess (process/query/QueryProcess): pass-through query —
+    the WPS chaining primitive."""
+    return store.query(Query(type_name, ecql))
+
+
+def join_process(store, primary_type: str, join_type: str,
+                 attribute: str, join_attribute: str | None = None,
+                 ecql=None):
+    """JoinProcess (process/query/JoinProcess): attribute equi-join —
+    features of `join_type` whose `join_attribute` matches a value of
+    `attribute` in the (optionally filtered) primary features."""
+    join_attribute = join_attribute or attribute
+    res = store.query(Query(primary_type, ecql or "INCLUDE"))
+    if res.batch is None or res.n == 0:
+        return store.query(Query(join_type, "EXCLUDE"))
+    col = res.batch.col(attribute)
+    vals = {col.value(i) for i in range(res.batch.n)} - {None}
+    if not vals:
+        return store.query(Query(join_type, "EXCLUDE"))
+    quoted = ", ".join(
+        "'" + v.replace("'", "''") + "'" if isinstance(v, str) else str(v)
+        for v in sorted(vals))
+    return store.query(Query(join_type, f"{join_attribute} IN ({quoted})"))
+
+
+def point2point_process(store, type_name: str, group_by: str,
+                        sort_by: str | None = None, ecql=None):
+    """Point2PointProcess (process/vector/Point2PointProcess): connect
+    each group's time-ordered points into line segments. Returns
+    {group: (k, 2, 2) segment array [[x0,y0],[x1,y1]]}."""
+    st, col = _point_cols(store, type_name)
+    if col is None:
+        return {}
+    q = Query(type_name, ecql or "INCLUDE")
+    q.sort_by = sort_by or st.sft.dtg_field  # store sorts the results
+    res = store.query(q)
+    if res.batch is None or res.n == 0:
+        return {}
+    batch = res.batch
+    gcol = batch.col(st.sft.geom_field)
+    keys = np.array([batch.col(group_by).value(i) for i in range(batch.n)],
+                    dtype=object)
+    order = np.arange(batch.n)
+    out = {}
+    for g in set(keys.tolist()):
+        rows = order[keys[order] == g]
+        if len(rows) < 2:
+            continue
+        xs, ys = gcol.x[rows], gcol.y[rows]
+        segs = np.stack([np.stack([xs[:-1], ys[:-1]], axis=1),
+                         np.stack([xs[1:], ys[1:]], axis=1)], axis=1)
+        out[g] = segs
+    return out
+
+
+def track_label_process(store, type_name: str, track: str, label: str,
+                        ecql=None):
+    """TrackLabelProcess (process/vector/TrackLabelProcess): reduce each
+    track to its most recent point + label attribute. Returns
+    {track: (x, y, label_value)}."""
+    st, col = _point_cols(store, type_name)
+    if col is None:
+        return {}
+    res = store.query(Query(type_name, ecql or "INCLUDE"))
+    if res.batch is None or res.n == 0:
+        return {}
+    batch = res.batch
+    gcol = batch.col(st.sft.geom_field)
+    tvals = np.array([batch.col(track).value(i) for i in range(batch.n)],
+                     dtype=object)
+    dtg = st.sft.dtg_field
+    ms = (batch.col(dtg).millis if dtg is not None
+          else np.arange(batch.n, dtype=np.int64))
+    out = {}
+    for t in set(tvals.tolist()):
+        rows = np.flatnonzero(tvals == t)
+        last = rows[np.argmax(ms[rows])]
+        out[t] = (float(gcol.x[last]), float(gcol.y[last]),
+                  batch.col(label).value(int(last)))
+    return out
+
+
+def route_search_process(store, type_name: str, route_x, route_y,
+                         buffer_deg: float, ecql=None):
+    """RouteSearchProcess (process/query/RouteSearchProcess): features
+    within buffer_deg of a route polyline — the TubeBuilder's gap-fill
+    densification + the device DWithin join against route vertices."""
+    st, col = _point_cols(store, type_name)
+    if col is None:
+        return np.empty(0, object)
+    route_x = np.asarray(route_x, np.float64)
+    route_y = np.asarray(route_y, np.float64)
+    # densify the polyline so vertex spacing <= buffer (LineGapFill
+    # analog, tube/TubeBuilder.scala:182): the DWithin join against the
+    # dense vertices then covers the whole route corridor
+    dxs, dys = [route_x[:1]], [route_y[:1]]
+    for i in range(len(route_x) - 1):
+        seg = np.hypot(route_x[i + 1] - route_x[i],
+                       route_y[i + 1] - route_y[i])
+        steps = max(int(np.ceil(seg / max(buffer_deg, 1e-9))), 1)
+        t = np.linspace(0, 1, steps + 1)[1:]
+        dxs.append(route_x[i] + t * (route_x[i + 1] - route_x[i]))
+        dys.append(route_y[i] + t * (route_y[i + 1] - route_y[i]))
+    dx = np.concatenate(dxs)
+    dy = np.concatenate(dys)
+    if ecql is not None:
+        res = store.query(Query(type_name, ecql))
+        if res.batch is None or res.n == 0:
+            return np.empty(0, object)
+        batch = res.batch
+        pcol = batch.col(st.sft.geom_field)
+    else:
+        batch = st.batch
+        pcol = col
+    # vertex prefilter radius covers the corridor between vertices
+    # (worst case: point at buffer from a segment midpoint), then the
+    # exact distance-to-polyline check runs on candidates only
+    r_pre = float(np.hypot(buffer_deg, buffer_deg / 2))
+    _, pairs = dwithin_join(pcol.x, pcol.y, dx, dy, r_pre)
+    hit = np.zeros(batch.n, dtype=bool)
+    if len(pairs):
+        cand = np.unique(pairs[:, 0])
+        if len(route_x) < 2:
+            # degenerate single-vertex route: plain radius test
+            d2 = ((pcol.x[cand] - route_x[0]) ** 2
+                  + (pcol.y[cand] - route_y[0]) ** 2)
+            keep = d2 <= buffer_deg * buffer_deg
+        else:
+            from ..geometry.base import _point_segments_dist2
+            coords = np.stack([route_x, route_y], axis=1)
+            keep = np.array([
+                _point_segments_dist2(pcol.x[i], pcol.y[i], coords)
+                <= buffer_deg * buffer_deg for i in cand])
+        hit[cand[keep]] = True
+    return batch.ids[hit]
+
+
+def hash_attribute_process(store, type_name: str, attribute: str,
+                           modulo: int, ecql=None) -> np.ndarray:
+    """HashAttributeProcess (process/transform/HashAttributeProcess):
+    stable per-feature hash of an attribute mod `modulo` (coloring /
+    partitioning aid)."""
+    from ..scan.aggregations import _id_hashes
+    res = store.query(Query(type_name, ecql or "INCLUDE"))
+    if res.batch is None or res.n == 0:
+        return np.empty(0, np.int64)
+    col = res.batch.col(attribute)
+    vals = np.array([str(col.value(i)) for i in range(res.batch.n)],
+                    dtype=object)
+    # java String.hashCode (shared with the BIN encoder) mod modulo;
+    # numpy % with a positive divisor is non-negative
+    return _id_hashes(vals).astype(np.int64) % modulo
+
+
+def arrow_conversion_process(store, type_name: str, ecql=None) -> bytes:
+    """ArrowConversionProcess (process/transform/ArrowConversionProcess
+    :38): query results as Arrow IPC stream bytes."""
+    import io
+
+    import pyarrow as pa
+    rb = store.arrow_query(type_name, ecql or "INCLUDE")
+    if rb is None:  # empty result: stream with the schema, zero batches
+        from ..features.batch import FeatureBatch
+        sft = store.get_schema(type_name)
+        rb = FeatureBatch.from_dict(
+            sft, [], {a.name: [] for a in sft.attributes}).to_arrow()
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    return sink.getvalue()
+
+
+def bin_conversion_process(store, type_name: str, ecql=None,
+                           track: str | None = None,
+                           label: str | None = None) -> bytes:
+    """BinConversionProcess (process/transform/BinConversionProcess):
+    query results as BIN records."""
+    return store.bin_query(type_name, ecql or "INCLUDE", track=track,
+                           label=label)

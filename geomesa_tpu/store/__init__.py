@@ -6,10 +6,12 @@ from .memory import InMemoryDataStore, QueryResult
 from .fs import FileSystemDataStore
 from .live import GeoMessage, LiveDataStore, MessageBus
 from .lambda_store import LambdaDataStore
+from .mesh_store import DistributedDataStore
 from .partitions import (AttributeScheme, CompositeScheme, DateTimeScheme,
                          PartitionScheme, Z2Scheme, scheme_from_config)
 
 __all__ = ["InMemoryDataStore", "QueryResult", "FileSystemDataStore",
+           "DistributedDataStore",
            "GeoMessage", "LiveDataStore", "MessageBus", "LambdaDataStore",
            "AttributeScheme", "CompositeScheme", "DateTimeScheme",
            "PartitionScheme", "Z2Scheme", "scheme_from_config"]

@@ -1,0 +1,284 @@
+"""Mesh-distributed datastore: the multi-chip execution tier.
+
+Where InMemoryDataStore runs fused scans on one device, this store
+shards the hot columns of each point type over a ``jax.sharding.Mesh``
+and executes the same query plans with shard-local kernels + ICI
+reduces — the architectural analog of the reference's horizontal
+scaling across tablet/region servers (SURVEY.md §2.5 #2/#5: shard
+parallelism + server-side pushdown with client reduce):
+
+- query ids/features: distributed scan mask (shard_map) gathered with
+  the exact f64 boundary patch, residual filters evaluated on host
+  candidates only;
+- count: psum on ICI, host boundary adjustment (never gathers a mask);
+- density: shard-local scatter-add grids psum-merged over ICI;
+- histogram stats: shard-local bincount + psum;
+- KNN: shard-local top-k prune + host exact re-rank.
+
+The host batch stays resident as the source of truth for residual
+predicates and attribute materialization (the "record table" role);
+device shards hold the scan-hot columns (the "index tables").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..features.batch import FeatureBatch, PointColumn
+from ..features.sft import SimpleFeatureType, parse_spec
+from ..filters import ast
+from ..filters.evaluate import evaluate
+from ..filters.helper import extract_geometries
+from ..index.api import Explainer, FilterStrategy, Query, QueryHints
+from ..index.planner import decide_strategy
+from ..parallel import (DistributedScanData, data_mesh, distributed_count,
+                        distributed_density, distributed_histogram,
+                        distributed_knn, exact_host_mask, shard_points,
+                        shard_scan_data)
+from ..scan import zscan
+from .memory import (QueryResult, _intervals_ms, _is_envelope, _needs_exact,
+                     _spatial_only, _walk)
+
+__all__ = ["DistributedDataStore"]
+
+
+class _MeshTypeState:
+    def __init__(self, sft: SimpleFeatureType):
+        self.sft = sft
+        self.batch: FeatureBatch | None = None
+        self.data: DistributedScanData | None = None
+        self.points = None  # (xj, yj, valid, n) for KNN
+        self.dirty = False
+
+    @property
+    def n(self) -> int:
+        return 0 if self.batch is None else self.batch.n
+
+
+class DistributedDataStore:
+    """Point-type datastore sharded over a device mesh.
+
+    Extent (non-point) types belong on the single-device store for now;
+    this tier is the 100M+-row scan engine (BASELINE.md target shape).
+    """
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh if mesh is not None else data_mesh()
+        self._types: dict[str, _MeshTypeState] = {}
+
+    # -- schema / writes --------------------------------------------------
+
+    def create_schema(self, sft: SimpleFeatureType | str,
+                      spec: str | None = None):
+        if isinstance(sft, str):
+            sft = parse_spec(sft, spec)
+        if sft.geom_field is None or not sft.is_points:
+            raise ValueError("DistributedDataStore requires a point "
+                             "geometry type")
+        self._types[sft.type_name] = _MeshTypeState(sft)
+
+    def get_schema(self, type_name: str) -> SimpleFeatureType:
+        return self._state(type_name).sft
+
+    def get_type_names(self) -> list[str]:
+        return sorted(self._types)
+
+    def _state(self, type_name: str) -> _MeshTypeState:
+        try:
+            return self._types[type_name]
+        except KeyError:
+            raise KeyError(f"unknown feature type '{type_name}'") from None
+
+    def write(self, type_name: str, batch: FeatureBatch):
+        st = self._state(type_name)
+        st.batch = batch if st.batch is None else st.batch.concat(batch)
+        st.dirty = True
+
+    def write_dict(self, type_name: str, ids, data):
+        st = self._state(type_name)
+        self.write(type_name, FeatureBatch.from_dict(st.sft, ids, data))
+
+    def count(self, type_name: str) -> int:
+        return self._state(type_name).n
+
+    # -- sharding ---------------------------------------------------------
+
+    def _ensure_sharded(self, st: _MeshTypeState):
+        """(Re)shard the hot columns after writes — the re-balance that
+        tablet splits do continuously happens here at scan boundaries."""
+        if not st.dirty and st.data is not None:
+            return
+        if st.batch is None or st.batch.n == 0:
+            st.data = None
+            st.points = None
+            st.dirty = False
+            return
+        col = st.batch.col(st.sft.geom_field)
+        dtg = st.sft.dtg_field
+        millis = (st.batch.col(dtg).millis if dtg is not None
+                  else np.zeros(st.batch.n, dtype=np.int64))
+        st.data = shard_scan_data(col.x, col.y, millis, self.mesh)
+        st.points = shard_points(col.x, col.y, self.mesh)
+        st.dirty = False
+
+    # -- queries ----------------------------------------------------------
+
+    def _scan_query(self, st: _MeshTypeState,
+                    strategy: FilterStrategy) -> zscan.ScanQuery:
+        primary = (strategy.primary if strategy.primary is not None
+                   else ast.Include())
+        geom = st.sft.geom_field
+        dtg = st.sft.dtg_field
+        geoms = extract_geometries(primary, geom)
+        boxes = [g.envelope.as_tuple() for g in geoms] or \
+            [(-180.0, -90.0, 180.0, 90.0)]
+        intervals = (_intervals_ms(primary, dtg)
+                     if dtg is not None and strategy.index == "z3" else [])
+        return zscan.make_query(boxes, intervals)
+
+    def _plan(self, q: Query, st: _MeshTypeState, explain: Explainer):
+        indices = ["z3", "z2"] if st.sft.dtg_field is not None else ["z2"]
+        indices.append("id")
+        return decide_strategy(st.sft, q, indices, st.n, explain=explain)
+
+    def query(self, q: Query | str, type_name: str | None = None,
+              explain_out=None) -> QueryResult:
+        if isinstance(q, str):
+            if type_name is None:
+                raise ValueError("type_name required with a filter string")
+            q = Query(type_name, q)
+        st = self._state(q.type_name)
+        explain = Explainer(explain_out)
+        explain.push(f"Distributed planning '{q.type_name}' "
+                     f"filter={q.filter} mesh={self.mesh.devices.size}dev")
+        if st.n == 0:
+            explain("Store is empty").pop()
+            return QueryResult(np.empty(0, dtype=object), None, explain,
+                               FilterStrategy("empty", None, None))
+        self._ensure_sharded(st)
+        strategy = self._plan(q, st, explain)
+
+        if strategy.index == "empty":
+            mask = np.zeros(st.n, dtype=bool)
+        elif strategy.index == "id" and strategy.primary is not None:
+            mask = np.isin(st.batch.ids.astype(str),
+                           np.asarray(strategy.primary.ids, dtype=str))
+        else:
+            sq = self._scan_query(st, strategy)
+            mask = exact_host_mask(st.data, sq)
+            explain(f"Distributed scan over {self.mesh.devices.size} "
+                    f"device(s)")
+            primary = strategy.primary or ast.Include()
+            geoms = extract_geometries(primary, st.sft.geom_field)
+            if _needs_exact(geoms, primary):
+                cand = np.flatnonzero(mask)
+                spatial_f = _spatial_only(primary, st.sft.geom_field)
+                if spatial_f is not None and len(cand):
+                    keep = evaluate(spatial_f, st.batch.take(cand))
+                    mask = np.zeros(st.n, dtype=bool)
+                    mask[cand[keep]] = True
+                    explain(f"Exact predicate on {len(cand)} candidate(s)")
+
+        if strategy.secondary is not None:
+            cand = np.flatnonzero(mask)
+            if len(cand):
+                keep = evaluate(strategy.secondary, st.batch.take(cand))
+                mask = np.zeros(st.n, dtype=bool)
+                mask[cand[keep]] = True
+            explain(f"Residual filter applied: {strategy.secondary}")
+
+        idx = np.flatnonzero(mask)
+        rate = q.hints.get(QueryHints.SAMPLING)
+        if rate is not None and len(idx):
+            from ..scan.aggregations import sample_mask
+            by_attr = q.hints.get(QueryHints.SAMPLE_BY)
+            by = None
+            if by_attr is not None:
+                col = st.batch.col(by_attr)
+                by = np.array([col.value(int(i)) or "" for i in idx],
+                              dtype=object).astype(str)
+            idx = idx[sample_mask(len(idx), float(rate), by)]
+            explain(f"Sampling applied: rate={rate}")
+        if q.max_features is not None:
+            idx = idx[: q.max_features]
+        ids = st.batch.ids[idx]
+        batch = st.batch.take(idx)
+        explain(f"Hits: {len(ids)}").pop()
+        return QueryResult(ids, batch, explain, strategy)
+
+    def query_count(self, q: Query | str, type_name: str | None = None) -> int:
+        """Count without gathering a mask: psum over ICI + host boundary
+        adjustment (exact). Falls back to query() when the plan needs
+        residual/exact predicates."""
+        if isinstance(q, str):
+            q = Query(type_name, q)
+        st = self._state(q.type_name)
+        if st.n == 0:
+            return 0
+        self._ensure_sharded(st)
+        explain = Explainer()
+        strategy = self._plan(q, st, explain)
+        primary = strategy.primary or ast.Include()
+        geoms = extract_geometries(primary, st.sft.geom_field)
+        if (strategy.index not in ("z2", "z3")
+                or strategy.secondary is not None
+                or _needs_exact(geoms, primary)):
+            return int(self.query(q).n)
+        return distributed_count(st.data, self._scan_query(st, strategy))
+
+    def density(self, type_name: str, ecql, bbox, width: int, height: int):
+        """Heatmap grid via shard-local scatter-add + psum."""
+        st = self._state(type_name)
+        if st.n == 0:
+            return np.zeros((height, width), dtype=np.float32)
+        self._ensure_sharded(st)
+        q = Query(type_name, ecql)
+        explain = Explainer()
+        strategy = self._plan(q, st, explain)
+        if strategy.index in ("z2", "z3") and strategy.secondary is None:
+            sq = self._scan_query(st, strategy)
+            return distributed_density(st.data, sq, bbox, width, height)
+        # residual-bearing plans: exact mask, host binning
+        res = self.query(q)
+        from ..scan.aggregations import density_grid
+        col = res.batch.col(st.sft.geom_field)
+        return density_grid(col.x, col.y, np.ones(len(col.x), bool),
+                            bbox, width, height)
+
+    def histogram(self, type_name: str, attribute: str, nbins: int,
+                  lo: float, hi: float) -> np.ndarray:
+        """Distributed attribute histogram (psum-merged)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        st = self._state(type_name)
+        if st.n == 0:
+            return np.zeros(nbins, dtype=np.int64)
+        self._ensure_sharded(st)
+        vals = st.batch.col(attribute)
+        v = np.asarray(getattr(vals, "values", getattr(vals, "millis", None)),
+                       np.float64)
+        k = self.mesh.devices.size
+        n_padded = ((st.n + k - 1) // k) * k
+        vp = np.full(n_padded, np.nan, np.float32)
+        vp[: st.n] = v
+        m = np.zeros(n_padded, dtype=bool)
+        m[: st.n] = np.asarray(vals.valid)
+        sh = NamedSharding(self.mesh, P("data"))
+        return distributed_histogram(jax.device_put(jnp.asarray(vp), sh),
+                                     jax.device_put(jnp.asarray(m), sh),
+                                     self.mesh, nbins, lo, hi)
+
+    def knn(self, type_name: str, qx: float, qy: float, k: int) -> np.ndarray:
+        """k nearest feature ids via the distributed prune + exact
+        host re-rank."""
+        st = self._state(type_name)
+        if st.n == 0:
+            return np.empty(0, dtype=object)
+        self._ensure_sharded(st)
+        xj, yj, valid, n = st.points
+        col = st.batch.col(st.sft.geom_field)
+        idx = distributed_knn(xj, yj, valid, self.mesh, n, qx, qy, k,
+                              host_x=col.x, host_y=col.y)
+        return st.batch.ids[idx]

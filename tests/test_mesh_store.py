@@ -1,0 +1,87 @@
+"""DistributedDataStore on the 8-virtual-device CPU mesh: differential
+tests against InMemoryDataStore (same plans, same feature IDs)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.store import DistributedDataStore, InMemoryDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+SPEC = "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326"
+
+
+@pytest.fixture(scope="module")
+def stores():
+    rng = np.random.default_rng(42)
+    n = 120_007
+    data = {
+        "name": [f"n{i % 13}" for i in range(n)],
+        "age": rng.integers(0, 100, n),
+        "dtg": rng.integers(MS("2019-01-01"), MS("2019-06-01"), n),
+        "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+    }
+    ids = [f"f{i}" for i in range(n)]
+    dist = DistributedDataStore()
+    dist.create_schema(parse_spec("pts", SPEC))
+    dist.write_dict("pts", ids, data)
+    mem = InMemoryDataStore()
+    mem.create_schema(parse_spec("pts", SPEC))
+    mem.write_dict("pts", ids, data)
+    return dist, mem
+
+
+QUERIES = [
+    "BBOX(geom, -20, -15, 31.5, 42.25)",
+    ("BBOX(geom, 10, 10, 60, 55) AND "
+     "dtg DURING 2019-02-01T00:00:00Z/2019-03-15T00:00:00Z"),
+    "INTERSECTS(geom, POLYGON ((0 0, 40 5, 35 45, -5 30, 0 0)))",
+    "BBOX(geom, -20, -15, 31.5, 42.25) AND age > 50",
+    "IN ('f17', 'f99', 'nope')",
+]
+
+
+class TestDistributedStore:
+    @pytest.mark.parametrize("ecql", QUERIES)
+    def test_ids_match_single_device_store(self, stores, ecql):
+        dist, mem = stores
+        got = set(dist.query(ecql, "pts").ids.astype(str))
+        want = set(mem.query(ecql, "pts").ids.astype(str))
+        assert got == want
+
+    @pytest.mark.parametrize("ecql", QUERIES)
+    def test_count_matches(self, stores, ecql):
+        dist, mem = stores
+        assert dist.query_count(ecql, "pts") == mem.query(ecql, "pts").n
+
+    def test_density_mass(self, stores):
+        dist, mem = stores
+        ecql = "BBOX(geom, -90, -45, 90, 45)"
+        grid = dist.density("pts", ecql, (-180, -90, 180, 90), 32, 16)
+        assert int(grid.sum()) == mem.query(ecql, "pts").n
+
+    def test_histogram_matches_numpy(self, stores):
+        dist, mem = stores
+        hist = dist.histogram("pts", "age", 10, 0.0, 100.0)
+        ages = mem._state("pts").batch.col("age").values
+        want, _ = np.histogram(ages, bins=10, range=(0.0, 100.0))
+        assert np.array_equal(hist, want)
+
+    def test_knn(self, stores):
+        dist, mem = stores
+        ids = dist.knn("pts", 12.3, -45.6, 25)
+        col = mem._state("pts").batch.col("geom")
+        d2 = (col.x - 12.3) ** 2 + (col.y + 45.6) ** 2
+        want = mem._state("pts").batch.ids[np.argsort(d2, kind="stable")[:25]]
+        assert set(ids.astype(str)) == set(want.astype(str))
+
+    def test_rejects_extent_types(self):
+        ds = DistributedDataStore()
+        with pytest.raises(ValueError):
+            ds.create_schema(parse_spec("z", "*geom:Polygon:srid=4326"))
+
+    def test_empty_store(self):
+        ds = DistributedDataStore()
+        ds.create_schema(parse_spec("e", SPEC))
+        assert ds.query("INCLUDE", "e").n == 0
+        assert ds.query_count("INCLUDE", "e") == 0

@@ -1,0 +1,153 @@
+"""Z3 UUID generator + second batch of process analogs (Point2Point,
+TrackLabel, RouteSearch, HashAttribute, Sampling, Query, Join,
+Arrow/Bin conversion)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.analytics.processes import (arrow_conversion_process,
+                                             bin_conversion_process,
+                                             hash_attribute_process,
+                                             join_process,
+                                             point2point_process,
+                                             query_process,
+                                             route_search_process,
+                                             sampling_process,
+                                             track_label_process)
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.store import InMemoryDataStore
+from geomesa_tpu.utils.uuid import ingest_time_uuids, z3_uuids
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+
+@pytest.fixture(scope="module")
+def store():
+    ds = InMemoryDataStore()
+    ds.create_schema(parse_spec(
+        "trk", "boat:String,label:String,dtg:Date,*geom:Point:srid=4326"))
+    # two boats moving east along different latitudes
+    n = 10
+    ds.write_dict("trk", [f"t{i}" for i in range(2 * n)], {
+        "boat": ["a"] * n + ["b"] * n,
+        "label": [f"L{i}" for i in range(2 * n)],
+        "dtg": np.concatenate([
+            np.arange(n) * 60_000 + MS("2022-01-01"),
+            np.arange(n) * 60_000 + MS("2022-01-01")]),
+        "geom": (np.concatenate([np.arange(n) * 1.0,
+                                 np.arange(n) * 1.0]),
+                 np.concatenate([np.zeros(n), np.full(n, 10.0)])),
+    })
+    return ds
+
+
+class TestUuids:
+    def test_z3_uuid_shape_and_locality(self):
+        rng = np.random.default_rng(1)
+        n = 2000
+        # two well-separated clusters at the same time
+        x = np.concatenate([rng.uniform(0, 1, n), rng.uniform(100, 101, n)])
+        y = np.concatenate([rng.uniform(0, 1, n), rng.uniform(50, 51, n)])
+        ms = np.full(2 * n, MS("2022-06-01"))
+        ids = z3_uuids(x, y, ms, rng=np.random.default_rng(2))
+        assert len(set(ids)) == 2 * n  # unique
+        for u in ids[:5]:
+            assert len(u) == 36 and u[14] == "4"  # version 4 slot
+        # locality: ids within a cluster share long prefixes more often
+        # than across clusters (compare the z3 part after the shard+bin)
+        def msb(u):
+            return u.replace("-", "")[:16]
+        same = sum(msb(ids[i])[5:12] == msb(ids[i + 1])[5:12]
+                   for i in range(0, n - 1))
+        cross = sum(msb(ids[i])[5:12] == msb(ids[n + i])[5:12]
+                    for i in range(n))
+        assert same > cross
+
+    def test_z3_uuid_rejects_nan(self):
+        with pytest.raises(ValueError):
+            z3_uuids(np.array([np.nan]), np.array([0.0]),
+                     np.array([0], dtype=np.int64))
+
+    def test_ingest_time_sorts(self):
+        a = ingest_time_uuids(3, millis=1000)
+        b = ingest_time_uuids(3, millis=2_000_000)
+        assert max(a) < min(b)
+
+
+class TestProcesses2:
+    def test_point2point(self, store):
+        segs = point2point_process(store, "trk", "boat")
+        assert set(segs) == {"a", "b"}
+        assert segs["a"].shape == (9, 2, 2)
+        # consecutive points connect in time order
+        assert np.allclose(segs["a"][0], [[0, 0], [1, 0]])
+
+    def test_track_label(self, store):
+        out = track_label_process(store, "trk", "boat", "label")
+        assert out["a"] == (9.0, 0.0, "L9")
+        assert out["b"] == (9.0, 10.0, "L19")
+
+    def test_route_search(self, store):
+        # route along y=0 -> only boat a's points
+        ids = route_search_process(store, "trk", [0.0, 9.0], [0.0, 0.0],
+                                   buffer_deg=0.5)
+        assert set(ids.astype(str)) == {f"t{i}" for i in range(10)}
+
+    def test_hash_attribute(self, store):
+        h = hash_attribute_process(store, "trk", "boat", 4)
+        assert len(h) == 20 and set(h) <= set(range(4))
+        assert len(set(h[:10])) == 1  # same boat -> same hash
+
+    def test_sampling(self, store):
+        res = sampling_process(store, "trk", rate=0.5)
+        assert 0 < res.n <= 20
+
+    def test_query_and_join(self, store):
+        res = query_process(store, "trk", "boat = 'a'")
+        assert res.n == 10
+        joined = join_process(store, "trk", "trk", "boat",
+                              ecql="label = 'L3'")
+        assert joined.n == 10  # all of boat a
+
+    def test_conversions(self, store):
+        from geomesa_tpu.scan.aggregations import decode_bin_records
+        b = bin_conversion_process(store, "trk", "boat = 'a'")
+        assert len(decode_bin_records(b)) == 10
+        arrow = arrow_conversion_process(store, "trk", "boat = 'b'")
+        assert isinstance(arrow, bytes) and len(arrow) > 0
+
+
+class TestReviewRegressions2:
+    def test_join_escapes_quotes(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("p", "name:String,*geom:Point:srid=4326"))
+        ds.write_dict("p", ["a", "b"], {
+            "name": ["O'Brien", "Smith"],
+            "geom": (np.array([0.0, 1.0]), np.array([0.0, 1.0]))})
+        res = join_process(ds, "p", "p", "name", ecql="name = 'O''Brien'")
+        assert set(res.ids.astype(str)) == {"a"}
+
+    def test_single_vertex_route(self, store):
+        ids = route_search_process(store, "trk", [0.0], [0.0],
+                                   buffer_deg=1.5)
+        assert set(ids.astype(str)) == {"t0", "t1"}
+
+    def test_arrow_conversion_empty(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("e", "name:String,*geom:Point:srid=4326"))
+        out = arrow_conversion_process(ds, "e")
+        import pyarrow as pa
+        rdr = pa.ipc.open_stream(out)
+        assert rdr.read_all().num_rows == 0
+
+    def test_sampling_on_mesh_store(self):
+        from geomesa_tpu.store import DistributedDataStore
+        ds = DistributedDataStore()
+        ds.create_schema(parse_spec("s", "dtg:Date,*geom:Point:srid=4326"))
+        rng = np.random.default_rng(9)
+        n = 1000
+        ds.write_dict("s", [f"f{i}" for i in range(n)], {
+            "dtg": rng.integers(0, 10**12, n),
+            "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))})
+        res = sampling_process(ds, "s", rate=0.1)
+        assert 50 <= res.n <= 150
