@@ -7,7 +7,7 @@ Subcommands (mirroring the reference's tools/ command set):
     delete-schema   --path R --name T
     list-schemas    --path R
     ingest          --path R --name T --converter conf.json FILES...
-    export          --path R --name T [--cql F] [--format csv|geojson|bin|arrow]
+    export          --path R --name T [--cql F] [--format csv|tsv|geojson|gml|avro|bin|arrow]
     count           --path R --name T [--cql F]
     explain         --path R --name T --cql F
     stats           --path R --name T --stat-spec 'MinMax(a)' [--cql F]
@@ -110,11 +110,12 @@ def cmd_export(args) -> int:
     if res.batch is None or res.n == 0:
         print("0 features", file=sys.stderr)
         return 0
-    if fmt == "csv":
+    if fmt in ("csv", "tsv"):
+        sep = "," if fmt == "csv" else "\t"
         names = [a.name for a in res.batch.sft.attributes]
-        out.write("id," + ",".join(names) + "\n")
+        out.write("id" + sep + sep.join(names) + "\n")
         for f in res.features():
-            out.write(",".join([str(f["id"])] + [
+            out.write(sep.join([str(f["id"])] + [
                 "" if f[n] is None else str(f[n]) for n in names]) + "\n")
     elif fmt == "geojson":
         from ..geometry.geojson import to_geojson
@@ -138,6 +139,27 @@ def cmd_export(args) -> int:
                        ds._files_for(ds._state(args.name), None))
         data = mem.bin_query(args.name, args.cql or "INCLUDE")
         sys.stdout.buffer.write(data)
+    elif fmt == "avro":
+        from ..convert.avro_writer import write_avro_batch
+        sys.stdout.buffer.write(write_avro_batch(res.batch.sft, res.batch))
+    elif fmt == "gml":
+        from xml.sax.saxutils import escape
+
+        from ..geometry import to_wkt
+        geom_field = res.batch.sft.geom_field
+        out.write('<?xml version="1.0" encoding="UTF-8"?>\n'
+                  '<wfs:FeatureCollection xmlns:wfs="http://www.opengis.net'
+                  '/wfs" xmlns:gml="http://www.opengis.net/gml">\n')
+        for f in res.features():
+            out.write(f'  <gml:featureMember><feature fid='
+                      f'"{escape(str(f["id"]))}">\n')
+            for k, v in f.items():
+                if k == "id" or v is None:
+                    continue
+                sv = to_wkt(v) if k == geom_field else str(v)
+                out.write(f"    <{k}>{escape(sv)}</{k}>\n")
+            out.write("  </feature></gml:featureMember>\n")
+        out.write("</wfs:FeatureCollection>\n")
     else:
         print(f"unknown format {fmt!r}", file=sys.stderr)
         return 2

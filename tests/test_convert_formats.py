@@ -195,3 +195,68 @@ class TestEnrichment:
                  "transform": "point($3::double, $4::double)"}]})
         batch, ctx = conv.process("alpha,1,1.0,2.0\nbeta,2,3.0,4.0\n")
         assert [batch.col("name").value(i) for i in range(2)] == ["US", "NO"]
+
+
+class TestAvroWriter:
+    def test_roundtrip_through_reader(self, tmp_path):
+        import numpy as np
+        from geomesa_tpu.convert.avro_reader import read_avro
+        from geomesa_tpu.convert.avro_writer import write_avro_batch
+        from geomesa_tpu.features import FeatureBatch, parse_spec
+        sft = parse_spec(
+            "t", "name:String,age:Integer,score:Double,dtg:Date,"
+            "*geom:Point:srid=4326")
+        batch = FeatureBatch.from_dict(sft, ["a", "b"], {
+            "name": ["x", None],
+            "age": [3, 7],
+            "score": [1.5, -2.25],
+            "dtg": [1_600_000_000_000, 1_600_000_100_000],
+            "geom": ["POINT (1 2)", "POINT (-3.5 4.5)"],
+        })
+        data = write_avro_batch(sft, batch)
+        recs = list(read_avro(data))
+        assert len(recs) == 2
+        assert recs[0]["__fid__"] == "a"
+        assert recs[0]["name"] == "x" and recs[1]["name"] is None
+        assert recs[1]["age"] == 7
+        assert recs[0]["score"] == 1.5
+        assert recs[0]["dtg"] == 1_600_000_000_000
+        assert recs[1]["geom"] == "POINT (-3.5 4.5)"
+
+
+class TestCliExportFormats:
+    def _mkstore(self, tmp_path):
+        import numpy as np
+        from geomesa_tpu.store import FileSystemDataStore
+        from geomesa_tpu.features import parse_spec
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema(parse_spec(
+            "t", "name:String,dtg:Date,*geom:Point:srid=4326"))
+        ds.write_dict("t", ["a", "b"], {
+            "name": ["x<&>", "y"],
+            "dtg": [1_600_000_000_000, 1_600_000_100_000],
+            "geom": (np.array([1.0, 2.0]), np.array([3.0, 4.0]))})
+        return ds
+
+    def test_tsv_gml_avro(self, tmp_path, capsys):
+        from geomesa_tpu.tools.cli import main
+        self._mkstore(tmp_path)
+        assert main(["export", "--path", str(tmp_path), "--name", "t",
+                     "--format", "tsv"]) == 0
+        out = capsys.readouterr().out
+        assert out.splitlines()[0] == "id\tname\tdtg\tgeom"
+        assert main(["export", "--path", str(tmp_path), "--name", "t",
+                     "--format", "gml"]) == 0
+        out = capsys.readouterr().out
+        assert "<wfs:FeatureCollection" in out and "x&lt;&amp;&gt;" in out
+        # avro writes binary to stdout.buffer: swap in a byte sink
+        import io, sys
+        from unittest import mock
+        sink = io.TextIOWrapper(io.BytesIO())
+        with mock.patch.object(sys, "stdout", sink):
+            assert main(["export", "--path", str(tmp_path), "--name", "t",
+                         "--format", "avro"]) == 0
+        sink.flush()
+        data = sink.buffer.getvalue()
+        from geomesa_tpu.convert.avro_reader import read_avro
+        assert len(list(read_avro(data))) == 2
