@@ -17,36 +17,29 @@ import os
 import struct
 from typing import Any
 
-__all__ = ["AvroFileWriter", "write_avro_batch"]
+from .avro_reader import _MAGIC, _w_bytes, _w_long
 
-_MAGIC = b"Obj\x01"
+__all__ = ["AvroFileWriter", "write_avro_batch"]
 
 
 class _Encoder:
+    """Thin buffer over the shared OCF wire primitives in avro_reader
+    (one zigzag-varint implementation for both writers)."""
+
     def __init__(self):
         self.buf = io.BytesIO()
 
     def write_long(self, v: int):
-        # zigzag varint
-        v = (v << 1) ^ (v >> 63)
-        while True:
-            b = v & 0x7F
-            v >>= 7
-            if v:
-                self.buf.write(bytes([b | 0x80]))
-            else:
-                self.buf.write(bytes([b]))
-                break
+        _w_long(self.buf, v)
 
     def write_double(self, v: float):
         self.buf.write(struct.pack("<d", v))
 
     def write_bytes(self, v: bytes):
-        self.write_long(len(v))
-        self.buf.write(v)
+        _w_bytes(self.buf, v)
 
     def write_string(self, v: str):
-        self.write_bytes(v.encode("utf-8"))
+        _w_bytes(self.buf, v.encode("utf-8"))
 
     def write_boolean(self, v: bool):
         self.buf.write(b"\x01" if v else b"\x00")

@@ -21,7 +21,11 @@ __all__ = ["SemiNormalizedDimension", "LegacyZ3SFC", "legacy_z3sfc"]
 
 
 class SemiNormalizedDimension:
-    """ceil-based normalization (SemiNormalizedDimension analog)."""
+    """ceil-based normalization (SemiNormalizedDimension analog,
+    NormalizedDimension.scala:83-87): ``normalize`` is a bare
+    ``ceil((x-min)/(max-min)*precision)`` with NO clamping, and
+    ``denormalize`` returns ``min`` for bin 0 and cell *midpoints*
+    otherwise (the "doesn't correctly bin lower bound" legacy quirk)."""
 
     def __init__(self, lo: float, hi: float, precision: int):
         self.lo = lo
@@ -31,11 +35,22 @@ class SemiNormalizedDimension:
     def normalize(self, x) -> np.ndarray:
         x = np.asarray(x, np.float64)
         i = np.ceil((x - self.lo) / (self.hi - self.lo) * self.precision)
-        return np.maximum(i, 0).astype(np.int64)
+        return i.astype(np.int64)
+
+    def lenient(self, x) -> np.ndarray:
+        """lenientIndex arithmetic (LegacyZ3SFC.scala:24-29): clamps the
+        ceil at the dimension MINIMUM as a double — e.g. max(-180.0, i)
+        for longitude — so far-out-of-range west/south inputs produce
+        negative indices like -180 that alias through the 21-bit mask
+        exactly as the old writer's did."""
+        x = np.asarray(x, np.float64)
+        i = np.ceil((x - self.lo) / (self.hi - self.lo) * self.precision)
+        return np.maximum(i, self.lo).astype(np.int64)
 
     def denormalize(self, i) -> np.ndarray:
-        i = np.asarray(i, np.float64)
-        return self.lo + i / self.precision * (self.hi - self.lo)
+        i = np.asarray(i)
+        mid = (i - 0.5) * (self.hi - self.lo) / self.precision + self.lo
+        return np.where(i == 0, self.lo, mid)
 
 
 class LegacyZ3SFC:
@@ -60,16 +75,19 @@ class LegacyZ3SFC:
         including its aliasing — which is the point: it finds whatever
         cell the old writer actually used (LegacyZ3SFC.scala:24-29).
         """
-        if not lenient:
-            x = np.asarray(x, np.float64)
-            y = np.asarray(y, np.float64)
-            t = np.asarray(t, np.float64)
-            if (np.any(x < -180) or np.any(x > 180) or np.any(y < -90)
-                    or np.any(y > 90) or np.any(t < 0)
-                    or np.any(t > self.time.hi)):
-                raise ValueError("value(s) out of bounds for legacy z3 "
-                                 "index (pass lenient=True to reproduce "
-                                 "the old aliasing write path)")
+        if lenient:
+            return zorder.z3_encode(self.lon.lenient(x),
+                                    self.lat.lenient(y),
+                                    self.time.lenient(t))
+        x = np.asarray(x, np.float64)
+        y = np.asarray(y, np.float64)
+        t = np.asarray(t, np.float64)
+        if (np.any(x < -180) or np.any(x > 180) or np.any(y < -90)
+                or np.any(y > 90) or np.any(t < 0)
+                or np.any(t > self.time.hi)):
+            raise ValueError("value(s) out of bounds for legacy z3 "
+                             "index (pass lenient=True to reproduce "
+                             "the old aliasing write path)")
         return zorder.z3_encode(self.lon.normalize(x),
                                 self.lat.normalize(y),
                                 self.time.normalize(t))

@@ -1,0 +1,27 @@
+"""Shared store-layer helpers (used by memory/mesh/lambda stores)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sort_order"]
+
+
+def sort_order(batch, sort_by: str, sort_desc: bool = False,
+               idx: np.ndarray | None = None) -> np.ndarray:
+    """Stable argsort of a batch's rows (or the row subset ``idx``) by an
+    attribute column — the SortingSimpleFeatureIterator analog
+    (reference utils/iterators/SortingSimpleFeatureIterator:22). Returns
+    positions into ``idx`` (or into the batch when ``idx`` is None)."""
+    col = batch.col(sort_by)
+    keys = getattr(col, "values", None)
+    if keys is None:
+        keys = getattr(col, "millis", None)
+    if keys is None:
+        raise ValueError(f"cannot sort by {sort_by}")
+    if idx is not None:
+        keys = keys[idx]
+    order = np.argsort(keys, kind="stable")
+    if sort_desc:
+        order = order[::-1]
+    return order

@@ -98,13 +98,8 @@ class LambdaDataStore:
         rt.explain(f"Lambda union: {rt.n} transient + "
                    f"{int(keep.sum())} persistent")
         if batch is not None and q.sort_by is not None:
-            col = batch.col(q.sort_by)
-            keys = getattr(col, "values", None)
-            if keys is None:
-                keys = getattr(col, "millis", None)
-            order = np.argsort(keys, kind="stable")
-            if q.sort_desc:
-                order = order[::-1]
+            from .common import sort_order
+            order = sort_order(batch, q.sort_by, q.sort_desc)
             ids = ids[order]
             batch = batch.take(order)
         if q.max_features is not None:

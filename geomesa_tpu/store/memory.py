@@ -361,14 +361,8 @@ class InMemoryDataStore:
             idx = idx[sample_mask(len(idx), float(rate), by)]
             explain(f"Sampling applied: rate={rate}")
         if q.sort_by is not None:
-            col = st.batch.col(q.sort_by)
-            keys = getattr(col, "values", getattr(col, "millis", None))
-            if keys is None:
-                raise ValueError(f"cannot sort by {q.sort_by}")
-            order = np.argsort(keys[idx], kind="stable")
-            if q.sort_desc:
-                order = order[::-1]
-            idx = idx[order]
+            from .common import sort_order
+            idx = idx[sort_order(st.batch, q.sort_by, q.sort_desc, idx)]
         if q.max_features is not None:
             idx = idx[:q.max_features]
 

@@ -199,6 +199,11 @@ class DistributedDataStore:
                               dtype=object).astype(str)
             idx = idx[sample_mask(len(idx), float(rate), by)]
             explain(f"Sampling applied: rate={rate}")
+        if q.sort_by is not None:
+            from .common import sort_order
+            idx = idx[sort_order(st.batch, q.sort_by, q.sort_desc, idx)]
+            explain(f"Sorted by {q.sort_by}"
+                    f"{' desc' if q.sort_desc else ''}")
         if q.max_features is not None:
             idx = idx[: q.max_features]
         ids = st.batch.ids[idx]

@@ -143,7 +143,7 @@ def cmd_export(args) -> int:
         from ..convert.avro_writer import write_avro_batch
         sys.stdout.buffer.write(write_avro_batch(res.batch.sft, res.batch))
     elif fmt == "gml":
-        from xml.sax.saxutils import escape
+        from xml.sax.saxutils import escape, quoteattr
 
         from ..geometry import to_wkt
         geom_field = res.batch.sft.geom_field
@@ -152,7 +152,7 @@ def cmd_export(args) -> int:
                   '/wfs" xmlns:gml="http://www.opengis.net/gml">\n')
         for f in res.features():
             out.write(f'  <gml:featureMember><feature fid='
-                      f'"{escape(str(f["id"]))}">\n')
+                      f'{quoteattr(str(f["id"]))}>\n')
             for k, v in f.items():
                 if k == "id" or v is None:
                     continue

@@ -214,7 +214,7 @@ class TestAvroWriter:
             "geom": ["POINT (1 2)", "POINT (-3.5 4.5)"],
         })
         data = write_avro_batch(sft, batch)
-        recs = list(read_avro(data))
+        _schema, recs = read_avro(data)
         assert len(recs) == 2
         assert recs[0]["__fid__"] == "a"
         assert recs[0]["name"] == "x" and recs[1]["name"] is None
@@ -259,4 +259,6 @@ class TestCliExportFormats:
         sink.flush()
         data = sink.buffer.getvalue()
         from geomesa_tpu.convert.avro_reader import read_avro
-        assert len(list(read_avro(data))) == 2
+        _schema, recs = read_avro(data)
+        assert len(recs) == 2
+        assert {r["__fid__"] for r in recs} == {"a", "b"}

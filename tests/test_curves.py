@@ -308,3 +308,28 @@ class TestLegacyZ3:
         x = np.array([-179.999, 0.0, 179.999])
         want = np.ceil((x + 180.0) / 360.0 * (2 ** 21 - 1)).astype(np.int64)
         assert np.array_equal(d.normalize(x), want)
+
+    def test_legacy_lenient_clamps_at_dimension_min(self):
+        # lenientIndex = max(dim.min, ceil(...)) — NOT max(0, ...):
+        # far-out-of-range west inputs clamp at -180, mildly negative
+        # ceils (e.g. -5) pass through (LegacyZ3SFC.scala:24-29)
+        from geomesa_tpu.curves.legacy import SemiNormalizedDimension
+        import numpy as np
+        d = SemiNormalizedDimension(-180.0, 180.0, 2 ** 21 - 1)
+        assert d.lenient(np.array([-1000.0]))[0] == -180
+        x = np.array([-180.001])  # ceil is ~-5.8 -> -5, above the clamp
+        want = int(np.ceil((x[0] + 180.0) / 360.0 * (2 ** 21 - 1)))
+        assert d.lenient(x)[0] == want and want < 0
+
+    def test_legacy_denormalize_midpoints(self):
+        # denormalize = min for bin 0 else cell midpoint (x-0.5)*w + min
+        # (NormalizedDimension.scala:86 SemiNormalizedDimension)
+        from geomesa_tpu.curves.legacy import SemiNormalizedDimension
+        import numpy as np
+        p = 2 ** 21 - 1
+        d = SemiNormalizedDimension(-180.0, 180.0, p)
+        got = d.denormalize(np.array([0, 1, 100]))
+        w = 360.0 / p
+        assert got[0] == -180.0
+        assert abs(got[1] - (-180.0 + 0.5 * w)) < 1e-12
+        assert abs(got[2] - (-180.0 + 99.5 * w)) < 1e-12

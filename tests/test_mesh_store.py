@@ -75,6 +75,21 @@ class TestDistributedStore:
         want = mem._state("pts").batch.ids[np.argsort(d2, kind="stable")[:25]]
         assert set(ids.astype(str)) == set(want.astype(str))
 
+    def test_sort_by_matches_memory(self, stores):
+        # point2point_process relies on the store honoring q.sort_by
+        # (ADVICE r1: mesh store silently ignored it)
+        from geomesa_tpu.index.api import Query
+        dist, mem = stores
+        q = Query("pts", "BBOX(geom, -90, -45, 90, 45)", sort_by="age")
+        got = list(dist.query(q).ids.astype(str))
+        want = list(mem.query(q).ids.astype(str))
+        assert got == want
+        qd = Query("pts", "BBOX(geom, -90, -45, 90, 45)", sort_by="age",
+                   sort_desc=True, max_features=10)
+        got = list(dist.query(qd).ids.astype(str))
+        want = list(mem.query(qd).ids.astype(str))
+        assert got == want
+
     def test_rejects_extent_types(self):
         ds = DistributedDataStore()
         with pytest.raises(ValueError):
