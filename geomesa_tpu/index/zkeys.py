@@ -1,0 +1,203 @@
+"""Host-side sorted z-key index: query ranges -> candidate rows.
+
+The TPU analog of the reference's key-range pruning: the reference sorts
+rows by ``[2-byte time bin][8-byte z3]`` in the backing table and turns a
+query into covering key ranges (Z3IndexKeySpace.getRanges,
+geomesa-index-api/.../index/z3/Z3IndexKeySpace.scala:121-136, delegating
+to Z3SFC.ranges / sfcurve zranges), so scans touch only intersecting
+tablets.  Here the *device* columns stay in insertion order (a gather is
+order-agnostic on TPU); what is sorted is a **host-side key array +
+permutation**.  Planning a query:
+
+    boxes + time intervals
+      -> per-bin z ranges (curves/zranges.py divide-and-conquer)
+      -> binary search into the sorted keys (np.searchsorted)
+      -> candidate row positions -> original row ids via the permutation
+
+The candidate set is a strict over-approximation of the true matches
+(range decomposition over-covers, exactly like the reference, which
+re-checks every row server-side with Z3Filter); the fused device kernel
+then evaluates the exact predicate on just the gathered candidates.
+When the candidate set is a large fraction of the table the store falls
+back to the full-batch scan — a gather of most rows costs more than a
+dense scan (the cost crossover the reference handles with
+``QueryProperties.SCAN_RANGES_TARGET`` coarsening).
+
+Index build is lazy per curve (z3 and z2 orders are built on first use,
+the two "tables" of the reference's Z3Index/Z2Index).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..curves import timebin
+from ..curves.sfc import z2sfc, z3sfc
+from ..curves.timebin import TimePeriod
+from ..utils.properties import SystemProperty
+
+__all__ = ["ZKeyIndex", "multi_arange", "SCAN_BLOCK_THRESHOLD"]
+
+# candidate-fraction above which an indexed scan falls back to the dense
+# full-batch kernel (gather cost crossover)
+SCAN_BLOCK_THRESHOLD = SystemProperty("geomesa.scan.index.threshold", "0.4")
+
+
+def multi_arange(starts: np.ndarray, stops: np.ndarray) -> np.ndarray:
+    """Concatenate ``arange(starts[i], stops[i])`` without a Python loop.
+
+    Standard cumsum trick: one output cell per emitted integer, seeded
+    with jumps at segment starts.
+    """
+    starts = np.asarray(starts, dtype=np.int64)
+    stops = np.asarray(stops, dtype=np.int64)
+    counts = stops - starts
+    keep = counts > 0
+    starts, counts = starts[keep], counts[keep]
+    if len(starts) == 0:
+        return np.empty(0, dtype=np.int64)
+    total = int(counts.sum())
+    out = np.ones(total, dtype=np.int64)
+    ends = np.cumsum(counts)
+    out[0] = starts[0]
+    out[ends[:-1]] = starts[1:] - (starts[:-1] + counts[:-1] - 1)
+    return np.cumsum(out)
+
+
+class ZKeyIndex:
+    """Sorted (bin, z3) and z2 key orders over point columns.
+
+    Parameters are host arrays in insertion order; ``millis`` may be
+    None for a time-less schema (z2 only).
+    """
+
+    def __init__(self, x: np.ndarray, y: np.ndarray,
+                 millis: np.ndarray | None,
+                 period: TimePeriod | str = TimePeriod.WEEK):
+        self._x = np.asarray(x, dtype=np.float64)
+        self._y = np.asarray(y, dtype=np.float64)
+        self._millis = (None if millis is None
+                        else np.asarray(millis, dtype=np.int64))
+        self.period = TimePeriod.parse(period)
+        self.n = len(self._x)
+        self._z3 = None  # (ubins, seg_offsets, z_sorted, perm)
+        self._z2 = None  # (z_sorted, perm)
+
+    # -- build -------------------------------------------------------------
+
+    def _perm_dtype(self):
+        return np.int32 if self.n < 2**31 else np.int64
+
+    def _build_z3(self):
+        if self._z3 is not None or self._millis is None:
+            return self._z3
+        sfc = z3sfc(self.period)
+        bins, offs = timebin.to_binned(self._millis, self.period,
+                                       lenient=True)
+        z = sfc.index(self._x, self._y, offs.astype(np.float64),
+                      lenient=True).astype(np.int64)
+        perm = np.lexsort((z, bins)).astype(self._perm_dtype())
+        bins_sorted = bins[perm]
+        z_sorted = z[perm]
+        # per-bin contiguous segments in the sorted order
+        ubins, seg_starts = np.unique(bins_sorted, return_index=True)
+        seg_offsets = np.append(seg_starts, self.n)
+        self._z3 = (ubins, seg_offsets, z_sorted, perm)
+        return self._z3
+
+    def _build_z2(self):
+        if self._z2 is not None:
+            return self._z2
+        z = z2sfc().index(self._x, self._y, lenient=True).astype(np.int64)
+        perm = np.argsort(z, kind="stable").astype(self._perm_dtype())
+        self._z2 = (z[perm], perm)
+        return self._z2
+
+    # -- candidates --------------------------------------------------------
+
+    def candidates_z3(self, boxes, intervals_ms, *,
+                      max_rows: int | None = None,
+                      max_ranges: int | None = None) -> np.ndarray | None:
+        """Candidate original-order row indices for boxes + intervals, or
+        None when the z3 order is unavailable / the set exceeds max_rows.
+
+        Mirrors the per-bin fan-out of Z3IndexKeySpace.getRanges
+        (:100-136): interior bins use whole-period ranges (computed
+        once), edge bins their partial-offset ranges.
+        """
+        built = self._build_z3()
+        if built is None:
+            return None
+        ubins, seg_offsets, z_sorted, perm = built
+        sfc = z3sfc(self.period)
+
+        # per-bin inclusive offset bounds, unioned across intervals.
+        # Interval bounds clamp into the indexable range EXACTLY like the
+        # lenient point keys do (to_binned(lenient=True) in _build_z3):
+        # clamp is monotone, so t in [lo,hi] => clamp(t) in
+        # [clamp(lo), clamp(hi)] and clamped point keys stay candidates.
+        cap = timebin.max_date_millis(self.period) - 1
+        by_bin: dict[int, list[int]] = {}
+        for lo_ms, hi_ms in intervals_ms:
+            if hi_ms < lo_ms:
+                continue
+            lo_ms = min(max(int(lo_ms), 0), cap)
+            hi_ms = min(max(int(hi_ms), 0), cap)
+            bs, los, his = timebin.bins_of_interval(lo_ms, hi_ms,
+                                                    self.period)
+            for b, lo, hi in zip(bs.tolist(), los.tolist(), his.tolist()):
+                cur = by_bin.get(b)
+                if cur is None:
+                    by_bin[b] = [lo, hi]
+                else:
+                    # over-approximate disjoint unions with the hull; the
+                    # exact kernel re-checks every candidate anyway
+                    cur[0] = min(cur[0], lo)
+                    cur[1] = max(cur[1], hi)
+        if not by_bin:
+            return None
+
+        range_cache: dict[tuple[int, int], np.ndarray] = {}
+        pieces: list[np.ndarray] = []
+        total = 0
+        for b in sorted(by_bin):
+            # locate this bin's segment in the sorted order
+            i = int(np.searchsorted(ubins, b))
+            if i >= len(ubins) or int(ubins[i]) != b:
+                continue
+            s, e = int(seg_offsets[i]), int(seg_offsets[i + 1])
+            key = tuple(by_bin[b])
+            ranges = range_cache.get(key)
+            if ranges is None:
+                ranges = sfc.ranges(boxes, [key], max_ranges=max_ranges)
+                range_cache[key] = ranges
+            if len(ranges) == 0:
+                continue
+            seg = z_sorted[s:e]
+            los = s + np.searchsorted(seg, ranges[:, 0], side="left")
+            his = s + np.searchsorted(seg, ranges[:, 1], side="right")
+            total += int(np.sum(his - los))
+            if max_rows is not None and total > max_rows:
+                return None
+            pos = multi_arange(los, his)
+            if len(pos):
+                pieces.append(pos)
+        if not pieces:
+            return np.empty(0, dtype=np.int64)
+        return perm[np.concatenate(pieces)].astype(np.int64)
+
+    def candidates_z2(self, boxes, *, max_rows: int | None = None,
+                      max_ranges: int | None = None) -> np.ndarray | None:
+        """Candidate rows for a pure-spatial query via the z2 order."""
+        z_sorted, perm = self._build_z2()
+        ranges = z2sfc().ranges(boxes, max_ranges=max_ranges)
+        if len(ranges) == 0:
+            return np.empty(0, dtype=np.int64)
+        los = np.searchsorted(z_sorted, ranges[:, 0], side="left")
+        his = np.searchsorted(z_sorted, ranges[:, 1], side="right")
+        if max_rows is not None and int(np.sum(his - los)) > max_rows:
+            return None
+        pos = multi_arange(los, his)
+        if len(pos) == 0:
+            return np.empty(0, dtype=np.int64)
+        return perm[pos].astype(np.int64)

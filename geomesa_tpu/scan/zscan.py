@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["DeviceScanData", "ScanQuery", "build_scan_data", "make_query",
-           "scan_mask", "split_two_float", "MILLIS_PER_DAY"]
+           "scan_mask", "scan_mask_at", "split_two_float", "MILLIS_PER_DAY"]
 
 MILLIS_PER_DAY = 86_400_000
 
@@ -156,8 +156,7 @@ def _le_two_float(hi, lo, b_hi, b_lo):
     return (hi < b_hi) | ((hi == b_hi) & (lo <= b_lo))
 
 
-@functools.partial(jax.jit, static_argnames=("time_any",))
-def _scan_mask(xhi, xlo, yhi, ylo, tday, tms,
+def _mask_body(xhi, xlo, yhi, ylo, tday, tms,
                boxes, box_valid, times, time_valid, time_any: bool):
     # spatial: any valid box contains the point — (n, K) broadcast
     bx = boxes[None, :, :]                      # (1, K, 8)
@@ -175,6 +174,41 @@ def _scan_mask(xhi, xlo, yhi, ylo, tday, tms,
                  | ((tday[:, None] == tx[..., 2]) & (tms[:, None] <= tx[..., 3])))
     temporal = jnp.any(after_lo & before_hi & time_valid[None, :], axis=1)
     return spatial & temporal
+
+
+_scan_mask = functools.partial(jax.jit, static_argnames=("time_any",))(
+    _mask_body)
+
+
+@functools.partial(jax.jit, static_argnames=("time_any",))
+def _gather_scan_mask(xhi, xlo, yhi, ylo, tday, tms, idx,
+                      boxes, box_valid, times, time_valid, time_any: bool):
+    """Scan only the gathered candidate rows (index-pruned path)."""
+    def g(a):
+        return jnp.take(a, idx, mode="clip")
+    return _mask_body(g(xhi), g(xlo), g(yhi), g(ylo), g(tday), g(tms),
+                      boxes, box_valid, times, time_valid, time_any)
+
+
+def scan_mask_at(data: DeviceScanData, q: ScanQuery,
+                 rows: np.ndarray) -> np.ndarray:
+    """Run the fused scan over just ``rows`` (original-order indices from
+    the z-key index); returns a host bool[len(rows)] mask.
+
+    The row list is padded to the next power of two so jit traces are
+    reused across queries (pad rows gather row 0 and are sliced off).
+    """
+    m = len(rows)
+    if m == 0:
+        return np.zeros(0, dtype=bool)
+    k = _next_pow2(m)
+    idx = np.zeros(k, dtype=np.int32)
+    idx[:m] = rows
+    out = _gather_scan_mask(data.xhi, data.xlo, data.yhi, data.ylo,
+                            data.tday, data.tms, jnp.asarray(idx),
+                            q.boxes, q.box_valid, q.times, q.time_valid,
+                            q.time_any)
+    return np.asarray(out)[:m]
 
 
 def scan_mask(data: DeviceScanData, q: ScanQuery) -> jax.Array:

@@ -69,11 +69,14 @@ class _TypeState:
         self.batch: FeatureBatch | None = None
         self.scan_data: zscan.DeviceScanData | None = None
         self.extent_data = None  # gscan.ExtentScanData for non-points
+        self.zindex = None       # index.zkeys.ZKeyIndex for points
         self.host_xhi: np.ndarray | None = None
         self.host_yhi: np.ndarray | None = None
         self.dirty = False
-        # per-feature visibility expressions (None = world-readable)
+        # per-feature visibility expressions (None = world-readable);
+        # has_vis avoids an O(n) object-array scan on every query
         self.vis: np.ndarray = np.empty(0, dtype=object)
+        self.has_vis = False
 
     @property
     def n(self) -> int:
@@ -88,8 +91,11 @@ class _TypeState:
         if len(vis) != batch.n:
             raise ValueError("visibilities length mismatch")
         from ..security import parse_visibility
-        for e in set(v for v in vis.tolist() if v):
+        distinct = set(v for v in vis.tolist() if v)
+        for e in distinct:
             parse_visibility(str(e))  # raises on malformed expressions
+        if distinct:
+            self.has_vis = True
         self.batch = batch if self.batch is None else self.batch.concat(batch)
         self.vis = np.concatenate([self.vis, vis])
         self.dirty = True
@@ -134,6 +140,12 @@ class _TypeState:
         self.scan_data = zscan.build_scan_data(x, y, millis)
         self.host_xhi = np.asarray(self.scan_data.xhi)
         self.host_yhi = np.asarray(self.scan_data.yhi)
+        # host sorted z-key index for range pruning (lazy per curve);
+        # Z3IndexKeySpace.getRanges analog feeding the gathered scan
+        from ..index.zkeys import ZKeyIndex
+        self.zindex = ZKeyIndex(x, y,
+                                millis if dtg is not None else None,
+                                self.sft.z3_interval)
         self.dirty = False
 
 
@@ -330,24 +342,21 @@ class InMemoryDataStore:
             if managed is not None:
                 managed.check()
             t_scan0 = _time.perf_counter()
-            mask = self._execute(st, q, strategy, explain)
+            idx = self._execute(st, q, strategy, explain)
             if managed is not None:
                 managed.check()
         finally:
             if managed is not None:
                 _REAPER.complete(managed)
 
-        if q.auths is not None or (st.vis != None).any():  # noqa: E711
+        if q.auths is not None or st.has_vis:
             from ..security import evaluate_visibilities
             auths = q.auths or []
-            # evaluate only the rows that survived the scan mask
-            hit = np.flatnonzero(mask)
-            vis_ok = evaluate_visibilities(st.vis[hit], auths)
-            mask = mask.copy()
-            mask[hit[~vis_ok]] = False
+            # evaluate only the rows that survived the scan
+            vis_ok = evaluate_visibilities(st.vis[idx], auths)
+            idx = idx[vis_ok]
             explain(f"Visibility filter applied ({len(auths)} auths)")
 
-        idx = np.flatnonzero(mask)
         rate = q.hints.get(QueryHints.SAMPLING)
         if rate is not None and len(idx):
             from ..scan.aggregations import sample_mask
@@ -382,46 +391,48 @@ class InMemoryDataStore:
 
     def _execute(self, st: _TypeState, q: Query, strategy: FilterStrategy,
                  explain: Explainer) -> np.ndarray:
-        """Run the chosen strategy; returns a host bool[n] mask."""
+        """Run the chosen strategy; returns sorted matching row indices.
+
+        Index-space (not mask-space) so an index-pruned scan never pays
+        O(n) host work — cost is proportional to the candidate set."""
         sft = st.sft
         n = st.n
         batch = st.batch
         if strategy.index == "empty":
-            return np.zeros(n, dtype=bool)
+            return np.empty(0, dtype=np.int64)
 
         if strategy.index in ("z3", "z2", "xz3", "xz2"):
             st.ensure_index()
 
         if strategy.index in ("z3", "z2") and st.scan_data is not None:
-            mask = self._device_scan(st, q, strategy, explain)
+            idx = self._device_scan(st, q, strategy, explain)
         elif strategy.index in ("xz3", "xz2") and st.extent_data is not None:
-            mask = self._device_extent_scan(st, q, strategy, explain)
+            idx = self._device_extent_scan(st, q, strategy, explain)
         elif strategy.index == "id" and strategy.primary is not None:
-            mask = np.isin(batch.ids.astype(str),
-                           np.asarray(strategy.primary.ids, dtype=str))
+            idx = np.flatnonzero(
+                np.isin(batch.ids.astype(str),
+                        np.asarray(strategy.primary.ids, dtype=str)))
         else:
             # fullscan / attr / extent-geometry path: host evaluation of
             # the primary (residual joins below)
             explain(f"Executing host scan for {strategy.index}")
-            mask = (evaluate(strategy.primary, batch)
-                    if strategy.primary is not None
-                    else np.ones(n, dtype=bool))
+            idx = (np.flatnonzero(evaluate(strategy.primary, batch))
+                   if strategy.primary is not None
+                   else np.arange(n, dtype=np.int64))
 
         if strategy.secondary is not None:
-            cand = np.flatnonzero(mask)
-            if len(cand):
-                sub = batch.take(cand)
+            if len(idx):
+                sub = batch.take(idx)
                 keep = evaluate(strategy.secondary, sub)
-                out = np.zeros(n, dtype=bool)
-                out[cand[keep]] = True
-                mask = out
+                idx = idx[keep]
             explain(f"Residual filter applied: {strategy.secondary}")
-        return mask
+        return idx
 
     def _device_scan(self, st: _TypeState, q: Query,
                      strategy: FilterStrategy, explain: Explainer) -> np.ndarray:
-        """The hot path: fused device kernel + exact boundary patch +
-        non-envelope geometry residual."""
+        """The hot path: z-range index pruning -> fused device kernel
+        (gathered candidates or dense) + exact boundary patch +
+        non-envelope geometry residual. Returns sorted row indices."""
         sft = st.sft
         batch = st.batch
         geom = sft.geom_field
@@ -436,35 +447,65 @@ class InMemoryDataStore:
                      if dtg is not None and strategy.index == "z3" else [])
 
         sq = zscan.make_query(boxes, intervals)
-        explain(f"Device scan: {len(boxes)} box(es), "
-                f"{len(intervals)} interval(s), n={st.n}")
-        mask = np.asarray(zscan.scan_mask(st.scan_data, sq))
 
-        # exact f64 patch along query boundaries
-        cand = zscan.boundary_candidates(st.host_xhi, st.host_yhi, sq)
-        if len(cand):
+        # z-range pruning (Z3IndexKeySpace.getRanges analog): candidate
+        # rows from the sorted key index, gathered device scan; dense
+        # full-batch kernel when the candidate set is a large fraction
+        rows = None
+        whole_world = boxes == [(-180.0, -90.0, 180.0, 90.0)]
+        if st.zindex is not None and not (whole_world and not intervals):
+            from ..index.zkeys import SCAN_BLOCK_THRESHOLD
+            max_rows = int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n)
+            if strategy.index == "z3" and intervals:
+                rows = st.zindex.candidates_z3(boxes, intervals,
+                                               max_rows=max_rows)
+            elif not whole_world:
+                rows = st.zindex.candidates_z2(boxes, max_rows=max_rows)
+
+        def patch_boundaries(mask, xhi, yhi, sel):
+            """Exact f64 recheck of rows whose hi-cell touches a query
+            bound; sel=None means full-table arrays, else a row subset
+            (rows outside a pruned candidate set are provably outside
+            the query in exact f64, so patching the subset is exact)."""
+            cand = zscan.boundary_candidates(xhi, yhi, sq)
+            if not len(cand):
+                return mask
             col = batch.col(geom)
+            x, y = col.x, col.y
             millis = (batch.col(dtg).millis if dtg is not None
                       else np.zeros(st.n, dtype=np.int64))
-            mask = zscan.exact_patch(mask, cand, col.x, col.y, millis, sq)
+            if sel is not None:
+                x, y, millis = x[sel], y[sel], millis[sel]
             explain(f"Boundary recheck: {len(cand)} candidate(s)")
+            return zscan.exact_patch(mask, cand, x, y, millis, sq)
+
+        if rows is not None:
+            explain(f"Index-pruned device scan: {len(rows)} candidate "
+                    f"row(s) of {st.n}, {len(boxes)} box(es), "
+                    f"{len(intervals)} interval(s)")
+            sub = zscan.scan_mask_at(st.scan_data, sq, rows)
+            sub = patch_boundaries(sub, st.host_xhi[rows],
+                                   st.host_yhi[rows], rows)
+            idx = np.sort(rows[sub])
+        else:
+            explain(f"Device scan: {len(boxes)} box(es), "
+                    f"{len(intervals)} interval(s), n={st.n}")
+            mask = np.asarray(zscan.scan_mask(st.scan_data, sq))
+            mask = patch_boundaries(mask, st.host_xhi, st.host_yhi, None)
+            idx = np.flatnonzero(mask)
 
         # non-envelope query geometries need the exact predicate too
         if _needs_exact(geoms, primary):
-            candidates = np.flatnonzero(mask)
-            if len(candidates):
+            if len(idx):
                 spatial_f = _spatial_only(primary, geom)
                 if spatial_f is not None:
                     col = batch.col(geom)
-                    keep = self._pip_residual(spatial_f, col, candidates,
-                                              explain)
+                    keep = self._pip_residual(spatial_f, col, idx, explain)
                     if keep is None:
-                        keep = evaluate(spatial_f, batch.take(candidates))
-                    out = np.zeros(st.n, dtype=bool)
-                    out[candidates[keep]] = True
-                    mask = out
+                        keep = evaluate(spatial_f, batch.take(idx))
+                    idx = idx[keep]
             explain("Exact geometry predicate applied")
-        return mask
+        return idx
 
     def _device_extent_scan(self, st: _TypeState, q: Query,
                             strategy: FilterStrategy,
@@ -512,7 +553,7 @@ class InMemoryDataStore:
             # no spatial constraint (pure time query on xz3): every
             # non-OUT row matches
             mask = state >= 1
-        return mask
+        return np.flatnonzero(mask)
 
     def _pip_residual(self, spatial_f, col, candidates: np.ndarray,
                       explain: Explainer):

@@ -1,0 +1,206 @@
+"""Z-key index pruning: differential tests vs the dense device scan.
+
+The pruned path must return EXACTLY the ids of the dense path (which is
+itself exact-f64 via the boundary patch) — the candidate set is an
+over-approximation re-checked by the fused kernel, mirroring the
+reference's Z3 ranges + Z3Iterator re-check
+(Z3IndexKeySpace.scala:121-136 + Z3Iterator.scala:47-60).
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.filters import evaluate, parse_ecql
+from geomesa_tpu.index.api import Query
+from geomesa_tpu.index.zkeys import SCAN_BLOCK_THRESHOLD, ZKeyIndex, multi_arange
+from geomesa_tpu.store import InMemoryDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+
+
+def _mkstore(n=40_000, seed=7, lon=(-180, 180), lat=(-90, 90),
+             t=("2017-01-01", "2018-01-01")):
+    ds = InMemoryDataStore()
+    ds.create_schema(parse_spec("pts", SPEC))
+    rng = np.random.default_rng(seed)
+    ds.write_dict("pts", [f"f{i}" for i in range(n)], {
+        "name": [f"n{i % 5}" for i in range(n)],
+        "dtg": rng.integers(MS(t[0]), MS(t[1]), n),
+        "geom": (rng.uniform(*lon, n), rng.uniform(*lat, n)),
+    })
+    return ds
+
+
+def _ids(res):
+    return set(res.ids.astype(str))
+
+
+def _oracle(ds, ecql):
+    batch = ds._state("pts").batch
+    return set(batch.ids[evaluate(parse_ecql(ecql), batch)].astype(str))
+
+
+class TestMultiArange:
+    def test_basic(self):
+        out = multi_arange(np.array([0, 5, 9]), np.array([3, 5, 12]))
+        assert out.tolist() == [0, 1, 2, 9, 10, 11]
+
+    def test_empty(self):
+        assert len(multi_arange(np.array([], dtype=np.int64),
+                                np.array([], dtype=np.int64))) == 0
+
+    def test_single(self):
+        assert multi_arange(np.array([4]), np.array([8])).tolist() == [4, 5, 6, 7]
+
+
+class TestPrunedVsDense:
+    @pytest.fixture(scope="class")
+    def ds(self):
+        return _mkstore()
+
+    def _explained(self, ds, ecql):
+        lines: list[str] = []
+        res = ds.query(Query("pts", ecql), explain_out=lines.append)
+        return res, "\n".join(lines)
+
+    def test_z3_low_selectivity_pruned(self, ds):
+        ecql = ("BBOX(geom, 10, 10, 12, 12) AND "
+                "dtg DURING 2017-03-01T00:00:00Z/2017-03-08T00:00:00Z")
+        res, text = self._explained(ds, ecql)
+        assert "Index-pruned" in text
+        assert _ids(res) == _oracle(ds, ecql)
+
+    def test_z3_high_selectivity_falls_back(self, ds):
+        ecql = ("BBOX(geom, -180, -90, 180, 90) AND "
+                "dtg DURING 2017-01-01T00:00:00Z/2017-12-01T00:00:00Z")
+        res, text = self._explained(ds, ecql)
+        assert "Index-pruned" not in text
+        assert _ids(res) == _oracle(ds, ecql)
+
+    def test_z2_pruned(self, ds):
+        ecql = "BBOX(geom, -5, -5, 5, 5)"
+        res, text = self._explained(ds, ecql)
+        assert "Index-pruned" in text
+        assert _ids(res) == _oracle(ds, ecql)
+
+    def test_boundary_points_exact(self):
+        """Points exactly on the query bounds must match inclusively,
+        through the pruned path's restricted boundary patch."""
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("pts", SPEC))
+        # a cloud plus exact-boundary points
+        rng = np.random.default_rng(3)
+        n = 5000
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        x[:4] = [10.0, 20.0, 10.0, 20.0]
+        y[:4] = [5.0, 15.0, 15.0, 5.0]
+        ds.write_dict("pts", [f"f{i}" for i in range(n)], {
+            "name": ["a"] * n,
+            "dtg": np.full(n, MS("2017-06-01")),
+            "geom": (x, y),
+        })
+        ecql = "BBOX(geom, 10, 5, 20, 15)"
+        res = ds.query(Query("pts", ecql))
+        got = _ids(res)
+        assert {"f0", "f1", "f2", "f3"} <= got
+        assert got == _oracle(ds, ecql)
+
+    def test_multiple_boxes_or(self, ds):
+        ecql = ("(BBOX(geom, 0, 0, 3, 3) OR BBOX(geom, 100, 40, 104, 44)) "
+                "AND dtg DURING 2017-05-01T00:00:00Z/2017-05-15T00:00:00Z")
+        res, text = self._explained(ds, ecql)
+        assert _ids(res) == _oracle(ds, ecql)
+
+    def test_interval_spanning_bins(self, ds):
+        """Query spanning many weekly bins: interior bins whole-period,
+        edge bins partial."""
+        ecql = ("BBOX(geom, -30, -20, -25, -15) AND "
+                "dtg DURING 2017-02-03T12:00:00Z/2017-04-20T06:30:00Z")
+        res, text = self._explained(ds, ecql)
+        assert "Index-pruned" in text
+        assert _ids(res) == _oracle(ds, ecql)
+
+    def test_threshold_property_forces_dense(self, ds):
+        SCAN_BLOCK_THRESHOLD.set("0.0")
+        try:
+            ecql = "BBOX(geom, -5, -5, 5, 5)"
+            res, text = self._explained(ds, ecql)
+            assert "Index-pruned" not in text
+            assert _ids(res) == _oracle(ds, ecql)
+        finally:
+            SCAN_BLOCK_THRESHOLD.set(None)
+
+    def test_results_match_dense_after_delete(self, ds):
+        ds2 = _mkstore(n=2000, seed=11)
+        ds2.delete("pts", [f"f{i}" for i in range(0, 2000, 3)])
+        ecql = ("BBOX(geom, -60, -30, -40, -10) AND "
+                "dtg DURING 2017-06-01T00:00:00Z/2017-07-01T00:00:00Z")
+        assert _ids(ds2.query(Query("pts", ecql))) == _oracle(ds2, ecql)
+
+
+class TestOutOfRangeDates:
+    def test_pre_epoch_dates_still_exact(self):
+        """Pre-1970 timestamps clamp in the key space; query intervals
+        clamp identically, and the exact kernel re-checks true millis."""
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("pts", SPEC))
+        n = 100
+        x = np.linspace(-10, 10, n)
+        y = np.linspace(-10, 10, n)
+        millis = np.full(n, MS("2017-01-01"))
+        millis[:5] = [MS("1960-01-01"), MS("1969-12-31"), -5, 0, 1]
+        ds.write_dict("pts", [f"f{i}" for i in range(n)], {
+            "name": ["a"] * n, "dtg": millis, "geom": (x, y),
+        })
+        ecql = ("BBOX(geom, -180, -90, 180, 90) AND "
+                "dtg BEFORE 1970-01-01T00:00:00Z")
+        assert _ids(ds.query(Query("pts", ecql))) == _oracle(ds, ecql)
+
+
+class TestZKeyIndexUnit:
+    def test_candidates_superset_of_matches(self):
+        rng = np.random.default_rng(0)
+        n = 20_000
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        millis = rng.integers(MS("2017-01-01"), MS("2017-03-01"), n)
+        zi = ZKeyIndex(x, y, millis, "week")
+        boxes = [(-40.0, 10.0, -30.0, 20.0)]
+        iv = [(MS("2017-01-10"), MS("2017-01-25"))]
+        rows = zi.candidates_z3(boxes, iv)
+        assert rows is not None
+        true = np.flatnonzero(
+            (x >= -40) & (x <= -30) & (y >= 10) & (y <= 20)
+            & (millis >= iv[0][0]) & (millis <= iv[0][1]))
+        assert set(true.tolist()) <= set(rows.tolist())
+        # pruning is real: way fewer candidates than rows
+        assert len(rows) < n // 4
+
+    def test_candidates_z2_superset(self):
+        rng = np.random.default_rng(1)
+        n = 10_000
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        zi = ZKeyIndex(x, y, None, "week")
+        boxes = [(100.0, -45.0, 110.0, -35.0)]
+        rows = zi.candidates_z2(boxes)
+        true = np.flatnonzero((x >= 100) & (x <= 110) & (y >= -45) & (y <= -35))
+        assert set(true.tolist()) <= set(rows.tolist())
+        assert len(rows) < n // 4
+
+    def test_max_rows_abort(self):
+        rng = np.random.default_rng(2)
+        n = 5000
+        zi = ZKeyIndex(rng.uniform(-1, 1, n), rng.uniform(-1, 1, n),
+                       None, "week")
+        assert zi.candidates_z2([(-2.0, -2.0, 2.0, 2.0)], max_rows=10) is None
+
+    def test_no_time_index_returns_none(self):
+        zi = ZKeyIndex(np.array([0.0]), np.array([0.0]), None, "week")
+        assert zi.candidates_z3([(0, 0, 1, 1)], [(0, 1000)]) is None
