@@ -63,6 +63,16 @@ def distance_degrees(geom: Geometry, meters: float) -> float:
     return best if best > 0 else math.degrees(meters / _WGS84_A)
 
 
+def to_millis(v) -> int:
+    """Interval/bound value -> epoch millis: ECQL quoted date strings
+    arrive as raw strings (only bare datetime tokens parse in the lexer)."""
+    if isinstance(v, str):
+        import numpy as np
+        return int(np.datetime64(v.strip().rstrip("Z").replace(" ", "T"),
+                                 "ms").astype(np.int64))
+    return int(v)
+
+
 @dataclasses.dataclass(frozen=True)
 class Bound(Generic[T]):
     """One side of an interval; value None = unbounded."""

@@ -1,0 +1,122 @@
+"""Sorted-attribute secondary index: the AttributeIndex analog.
+
+The reference's attribute index stores rows keyed by
+[attribute value][secondary date/z][feature id] and answers attribute
+predicates with key-range scans, then joins the matching ids back to the
+record table (/root/reference/geomesa-accumulo/geomesa-accumulo-datastore/
+src/main/scala/org/locationtech/geomesa/accumulo/index/AttributeIndex.scala:386-395,
+AttributeIndexKeySpace value-to-bytes encoding).
+
+Columnar analog: one sorted permutation per indexed attribute. Typed
+bounds from ``extract_attribute_bounds`` binary-search into the sorted
+key array, yielding contiguous slices of the permutation — row indices
+into the main columns. That gather IS the positional join the
+reference's BatchMultiScanner performs across tables; here both "tables"
+are columns of the same batch so the join is an index operation.
+
+Dictionary-encoded strings never materialize: bounds are translated to
+code-space thresholds against the sorted vocab (the ArrowFilterOptimizer
+trick, /root/reference/geomesa-arrow/geomesa-arrow-gt/src/main/scala/org/
+locationtech/geomesa/arrow/filter/ArrowFilterOptimizer.scala:36), so a
+string range scan is an integer binary search.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..features.batch import (BoolColumn, Column, DateColumn, NumericColumn,
+                              StringColumn)
+from ..filters.helper import Bound, FilterValues, to_millis
+
+__all__ = ["AttributeKeyIndex"]
+
+
+class AttributeKeyIndex:
+    """Sorted permutation over one column; bounds -> candidate rows."""
+
+    def __init__(self, col: Column):
+        if isinstance(col, NumericColumn):
+            keys = col.values
+            self._kind = "num"
+        elif isinstance(col, DateColumn):
+            keys = col.millis
+            self._kind = "date"
+        elif isinstance(col, StringColumn):
+            # codes index a sorted vocab, so code order == lexicographic
+            keys = col.codes
+            self._kind = "str"
+            self._vocab = col.vocab.astype(str)
+        elif isinstance(col, BoolColumn):
+            keys = col.values.astype(np.int8)
+            self._kind = "bool"
+        else:
+            raise TypeError(f"cannot index {type(col).__name__}")
+        rows = np.flatnonzero(col.valid)  # nulls are not indexed
+        order = np.argsort(keys[rows], kind="stable")
+        self.sorted_keys = keys[rows][order]
+        self.sorted_rows = rows[order]
+
+    @property
+    def n(self) -> int:
+        return len(self.sorted_rows)
+
+    # -- bound translation --------------------------------------------------
+
+    def _pos(self, bound: Bound, *, lower: bool) -> int:
+        """Permutation position for one side of a Bounds interval."""
+        if not bound.is_bounded:
+            return 0 if lower else self.n
+        v = bound.value
+        if self._kind == "str":
+            # code-space threshold t: lower keeps codes >= t, upper keeps
+            # codes < t; inclusivity is absorbed by the vocab search side
+            s = str(v)
+            if lower:
+                side = "left" if bound.inclusive else "right"
+            else:
+                side = "right" if bound.inclusive else "left"
+            t = int(np.searchsorted(self._vocab, s, side=side))
+            return int(np.searchsorted(self.sorted_keys, t, side="left"))
+        if self._kind == "date":
+            v = to_millis(v)
+        elif self._kind == "bool":
+            v = int(bool(v))
+        if lower:
+            side = "left" if bound.inclusive else "right"
+        else:
+            side = "right" if bound.inclusive else "left"
+        return int(np.searchsorted(self.sorted_keys, v, side=side))
+
+    # -- query --------------------------------------------------------------
+
+    def candidates(self, bounds: FilterValues,
+                   max_rows: int | None = None) -> np.ndarray | None:
+        """Sorted row indices whose value falls in any of the bounds.
+
+        Returns None when the bounds cannot be answered by range scans
+        (empty/unbounded extraction), or when the candidate set exceeds
+        ``max_rows`` — wide bounds cost more to gather + re-evaluate than
+        a dense column scan, the same crossover the z index applies via
+        SCAN_BLOCK_THRESHOLD (index/zkeys.py).
+        """
+        if bounds.disjoint:
+            return np.empty(0, dtype=np.int64)
+        if bounds.is_empty or not any(b.is_bounded for b in bounds):
+            return None
+        slices = []
+        total = 0
+        for b in bounds:
+            lo = self._pos(b.lower, lower=True)
+            hi = self._pos(b.upper, lower=False)
+            if hi > lo:
+                total += hi - lo
+                if max_rows is not None and total > max_rows:
+                    return None
+                slices.append(self.sorted_rows[lo:hi])
+        if not slices:
+            return np.empty(0, dtype=np.int64)
+        rows = np.concatenate(slices)
+        # OR'd bounds are union-merged upstream but may still touch after
+        # code-space rounding; unique sorts + dedupes in one pass
+        return np.unique(rows)

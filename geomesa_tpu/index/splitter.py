@@ -62,6 +62,14 @@ def temporal_part(f: ast.Filter, dtg: str | None):
     return _partition(f, lambda c: _is_temporal(c, dtg))
 
 
+def _or_primary(f: ast.Filter, pred) -> ast.Filter | None:
+    """A homogeneous OR (every child matches pred) is usable as a primary
+    (FilterSplitter's same-dimension OR rule)."""
+    if isinstance(f, ast.Or) and all(pred(c) for c in f.children):
+        return f
+    return None
+
+
 def _and_opt(a: ast.Filter | None, b: ast.Filter | None) -> ast.Filter | None:
     if a is None:
         return b
@@ -108,6 +116,9 @@ def split_filter(sft: SimpleFeatureType, f: ast.Filter,
                 return [FilterStrategy("empty", None, None, cost=0)]
             if geoms:
                 spatial, rest = spatial_part(f, geom)
+                if spatial is None:
+                    spatial, rest = _or_primary(
+                        f, lambda c: _is_spatial(c, geom)), None
                 if spatial is not None:
                     options.append(FilterStrategy(index, spatial, rest))
         elif index == "id":
@@ -131,10 +142,15 @@ def split_filter(sft: SimpleFeatureType, f: ast.Filter,
             if bounds.disjoint:
                 return [FilterStrategy("empty", None, None, cost=0)]
             if bounds and any(b.is_bounded for b in bounds):
-                primary, rest = _partition(
-                    f, lambda c: getattr(c, "prop", None) == attr
-                    and isinstance(c, (ast.Compare, ast.Between, ast.InList,
-                                       ast.Like)))
+                def _attr_pred(c, attr=attr):
+                    return (getattr(c, "prop", None) == attr
+                            and isinstance(c, (ast.Compare, ast.Between,
+                                               ast.InList, ast.Like,
+                                               ast.During, ast.Before,
+                                               ast.After, ast.TEquals)))
+                primary, rest = _partition(f, _attr_pred)
+                if primary is None:
+                    primary, rest = _or_primary(f, _attr_pred), None
                 if primary is not None:
                     options.append(FilterStrategy(index, primary, rest))
 

@@ -202,7 +202,9 @@ def scan_mask_at(data: DeviceScanData, q: ScanQuery,
     if m == 0:
         return np.zeros(0, dtype=bool)
     k = _next_pow2(m)
-    idx = np.zeros(k, dtype=np.int32)
+    # pad in the rows' own dtype: int64 permutations (n >= 2^31) must not
+    # wrap negative here
+    idx = np.zeros(k, dtype=rows.dtype)
     idx[:m] = rows
     out = _gather_scan_mask(data.xhi, data.xlo, data.yhi, data.ylo,
                             data.tday, data.tms, jnp.asarray(idx),

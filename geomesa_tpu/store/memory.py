@@ -72,6 +72,8 @@ class _TypeState:
         self.zindex = None       # index.zkeys.ZKeyIndex for points
         self.host_xhi: np.ndarray | None = None
         self.host_yhi: np.ndarray | None = None
+        # lazily-built sorted attribute indexes (AttributeIndex analog)
+        self.attr_idx: dict[str, Any] = {}
         self.dirty = False
         # per-feature visibility expressions (None = world-readable);
         # has_vis avoids an O(n) object-array scan on every query
@@ -98,6 +100,7 @@ class _TypeState:
             self.has_vis = True
         self.batch = batch if self.batch is None else self.batch.concat(batch)
         self.vis = np.concatenate([self.vis, vis])
+        self.attr_idx.clear()
         self.dirty = True
 
     def delete(self, ids: set[str]):
@@ -106,6 +109,7 @@ class _TypeState:
         keep = ~np.isin(self.batch.ids.astype(str), list(ids))
         self.batch = self.batch.take(np.flatnonzero(keep))
         self.vis = self.vis[keep]
+        self.attr_idx.clear()
         self.dirty = True
 
     def ensure_index(self):
@@ -147,6 +151,18 @@ class _TypeState:
                                 millis if dtg is not None else None,
                                 self.sft.z3_interval)
         self.dirty = False
+
+    def attr_index(self, name: str):
+        """Sorted attribute index for one column, built on first use
+        (AttributeIndex analog; see index/attr.py)."""
+        if name not in self.attr_idx:
+            from ..index.attr import AttributeKeyIndex
+            try:
+                self.attr_idx[name] = AttributeKeyIndex(
+                    self.batch.col(name))
+            except TypeError:
+                self.attr_idx[name] = None  # unindexable column type
+        return self.attr_idx[name]
 
 
 class InMemoryDataStore:
@@ -412,6 +428,9 @@ class InMemoryDataStore:
             idx = np.flatnonzero(
                 np.isin(batch.ids.astype(str),
                         np.asarray(strategy.primary.ids, dtype=str)))
+        elif (strategy.index.startswith("attr:")
+              and strategy.primary is not None):
+            idx = self._attr_scan(st, strategy, explain)
         else:
             # fullscan / attr / extent-geometry path: host evaluation of
             # the primary (residual joins below)
@@ -427,6 +446,34 @@ class InMemoryDataStore:
                 idx = idx[keep]
             explain(f"Residual filter applied: {strategy.secondary}")
         return idx
+
+    def _attr_scan(self, st: _TypeState, strategy: FilterStrategy,
+                   explain: Explainer) -> np.ndarray:
+        """Attribute-index scan: binary-searched candidate rows from the
+        sorted column, then the exact primary on just those rows (bounds
+        over-approximate e.g. non-prefix LIKE). The candidate gather is
+        the positional join back to the record columns — the reference's
+        attribute-index -> record-table join
+        (accumulo/index/AttributeIndex.scala:386-395)."""
+        from ..filters.helper import extract_attribute_bounds
+        from ..index.zkeys import SCAN_BLOCK_THRESHOLD
+        attr = strategy.index.split(":", 1)[1]
+        aidx = st.attr_index(attr)
+        rows = None
+        if aidx is not None:
+            bounds = extract_attribute_bounds(strategy.primary, attr)
+            max_rows = int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n)
+            rows = aidx.candidates(bounds, max_rows=max_rows)
+        if rows is None:
+            explain(f"Attribute bounds not range-scannable; "
+                    f"host scan for {strategy.index}")
+            return np.flatnonzero(evaluate(strategy.primary, st.batch))
+        explain(f"Attribute index scan: {len(rows)} candidate row(s) "
+                f"of {st.n}")
+        if not len(rows):
+            return rows
+        keep = evaluate(strategy.primary, st.batch.take(rows))
+        return rows[keep]
 
     def _device_scan(self, st: _TypeState, q: Query,
                      strategy: FilterStrategy, explain: Explainer) -> np.ndarray:
@@ -609,6 +656,7 @@ def _intervals_ms(primary: ast.Filter, dtg: str) -> list[tuple[int, int]]:
     """Extract inclusive [lo, hi] epoch-millis intervals for the device
     kernels, applying the reference's exclusive-bound adjustment
     (FilterHelper.scala:267-307 rounding semantics)."""
+    from ..filters.helper import to_millis as _to_millis
     out = []
     for b in extract_intervals(primary, dtg):
         lo = _to_millis(b.lower.value) if b.lower.is_bounded else 0
@@ -627,15 +675,6 @@ def _needs_exact(geoms, primary: ast.Filter) -> bool:
     return any(not _is_envelope(g) for g in geoms) or any(
         isinstance(c, (ast.DWithin, ast.SpatialPredicate))
         for c in _walk(primary))
-
-
-def _to_millis(v) -> int:
-    """Interval bound -> epoch millis: ECQL quoted date strings arrive as
-    raw strings (only bare datetime tokens parse to millis in the lexer)."""
-    if isinstance(v, str):
-        return int(np.datetime64(v.strip().rstrip("Z").replace(" ", "T"),
-                                 "ms").astype(np.int64))
-    return int(v)
 
 
 def _is_envelope(g) -> bool:

@@ -1,0 +1,168 @@
+"""Attribute index tests: sorted-column range scans must produce exactly
+the fullscan result with sub-linear candidate sets (the reference's
+attribute-index -> record-table join, AttributeIndex.scala:386-395)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.features.batch import StringColumn
+from geomesa_tpu.filters import evaluate, parse_ecql
+from geomesa_tpu.filters.helper import extract_attribute_bounds
+from geomesa_tpu.index.attr import AttributeKeyIndex
+from geomesa_tpu.index.api import Query
+from geomesa_tpu.store import InMemoryDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+SPEC = ("name:String:index=true,age:Integer:index=true,"
+        "score:Double:index=true,when:Date:index=true,"
+        "*geom:Point:srid=4326")
+
+N = 20_000
+
+
+@pytest.fixture(scope="module")
+def store():
+    ds = InMemoryDataStore()
+    ds.create_schema(parse_spec("recs", SPEC))
+    rng = np.random.default_rng(7)
+    names = np.array([f"tag{i:03d}" for i in range(500)], dtype=object)
+    name_vals = names[rng.integers(0, 500, N)].tolist()
+    name_vals[17] = None  # a null must stay out of the index
+    ds.write_dict("recs", [f"r{i}" for i in range(N)], {
+        "name": name_vals,
+        "age": rng.integers(0, 100, N),
+        "score": rng.uniform(0, 1, N),
+        "when": rng.integers(MS("2020-01-01"), MS("2020-12-31"), N),
+        "geom": (rng.uniform(-180, 180, N), rng.uniform(-90, 90, N)),
+    })
+    return ds
+
+
+@pytest.fixture(scope="module")
+def batch(store):
+    return store._state("recs").batch
+
+
+def oracle(batch, ecql):
+    return set(batch.ids[evaluate(parse_ecql(ecql), batch)].astype(str))
+
+
+QUERIES = [
+    "name = 'tag042'",
+    "name > 'tag400'",
+    "name >= 'tag099' AND name < 'tag101'",
+    "name BETWEEN 'tag490' AND 'tag499'",
+    "name IN ('tag001', 'tag002', 'zzz')",
+    "name LIKE 'tag49%'",
+    "name = 'not-in-vocab'",
+    "age = 41",
+    "age BETWEEN 20 AND 30",
+    "score < 0.01",
+    "score > 0.99 OR score < 0.005",
+]
+
+
+class TestAttrScanCorrectness:
+    @pytest.mark.parametrize("ecql", QUERIES)
+    def test_matches_fullscan(self, store, batch, ecql):
+        res = store.query(ecql, "recs")
+        assert res.plan.index.startswith("attr:"), res.plan
+        assert set(res.ids.astype(str)) == oracle(batch, ecql)
+
+    def test_date_attr_via_forced_index(self, store, batch):
+        # 'when' is the default dtg, so z3 wins by cost; forcing the
+        # attribute index must give the identical result sub-linearly
+        ecql = "when DURING 2020-06-01T00:00:00Z/2020-06-08T00:00:00Z"
+        res = store.query(
+            Query("recs", ecql, hints={"QUERY_INDEX": "attr:when"}))
+        assert res.plan.index == "attr:when"
+        assert set(res.ids.astype(str)) == oracle(batch, ecql)
+
+    def test_attr_primary_with_spatial_residual(self, store, batch):
+        ecql = "age = 41 AND BBOX(geom, -170, -80, 170, 80)"
+        res = store.query(ecql, "recs")
+        assert set(res.ids.astype(str)) == oracle(batch, ecql)
+
+    def test_null_rows_never_match(self, store, batch):
+        # row 17 has a null name: no equality/range scan may return it
+        res = store.query("name >= 'tag000'", "recs")
+        assert "r17" not in set(res.ids.astype(str))
+
+    def test_non_prefix_like_falls_back(self, store, batch):
+        # '%49%' has no leading prefix -> not range-scannable; the store
+        # must still answer correctly (host scan fallback)
+        ecql = "name LIKE '%049%'"
+        res = store.query(ecql, "recs")
+        assert set(res.ids.astype(str)) == oracle(batch, ecql)
+
+
+class TestSubLinearWork:
+    def test_candidate_set_is_sublinear(self, store):
+        lines = []
+        store.query(Query("recs", "name = 'tag042'"),
+                    explain_out=lines.append)
+        scan = [ln for ln in lines if "Attribute index scan" in ln]
+        assert scan, lines
+        k = int(scan[0].split("scan:")[1].split("candidate")[0])
+        assert 0 < k < N // 10  # ~N/500 expected, far below a full scan
+
+    def test_equality_candidates_are_exact(self, batch):
+        idx = AttributeKeyIndex(batch.col("age"))
+        bounds = extract_attribute_bounds(parse_ecql("age = 41"), "age")
+        rows = idx.candidates(bounds)
+        expect = np.flatnonzero(batch.col("age").values == 41)
+        assert np.array_equal(rows, expect)
+
+    def test_string_range_candidates_are_exact(self, batch):
+        idx = AttributeKeyIndex(batch.col("name"))
+        bounds = extract_attribute_bounds(
+            parse_ecql("name >= 'tag100' AND name < 'tag102'"), "name")
+        rows = idx.candidates(bounds)
+        col = batch.col("name")
+        vals = np.array([col.value(i) or "" for i in range(col.n)],
+                        dtype=object).astype(str)
+        expect = np.flatnonzero((vals >= "tag100") & (vals < "tag102")
+                                & col.valid)
+        assert np.array_equal(rows, expect)
+
+    def test_wide_bounds_cross_over_to_dense_scan(self, store, batch):
+        # ~100%-selectivity bounds must NOT gather the whole table; the
+        # store falls back to the dense host scan (and stays correct)
+        lines = []
+        ecql = "name >= 'tag000'"
+        res = store.query(Query("recs", ecql), explain_out=lines.append)
+        assert not any("Attribute index scan" in ln for ln in lines)
+        assert set(res.ids.astype(str)) == oracle(batch, ecql)
+
+    def test_candidates_max_rows_cap(self, batch):
+        idx = AttributeKeyIndex(batch.col("age"))
+        bounds = extract_attribute_bounds(parse_ecql("age >= 0"), "age")
+        assert idx.candidates(bounds, max_rows=100) is None
+
+    def test_unbounded_returns_none(self, batch):
+        idx = AttributeKeyIndex(batch.col("age"))
+        bounds = extract_attribute_bounds(parse_ecql("age <> 5"), "age")
+        assert idx.candidates(bounds) is None
+
+
+class TestIndexMaintenance:
+    def test_append_invalidates(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("t", "v:Integer:index=true,"
+                                    "*geom:Point:srid=4326"))
+        ds.write_dict("t", ["a"], {"v": [1], "geom": ([0.0], [0.0])})
+        assert ds.query("v = 1", "t").n == 1
+        ds.write_dict("t", ["b"], {"v": [1], "geom": ([1.0], [1.0])})
+        assert ds.query("v = 1", "t").n == 2
+        ds.delete("t", ["a"])
+        assert ds.query("v = 1", "t").n == 1
+
+    def test_bound_value_not_in_vocab(self):
+        col = StringColumn.from_strings("s", ["b", "d", "f", None])
+        idx = AttributeKeyIndex(col)
+        bounds = extract_attribute_bounds(
+            parse_ecql("s > 'c' AND s <= 'e'"), "s")
+        rows = idx.candidates(bounds)
+        assert rows.tolist() == [1]
