@@ -24,7 +24,8 @@ from ..features.batch import (BoolColumn, DateColumn, FeatureBatch,
                               StringColumn)
 from ..geometry import Envelope, Point
 from . import ast
-from .helper import METERS_MULTIPLIERS, distance_degrees
+from .helper import (METERS_MULTIPLIERS, distance_degrees, like_vocab_mask,
+                     to_millis)
 
 __all__ = ["evaluate"]
 
@@ -115,7 +116,7 @@ def _compare(f: ast.Compare, b: FeatureBatch) -> np.ndarray:
     vals = _values(col)
     v = f.value
     if isinstance(col, DateColumn) and isinstance(v, str):
-        v = int(np.datetime64(v.rstrip("Z"), "ms").astype(np.int64))
+        v = to_millis(v)
     res = {
         ast.CompareOp.EQ: vals == v,
         ast.CompareOp.NE: vals != v,
@@ -131,11 +132,7 @@ def _like(f: ast.Like, b: FeatureBatch) -> np.ndarray:
     col = b.col(f.prop)
     if not isinstance(col, StringColumn):
         raise TypeError("LIKE requires a string attribute")
-    # SQL LIKE -> regex over the vocab
-    pat = re.escape(f.pattern).replace("%", ".*").replace("_", ".")
-    flags = 0 if f.case_sensitive else re.IGNORECASE
-    rx = re.compile(f"^{pat}$", flags)
-    vocab_ok = np.array([bool(rx.match(s)) for s in col.vocab.astype(str)])
+    vocab_ok = like_vocab_mask(f.pattern, f.case_sensitive, col.vocab)
     ok = np.zeros(b.n, dtype=bool)
     valid = col.codes >= 0
     ok[valid] = vocab_ok[col.codes[valid]]

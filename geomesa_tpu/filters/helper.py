@@ -67,10 +67,22 @@ def to_millis(v) -> int:
     """Interval/bound value -> epoch millis: ECQL quoted date strings
     arrive as raw strings (only bare datetime tokens parse in the lexer)."""
     if isinstance(v, str):
-        import numpy as np
         return int(np.datetime64(v.strip().rstrip("Z").replace(" ", "T"),
                                  "ms").astype(np.int64))
     return int(v)
+
+
+def like_vocab_mask(pattern: str, case_sensitive: bool,
+                    vocab: np.ndarray) -> np.ndarray:
+    """SQL LIKE pattern -> bool mask over a string vocab. The single
+    source of LIKE semantics for the host evaluator and the device
+    residual compiler (their parity is a correctness contract)."""
+    import re
+    pat = re.escape(pattern).replace("%", ".*").replace("_", ".")
+    flags = 0 if case_sensitive else re.IGNORECASE
+    rx = re.compile(f"^{pat}$", flags)
+    return np.array([bool(rx.match(s)) for s in vocab.astype(str)],
+                    dtype=bool)
 
 
 @dataclasses.dataclass(frozen=True)

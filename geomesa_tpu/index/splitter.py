@@ -54,20 +54,28 @@ def _partition(f: ast.Filter, pred) -> tuple[ast.Filter | None, ast.Filter | Non
     return None, f
 
 
-def spatial_part(f: ast.Filter, geom: str):
-    return _partition(f, lambda c: _is_spatial(c, geom))
-
-
-def temporal_part(f: ast.Filter, dtg: str | None):
-    return _partition(f, lambda c: _is_temporal(c, dtg))
-
-
 def _or_primary(f: ast.Filter, pred) -> ast.Filter | None:
     """A homogeneous OR (every child matches pred) is usable as a primary
     (FilterSplitter's same-dimension OR rule)."""
     if isinstance(f, ast.Or) and all(pred(c) for c in f.children):
         return f
     return None
+
+
+def _with_or(pred):
+    """Extend a node predicate so a homogeneous OR counts as matching —
+    both at the top level and as a conjunct inside an AND."""
+    def p(c):
+        return pred(c) or _or_primary(c, pred) is not None
+    return p
+
+
+def spatial_part(f: ast.Filter, geom: str):
+    return _partition(f, _with_or(lambda c: _is_spatial(c, geom)))
+
+
+def temporal_part(f: ast.Filter, dtg: str | None):
+    return _partition(f, _with_or(lambda c: _is_temporal(c, dtg)))
 
 
 def _and_opt(a: ast.Filter | None, b: ast.Filter | None) -> ast.Filter | None:
@@ -116,9 +124,6 @@ def split_filter(sft: SimpleFeatureType, f: ast.Filter,
                 return [FilterStrategy("empty", None, None, cost=0)]
             if geoms:
                 spatial, rest = spatial_part(f, geom)
-                if spatial is None:
-                    spatial, rest = _or_primary(
-                        f, lambda c: _is_spatial(c, geom)), None
                 if spatial is not None:
                     options.append(FilterStrategy(index, spatial, rest))
         elif index == "id":
@@ -148,9 +153,7 @@ def split_filter(sft: SimpleFeatureType, f: ast.Filter,
                                                ast.InList, ast.Like,
                                                ast.During, ast.Before,
                                                ast.After, ast.TEquals)))
-                primary, rest = _partition(f, _attr_pred)
-                if primary is None:
-                    primary, rest = _or_primary(f, _attr_pred), None
+                primary, rest = _partition(f, _with_or(_attr_pred))
                 if primary is not None:
                     options.append(FilterStrategy(index, primary, rest))
 

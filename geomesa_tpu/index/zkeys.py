@@ -86,7 +86,15 @@ class ZKeyIndex:
     # -- build -------------------------------------------------------------
 
     def _perm_dtype(self):
-        return np.int32 if self.n < 2**31 else np.int64
+        # XLA TPU gathers address with 32-bit indices, and a >=2^31-row
+        # column set exceeds single-chip HBM anyway: larger tables must
+        # shard over the mesh (store/mesh_store.py), which keeps every
+        # per-device shard far below this cap.
+        if self.n >= 2**31:
+            raise ValueError(
+                "single-shard table exceeds 2^31 rows; shard it over "
+                "the mesh-distributed store instead")
+        return np.int32
 
     def _build_z3(self):
         if self._z3 is not None or self._millis is None:

@@ -1,0 +1,341 @@
+"""Device residual-predicate compiler: attribute filters as XLA ops.
+
+The reference evaluates residual CQL row-by-row inside server-side
+iterators (/root/reference/geomesa-accumulo/geomesa-accumulo-datastore/
+src/main/scala/org/locationtech/geomesa/accumulo/iterators/
+KryoLazyFilterTransformIterator.scala:37); for Arrow scans it first
+rewrites string predicates against dictionary codes so the hot loop
+compares ints (/root/reference/geomesa-arrow/geomesa-arrow-gt/src/main/
+scala/org/locationtech/geomesa/arrow/filter/ArrowFilterOptimizer.scala:36).
+
+TPU analog: attribute predicates compile to vector compares over
+device-resident columns. TPUs run with 32-bit lanes (no x64), so every
+64-bit column gets an exact 32-bit decomposition:
+
+- float64   -> (f32 hi, f32 residual lo); compares are lexicographic on
+               (hi, lo) with a host patch of the (rare) rows whose key
+               collides with the threshold's key — the same two-float
+               exactness scheme as the coordinate scan (scan/zscan.py)
+- int64     -> (signed high word v >> 32, unsigned low word
+               v & 0xFFFFFFFF); lexicographic compare is exact over the
+               full int64 range, no patch needed
+- date      -> (day, millis-of-day) pair, as in the z3 time axis
+- string    -> integer compares against code-space thresholds from the
+               sorted vocab; IN/LIKE run over the vocab on host and map
+               through one device gather
+- AND/OR/NOT -> logical ops on device masks
+
+Spatial and id predicates are NOT handled here — they are the primary
+scan's job (zscan/gscan). ``is_compilable`` reports whether a filter
+tree is fully in this subset; callers fall back to the host reference
+evaluator (filters/evaluate.py) otherwise, so this layer can never
+change semantics — parity is enforced by differential tests.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..features.batch import (BoolColumn, DateColumn, FeatureBatch,
+                              NumericColumn, StringColumn)
+from ..filters import ast
+from ..filters.helper import like_vocab_mask, to_millis
+from .zscan import MILLIS_PER_DAY
+
+__all__ = ["is_compilable", "device_mask", "DeviceColumns"]
+
+def _split_f64(v: np.ndarray | float):
+    v = np.asarray(v, dtype=np.float64)
+    hi = v.astype(np.float32)
+    lo = (v - hi.astype(np.float64)).astype(np.float32)
+    return hi, lo
+
+
+def _split_i64(v: np.ndarray | int):
+    """Exact full-range int64 split: (signed high word, unsigned low
+    word) — lexicographic compare on the pair equals int64 compare."""
+    v = np.asarray(v, dtype=np.int64)
+    return (v >> 32).astype(np.int32), (v & 0xFFFFFFFF).astype(np.uint32)
+
+
+def _split_ms(v: np.ndarray | int):
+    v = np.asarray(v, dtype=np.int64)
+    day = v // MILLIS_PER_DAY
+    return (day.astype(np.int32),
+            (v - day * MILLIS_PER_DAY).astype(np.int32))
+
+
+class DeviceColumns:
+    """Lazy per-column device uploads for one feature batch.
+
+    Columns move to HBM once, on first use by a device residual, and are
+    reused across queries until the batch changes (the owner clears the
+    cache on write/delete).
+    """
+
+    def __init__(self, batch: FeatureBatch):
+        self._batch = batch
+        self._cache: dict[str, dict] = {}
+
+    def get(self, name: str) -> dict | None:
+        if name in self._cache:
+            return self._cache[name]
+        col = self._batch.col(name)
+        if isinstance(col, NumericColumn):
+            if col.values.dtype.kind == "f":
+                hi, lo = _split_f64(col.values)
+                dev = {"kind": "f64", "hi": jnp.asarray(hi),
+                       "lo": jnp.asarray(lo),
+                       "valid": jnp.asarray(col.valid),
+                       "host": col.values}
+            else:
+                hi, lo = _split_i64(col.values)
+                dev = {"kind": "i64", "hi": jnp.asarray(hi),
+                       "lo": jnp.asarray(lo),
+                       "valid": jnp.asarray(col.valid)}
+        elif isinstance(col, DateColumn):
+            day, ms = _split_ms(col.millis)
+            dev = {"kind": "date", "hi": jnp.asarray(day),
+                   "lo": jnp.asarray(ms), "valid": jnp.asarray(col.valid)}
+        elif isinstance(col, BoolColumn):
+            dev = {"kind": "bool", "values": jnp.asarray(col.values),
+                   "valid": jnp.asarray(col.valid)}
+        elif isinstance(col, StringColumn):
+            dev = {"kind": "str", "codes": jnp.asarray(col.codes)}
+        else:
+            return None
+        self._cache[name] = dev
+        return dev
+
+
+_COMPILABLE_COLS = (NumericColumn, DateColumn, BoolColumn, StringColumn)
+
+
+def is_compilable(f: ast.Filter, batch: FeatureBatch) -> bool:
+    """True if the whole filter tree evaluates on device."""
+    if isinstance(f, (ast.Include, ast.Exclude)):
+        return True
+    if isinstance(f, (ast.And, ast.Or)):
+        return all(is_compilable(c, batch) for c in f.children)
+    if isinstance(f, ast.Not):
+        return is_compilable(f.child, batch)
+    if isinstance(f, (ast.Compare, ast.Between, ast.InList, ast.IsNull,
+                      ast.During, ast.Before, ast.After, ast.TEquals)):
+        col = batch.columns.get(f.prop)
+        return isinstance(col, _COMPILABLE_COLS)
+    if isinstance(f, ast.Like):
+        return isinstance(batch.columns.get(f.prop), StringColumn)
+    return False
+
+
+def device_mask(f: ast.Filter, batch: FeatureBatch,
+                cols: DeviceColumns) -> jnp.ndarray:
+    """Evaluate a compilable filter tree; returns a device bool[n] mask.
+
+    Eager jnp ops: every node is a memory-bound vector pass, so there is
+    nothing for a jit to fuse that XLA's eager dispatch doesn't already
+    pipeline, and skipping jit avoids per-query retraces.
+    """
+    n = batch.n
+    if isinstance(f, ast.Include):
+        return jnp.ones(n, dtype=bool)
+    if isinstance(f, ast.Exclude):
+        return jnp.zeros(n, dtype=bool)
+    if isinstance(f, ast.And):
+        out = device_mask(f.children[0], batch, cols)
+        for c in f.children[1:]:
+            out = out & device_mask(c, batch, cols)
+        return out
+    if isinstance(f, ast.Or):
+        out = device_mask(f.children[0], batch, cols)
+        for c in f.children[1:]:
+            out = out | device_mask(c, batch, cols)
+        return out
+    if isinstance(f, ast.Not):
+        return ~device_mask(f.child, batch, cols)
+    if isinstance(f, ast.IsNull):
+        col = batch.col(f.prop)
+        if isinstance(col, StringColumn):
+            return cols.get(f.prop)["codes"] < 0
+        return ~cols.get(f.prop)["valid"]
+    if isinstance(f, ast.Compare):
+        return _compare(f.op, f.prop, f.value, batch, cols)
+    if isinstance(f, ast.Between):
+        return (_compare(ast.CompareOp.GE, f.prop, f.lo, batch, cols)
+                & _compare(ast.CompareOp.LE, f.prop, f.hi, batch, cols))
+    if isinstance(f, ast.InList):
+        return _in_list(f, batch, cols)
+    if isinstance(f, ast.Like):
+        return _like(f, batch, cols)
+    if isinstance(f, ast.During):
+        return (_compare(ast.CompareOp.GT, f.prop, f.start, batch, cols)
+                & _compare(ast.CompareOp.LT, f.prop, f.end, batch, cols))
+    if isinstance(f, ast.Before):
+        return _compare(ast.CompareOp.LT, f.prop, f.time, batch, cols)
+    if isinstance(f, ast.After):
+        return _compare(ast.CompareOp.GT, f.prop, f.time, batch, cols)
+    if isinstance(f, ast.TEquals):
+        return _compare(ast.CompareOp.EQ, f.prop, f.time, batch, cols)
+    raise TypeError(f"not device-compilable: {type(f).__name__}")
+
+
+def _int_cmp_const(op: str, v):
+    """Rewrite a compare against a possibly-fractional literal into an
+    exact integer compare: returns (op', int_value, const) where const
+    (True/False) short-circuits the whole predicate. `x < 30.5` becomes
+    `x <= 30`; `x = 30.5` is constant False — matching the host
+    evaluator's numpy promotion semantics exactly."""
+    if not isinstance(v, float) or v.is_integer():
+        op2, iv = op, int(v)
+    else:
+        import math
+        if op == ast.CompareOp.EQ:
+            return None, None, False
+        if op == ast.CompareOp.NE:
+            return None, None, True
+        if op in (ast.CompareOp.LT, ast.CompareOp.LE):
+            op2, iv = ast.CompareOp.LE, math.floor(v)
+        else:
+            op2, iv = ast.CompareOp.GE, math.floor(v) + 1
+    # literals beyond int64 are constants against any int64 column
+    if iv > 2**63 - 1 or iv < -(2**63):
+        above = iv > 0
+        if op2 == ast.CompareOp.EQ:
+            return None, None, False
+        if op2 == ast.CompareOp.NE:
+            return None, None, True
+        if op2 in (ast.CompareOp.LT, ast.CompareOp.LE):
+            return None, None, above
+        return None, None, not above
+    return op2, iv, None
+
+
+def _pair_cmp(hi, lo, vh, vl, op: str, valid):
+    """Lexicographic compare of a (hi, lo) pair column against a split
+    threshold. Exact for the integer splits; for f64 the EQ band is
+    patched by the caller."""
+    lt = (hi < vh) | ((hi == vh) & (lo < vl))
+    gt = (hi > vh) | ((hi == vh) & (lo > vl))
+    if op == ast.CompareOp.LT:
+        return lt & valid
+    if op == ast.CompareOp.GT:
+        return gt & valid
+    if op == ast.CompareOp.LE:
+        return ~gt & valid
+    if op == ast.CompareOp.GE:
+        return ~lt & valid
+    if op == ast.CompareOp.EQ:
+        return ~lt & ~gt & valid
+    if op == ast.CompareOp.NE:
+        return (lt | gt) & valid
+    raise ValueError(op)
+
+
+def _compare(op: str, prop: str, value, batch: FeatureBatch,
+             cols: DeviceColumns) -> jnp.ndarray:
+    col = batch.col(prop)
+    dev = cols.get(prop)
+    kind = dev["kind"]
+    if kind == "str":
+        return _compare_str(op, str(value), col, dev)
+    if kind == "bool":
+        # promote to int like numpy: True==1, False==0, fractional
+        # literals compare in float space
+        v = int(value) if isinstance(value, bool) else value
+        vals = dev["values"].astype(jnp.int32)
+        res = {
+            ast.CompareOp.EQ: lambda: vals == v,
+            ast.CompareOp.NE: lambda: vals != v,
+            ast.CompareOp.LT: lambda: vals < v,
+            ast.CompareOp.GT: lambda: vals > v,
+            ast.CompareOp.LE: lambda: vals <= v,
+            ast.CompareOp.GE: lambda: vals >= v,
+        }[op]()
+        return res & dev["valid"]
+    if kind in ("date", "i64"):
+        if kind == "date" and isinstance(value, str):
+            op2, iv, const = op, to_millis(value), None
+        else:
+            op2, iv, const = _int_cmp_const(op, value)
+        if const is not None:
+            return dev["valid"] if const else jnp.zeros_like(dev["valid"])
+        vh, vl = _split_ms(iv) if kind == "date" else _split_i64(iv)
+        return _pair_cmp(dev["hi"], dev["lo"], int(vh), int(vl), op2,
+                         dev["valid"])
+    # f64: two-float lexicographic compare + host patch of the band
+    # where the split key collides with the threshold key (the same
+    # boundary-exactness scheme as zscan.exact_patch)
+    v = float(value)
+    vh, vl = _split_f64(v)
+    res = _pair_cmp(dev["hi"], dev["lo"], vh, vl, op, dev["valid"])
+    band = (dev["hi"] == vh) & (dev["lo"] == vl) & dev["valid"]
+    bidx = np.flatnonzero(np.asarray(band))
+    if len(bidx):
+        host = dev["host"][bidx]
+        ok = {
+            ast.CompareOp.EQ: host == v, ast.CompareOp.NE: host != v,
+            ast.CompareOp.LT: host < v, ast.CompareOp.GT: host > v,
+            ast.CompareOp.LE: host <= v, ast.CompareOp.GE: host >= v,
+        }[op]
+        res = res.at[jnp.asarray(bidx)].set(jnp.asarray(ok))
+    return res
+
+
+def _compare_str(op: str, v: str, col: StringColumn,
+                 dev: dict) -> jnp.ndarray:
+    """String compare as integer compares in code space: codes index a
+    sorted vocab, so lexicographic thresholds are vocab positions."""
+    codes = dev["codes"]
+    vocab = col.vocab.astype(str)
+    valid = codes >= 0
+    if op in (ast.CompareOp.EQ, ast.CompareOp.NE):
+        c = col.code_of(v)
+        if op == ast.CompareOp.EQ:
+            # c == -1 (absent) would compare equal to nulls; mask them
+            return (codes == c) & valid
+        return (codes != c) & valid
+    if op == ast.CompareOp.LT:
+        t = int(np.searchsorted(vocab, v, side="left"))
+        return (codes < t) & valid
+    if op == ast.CompareOp.LE:
+        t = int(np.searchsorted(vocab, v, side="right"))
+        return (codes < t) & valid
+    if op == ast.CompareOp.GT:
+        t = int(np.searchsorted(vocab, v, side="right"))
+        return codes >= t  # codes >= t implies valid (t >= 0)
+    if op == ast.CompareOp.GE:
+        t = int(np.searchsorted(vocab, v, side="left"))
+        return codes >= t
+    raise ValueError(op)
+
+
+def _vocab_gather(vocab_ok: np.ndarray, codes: jnp.ndarray) -> jnp.ndarray:
+    """Map a host vocab-level bool mask through device codes: one gather.
+    Nulls (code -1) hit the appended always-False sentinel slot."""
+    table = jnp.asarray(np.append(vocab_ok, False))
+    idx = jnp.where(codes < 0, len(vocab_ok), codes)
+    return jnp.take(table, idx, mode="clip")
+
+
+def _in_list(f: ast.InList, batch: FeatureBatch,
+             cols: DeviceColumns) -> jnp.ndarray:
+    col = batch.col(f.prop)
+    dev = cols.get(f.prop)
+    if isinstance(col, StringColumn):
+        vocab_ok = np.isin(col.vocab.astype(str),
+                           np.asarray([str(v) for v in f.values], dtype=str))
+        return _vocab_gather(vocab_ok, dev["codes"])
+    # IN lists are small: OR of equality compares (each exact)
+    out = jnp.zeros(batch.n, dtype=bool)
+    for v in f.values:
+        out = out | _compare(ast.CompareOp.EQ, f.prop, v, batch, cols)
+    return out
+
+
+def _like(f: ast.Like, batch: FeatureBatch,
+          cols: DeviceColumns) -> jnp.ndarray:
+    col = batch.col(f.prop)
+    # LIKE runs over the (small) vocab on host; device sees one gather
+    vocab_ok = like_vocab_mask(f.pattern, f.case_sensitive, col.vocab)
+    return _vocab_gather(vocab_ok, cols.get(f.prop)["codes"])

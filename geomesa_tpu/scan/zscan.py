@@ -202,8 +202,8 @@ def scan_mask_at(data: DeviceScanData, q: ScanQuery,
     if m == 0:
         return np.zeros(0, dtype=bool)
     k = _next_pow2(m)
-    # pad in the rows' own dtype: int64 permutations (n >= 2^31) must not
-    # wrap negative here
+    # pad in the rows' own dtype (row counts are capped at int32 range
+    # by ZKeyIndex._perm_dtype; device gathers are 32-bit)
     idx = np.zeros(k, dtype=rows.dtype)
     idx[:m] = rows
     out = _gather_scan_mask(data.xhi, data.xlo, data.yhi, data.ylo,

@@ -74,6 +74,8 @@ class _TypeState:
         self.host_yhi: np.ndarray | None = None
         # lazily-built sorted attribute indexes (AttributeIndex analog)
         self.attr_idx: dict[str, Any] = {}
+        # lazy device uploads of attribute columns for residual kernels
+        self.devcols = None  # scan.residual.DeviceColumns
         self.dirty = False
         # per-feature visibility expressions (None = world-readable);
         # has_vis avoids an O(n) object-array scan on every query
@@ -101,6 +103,7 @@ class _TypeState:
         self.batch = batch if self.batch is None else self.batch.concat(batch)
         self.vis = np.concatenate([self.vis, vis])
         self.attr_idx.clear()
+        self.devcols = None
         self.dirty = True
 
     def delete(self, ids: set[str]):
@@ -110,6 +113,7 @@ class _TypeState:
         self.batch = self.batch.take(np.flatnonzero(keep))
         self.vis = self.vis[keep]
         self.attr_idx.clear()
+        self.devcols = None
         self.dirty = True
 
     def ensure_index(self):
@@ -163,6 +167,12 @@ class _TypeState:
             except TypeError:
                 self.attr_idx[name] = None  # unindexable column type
         return self.attr_idx[name]
+
+    def device_cols(self):
+        if self.devcols is None:
+            from ..scan.residual import DeviceColumns
+            self.devcols = DeviceColumns(self.batch)
+        return self.devcols
 
 
 class InMemoryDataStore:
@@ -432,20 +442,44 @@ class InMemoryDataStore:
               and strategy.primary is not None):
             idx = self._attr_scan(st, strategy, explain)
         else:
-            # fullscan / attr / extent-geometry path: host evaluation of
-            # the primary (residual joins below)
-            explain(f"Executing host scan for {strategy.index}")
-            idx = (np.flatnonzero(evaluate(strategy.primary, batch))
-                   if strategy.primary is not None
-                   else np.arange(n, dtype=np.int64))
+            # fullscan / attr-fallback / extent-geometry path: device
+            # kernel when the primary is attribute-only (the pushed-down
+            # "iterator" of the reference), else host evaluation
+            if strategy.primary is None:
+                idx = np.arange(n, dtype=np.int64)
+            else:
+                from ..scan import residual
+                if residual.is_compilable(strategy.primary, batch):
+                    explain(f"Device residual scan for {strategy.index}")
+                    mask = residual.device_mask(strategy.primary, batch,
+                                                st.device_cols())
+                    idx = np.flatnonzero(np.asarray(mask))
+                else:
+                    explain(f"Executing host scan for {strategy.index}")
+                    idx = np.flatnonzero(evaluate(strategy.primary, batch))
 
         if strategy.secondary is not None:
             if len(idx):
-                sub = batch.take(idx)
-                keep = evaluate(strategy.secondary, sub)
-                idx = idx[keep]
+                idx = self._apply_residual(st, strategy.secondary, idx,
+                                           explain)
             explain(f"Residual filter applied: {strategy.secondary}")
         return idx
+
+    def _apply_residual(self, st: _TypeState, residual_f: ast.Filter,
+                        idx: np.ndarray, explain: Explainer) -> np.ndarray:
+        """Secondary-filter application: a dense device pass when the
+        candidate set is a large fraction of the table (gathering would
+        cost more than re-touching the column), host evaluation on the
+        gathered candidates otherwise."""
+        from ..scan import residual
+        batch = st.batch
+        if (len(idx) * 4 > st.n
+                and residual.is_compilable(residual_f, batch)):
+            explain("Device residual scan (dense)")
+            mask = np.asarray(residual.device_mask(residual_f, batch,
+                                                   st.device_cols()))
+            return idx[mask[idx]]
+        return idx[evaluate(residual_f, batch.take(idx))]
 
     def _attr_scan(self, st: _TypeState, strategy: FilterStrategy,
                    explain: Explainer) -> np.ndarray:
@@ -465,6 +499,13 @@ class InMemoryDataStore:
             max_rows = int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n)
             rows = aidx.candidates(bounds, max_rows=max_rows)
         if rows is None:
+            from ..scan import residual
+            if residual.is_compilable(strategy.primary, st.batch):
+                explain(f"Attribute bounds too wide; dense device scan "
+                        f"for {strategy.index}")
+                mask = residual.device_mask(strategy.primary, st.batch,
+                                            st.device_cols())
+                return np.flatnonzero(np.asarray(mask))
             explain(f"Attribute bounds not range-scannable; "
                     f"host scan for {strategy.index}")
             return np.flatnonzero(evaluate(strategy.primary, st.batch))

@@ -85,6 +85,14 @@ class TestAttrScanCorrectness:
         res = store.query(ecql, "recs")
         assert set(res.ids.astype(str)) == oracle(batch, ecql)
 
+    def test_or_conjunct_inside_and_uses_attr_index(self, store, batch):
+        # a homogeneous OR conjunct must still offer the attr strategy
+        ecql = ("(name = 'tag001' OR name = 'tag002') AND "
+                "BBOX(geom, -170, -80, 170, 80)")
+        res = store.query(ecql, "recs")
+        assert res.plan.index == "attr:name", res.plan
+        assert set(res.ids.astype(str)) == oracle(batch, ecql)
+
     def test_null_rows_never_match(self, store, batch):
         # row 17 has a null name: no equality/range scan may return it
         res = store.query("name >= 'tag000'", "recs")

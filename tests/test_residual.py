@@ -1,0 +1,162 @@
+"""Device residual compiler: differential parity with the host reference
+evaluator (filters/evaluate.py is the oracle), incl. dictionary-string
+predicates running as integer compares on device."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.filters import evaluate, parse_ecql
+from geomesa_tpu.scan import residual
+from geomesa_tpu.store import InMemoryDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+N = 10_000
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(3)
+    sft = parse_spec(
+        "t", "name:String,age:Integer,score:Double,flag:Boolean,"
+        "dtg:Date,*geom:Point:srid=4326")
+    names = np.array([f"w{i:03d}" for i in range(40)], dtype=object)
+    vals = names[rng.integers(0, 40, N)].tolist()
+    for i in range(0, N, 97):
+        vals[i] = None
+    age = rng.integers(0, 100, N).astype(object)
+    age[5] = None
+    return FeatureBatch.from_dict(sft, [f"f{i}" for i in range(N)], {
+        "name": vals,
+        "age": age,
+        "score": rng.uniform(0, 1, N),
+        "flag": rng.integers(0, 2, N).astype(bool),
+        "dtg": rng.integers(MS("2021-01-01"), MS("2021-12-31"), N),
+        "geom": (rng.uniform(-180, 180, N), rng.uniform(-90, 90, N)),
+    })
+
+
+@pytest.fixture(scope="module")
+def devcols(batch):
+    return residual.DeviceColumns(batch)
+
+
+FILTERS = [
+    "name = 'w007'",
+    "name <> 'w007'",
+    "name < 'w010'",
+    "name <= 'w010'",
+    "name > 'w035'",
+    "name >= 'w035'",
+    "name = 'absent'",
+    "name <> 'absent'",
+    "name BETWEEN 'w010' AND 'w012'",
+    "name > 'w0071'",          # threshold between vocab entries
+    "name IN ('w001', 'w002', 'nope')",
+    "name LIKE 'w00%'",
+    "name LIKE '%3'",
+    "name ILIKE 'W01_'",
+    "name IS NULL",
+    "NOT (name = 'w007')",
+    "age = 41",
+    "age <> 41",
+    "age BETWEEN 20 AND 30",
+    "age IN (1, 2, 3)",
+    "age IS NULL",
+    "score < 0.25 OR score > 0.9",
+    "flag = true",
+    "dtg DURING 2021-03-01T00:00:00Z/2021-04-01T00:00:00Z",
+    "dtg BEFORE 2021-02-01T00:00:00Z",
+    "dtg AFTER 2021-11-01T00:00:00Z",
+    "dtg >= '2021-06-01T00:00:00Z'",
+    "age > 50 AND name = 'w002' AND score <= 0.5",
+    "(name = 'w001' OR name = 'w002') AND flag = false",
+    # fractional literals against integer columns: floor/ceil rewrite
+    "age < 30.5",
+    "age >= 0.5",
+    "age = 41.5",
+    "age <> 41.5",
+    "age BETWEEN 19.5 AND 30.5",
+]
+
+
+class TestDeviceHostParity:
+    @pytest.mark.parametrize("ecql", FILTERS)
+    def test_parity(self, batch, devcols, ecql):
+        f = parse_ecql(ecql)
+        assert residual.is_compilable(f, batch)
+        dev = np.asarray(residual.device_mask(f, batch, devcols))
+        host = evaluate(f, batch)
+        assert np.array_equal(dev, host), ecql
+
+    def test_f64_band_exactness(self):
+        # values whose two-float key collides with the threshold's key:
+        # the host patch must restore exact f64 semantics
+        t = 0.25
+        vals = np.array([t, np.nextafter(t, 0), np.nextafter(t, 1),
+                         t + 1e-17, t - 1e-17, 0.3, 0.2])
+        sft = parse_spec("b", "v:Double,*geom:Point:srid=4326")
+        n = len(vals)
+        b = FeatureBatch.from_dict(sft, [str(i) for i in range(n)], {
+            "v": vals, "geom": (np.zeros(n), np.zeros(n))})
+        dc = residual.DeviceColumns(b)
+        for op in ("<", "<=", "=", ">=", ">", "<>"):
+            f = parse_ecql(f"v {op} 0.25")
+            dev = np.asarray(residual.device_mask(f, b, dc))
+            host = evaluate(f, b)
+            assert np.array_equal(dev, host), op
+
+    def test_i64_full_range(self):
+        vals = np.array([0, 1, -1, 2**62, -(2**62), 2**33, -(2**33),
+                         (1 << 40) + 7], dtype=np.int64)
+        sft = parse_spec("b", "v:Long,*geom:Point:srid=4326")
+        n = len(vals)
+        b = FeatureBatch.from_dict(sft, [str(i) for i in range(n)], {
+            "v": vals, "geom": (np.zeros(n), np.zeros(n))})
+        dc = residual.DeviceColumns(b)
+        for ecql in (f"v > {2**33}", f"v <= {-(2**33)}", f"v = {2**62}",
+                     f"v BETWEEN {-(2**40)} AND {2**40}"):
+            f = parse_ecql(ecql)
+            dev = np.asarray(residual.device_mask(f, b, dc))
+            host = evaluate(f, b)
+            assert np.array_equal(dev, host), ecql
+
+    def test_spatial_not_compilable(self, batch):
+        f = parse_ecql("BBOX(geom, 0, 0, 10, 10)")
+        assert not residual.is_compilable(f, batch)
+
+    def test_fid_not_compilable(self, batch):
+        f = parse_ecql("IN ('f1')")
+        assert not residual.is_compilable(f, batch)
+
+    def test_mixed_tree_not_compilable(self, batch):
+        f = parse_ecql("age > 5 AND BBOX(geom, 0, 0, 10, 10)")
+        assert not residual.is_compilable(f, batch)
+
+
+class TestStoreIntegration:
+    @pytest.fixture(scope="class")
+    def store(self, batch):
+        ds = InMemoryDataStore()
+        ds.create_schema(batch.sft)
+        ds.write("t", batch)
+        return ds
+
+    def test_fullscan_uses_device(self, store, batch):
+        # non-indexed attributes -> fullscan strategy (whole filter as
+        # secondary) -> dense device residual kernel
+        from geomesa_tpu.index.api import Query
+        lines = []
+        ecql = "age > 50 AND name = 'w002'"
+        res = store.query(Query("t", ecql), explain_out=lines.append)
+        assert any("Device residual scan (dense)" in ln
+                   for ln in lines), lines
+        assert set(res.ids.astype(str)) == set(
+            batch.ids[evaluate(parse_ecql(ecql), batch)].astype(str))
+
+    def test_wide_secondary_residual_on_device(self, store, batch):
+        ecql = "BBOX(geom, -180, -90, 180, 84) AND age <> 5"
+        res = store.query(ecql, "t")
+        assert set(res.ids.astype(str)) == set(
+            batch.ids[evaluate(parse_ecql(ecql), batch)].astype(str))
