@@ -124,4 +124,5 @@ def tube_select_mask(data, boxes: np.ndarray,
     mask = _tube_kernel(data.xhi, data.xlo, data.yhi, data.ylo,
                         data.tday, data.tms,
                         jnp.asarray(bx), jnp.asarray(tm), jnp.asarray(valid))
-    return np.asarray(mask)
+    # slice off capacity padding (rows >= n are not real features)
+    return np.asarray(mask)[:data.n]

@@ -308,42 +308,62 @@ class FeatureBatch:
         return out
 
     def concat(self, other: "FeatureBatch") -> "FeatureBatch":
-        if self.sft != other.sft:
-            raise ValueError("schema mismatch")
-        cols = {}
-        for name, c in self.columns.items():
-            oc = other.columns[name]
+        return FeatureBatch.concat_all([self, other])
+
+    @classmethod
+    def concat_all(cls, batches: list["FeatureBatch"]) -> "FeatureBatch":
+        """Single-pass multi-way concatenation: each column is copied
+        once and string vocabs merge with one np.unique, so folding a
+        burst of k small writes is O(total), not O(k * total)."""
+        if not batches:
+            raise ValueError("nothing to concatenate")
+        if len(batches) == 1:
+            return batches[0]
+        first = batches[0]
+        for b in batches[1:]:
+            if b.sft != first.sft:
+                raise ValueError("schema mismatch")
+        cols: dict[str, Column] = {}
+        for name, c in first.columns.items():
+            parts = [b.columns[name] for b in batches]
             if isinstance(c, StringColumn):
-                # vectorized vocab merge: re-unique the two vocabs, remap
-                # both code arrays through the inverse index, keep -1 nulls
+                # one vocab merge: re-unique all vocabs, remap each code
+                # array through its inverse segment, keep -1 nulls
+                sizes = [len(p.vocab) for p in parts]
                 vocab, inverse = np.unique(
-                    np.concatenate([c.vocab, oc.vocab]).astype(str),
+                    np.concatenate([p.vocab for p in parts]).astype(str),
                     return_inverse=True)
-                map_a = inverse[:len(c.vocab)]
-                map_b = inverse[len(c.vocab):]
-                codes_a = np.where(c.codes >= 0, map_a[np.maximum(c.codes, 0)], -1)
-                codes_b = np.where(oc.codes >= 0, map_b[np.maximum(oc.codes, 0)], -1)
+                offs = np.cumsum([0] + sizes)
+                codes = [
+                    np.where(p.codes >= 0,
+                             inverse[offs[i]:offs[i + 1]][
+                                 np.maximum(p.codes, 0)], -1)
+                    for i, p in enumerate(parts)]
                 cols[name] = StringColumn(
-                    name, np.concatenate([codes_a, codes_b]).astype(np.int32),
+                    name, np.concatenate(codes).astype(np.int32),
                     vocab.astype(object))
             elif isinstance(c, GeometryColumn):
+                geoms: list = []
+                for p in parts:
+                    geoms.extend(p.geoms)  # type: ignore[union-attr]
                 cols[name] = GeometryColumn(
-                    name, c.geoms + oc.geoms,  # type: ignore[union-attr]
-                    np.vstack([c.bounds, oc.bounds]))  # type: ignore[union-attr]
+                    name, geoms, np.vstack([p.bounds for p in parts]))
             elif isinstance(c, PointColumn):
                 cols[name] = PointColumn(
-                    name, np.concatenate([c.x, oc.x]),
-                    np.concatenate([c.y, oc.y]),
-                    np.concatenate([c.valid, oc.valid]))
+                    name, np.concatenate([p.x for p in parts]),
+                    np.concatenate([p.y for p in parts]),
+                    np.concatenate([p.valid for p in parts]))
             elif isinstance(c, DateColumn):
                 cols[name] = DateColumn(
-                    name, np.concatenate([c.millis, oc.millis]),
-                    np.concatenate([c.valid, oc.valid]))
+                    name, np.concatenate([p.millis for p in parts]),
+                    np.concatenate([p.valid for p in parts]))
             else:
                 cols[name] = type(c)(
-                    name, np.concatenate([c.values, oc.values]),  # type: ignore[attr-defined]
-                    np.concatenate([c.valid, oc.valid]))
-        return FeatureBatch(self.sft, np.concatenate([self.ids, other.ids]), cols)
+                    name,
+                    np.concatenate([p.values for p in parts]),  # type: ignore[attr-defined]
+                    np.concatenate([p.valid for p in parts]))
+        return FeatureBatch(first.sft,
+                            np.concatenate([b.ids for b in batches]), cols)
 
     # -- arrow interchange ------------------------------------------------
 

@@ -121,6 +121,81 @@ class ZKeyIndex:
         self._z2 = (z[perm], perm)
         return self._z2
 
+    # -- incremental maintenance -------------------------------------------
+
+    def extend(self, x: np.ndarray, y: np.ndarray,
+               millis: np.ndarray | None) -> "ZKeyIndex":
+        """New index covering the existing rows plus appended rows, with
+        already-built sort orders MERGED (sorted-run merge: O(N) memcpy
+        + O(D log D) delta sort) instead of re-sorted from scratch — the
+        LSM-style write path the reference gets from its backing stores'
+        minor compactions (BatchWriter mutations merging into tablets).
+        """
+        if (self._millis is None) != (millis is None):
+            raise ValueError("time column presence must match")
+        out = ZKeyIndex.__new__(ZKeyIndex)
+        out._x = np.concatenate([self._x, np.asarray(x, dtype=np.float64)])
+        out._y = np.concatenate([self._y, np.asarray(y, dtype=np.float64)])
+        out._millis = (None if millis is None else np.concatenate(
+            [self._millis, np.asarray(millis, dtype=np.int64)]))
+        out.period = self.period
+        out.n = len(out._x)
+        out._perm_dtype()  # enforce the row cap before any merge work
+        out._z3 = self._merged_z3(x, y, millis) if self._z3 else None
+        out._z2 = self._merged_z2(x, y) if self._z2 else None
+        return out
+
+    def _merged_z2(self, x, y):
+        z_sorted, perm = self._z2
+        dz = z2sfc().index(np.asarray(x, dtype=np.float64),
+                           np.asarray(y, dtype=np.float64),
+                           lenient=True).astype(np.int64)
+        dorder = np.argsort(dz, kind="stable")
+        dzs = dz[dorder]
+        # side="right": appended rows land after equal existing keys,
+        # preserving stable insertion order
+        pos = np.searchsorted(z_sorted, dzs, side="right")
+        new_z = np.insert(z_sorted, pos, dzs)
+        new_perm = np.insert(perm, pos,
+                             (dorder + self.n).astype(perm.dtype))
+        return (new_z, new_perm)
+
+    def _merged_z3(self, x, y, millis):
+        ubins, seg_offsets, z_sorted, perm = self._z3
+        sfc = z3sfc(self.period)
+        dbins, doffs = timebin.to_binned(
+            np.asarray(millis, dtype=np.int64), self.period, lenient=True)
+        dz = sfc.index(np.asarray(x, dtype=np.float64),
+                       np.asarray(y, dtype=np.float64),
+                       doffs.astype(np.float64), lenient=True).astype(np.int64)
+        dorder = np.lexsort((dz, dbins))
+        dbs, dzs = dbins[dorder], dz[dorder]
+        pos = np.empty(len(dbs), dtype=np.int64)
+        # per-unique-delta-bin: binary search within the bin's segment
+        # (few distinct bins per write burst)
+        for b in np.unique(dbs):
+            m = dbs == b
+            i = int(np.searchsorted(ubins, b))
+            if i < len(ubins) and int(ubins[i]) == b:
+                s, e = int(seg_offsets[i]), int(seg_offsets[i + 1])
+                pos[m] = s + np.searchsorted(z_sorted[s:e], dzs[m],
+                                             side="right")
+            else:
+                # a bin the table has not seen: insert at the boundary
+                pos[m] = int(seg_offsets[i])
+        counts = np.diff(seg_offsets)
+        bins_sorted = np.repeat(ubins, counts)
+        new_z = np.insert(z_sorted, pos, dzs)
+        new_bins = np.insert(bins_sorted, pos, dbs)
+        new_perm = np.insert(perm, pos,
+                             (dorder + self.n).astype(perm.dtype))
+        # new_bins is sorted: segment bounds from value changes, no sort
+        steps = np.flatnonzero(new_bins[1:] != new_bins[:-1]) + 1
+        seg_starts = np.concatenate([[0], steps])
+        ubins2 = new_bins[seg_starts]
+        seg_offsets2 = np.append(seg_starts, len(new_bins))
+        return (ubins2, seg_offsets2, new_z, new_perm)
+
     # -- candidates --------------------------------------------------------
 
     def candidates_z3(self, boxes, intervals_ms, *,

@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .zscan import MILLIS_PER_DAY, _next_pow2
+from .zscan import MILLIS_PER_DAY, next_pow2
 
 __all__ = ["ExtentScanData", "build_extent_data", "extent_query",
            "extent_tristate", "PackedPolygon", "pack_polygon",
@@ -118,7 +118,7 @@ class ExtentQuery:
 
 def extent_query(boxes_f64, intervals_ms=None) -> ExtentQuery:
     boxes_f64 = list(boxes_f64)
-    k = _next_pow2(max(len(boxes_f64), 1))
+    k = next_pow2(max(len(boxes_f64), 1))
     outer = np.zeros((k, 4), np.float32)
     inner = np.zeros((k, 4), np.float32)
     valid = np.zeros(k, dtype=bool)
@@ -133,7 +133,7 @@ def extent_query(boxes_f64, intervals_ms=None) -> ExtentQuery:
 
     intervals_ms = list(intervals_ms or [])
     time_any = not intervals_ms
-    b = _next_pow2(max(len(intervals_ms), 1))
+    b = next_pow2(max(len(intervals_ms), 1))
     times = np.zeros((b, 4), np.int32)
     tvalid = np.zeros(b, dtype=bool)
     for i, (lo, hi) in enumerate(intervals_ms):
@@ -220,7 +220,7 @@ def pack_polygon(poly) -> PackedPolygon:
         b = np.roll(a, -1, axis=0)
         segs.append(np.concatenate([a, b], axis=1))
     e = np.concatenate(segs, axis=0) if segs else np.zeros((0, 4))
-    ne = _next_pow2(max(len(e), 1))
+    ne = next_pow2(max(len(e), 1))
     edges = np.zeros((ne, 4), np.float32)
     edges[: len(e)] = e.astype(np.float32)
     valid = np.zeros(ne, dtype=bool)
@@ -276,7 +276,7 @@ def points_in_polygon_device(px: np.ndarray, py: np.ndarray,
     # doesn't retrace/recompile the kernel (same reason edges/query
     # boxes are padded); the fill point is far outside any geometry so
     # it lands inside=False, band=False and is sliced away below
-    np_pad = _next_pow2(max(n, 1))
+    np_pad = next_pow2(max(n, 1))
     px32 = np.full(np_pad, 1e9, np.float32)
     py32 = np.full(np_pad, 1e9, np.float32)
     px32[:n] = np.asarray(px, np.float64).astype(np.float32)

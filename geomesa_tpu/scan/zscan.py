@@ -28,8 +28,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["DeviceScanData", "ScanQuery", "build_scan_data", "make_query",
-           "scan_mask", "scan_mask_at", "split_two_float", "MILLIS_PER_DAY"]
+__all__ = ["DeviceScanData", "ScanQuery", "build_scan_data",
+           "extend_scan_data", "make_query", "next_pow2", "scan_mask", "scan_mask_at",
+           "split_two_float", "MILLIS_PER_DAY"]
 
 MILLIS_PER_DAY = 86_400_000
 
@@ -48,7 +49,13 @@ def split_two_float(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
 @dataclasses.dataclass
 class DeviceScanData:
-    """Device-resident columns for the spatio-temporal scan."""
+    """Device-resident columns for the spatio-temporal scan.
+
+    Arrays may be longer than ``n`` (capacity padding): the write path
+    allocates power-of-two capacity and appends in place with
+    dynamic_update_slice, so incremental writes keep STATIC shapes —
+    no per-flush XLA recompiles of the scan or the append. Kernels mask
+    rows >= n."""
     xhi: jax.Array
     xlo: jax.Array
     yhi: jax.Array
@@ -58,21 +65,68 @@ class DeviceScanData:
     n: int
 
     @property
+    def cap(self) -> int:
+        return int(self.xhi.shape[0])
+
+    @property
     def nbytes(self) -> int:
-        return self.n * (4 * 4 + 2 * 4)
+        return self.cap * (4 * 4 + 2 * 4)
 
 
-def build_scan_data(x: np.ndarray, y: np.ndarray, millis: np.ndarray,
-                    device=None) -> DeviceScanData:
-    """Host f64 coords + epoch millis -> device arrays."""
-    xhi, xlo = split_two_float(x)
-    yhi, ylo = split_two_float(y)
+def _split_time(millis) -> tuple[np.ndarray, np.ndarray]:
     millis = np.asarray(millis, dtype=np.int64)
     tday = (millis // MILLIS_PER_DAY).astype(np.int32)
     tms = (millis - tday.astype(np.int64) * MILLIS_PER_DAY).astype(np.int32)
+    return tday, tms
+
+
+def build_scan_data(x: np.ndarray, y: np.ndarray, millis: np.ndarray,
+                    device=None, cap: int | None = None) -> DeviceScanData:
+    """Host f64 coords + epoch millis -> device arrays, zero-padded to
+    ``cap`` rows when given (capacity headroom for in-place appends)."""
+    xhi, xlo = split_two_float(x)
+    yhi, ylo = split_two_float(y)
+    tday, tms = _split_time(millis)
+    n = len(xhi)
+    if cap is not None and cap > n:
+        def padded(a):
+            return np.pad(a, (0, cap - n))
+        xhi, xlo, yhi, ylo, tday, tms = (
+            padded(a) for a in (xhi, xlo, yhi, ylo, tday, tms))
     put = functools.partial(jax.device_put, device=device)
     return DeviceScanData(put(xhi), put(xlo), put(yhi), put(ylo),
-                          put(tday), put(tms), len(xhi))
+                          put(tday), put(tms), n)
+
+
+@jax.jit
+def _update1(a, u, i):
+    return jax.lax.dynamic_update_slice(a, u, (i,))
+
+
+def extend_scan_data(data: DeviceScanData, x, y,
+                     millis) -> DeviceScanData | None:
+    """Append rows in place within existing capacity, or None when the
+    capacity is exhausted (caller rebuilds with fresh headroom). The
+    delta is padded to a power of two so the device program is reused
+    across write bursts of any size."""
+    d = len(x)
+    if d == 0:
+        return data
+    k = next_pow2(d)
+    if data.n + k > data.cap:
+        return None
+    xhi, xlo = split_two_float(np.asarray(x, dtype=np.float64))
+    yhi, ylo = split_two_float(np.asarray(y, dtype=np.float64))
+    tday, tms = _split_time(millis)
+
+    def padded(a):
+        return jnp.asarray(np.pad(a, (0, k - d)))
+    i = data.n  # python int traces as a dynamic scalar: no retrace
+    return DeviceScanData(
+        _update1(data.xhi, padded(xhi), i), _update1(data.xlo, padded(xlo), i),
+        _update1(data.yhi, padded(yhi), i), _update1(data.ylo, padded(ylo), i),
+        _update1(data.tday, padded(tday), i), _update1(data.tms, padded(tms), i),
+        data.n + d)
 
 
 @dataclasses.dataclass
@@ -97,7 +151,7 @@ class ScanQuery:
     host_intervals: np.ndarray   # (n_intervals, 2) i64 inclusive millis
 
 
-def _next_pow2(n: int) -> int:
+def next_pow2(n: int) -> int:
     p = 1
     while p < n:
         p *= 2
@@ -112,7 +166,7 @@ def make_query(boxes_f64, intervals_ms) -> ScanQuery:
       or None/[] for no time constraint.
     """
     boxes_f64 = list(boxes_f64)
-    k = max(_next_pow2(max(len(boxes_f64), 1)), 1)
+    k = max(next_pow2(max(len(boxes_f64), 1)), 1)
     boxes = np.zeros((k, 8), dtype=np.float32)
     valid = np.zeros(k, dtype=bool)
     host_boxes = np.zeros((len(boxes_f64), 4), dtype=np.float64)
@@ -130,7 +184,7 @@ def make_query(boxes_f64, intervals_ms) -> ScanQuery:
 
     intervals_ms = list(intervals_ms or [])
     time_any = not intervals_ms
-    b = max(_next_pow2(max(len(intervals_ms), 1)), 1)
+    b = max(next_pow2(max(len(intervals_ms), 1)), 1)
     times = np.zeros((b, 4), dtype=np.int32)
     tvalid = np.zeros(b, dtype=bool)
     for i, (lo, hi) in enumerate(intervals_ms):
@@ -157,7 +211,8 @@ def _le_two_float(hi, lo, b_hi, b_lo):
 
 
 def _mask_body(xhi, xlo, yhi, ylo, tday, tms,
-               boxes, box_valid, times, time_valid, time_any: bool):
+               boxes, box_valid, times, time_valid, time_any: bool,
+               n_valid=None):
     # spatial: any valid box contains the point — (n, K) broadcast
     bx = boxes[None, :, :]                      # (1, K, 8)
     sx = (_ge_two_float(xhi[:, None], xlo[:, None], bx[..., 0], bx[..., 1])
@@ -165,6 +220,9 @@ def _mask_body(xhi, xlo, yhi, ylo, tday, tms,
           & _ge_two_float(yhi[:, None], ylo[:, None], bx[..., 4], bx[..., 5])
           & _le_two_float(yhi[:, None], ylo[:, None], bx[..., 6], bx[..., 7]))
     spatial = jnp.any(sx & box_valid[None, :], axis=1)
+    # capacity-padded rows (>= n_valid) are never matches
+    if n_valid is not None:
+        spatial = spatial & (jnp.arange(xhi.shape[0]) < n_valid)
     if time_any:
         return spatial
     tx = times[None, :, :]                      # (1, B, 4)
@@ -201,7 +259,7 @@ def scan_mask_at(data: DeviceScanData, q: ScanQuery,
     m = len(rows)
     if m == 0:
         return np.zeros(0, dtype=bool)
-    k = _next_pow2(m)
+    k = next_pow2(m)
     # pad in the rows' own dtype (row counts are capped at int32 range
     # by ZKeyIndex._perm_dtype; device gathers are 32-bit)
     idx = np.zeros(k, dtype=rows.dtype)
@@ -214,11 +272,13 @@ def scan_mask_at(data: DeviceScanData, q: ScanQuery,
 
 
 def scan_mask(data: DeviceScanData, q: ScanQuery) -> jax.Array:
-    """Run the fused scan; returns a device bool[n] mask."""
+    """Run the fused scan; returns a device bool[cap] mask whose
+    capacity-padding tail (rows >= data.n) is always False."""
+    n_valid = None if data.cap == data.n else data.n
     return _scan_mask(data.xhi, data.xlo, data.yhi, data.ylo,
                       data.tday, data.tms,
                       q.boxes, q.box_valid, q.times, q.time_valid,
-                      q.time_any)
+                      q.time_any, n_valid)
 
 
 def boundary_candidates(data_xhi: np.ndarray, data_yhi: np.ndarray,

@@ -62,11 +62,21 @@ class QueryResult:
 
 
 class _TypeState:
-    """Per-feature-type storage: host batch + lazily-built device index."""
+    """Per-feature-type storage: host batch + lazily-built device index.
+
+    Writes are LSM-style: appends land in a pending buffer (O(delta));
+    the first read flushes the buffer — one concat, and already-built
+    sort orders are MERGED with the delta (ZKeyIndex.extend sorted-run
+    merge, device-side scan-array concat) instead of rebuilt from
+    scratch. The reference gets the same shape from BatchWriter
+    mutations merging into tablets at minor compaction
+    (accumulo/util/GeoMesaBatchWriterConfig.scala)."""
 
     def __init__(self, sft: SimpleFeatureType):
         self.sft = sft
-        self.batch: FeatureBatch | None = None
+        self._batch: FeatureBatch | None = None
+        self._pending: list[tuple[FeatureBatch, np.ndarray]] = []
+        self._pending_n = 0
         self.scan_data: zscan.DeviceScanData | None = None
         self.extent_data = None  # gscan.ExtentScanData for non-points
         self.zindex = None       # index.zkeys.ZKeyIndex for points
@@ -84,7 +94,13 @@ class _TypeState:
 
     @property
     def n(self) -> int:
-        return 0 if self.batch is None else self.batch.n
+        return (0 if self._batch is None else self._batch.n) \
+            + self._pending_n
+
+    @property
+    def batch(self) -> FeatureBatch | None:
+        self.flush()
+        return self._batch
 
     def append(self, batch: FeatureBatch, visibilities=None):
         # validate everything BEFORE mutating: a failed write must not
@@ -100,17 +116,76 @@ class _TypeState:
             parse_visibility(str(e))  # raises on malformed expressions
         if distinct:
             self.has_vis = True
-        self.batch = batch if self.batch is None else self.batch.concat(batch)
-        self.vis = np.concatenate([self.vis, vis])
+        self._pending.append((batch, vis))
+        self._pending_n += batch.n
+
+    def flush(self):
+        """Materialize pending appends: one concat for the burst, then
+        incremental index maintenance when the index is already built."""
+        if not self._pending:
+            return
+        delta = FeatureBatch.concat_all([b for b, _ in self._pending])
+        base = self._batch
+        can_merge = (base is not None and not self.dirty
+                     and self.scan_data is not None
+                     and self.zindex is not None)
+        # build everything BEFORE mutating state: a MemoryError on the
+        # big concat must leave the store consistent (batch/vis/pending
+        # aligned), matching append()'s fail-atomic contract
+        new_batch = delta if base is None else base.concat(delta)
+        new_vis = np.concatenate([self.vis]
+                                 + [v for _, v in self._pending])
+        self._batch = new_batch
+        self.vis = new_vis
+        self._pending = []
+        self._pending_n = 0
+        # merged indexes go stale per-column; rebuild those lazily
         self.attr_idx.clear()
         self.devcols = None
+        # pessimistically dirty: if index maintenance below fails midway,
+        # the next read must rebuild rather than scan a short index
         self.dirty = True
+        if not can_merge:
+            return
+        geom = self.sft.geom_field
+        col = delta.col(geom) if geom else None
+        if not isinstance(col, PointColumn):
+            return
+        dtg = self.sft.dtg_field
+        dmillis = (delta.col(dtg).millis if dtg is not None
+                   else np.zeros(delta.n, dtype=np.int64))
+        scan_data = zscan.extend_scan_data(self.scan_data, col.x, col.y,
+                                           dmillis)
+        if scan_data is None:
+            # capacity exhausted: rebuild once with power-of-two
+            # headroom, then future bursts append in place again
+            gcol = self._batch.col(self.sft.geom_field)
+            fmillis = (self._batch.col(dtg).millis if dtg is not None
+                       else np.zeros(self._batch.n, dtype=np.int64))
+            scan_data = zscan.build_scan_data(
+                gcol.x, gcol.y, fmillis,
+                cap=zscan.next_pow2(self._batch.n + 1))
+        dxhi, _ = zscan.split_two_float(col.x)
+        dyhi, _ = zscan.split_two_float(col.y)
+        host_xhi = np.concatenate([self.host_xhi, dxhi])
+        host_yhi = np.concatenate([self.host_yhi, dyhi])
+        zindex = self.zindex.extend(
+            col.x, col.y, dmillis if dtg is not None else None)
+        # all three structures built: publish atomically
+        self.scan_data, self.host_xhi, self.host_yhi = \
+            scan_data, host_xhi, host_yhi
+        self.zindex = zindex
+        self.dirty = False
 
     def delete(self, ids: set[str]):
-        if self.batch is None:
+        # dirty first: the flush skips merge work the delete is about to
+        # invalidate anyway
+        self.dirty = True
+        self.flush()
+        if self._batch is None:
             return
-        keep = ~np.isin(self.batch.ids.astype(str), list(ids))
-        self.batch = self.batch.take(np.flatnonzero(keep))
+        keep = ~np.isin(self._batch.ids.astype(str), list(ids))
+        self._batch = self._batch.take(np.flatnonzero(keep))
         self.vis = self.vis[keep]
         self.attr_idx.clear()
         self.devcols = None
@@ -118,6 +193,7 @@ class _TypeState:
 
     def ensure_index(self):
         """(Re)build device arrays if writes happened."""
+        self.flush()  # may maintain the index incrementally
         if not self.dirty and (self.scan_data is not None
                                or self.extent_data is not None):
             return
@@ -159,6 +235,7 @@ class _TypeState:
     def attr_index(self, name: str):
         """Sorted attribute index for one column, built on first use
         (AttributeIndex analog; see index/attr.py)."""
+        self.flush()  # cached indexes must cover pending rows
         if name not in self.attr_idx:
             from ..index.attr import AttributeKeyIndex
             try:
@@ -169,6 +246,7 @@ class _TypeState:
         return self.attr_idx[name]
 
     def device_cols(self):
+        self.flush()  # cached uploads must cover pending rows
         if self.devcols is None:
             from ..scan.residual import DeviceColumns
             self.devcols = DeviceColumns(self.batch)
@@ -578,7 +656,7 @@ class InMemoryDataStore:
         else:
             explain(f"Device scan: {len(boxes)} box(es), "
                     f"{len(intervals)} interval(s), n={st.n}")
-            mask = np.asarray(zscan.scan_mask(st.scan_data, sq))
+            mask = np.asarray(zscan.scan_mask(st.scan_data, sq))[:st.n]
             mask = patch_boundaries(mask, st.host_xhi, st.host_yhi, None)
             idx = np.flatnonzero(mask)
 

@@ -1,0 +1,197 @@
+"""Incremental write path: append buffering + sorted-run index merges
+must give identical results to a from-scratch rebuild, with re-index
+work proportional to the delta (the LSM/BatchWriter analog)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.filters import evaluate, parse_ecql
+from geomesa_tpu.index.zkeys import ZKeyIndex
+from geomesa_tpu.store import InMemoryDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+
+
+def make_data(rng, n, t0="2019-01-01", t1="2019-06-01"):
+    return {
+        "name": [f"n{i % 5}" for i in range(n)],
+        "dtg": rng.integers(MS(t0), MS(t1), n),
+        "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+    }
+
+
+class TestZKeyMerge:
+    """ZKeyIndex.extend == building from the concatenated arrays."""
+
+    @pytest.mark.parametrize("with_time", [True, False])
+    def test_merged_equals_rebuilt(self, with_time):
+        rng = np.random.default_rng(11)
+        n, d = 50_000, 3_000
+        x = rng.uniform(-180, 180, n + d)
+        y = rng.uniform(-90, 90, n + d)
+        ms = rng.integers(MS("2019-01-01"), MS("2019-03-01"), n + d)
+        base = ZKeyIndex(x[:n], y[:n], ms[:n] if with_time else None)
+        # build both orders before extending so the merge path runs
+        if with_time:
+            base._build_z3()
+        base._build_z2()
+        merged = base.extend(x[n:], y[n:], ms[n:] if with_time else None)
+        # merged orders exist without a query (they were merged, not
+        # lazily dropped for rebuild)
+        assert merged._z2 is not None
+        if with_time:
+            assert merged._z3 is not None
+        fresh = ZKeyIndex(x, y, ms if with_time else None)
+        boxes = [(-10.0, -10.0, 25.0, 30.0), (100.0, 40.0, 140.0, 80.0)]
+        ivals = [(MS("2019-01-10"), MS("2019-01-20"))]
+        for b in (boxes[:1], boxes):
+            got = merged.candidates_z2(b)
+            want = fresh.candidates_z2(b)
+            assert np.array_equal(np.sort(got), np.sort(want))
+            if with_time:
+                got = merged.candidates_z3(b, ivals)
+                want = fresh.candidates_z3(b, ivals)
+                assert np.array_equal(np.sort(got), np.sort(want))
+
+    def test_merge_into_new_time_bins(self):
+        # delta rows in bins the base never saw (incl. before & after)
+        rng = np.random.default_rng(12)
+        n, d = 20_000, 500
+        x = rng.uniform(-50, 50, n + d)
+        y = rng.uniform(-50, 50, n + d)
+        ms = np.concatenate([
+            rng.integers(MS("2019-02-01"), MS("2019-02-15"), n),
+            rng.integers(MS("2021-01-01"), MS("2021-01-05"), d // 2),
+            rng.integers(MS("2017-01-01"), MS("2017-01-05"), d - d // 2),
+        ])
+        base = ZKeyIndex(x[:n], y[:n], ms[:n])
+        base._build_z3()
+        merged = base.extend(x[n:], y[n:], ms[n:])
+        fresh = ZKeyIndex(x, y, ms)
+        boxes = [(-20.0, -20.0, 20.0, 20.0)]
+        for iv in [(MS("2021-01-01"), MS("2021-02-01")),
+                   (MS("2017-01-01"), MS("2019-03-01")),
+                   (MS("2016-01-01"), MS("2022-01-01"))]:
+            got = merged.candidates_z3(boxes, [iv])
+            want = fresh.candidates_z3(boxes, [iv])
+            assert np.array_equal(np.sort(got), np.sort(want))
+
+    def test_sort_invariant_after_merge(self):
+        rng = np.random.default_rng(13)
+        x = rng.uniform(-180, 180, 5_000)
+        y = rng.uniform(-90, 90, 5_000)
+        base = ZKeyIndex(x[:4000], y[:4000], None)
+        base._build_z2()
+        merged = base.extend(x[4000:], y[4000:], None)
+        z_sorted, perm = merged._z2
+        assert np.all(np.diff(z_sorted) >= 0)
+        assert len(np.unique(perm)) == 5_000
+
+
+class TestStoreIncrementalWrites:
+    def test_appends_buffer_until_read(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("t", SPEC))
+        rng = np.random.default_rng(14)
+        st = ds._state("t")
+        for i in range(10):
+            ds.write_dict("t", [f"a{i}-{j}" for j in range(100)],
+                          make_data(rng, 100))
+        assert st._pending_n == 1_000  # nothing materialized yet
+        assert st.n == 1_000
+        assert ds.query("BBOX(geom, -180, -90, 180, 90)", "t").n == 1_000
+        assert st._pending_n == 0
+
+    def test_incremental_index_matches_oracle(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("t", SPEC))
+        rng = np.random.default_rng(15)
+        n = 100_000
+        ds.write_dict("t", [f"b{i}" for i in range(n)], make_data(rng, n))
+        ecql = ("BBOX(geom, -30, -20, 40, 35) AND "
+                "dtg DURING 2019-02-01T00:00:00Z/2019-03-01T00:00:00Z")
+        res = ds.query(ecql, "t")  # builds the index
+        st = ds._state("t")
+        assert st.zindex is not None and not st.dirty
+        # appended rows merge into the existing index, no full rebuild
+        d = 5_000
+        ds.write_dict("t", [f"c{i}" for i in range(d)],
+                      make_data(rng, d, "2019-02-05", "2019-02-20"))
+        res2 = ds.query(ecql, "t")
+        assert not st.dirty  # incremental path kept the index valid
+        assert st.zindex.n == n + d
+        oracle = set(st.batch.ids[evaluate(parse_ecql(ecql),
+                                           st.batch)].astype(str))
+        assert set(res2.ids.astype(str)) == oracle
+        assert res2.n > res.n  # delta rows actually landed in the window
+
+    def test_capacity_growth_across_many_bursts(self):
+        # repeated bursts cross the power-of-two capacity boundary
+        # several times; results stay exact and shapes stay padded
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("t", SPEC))
+        rng = np.random.default_rng(19)
+        ecql = "BBOX(geom, -90, -45, 90, 45)"
+        total = 0
+        for burst in (1_000, 30, 30, 2_000, 30, 5_000, 30):
+            ds.write_dict("t", [f"g{total + i}" for i in range(burst)],
+                          make_data(rng, burst))
+            total += burst
+            res = ds.query(ecql, "t")
+            st = ds._state("t")
+            oracle = set(st.batch.ids[evaluate(parse_ecql(ecql),
+                                               st.batch)].astype(str))
+            assert set(res.ids.astype(str)) == oracle
+            assert st.scan_data.n == total
+            assert st.scan_data.cap >= total
+
+    def test_delete_forces_rebuild_and_stays_correct(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("t", SPEC))
+        rng = np.random.default_rng(16)
+        ds.write_dict("t", [f"r{i}" for i in range(1_000)],
+                      make_data(rng, 1_000))
+        ds.query("BBOX(geom, -180, -90, 180, 90)", "t")
+        ds.write_dict("t", ["extra1", "extra2"], make_data(rng, 2))
+        ds.delete("t", ["r5", "extra1"])
+        st = ds._state("t")
+        assert st.dirty
+        res = ds.query("BBOX(geom, -180, -90, 180, 90)", "t")
+        ids = set(res.ids.astype(str))
+        assert res.n == 1_000 and "r5" not in ids and "extra1" not in ids
+        assert "extra2" in ids
+
+    def test_visibility_spans_pending_writes(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("t", SPEC))
+        rng = np.random.default_rng(17)
+        ds.write_dict("t", ["p1"], make_data(rng, 1))
+        ds.query("INCLUDE", "t")
+        ds.write_dict("t", ["p2"], make_data(rng, 1),
+                      visibilities=["secret"])
+        from geomesa_tpu.index.api import Query
+        assert {str(i) for i in ds.query(
+            Query("t", auths=[])).ids} == {"p1"}
+        assert {str(i) for i in ds.query(
+            Query("t", auths=["secret"])).ids} == {"p1", "p2"}
+
+    def test_mixed_bursts_and_queries(self):
+        # interleave writes and queries; every answer matches brute force
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("t", SPEC))
+        rng = np.random.default_rng(18)
+        ecql = "BBOX(geom, -90, -45, 90, 45)"
+        total = 0
+        for burst in (2_000, 1, 999, 3_000):
+            ds.write_dict("t", [f"m{total + i}" for i in range(burst)],
+                          make_data(rng, burst))
+            total += burst
+            res = ds.query(ecql, "t")
+            st = ds._state("t")
+            oracle = set(st.batch.ids[evaluate(parse_ecql(ecql),
+                                               st.batch)].astype(str))
+            assert set(res.ids.astype(str)) == oracle
+            assert st.n == total
