@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..scan.zscan import MILLIS_PER_DAY, split_two_float
+from ..scan.zscan import MILLIS_PER_DAY, next_pow2, split_two_float
 
 __all__ = ["TubeBuilder", "tube_select_mask"]
 
@@ -104,9 +104,7 @@ def tube_select_mask(data, boxes: np.ndarray,
     k = len(boxes)
     if k == 0:
         return np.zeros(data.n, dtype=bool)
-    p = 1
-    while p < k:
-        p *= 2
+    p = next_pow2(k)
     bx = np.zeros((p, 8), np.float32)
     tm = np.zeros((p, 4), np.int32)
     valid = np.zeros(p, bool)

@@ -103,20 +103,24 @@ def _update1(a, u, i):
     return jax.lax.dynamic_update_slice(a, u, (i,))
 
 
-def extend_scan_data(data: DeviceScanData, x, y,
-                     millis) -> DeviceScanData | None:
+def extend_scan_data(data: DeviceScanData, x, y, millis,
+                     xy_split=None) -> DeviceScanData | None:
     """Append rows in place within existing capacity, or None when the
     capacity is exhausted (caller rebuilds with fresh headroom). The
     delta is padded to a power of two so the device program is reused
-    across write bursts of any size."""
+    across write bursts of any size. ``xy_split`` passes precomputed
+    (xhi, xlo, yhi, ylo) two-float pairs to avoid re-splitting."""
     d = len(x)
     if d == 0:
         return data
     k = next_pow2(d)
     if data.n + k > data.cap:
         return None
-    xhi, xlo = split_two_float(np.asarray(x, dtype=np.float64))
-    yhi, ylo = split_two_float(np.asarray(y, dtype=np.float64))
+    if xy_split is None:
+        xhi, xlo = split_two_float(np.asarray(x, dtype=np.float64))
+        yhi, ylo = split_two_float(np.asarray(y, dtype=np.float64))
+    else:
+        xhi, xlo, yhi, ylo = xy_split
     tday, tms = _split_time(millis)
 
     def padded(a):

@@ -35,10 +35,17 @@ from ..index.api import Explainer, FilterStrategy, Query, QueryHints
 from ..index.planner import decide_strategy
 from ..scan import gscan, zscan
 from ..stats import DataStoreStats, parse_stat
+from ..utils.properties import SystemProperty
 from ..utils.threads import ThreadManagement
 
 # process-wide query reaper (ThreadManagement.scala's 5s sweep)
 _REAPER = ThreadManagement()
+
+# dense-scan kernel selection: "xla" (default) or "pallas" — the
+# hand-tiled kernel (scan/pallas_scan.py) is numerically identical and
+# parity-tested; the flag mirrors the reference's pluggable iterator
+# stack selection (AccumuloIndexAdapter.scanConfig choosing iterators)
+SCAN_KERNEL = SystemProperty("geomesa.scan.kernel", "xla")
 
 __all__ = ["InMemoryDataStore", "QueryResult"]
 
@@ -86,6 +93,8 @@ class _TypeState:
         self.attr_idx: dict[str, Any] = {}
         # lazy device uploads of attribute columns for residual kernels
         self.devcols = None  # scan.residual.DeviceColumns
+        # lazily-built tiled columns for the Pallas kernel (flag-gated)
+        self.pallas_data = None
         self.dirty = False
         # per-feature visibility expressions (None = world-readable);
         # has_vis avoids an O(n) object-array scan on every query
@@ -142,6 +151,7 @@ class _TypeState:
         # merged indexes go stale per-column; rebuild those lazily
         self.attr_idx.clear()
         self.devcols = None
+        self.pallas_data = None
         # pessimistically dirty: if index maintenance below fails midway,
         # the next read must rebuild rather than scan a short index
         self.dirty = True
@@ -154,8 +164,11 @@ class _TypeState:
         dtg = self.sft.dtg_field
         dmillis = (delta.col(dtg).millis if dtg is not None
                    else np.zeros(delta.n, dtype=np.int64))
-        scan_data = zscan.extend_scan_data(self.scan_data, col.x, col.y,
-                                           dmillis)
+        dxhi, dxlo = zscan.split_two_float(col.x)
+        dyhi, dylo = zscan.split_two_float(col.y)
+        scan_data = zscan.extend_scan_data(
+            self.scan_data, col.x, col.y, dmillis,
+            xy_split=(dxhi, dxlo, dyhi, dylo))
         if scan_data is None:
             # capacity exhausted: rebuild once with power-of-two
             # headroom, then future bursts append in place again
@@ -165,8 +178,6 @@ class _TypeState:
             scan_data = zscan.build_scan_data(
                 gcol.x, gcol.y, fmillis,
                 cap=zscan.next_pow2(self._batch.n + 1))
-        dxhi, _ = zscan.split_two_float(col.x)
-        dyhi, _ = zscan.split_two_float(col.y)
         host_xhi = np.concatenate([self.host_xhi, dxhi])
         host_yhi = np.concatenate([self.host_yhi, dyhi])
         zindex = self.zindex.extend(
@@ -189,6 +200,7 @@ class _TypeState:
         self.vis = self.vis[keep]
         self.attr_idx.clear()
         self.devcols = None
+        self.pallas_data = None
         self.dirty = True
 
     def ensure_index(self):
@@ -251,6 +263,20 @@ class _TypeState:
             from ..scan.residual import DeviceColumns
             self.devcols = DeviceColumns(self.batch)
         return self.devcols
+
+    def pallas(self):
+        """Tiled device columns for the Pallas dense-scan kernel, built
+        on first use under the geomesa.scan.kernel=pallas flag."""
+        self.flush()
+        if self.pallas_data is None:
+            from ..scan.pallas_scan import build_pallas_data
+            geom = self.sft.geom_field
+            dtg = self.sft.dtg_field
+            col = self._batch.col(geom)
+            millis = (self._batch.col(dtg).millis if dtg is not None
+                      else np.zeros(self._batch.n, dtype=np.int64))
+            self.pallas_data = build_pallas_data(col.x, col.y, millis)
+        return self.pallas_data
 
 
 class InMemoryDataStore:
@@ -653,6 +679,13 @@ class InMemoryDataStore:
             sub = patch_boundaries(sub, st.host_xhi[rows],
                                    st.host_yhi[rows], rows)
             idx = np.sort(rows[sub])
+        elif SCAN_KERNEL.get() == "pallas":
+            from ..scan.pallas_scan import pallas_scan_mask
+            explain(f"Pallas device scan: {len(boxes)} box(es), "
+                    f"{len(intervals)} interval(s), n={st.n}")
+            mask = pallas_scan_mask(st.pallas(), sq)
+            mask = patch_boundaries(mask, st.host_xhi, st.host_yhi, None)
+            idx = np.flatnonzero(mask)
         else:
             explain(f"Device scan: {len(boxes)} box(es), "
                     f"{len(intervals)} interval(s), n={st.n}")
