@@ -24,8 +24,7 @@ from ..features.batch import (BoolColumn, DateColumn, FeatureBatch,
                               StringColumn)
 from ..geometry import Envelope, Point
 from . import ast
-from .helper import (METERS_MULTIPLIERS, distance_degrees, like_vocab_mask,
-                     to_millis)
+from .helper import dwithin_degrees, like_vocab_mask, to_millis
 
 __all__ = ["evaluate"]
 
@@ -227,8 +226,7 @@ def _interiors_intersect(a, b) -> bool:
 
 
 def _dwithin(f: ast.DWithin, b: FeatureBatch) -> np.ndarray:
-    mult = METERS_MULTIPLIERS.get(f.units, 1.0)
-    deg = distance_degrees(f.geom, f.distance * mult)
+    deg = dwithin_degrees(f.geom, f.distance, f.units)
     x, y, valid, gc = _geom_xy(b, f.prop)
     if gc is None and isinstance(f.geom, Point):
         dx = x - f.geom.x

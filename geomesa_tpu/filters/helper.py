@@ -32,7 +32,8 @@ T = TypeVar("T")
 
 __all__ = ["Bound", "Bounds", "FilterValues", "extract_geometries",
            "extract_intervals", "extract_attribute_bounds",
-           "is_filter_whole_world", "distance_degrees", "METERS_MULTIPLIERS"]
+           "is_filter_whole_world", "distance_degrees", "dwithin_degrees",
+           "METERS_MULTIPLIERS"]
 
 # ECQL distance units -> meters (FilterHelper.visitDwithin:93-101)
 METERS_MULTIPLIERS = {
@@ -61,6 +62,16 @@ def distance_degrees(geom: Geometry, meters: float) -> float:
             continue
         best = max(best, math.degrees(meters / circ))
     return best if best > 0 else math.degrees(meters / _WGS84_A)
+
+
+def dwithin_degrees(geom: Geometry, distance: float, units: str) -> float:
+    """DWithin distance -> planar degrees. ECQL units convert via
+    meters; 'degrees' passes through (the Spark-SQL ST_DWithin
+    semantics — CRS units, SQLSpatialFunctions)."""
+    if units == "degrees":
+        return float(distance)
+    return distance_degrees(geom,
+                            distance * METERS_MULTIPLIERS.get(units, 1.0))
 
 
 def to_millis(v) -> int:
@@ -364,8 +375,7 @@ def _extract_geoms(f: ast.Filter, attribute: str | None,
     if isinstance(f, ast.DWithin):
         if attribute is not None and f.prop != attribute:
             return FilterValues.empty()
-        mult = METERS_MULTIPLIERS.get(f.units, 1.0)
-        deg = distance_degrees(f.geom, f.distance * mult)
+        deg = dwithin_degrees(f.geom, f.distance, f.units)
         buffered = f.geom.envelope.buffer(deg).to_polygon()
         return FilterValues([p for g in _split_idl(buffered) for p in _flatten(g)])
     if isinstance(f, (ast.Intersects, ast.Contains, ast.Within,

@@ -1,0 +1,9 @@
+"""SQL surface: SELECT with ST_* predicates over datastores.
+
+The geomesa-spark-sql analog (see parser.py / engine.py for the
+STContainsRule / SpatialJoinStrategy mapping)."""
+
+from .engine import SqlEngine, SqlResult
+from .parser import SqlError, parse_sql
+
+__all__ = ["SqlEngine", "SqlResult", "parse_sql", "SqlError"]

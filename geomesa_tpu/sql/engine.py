@@ -1,0 +1,301 @@
+"""SQL execution: pushdown into the planner + device spatial joins.
+
+Mirrors the reference's two catalyst rules
+(/root/reference/geomesa-spark/geomesa-spark-sql/src/main/scala/org/
+apache/spark/sql/SQLRules.scala):
+
+- STContainsRule (:99): spatial/attribute predicates in WHERE are
+  rewritten to Filter AST at parse time and handed to the store's
+  planner as a Query — the same cost-based index selection the ECQL
+  path gets, so `SELECT ... WHERE ST_Contains(...)` and the equivalent
+  ECQL text produce identical plans and identical feature IDs.
+- SpatialJoinStrategy (:270): `JOIN b ON ST_DWithin/ST_Contains/
+  ST_Intersects` routes to the tiled device join kernels
+  (analytics/join.py) instead of a nested-loop evaluation, with
+  single-side WHERE conjuncts pushed below the join
+  (GeoMesaJoinRelation.buildScan:312-360).
+
+Aggregates (COUNT/MIN/MAX/SUM/AVG) reduce over the query result
+columns; ORDER BY / LIMIT push into Query.sort_by / max_features.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator
+
+import numpy as np
+
+from ..features.batch import (FeatureBatch, GeometryColumn, PointColumn)
+from ..filters import ast
+from ..index.api import Query
+from .parser import SelectItem, SqlJoin, SqlSelect, parse_sql
+
+__all__ = ["SqlEngine", "SqlResult"]
+
+
+@dataclasses.dataclass
+class SqlResult:
+    """Columnar result table."""
+    names: list[str]
+    columns: dict[str, np.ndarray]
+
+    @property
+    def n(self) -> int:
+        return 0 if not self.names else len(self.columns[self.names[0]])
+
+    def rows(self) -> Iterator[tuple]:
+        cols = [self.columns[n] for n in self.names]
+        for i in range(self.n):
+            yield tuple(c[i] for c in cols)
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+
+def _strip_qualifier(f: ast.Filter, alias: str) -> ast.Filter:
+    """Rewrite 'alias.col' props to 'col' for single-table execution."""
+    def fix(name: str) -> str:
+        if "." in name:
+            q, col = name.split(".", 1)
+            if q != alias:
+                raise ValueError(f"unknown table qualifier {q!r}")
+            return col
+        return name
+    return _map_props(f, fix)
+
+
+def _map_props(f: ast.Filter, fix) -> ast.Filter:
+    if isinstance(f, (ast.And, ast.Or)):
+        return type(f)([_map_props(c, fix) for c in f.children])
+    if isinstance(f, ast.Not):
+        return ast.Not(_map_props(f.child, fix))
+    if hasattr(f, "prop"):
+        return dataclasses.replace(f, prop=fix(f.prop)) \
+            if dataclasses.is_dataclass(f) else _rebuild(f, fix)
+    return f
+
+
+def _rebuild(f: ast.Filter, fix):
+    # SpatialPredicate subclasses are dataclass-free: rebuild by type
+    return type(f)(fix(f.prop), f.geom)
+
+
+def _qualifier_of(f: ast.Filter) -> set[str]:
+    """Table qualifiers referenced by the filter (empty = unqualified)."""
+    out: set[str] = set()
+    for node in _walk(f):
+        prop = getattr(node, "prop", None)
+        if prop and "." in prop:
+            out.add(prop.split(".", 1)[0])
+        elif prop:
+            out.add("")
+    return out
+
+
+def _walk(f: ast.Filter):
+    yield f
+    for c in getattr(f, "children", ()) or ():
+        yield from _walk(c)
+    child = getattr(f, "child", None)
+    if child is not None:
+        yield from _walk(child)
+
+
+def _centroids(batch: FeatureBatch, geom_field: str):
+    col = batch.col(geom_field)
+    if isinstance(col, PointColumn):
+        return col.x, col.y
+    b = col.bounds
+    return (b[:, 0] + b[:, 2]) / 2, (b[:, 1] + b[:, 3]) / 2
+
+
+class SqlEngine:
+    """Executes SELECTs against one datastore's feature types."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def query(self, text: str) -> SqlResult:
+        sel = parse_sql(text)
+        if sel.join is not None:
+            return self._join_query(sel)
+        return self._single_table(sel)
+
+    # -- single table ------------------------------------------------------
+
+    def _single_table(self, sel: SqlSelect) -> SqlResult:
+        where = (_strip_qualifier(sel.where, sel.alias)
+                 if sel.where is not None else ast.Include())
+        aggs = [i for i in sel.items if i.agg]
+        plain = [i for i in sel.items if not i.agg]
+        if aggs and plain:
+            raise ValueError("cannot mix aggregates and plain columns "
+                             "(no GROUP BY support)")
+        order = sel.order_by
+        if order and "." in order:
+            order = order.split(".", 1)[1]
+        q = Query(sel.table, where,
+                  sort_by=None if aggs else order,
+                  sort_desc=sel.order_desc,
+                  max_features=None if aggs else sel.limit)
+        res = self.store.query(q)
+        if aggs:
+            return self._aggregate(aggs, res.batch, res.n)
+        return self._project(plain, res.batch, res.ids, sel.alias)
+
+    def _aggregate(self, items: list[SelectItem], batch, n: int) -> SqlResult:
+        names, cols = [], {}
+        for it in items:
+            name = it.name
+            names.append(name)
+            if it.agg == "count":
+                cols[name] = np.array([n], dtype=np.int64)
+                continue
+            col = batch.col(it.expr.split(".")[-1]) if batch else None
+            if col is None or n == 0:
+                cols[name] = np.array([None], dtype=object)
+                continue
+            vals = getattr(col, "values", None)
+            if vals is None:
+                vals = getattr(col, "millis", None)
+            if vals is None:
+                raise ValueError(f"cannot aggregate column {it.expr}")
+            vals = vals[col.valid]
+            fn = {"min": np.min, "max": np.max, "sum": np.sum,
+                  "avg": np.mean}[it.agg]
+            cols[name] = np.array([fn(vals) if len(vals) else None])
+        return SqlResult(names, cols)
+
+    def _project(self, items: list[SelectItem], batch, ids,
+                 alias: str) -> SqlResult:
+        if batch is None:
+            return SqlResult(["__fid__"], {"__fid__": np.empty(0, object)})
+        names: list[str] = []
+        cols: dict[str, np.ndarray] = {}
+
+        def add(name: str, arr):
+            names.append(name)
+            cols[name] = arr
+
+        star = any(i.expr == "*" for i in items)
+        if star:
+            add("__fid__", ids)
+            for a in batch.sft.attributes:
+                c = batch.col(a.name)
+                add(a.name, np.array([c.value(i) for i in range(c.n)],
+                                     dtype=object))
+            return SqlResult(names, cols)
+        for it in items:
+            col_name = it.expr.split(".")[-1] if "." in it.expr else it.expr
+            if col_name in ("__fid__", "id"):
+                add(it.name, ids)
+                continue
+            c = batch.col(col_name)
+            add(it.name, np.array([c.value(i) for i in range(c.n)],
+                                  dtype=object))
+        return SqlResult(names, cols)
+
+    # -- joins -------------------------------------------------------------
+
+    def _join_query(self, sel: SqlSelect) -> SqlResult:
+        join = sel.join
+        left_alias, right_alias = sel.alias, join.alias
+        # push single-side WHERE conjuncts below the join
+        left_f: list[ast.Filter] = []
+        right_f: list[ast.Filter] = []
+        if sel.where is not None:
+            conjuncts = (list(sel.where.children)
+                         if isinstance(sel.where, ast.And) else [sel.where])
+            for c in conjuncts:
+                quals = _qualifier_of(c)
+                if quals <= {left_alias}:
+                    left_f.append(_strip_qualifier(c, left_alias))
+                elif quals <= {right_alias}:
+                    right_f.append(_strip_qualifier(c, right_alias))
+                else:
+                    raise ValueError(
+                        "WHERE conjuncts must reference one side only")
+
+        def side(table, fs):
+            f = (ast.And(fs) if len(fs) > 1 else fs[0]) if fs \
+                else ast.Include()
+            return self.store.query(Query(table, f))
+
+        lres = side(sel.table, left_f)
+        rres = side(join.table, right_f)
+        if lres.batch is None or rres.batch is None \
+                or lres.n == 0 or rres.n == 0:
+            pairs = np.empty((0, 2), dtype=np.int64)
+        else:
+            pairs = self._join_pairs(sel, join, lres, rres)
+        return self._project_join(sel, lres, rres, pairs,
+                                  left_alias, right_alias)
+
+    def _join_pairs(self, sel: SqlSelect, join: SqlJoin, lres, rres):
+        """Pairs (left_row, right_row) from the device join kernels."""
+        from ..analytics.join import contains_join, dwithin_join
+        a_alias, a_col = join.left_prop.split(".", 1)   # first ON arg
+        b_alias, b_col = join.right_prop.split(".", 1)  # second ON arg
+        sides = {sel.alias: lres, join.alias: rres}
+        if a_alias not in sides or b_alias not in sides:
+            raise ValueError("ON predicate must reference both tables")
+        a_res, b_res = sides[a_alias], sides[b_alias]
+        a_is_left = a_alias == sel.alias
+
+        if join.kind == "dwithin":
+            ax, ay = _centroids(a_res.batch, a_col)
+            bx, by = _centroids(b_res.batch, b_col)
+            _, pairs = dwithin_join(ax, ay, bx, by, join.distance)
+            # dwithin_join pairs are (a_idx, b_idx)
+        else:
+            # ST_Contains(a, b): a (polygons) contains b (points)
+            acol = a_res.batch.col(a_col)
+            if not isinstance(acol, GeometryColumn):
+                raise ValueError("contains join needs a polygon column "
+                                 "as the first ON argument")
+            bx, by = _centroids(b_res.batch, b_col)
+            _, pairs = contains_join(acol.geoms, bx, by)
+            # contains_join pairs are (point_idx, poly_idx) = (b, a)
+            if len(pairs):
+                pairs = pairs[:, ::-1]
+        if not a_is_left and len(pairs):
+            pairs = pairs[:, ::-1]
+        return pairs
+
+    def _project_join(self, sel: SqlSelect, lres, rres, pairs,
+                      la: str, ra: str) -> SqlResult:
+        li = pairs[:, 0] if len(pairs) else np.empty(0, np.int64)
+        ri = pairs[:, 1] if len(pairs) else np.empty(0, np.int64)
+        aggs = [i for i in sel.items if i.agg]
+        if aggs:
+            if any(i.agg != "count" for i in aggs):
+                raise ValueError("join aggregates support COUNT only")
+            return SqlResult([aggs[0].name],
+                             {aggs[0].name: np.array([len(pairs)])})
+        names, cols = [], {}
+
+        def add(name, arr):
+            names.append(name)
+            cols[name] = arr
+
+        star = any(i.expr == "*" for i in sel.items)
+        items = sel.items
+        if star:
+            items = [SelectItem(f"{la}.__fid__"), SelectItem(f"{ra}.__fid__")]
+        for it in items:
+            if "." not in it.expr:
+                raise ValueError(f"join columns must be qualified: {it.expr}")
+            q, col = it.expr.split(".", 1)
+            res, idx = (lres, li) if q == la else (rres, ri)
+            if col in ("__fid__", "id"):
+                add(it.name if it.alias else it.expr, res.ids[idx])
+            else:
+                c = res.batch.col(col)
+                add(it.name if it.alias else it.expr,
+                    np.array([c.value(int(i)) for i in idx], dtype=object))
+        out = SqlResult(names, cols)
+        if sel.limit is not None and out.n > sel.limit:
+            out = SqlResult(names, {k: v[:sel.limit]
+                                    for k, v in cols.items()})
+        return out

@@ -266,7 +266,11 @@ class _TypeState:
 
     def pallas(self):
         """Tiled device columns for the Pallas dense-scan kernel, built
-        on first use under the geomesa.scan.kernel=pallas flag."""
+        on first use under the geomesa.scan.kernel=pallas flag.
+
+        Unlike scan_data, pallas tiles rebuild fully after a write burst
+        (no capacity-padded extend yet) — the flag targets read-heavy
+        scans; write-heavy workloads should stay on the XLA path."""
         self.flush()
         if self.pallas_data is None:
             from ..scan.pallas_scan import build_pallas_data

@@ -12,6 +12,7 @@ Subcommands (mirroring the reference's tools/ command set):
     explain         --path R --name T --cql F
     stats           --path R --name T --stat-spec 'MinMax(a)' [--cql F]
     density         --path R --name T --bbox x1,y1,x2,y2 --size WxH [--cql F]
+    sql             --path R 'SELECT ... WHERE ST_...'
     serve           --path R [--host H] [--port P]
     version / env
 """
@@ -203,6 +204,16 @@ def cmd_density(args) -> int:
     return 0
 
 
+def cmd_sql(args) -> int:
+    """Run a SQL SELECT against the store (spark-sql surface analog)."""
+    from ..sql import SqlEngine
+    res = SqlEngine(_store(args)).query(args.query)
+    print("\t".join(res.names))
+    for row in res.rows():
+        print("\t".join("" if v is None else str(v) for v in row))
+    return 0
+
+
 def cmd_serve(args) -> int:
     """REST endpoints over the store (geomesa-web analog)."""
     from ..web import GeoMesaWebServer
@@ -269,6 +280,7 @@ def main(argv=None) -> int:
     add("density", cmd_density, name_arg, cql_arg,
         (["--bbox"], {"required": True}),
         (["--size"], {"required": True}))
+    add("sql", cmd_sql, (["query"], {"help": "SELECT statement"}))
     add("serve", cmd_serve,
         (["--host"], {"default": "127.0.0.1"}),
         (["--port"], {"type": int, "default": 8080}))

@@ -55,7 +55,7 @@ def test_pallas_flag_parity(store, ecql, dense):
     assert set(res.ids.astype(str)) == want
 
 
-def test_pallas_data_invalidated_by_writes(store):
+def test_pallas_data_invalidated_by_writes():
     ds = InMemoryDataStore()
     ds.create_schema(parse_spec("t", "dtg:Date,*geom:Point:srid=4326"))
     rng = np.random.default_rng(24)

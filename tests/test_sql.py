@@ -1,0 +1,178 @@
+"""SQL surface tests: the SQL path must produce identical feature IDs
+to the equivalent ECQL path (STContainsRule pushdown contract), and
+ST-joins must match brute force."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.sql import SqlEngine, SqlError, parse_sql
+from geomesa_tpu.store import InMemoryDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+N = 30_000
+
+
+@pytest.fixture(scope="module")
+def store():
+    ds = InMemoryDataStore()
+    ds.create_schema(parse_spec(
+        "gdelt", "name:String:index=true,val:Integer,dtg:Date,"
+        "*geom:Point:srid=4326"))
+    rng = np.random.default_rng(31)
+    ds.write_dict("gdelt", [f"f{i}" for i in range(N)], {
+        "name": [f"actor{i % 50}" for i in range(N)],
+        "val": rng.integers(0, 1000, N),
+        "dtg": rng.integers(MS("2018-01-01"), MS("2018-06-01"), N),
+        "geom": (rng.uniform(-180, 180, N), rng.uniform(-90, 90, N)),
+    })
+    # a polygon layer for join tests
+    ds.create_schema(parse_spec("zones", "zid:Integer,*area:Polygon"))
+    polys, zids = [], []
+    for i in range(12):
+        cx, cy = rng.uniform(-150, 150), rng.uniform(-70, 70)
+        w, h = rng.uniform(3, 12), rng.uniform(3, 12)
+        polys.append(f"POLYGON (({cx-w} {cy-h}, {cx+w} {cy-h}, "
+                     f"{cx+w} {cy+h}, {cx-w} {cy+h}, {cx-w} {cy-h}))")
+        zids.append(i)
+    ds.write_dict("zones", [f"z{i}" for i in range(12)],
+                  {"zid": zids, "area": polys})
+    return ds
+
+
+@pytest.fixture(scope="module")
+def engine(store):
+    return SqlEngine(store)
+
+
+SQL_ECQL = [
+    ("SELECT * FROM gdelt WHERE ST_Contains(ST_MakeBBOX(-30, -20, 40, 35),"
+     " geom)",
+     "BBOX(geom, -30, -20, 40, 35)"),
+    ("SELECT * FROM gdelt WHERE ST_Intersects(geom, "
+     "ST_GeomFromText('POLYGON ((0 0, 40 0, 20 35, 0 0))'))",
+     "INTERSECTS(geom, POLYGON ((0 0, 40 0, 20 35, 0 0)))"),
+    ("SELECT * FROM gdelt WHERE ST_Within(geom, "
+     "ST_GeomFromText('POLYGON ((0 0, 40 0, 20 35, 0 0))'))",
+     "WITHIN(geom, POLYGON ((0 0, 40 0, 20 35, 0 0)))"),
+    ("SELECT * FROM gdelt WHERE name = 'actor7' AND val > 500",
+     "name = 'actor7' AND val > 500"),
+    ("SELECT * FROM gdelt WHERE ST_Contains(ST_MakeBBOX(-30,-20,40,35), "
+     "geom) AND dtg > '2018-03-01T00:00:00Z'",
+     "BBOX(geom, -30, -20, 40, 35) AND dtg > '2018-03-01T00:00:00Z'"),
+    ("SELECT * FROM gdelt WHERE name IN ('actor1','actor2') "
+     "AND val BETWEEN 10 AND 200",
+     "name IN ('actor1','actor2') AND val BETWEEN 10 AND 200"),
+]
+
+
+class TestPushdownParity:
+    @pytest.mark.parametrize("sql,ecql", SQL_ECQL)
+    def test_identical_ids(self, store, engine, sql, ecql):
+        want = set(store.query(ecql, "gdelt").ids.astype(str))
+        res = engine.query(sql)
+        assert set(res.column("__fid__").astype(str)) == want
+
+    def test_dwithin_degrees(self, store, engine):
+        res = engine.query(
+            "SELECT * FROM gdelt WHERE ST_DWithin(geom, ST_Point(10, 10), "
+            "5.0)")
+        batch = store._state("gdelt").batch
+        g = batch.col("geom")
+        d2 = (g.x - 10.0) ** 2 + (g.y - 10.0) ** 2
+        want = set(batch.ids[d2 <= 25.0].astype(str))
+        assert set(res.column("__fid__").astype(str)) == want
+
+    def test_pushdown_selects_spatial_index(self, store):
+        # the SQL WHERE must reach the planner as a spatial primary
+        from geomesa_tpu.sql.parser import parse_sql as p
+        from geomesa_tpu.sql.engine import _strip_qualifier
+        sel = p("SELECT * FROM gdelt WHERE "
+                "ST_Contains(ST_MakeBBOX(-30,-20,40,35), geom)")
+        from geomesa_tpu.index.api import Query
+        f = _strip_qualifier(sel.where, sel.alias)
+        res = store.query(Query("gdelt", f))
+        assert res.plan.index == "z2"
+
+
+class TestProjectionAggLimit:
+    def test_count(self, engine, store):
+        res = engine.query("SELECT COUNT(*) FROM gdelt WHERE val < 100")
+        want = store.query("val < 100", "gdelt").n
+        assert res.column("count(*)")[0] == want
+
+    def test_min_max_avg(self, engine, store):
+        res = engine.query(
+            "SELECT MIN(val) AS lo, MAX(val) AS hi, AVG(val) AS mean "
+            "FROM gdelt WHERE name = 'actor3'")
+        batch = store.query("name = 'actor3'", "gdelt").batch
+        vals = batch.col("val").values
+        assert res.column("lo")[0] == vals.min()
+        assert res.column("hi")[0] == vals.max()
+        assert res.column("mean")[0] == pytest.approx(vals.mean())
+
+    def test_projection_and_alias(self, engine):
+        res = engine.query(
+            "SELECT name, val AS v FROM gdelt WHERE val = 7 LIMIT 5")
+        assert res.names == ["name", "v"]
+        assert res.n <= 5
+        assert all(r[1] == 7 for r in res.rows())
+
+    def test_order_by_limit(self, engine, store):
+        res = engine.query(
+            "SELECT val FROM gdelt WHERE name = 'actor9' "
+            "ORDER BY val DESC LIMIT 3")
+        batch = store.query("name = 'actor9'", "gdelt").batch
+        want = np.sort(batch.col("val").values)[::-1][:3].tolist()
+        assert [int(v) for v in res.column("val")] == want
+
+
+class TestSpatialJoin:
+    def test_contains_join_matches_bruteforce(self, engine, store):
+        res = engine.query(
+            "SELECT z.zid, g.__fid__ FROM zones z JOIN gdelt g "
+            "ON ST_Contains(z.area, g.geom) WHERE g.val < 50")
+        zb = store._state("zones").batch
+        gb = store._state("gdelt").batch
+        gx, gy = gb.col("geom").x, gb.col("geom").y
+        keep = gb.col("val").values < 50
+        want = set()
+        for zi, poly in enumerate(zb.col("area").geoms):
+            inside = poly.contains_points(gx, gy) & keep
+            for gi in np.flatnonzero(inside):
+                want.add((int(zb.col("zid").value(zi)), str(gb.ids[gi])))
+        got = {(int(a), str(b)) for a, b in
+               zip(res.column("z.zid"), res.column("g.__fid__"))}
+        assert got == want and len(got) > 0
+
+    def test_dwithin_join_count(self, engine, store):
+        res = engine.query(
+            "SELECT COUNT(*) FROM gdelt a JOIN gdelt b "
+            "ON ST_DWithin(a.geom, b.geom, 0.2) WHERE a.val < 5 "
+            "AND b.val >= 5")
+        ab = store.query("val < 5", "gdelt").batch
+        bb = store.query("val >= 5", "gdelt").batch
+        ax, ay = ab.col("geom").x, ab.col("geom").y
+        bx, by = bb.col("geom").x, bb.col("geom").y
+        d2 = (ax[:, None] - bx[None, :]) ** 2 \
+            + (ay[:, None] - by[None, :]) ** 2
+        want = int((d2 <= 0.04).sum())
+        assert int(res.column("count(*)")[0]) == want
+
+
+class TestParserErrors:
+    @pytest.mark.parametrize("bad", [
+        "SELECT FROM gdelt",
+        "SELECT * FROM gdelt WHERE",
+        "SELECT * FROM gdelt WHERE ST_Contains(geom, geom2)",
+        "UPDATE gdelt SET val = 1",
+    ])
+    def test_rejects(self, engine, bad):
+        with pytest.raises((SqlError, Exception)):
+            r = engine.query(bad)
+            assert r is not None
+
+    def test_trailing_garbage(self):
+        with pytest.raises(SqlError):
+            parse_sql("SELECT * FROM t WHERE a = 1 GARBAGE MORE")

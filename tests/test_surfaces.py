@@ -201,6 +201,17 @@ class TestCli:
         out = capsys.readouterr().out
         assert "Selected" in out
 
+    def test_sql_command(self, tmp_path, capsys):
+        root = self._setup(tmp_path)
+        capsys.readouterr()  # drain setup output
+        rc = cli_main(["sql", "--path", root,
+                       "SELECT name, count FROM t WHERE "
+                       "ST_Contains(ST_MakeBBOX(-80, 30, -70, 40), geom)"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert out[0] == "name\tcount"
+        assert out[1] == "alpha\t5" and len(out) == 2
+
     def test_geojson_export(self, tmp_path, capsys):
         root = self._setup(tmp_path)
         capsys.readouterr()  # drain setup output
