@@ -21,7 +21,7 @@ __all__ = [
     "Filter", "Include", "Exclude", "And", "Or", "Not", "FidFilter",
     "Compare", "CompareOp", "Between", "Like", "IsNull", "InList",
     "SpatialPredicate", "BBox", "Intersects", "Disjoint", "Contains",
-    "Within", "Touches", "Crosses", "Overlaps", "DWithin",
+    "Within", "Touches", "Crosses", "Overlaps", "GeomEquals", "DWithin",
     "During", "Before", "After", "TEquals",
 ]
 
@@ -208,6 +208,11 @@ class Crosses(SpatialPredicate):
 
 class Overlaps(SpatialPredicate):
     op_name = "OVERLAPS"
+
+
+class GeomEquals(SpatialPredicate):
+    """EQUALS / ST_Equals: exact coordinate-sequence equality."""
+    op_name = "EQUALS"
 
 
 @dataclasses.dataclass(frozen=True)

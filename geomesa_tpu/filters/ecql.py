@@ -59,7 +59,7 @@ _SPATIAL = {
     "INTERSECTS": ast.Intersects, "DISJOINT": ast.Disjoint,
     "CONTAINS": ast.Contains, "WITHIN": ast.Within,
     "TOUCHES": ast.Touches, "CROSSES": ast.Crosses,
-    "OVERLAPS": ast.Overlaps,
+    "OVERLAPS": ast.Overlaps, "EQUALS": ast.GeomEquals,
 }
 
 _KEYWORDS = {"AND", "OR", "NOT", "IN", "BETWEEN", "LIKE", "ILIKE", "IS",

@@ -211,6 +211,8 @@ def _apply_pred(f: ast.SpatialPredicate, feature_geom, query_geom) -> bool:
     if isinstance(f, ast.Touches):
         return (feature_geom.intersects(query_geom)
                 and not _interiors_intersect(feature_geom, query_geom))
+    if isinstance(f, ast.GeomEquals):
+        return feature_geom == query_geom
     if isinstance(f, ast.Crosses) or isinstance(f, ast.Overlaps):
         # pragmatic: interiors intersect but neither contains the other
         return (feature_geom.intersects(query_geom)

@@ -379,7 +379,8 @@ def _extract_geoms(f: ast.Filter, attribute: str | None,
         buffered = f.geom.envelope.buffer(deg).to_polygon()
         return FilterValues([p for g in _split_idl(buffered) for p in _flatten(g)])
     if isinstance(f, (ast.Intersects, ast.Contains, ast.Within,
-                      ast.Overlaps, ast.Touches, ast.Crosses)):
+                      ast.Overlaps, ast.Touches, ast.Crosses,
+                      ast.GeomEquals)):
         if attribute is not None and f.prop != attribute:
             return FilterValues.empty()
         return FilterValues([p for g in _split_idl(f.geom) for p in _flatten(g)])

@@ -149,10 +149,16 @@ class SqlEngine:
         for it in items:
             name = it.name
             names.append(name)
-            if it.agg == "count":
+            if it.agg == "count" and it.expr == "*":
                 cols[name] = np.array([n], dtype=np.int64)
                 continue
             col = batch.col(it.expr.split(".")[-1]) if batch else None
+            if it.agg == "count":
+                # COUNT(col) skips nulls (SQL semantics)
+                cols[name] = np.array(
+                    [0 if col is None else int(col.valid.sum())],
+                    dtype=np.int64)
+                continue
             if col is None or n == 0:
                 cols[name] = np.array([None], dtype=object)
                 continue

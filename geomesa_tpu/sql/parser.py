@@ -60,11 +60,10 @@ class SelectItem:
 class SqlJoin:
     table: str
     alias: str
-    on: ast.Filter            # ST predicate with qualified props
     kind: str                 # 'dwithin' | 'contains' | 'intersects'
     distance: float | None    # for dwithin (degrees)
-    left_prop: str            # qualified 'alias.col'
-    right_prop: str
+    left_prop: str            # qualified 'alias.col' (first ON arg)
+    right_prop: str           # qualified 'alias.col' (second ON arg)
 
 
 @dataclasses.dataclass
@@ -104,7 +103,7 @@ _ST_PREDS = {
     "ST_CROSSES": (ast.Crosses, ast.Crosses),
     "ST_OVERLAPS": (ast.Overlaps, ast.Overlaps),
     "ST_TOUCHES": (ast.Touches, ast.Touches),
-    "ST_EQUALS": (ast.Intersects, ast.Intersects),  # eq -> exact residual
+    "ST_EQUALS": (ast.GeomEquals, ast.GeomEquals),  # symmetric
 }
 
 
@@ -219,22 +218,22 @@ class _Parser:
         a = self._name()
         self.t.expect("comma")
         b = self._name()
+        if "." not in a or "." not in b:
+            raise SqlError("join ON columns must be alias-qualified "
+                           f"(got {a!r}, {b!r})")
         distance = None
         if fn == "ST_DWITHIN":
             self.t.expect("comma")
             distance = float(_num(self.t.expect("number")))
             kind = "dwithin"
-            node = ast.DWithin(a, Point(0, 0), distance, "degrees")
         elif fn in ("ST_CONTAINS", "ST_COVERS"):
             kind = "contains"
-            node = ast.Contains(a, Point(0, 0))
         elif fn == "ST_INTERSECTS":
             kind = "intersects"
-            node = ast.Intersects(a, Point(0, 0))
         else:
             raise SqlError(f"unsupported join predicate {fn}")
         self.t.expect("rparen")
-        return SqlJoin(table, alias, node, kind, distance, a, b)
+        return SqlJoin(table, alias, kind, distance, a, b)
 
     def _items(self) -> list[SelectItem]:
         items = [self._item()]

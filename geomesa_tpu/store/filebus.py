@@ -1,0 +1,225 @@
+"""File-backed message bus: cross-process transport for the live tier.
+
+The reference's live tier is real network pub/sub — feature mutations
+flow through Kafka topics as GeoMessages and consumer offsets checkpoint
+in Zookeeper (/root/reference/geomesa-kafka/geomesa-kafka-datastore/src/
+main/scala/org/locationtech/geomesa/kafka/data/KafkaDataStore.scala:44,
+/root/reference/geomesa-lambda/geomesa-lambda-datastore/src/main/scala/
+org/locationtech/geomesa/lambda/stream/ZookeeperOffsetManager.scala:27).
+
+Kafka's essence is a durable, ordered, append-only log per topic with
+independent consumer offsets; this module is that design on a shared
+filesystem, so two PROCESSES see each other's mutations:
+
+- topic = directory; message = one segment file named by sequence
+  number, claimed atomically with O_CREAT|O_EXCL (multi-producer safe)
+  and written tmp-then-rename (readers never see partial messages);
+- payload = JSON header (kind/ids/timestamp/schema spec) + an Arrow IPC
+  stream for create batches — a self-describing wire format, so
+  consumers need no out-of-band schema exchange;
+- consumers poll for sequence numbers past their offset; offsets
+  checkpoint to ``offsets/<group>.json`` after every poll, so a
+  restarted consumer resumes where it left off (the checkpointed
+  stream-recovery shape of the Lambda tier).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.sft import encode_spec, parse_spec
+from .live import GeoMessage
+
+__all__ = ["FileBus"]
+
+_SEQ_DIGITS = 12
+
+
+def _encode(msg: GeoMessage) -> bytes:
+    header: dict = {"kind": msg.kind, "type_name": msg.type_name,
+                    "ids": list(msg.ids), "timestamp_ms": msg.timestamp_ms}
+    payload = b""
+    if msg.batch is not None:
+        import pyarrow as pa
+        header["spec"] = encode_spec(msg.batch.sft)
+        rb = msg.batch.to_arrow()
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, rb.schema) as w:
+            w.write_batch(rb)
+        payload = sink.getvalue().to_pybytes()
+    h = json.dumps(header).encode()
+    return len(h).to_bytes(4, "big") + h + payload
+
+
+def _decode(raw: bytes) -> GeoMessage:
+    hlen = int.from_bytes(raw[:4], "big")
+    header = json.loads(raw[4:4 + hlen].decode())
+    batch = None
+    payload = raw[4 + hlen:]
+    if payload:
+        import pyarrow as pa
+        sft = parse_spec(header["type_name"], header["spec"])
+        with pa.ipc.open_stream(pa.BufferReader(payload)) as r:
+            rb = r.read_next_batch()
+        batch = FeatureBatch.from_arrow(sft, rb)
+    return GeoMessage(header["kind"], header["type_name"], batch,
+                      tuple(header.get("ids") or ()),
+                      header.get("timestamp_ms", 0))
+
+
+class FileBus:
+    """Durable multi-process topic log. Same subscribe surface as the
+    in-process MessageBus, but delivery is poll-driven: ``publish``
+    appends to the shared log; ``poll()`` drains messages past this
+    consumer group's offsets into the subscribers."""
+
+    # an empty claimed-but-never-written message file older than this is
+    # an aborted publish: consumers skip it instead of wedging the topic
+    STALE_CLAIM_S = 5.0
+
+    def __init__(self, root: str, group: str = "default"):
+        self.root = root
+        self.group = group
+        self._subs: dict[str, list[Callable[[GeoMessage], None]]] = {}
+        self._offsets: dict[str, int] = {}
+        self._next_seq: dict[str, int] = {}  # producer-side cache
+        os.makedirs(os.path.join(root, "offsets"), exist_ok=True)
+        self._load_offsets()
+
+    # -- offsets (ZookeeperOffsetManager analog) ---------------------------
+
+    def _offsets_path(self) -> str:
+        return os.path.join(self.root, "offsets", f"{self.group}.json")
+
+    def _load_offsets(self):
+        try:
+            with open(self._offsets_path()) as f:
+                self._offsets = {k: int(v) for k, v in json.load(f).items()}
+        except (FileNotFoundError, json.JSONDecodeError):
+            self._offsets = {}
+
+    def _save_offsets(self):
+        path = self._offsets_path()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self._offsets, f)
+        os.replace(tmp, path)
+
+    def offset(self, topic: str) -> int:
+        return self._offsets.get(topic, 0)
+
+    def set_offset(self, topic: str, offset: int):
+        """Manual seek (offset = last consumed sequence number)."""
+        self._offsets[topic] = int(offset)
+        self._save_offsets()
+
+    # -- producer ----------------------------------------------------------
+
+    def _topic_dir(self, topic: str) -> str:
+        d = os.path.join(self.root, "topics", topic)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _last_seq(self, topic: str) -> int:
+        d = self._topic_dir(topic)
+        seqs = [int(f[:_SEQ_DIGITS]) for f in os.listdir(d)
+                if f.endswith(".msg")]
+        return max(seqs, default=0)
+
+    def publish(self, topic: str, msg: GeoMessage):
+        d = self._topic_dir(topic)
+        raw = _encode(msg)
+        # cached next sequence avoids an O(topic-size) listdir per
+        # publish; contention falls through to the O_EXCL retry loop
+        seq = self._next_seq.get(topic)
+        if seq is None:
+            seq = self._last_seq(topic) + 1
+        while True:
+            name = f"{seq:0{_SEQ_DIGITS}d}.msg"
+            tmp = os.path.join(d, f".{name}.{os.getpid()}.tmp")
+            try:
+                # claim the sequence number atomically across processes
+                fd = os.open(os.path.join(d, name),
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                seq += 1
+                continue
+            try:
+                # write the payload beside it, then swap into place so a
+                # concurrent reader never sees a partial message
+                with open(tmp, "wb") as f:
+                    f.write(raw)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, os.path.join(d, name))
+            finally:
+                os.close(fd)
+            self._next_seq[topic] = seq + 1
+            return seq
+
+    # -- consumer ----------------------------------------------------------
+
+    def subscribe(self, topic: str, fn: Callable[[GeoMessage], None]):
+        self._subs.setdefault(topic, []).append(fn)
+
+    def poll(self, max_messages: int | None = None) -> int:
+        """Drain new messages on all subscribed topics to their
+        subscribers, in sequence order; checkpoints offsets. Returns the
+        number of messages delivered."""
+        delivered = 0
+        # snapshot: a subscriber may register new topics mid-delivery
+        # (consumer-side schema auto-create)
+        for topic, fns in list(self._subs.items()):
+            d = self._topic_dir(topic)
+            start = self._offsets.get(topic, 0)
+            seqs = sorted(int(f[:_SEQ_DIGITS]) for f in os.listdir(d)
+                          if f.endswith(".msg")
+                          and int(f[:_SEQ_DIGITS]) > start)
+            for seq in seqs:
+                path = os.path.join(d, f"{seq:0{_SEQ_DIGITS}d}.msg")
+                try:
+                    with open(path, "rb") as f:
+                        raw = f.read()
+                    if not raw:
+                        if (time.time() - os.path.getmtime(path)
+                                > self.STALE_CLAIM_S):
+                            # aborted publish (producer died between
+                            # claim and payload swap): skip it rather
+                            # than wedging the topic forever
+                            self._offsets[topic] = seq
+                            continue
+                        # claimed but not yet swapped in by the writer:
+                        # stop here, retry from this offset next poll
+                        break
+                    msg = _decode(raw)
+                except (FileNotFoundError, json.JSONDecodeError,
+                        ValueError):
+                    break
+                for fn in fns:
+                    fn(msg)
+                self._offsets[topic] = seq
+                delivered += 1
+                if max_messages is not None and delivered >= max_messages:
+                    break
+            if max_messages is not None and delivered >= max_messages:
+                break
+        if delivered:
+            self._save_offsets()
+        return delivered
+
+    def wait_for(self, predicate, timeout_s: float = 10.0,
+                 interval_s: float = 0.05) -> bool:
+        """Poll until predicate() is true or the timeout lapses."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self.poll()
+            if predicate():
+                return True
+            time.sleep(interval_s)
+        return False

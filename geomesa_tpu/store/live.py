@@ -68,6 +68,7 @@ class LiveDataStore:
         self._mem = InMemoryDataStore()
         self._listeners: dict[str, list[Callable[[GeoMessage], None]]] = {}
         self._arrival_ms: dict[str, np.ndarray] = {}
+        self._subscribed: set[str] = set()
 
     # -- schema ------------------------------------------------------------
 
@@ -77,7 +78,9 @@ class LiveDataStore:
             sft = parse_spec(sft, spec or "")
         self._mem.create_schema(sft)
         self._arrival_ms[sft.type_name] = np.empty(0, dtype=np.int64)
-        self.bus.subscribe(sft.type_name, self._on_message)
+        if sft.type_name not in self._subscribed:
+            self._subscribed.add(sft.type_name)
+            self.bus.subscribe(sft.type_name, self._on_message)
 
     def get_schema(self, type_name: str) -> SimpleFeatureType:
         return self._mem.get_schema(type_name)
@@ -110,8 +113,22 @@ class LiveDataStore:
 
     # -- consumer side -----------------------------------------------------
 
+    def poll(self) -> int:
+        """Drain a poll-driven bus (FileBus) into this store's cache;
+        no-op for the synchronous in-process bus. Returns messages
+        applied."""
+        poll = getattr(self.bus, "poll", None)
+        return poll() if poll is not None else 0
+
     def _on_message(self, msg: GeoMessage):
         t = msg.type_name
+        if t not in self._mem.get_type_names() and msg.batch is not None:
+            # consumer side of a cross-process bus: the schema travels
+            # with the message (self-describing wire format). The topic
+            # is already subscribed — this message arrived through it —
+            # so mark it before create_schema to avoid double delivery.
+            self._subscribed.add(t)
+            self.create_schema(msg.batch.sft)
         if msg.kind == "create":
             # upsert semantics: replace existing ids (the cache keeps the
             # latest version of each feature, as the reference's does)

@@ -1,0 +1,191 @@
+"""Cross-process live tier: a writer in another PROCESS publishes over
+the file-backed bus; this process's consumer store sees the mutations
+(the KafkaDataStore network-pub/sub contract), with offsets
+checkpointed per consumer group (ZookeeperOffsetManager analog)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.store.filebus import FileBus, _decode, _encode
+from geomesa_tpu.store.live import GeoMessage, LiveDataStore
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+
+def make_batch(ids, xs, ys):
+    sft = parse_spec("live", SPEC)
+    n = len(ids)
+    return FeatureBatch.from_dict(sft, ids, {
+        "name": [f"n{i}" for i in range(n)],
+        "dtg": np.full(n, MS("2024-01-01")),
+        "geom": (np.asarray(xs, float), np.asarray(ys, float)),
+    })
+
+
+class TestWireFormat:
+    def test_roundtrip_create(self):
+        msg = GeoMessage("create", "live", make_batch(["a", "b"],
+                                                      [1.0, 2.0],
+                                                      [3.0, 4.0]),
+                         timestamp_ms=1234)
+        out = _decode(_encode(msg))
+        assert out.kind == "create" and out.timestamp_ms == 1234
+        assert out.batch.ids.tolist() == ["a", "b"]
+        assert out.batch.col("geom").x.tolist() == [1.0, 2.0]
+        assert out.batch.col("name").value(0) == "n0"
+
+    def test_roundtrip_delete_clear(self):
+        msg = _decode(_encode(GeoMessage("delete", "live",
+                                         ids=("x", "y"))))
+        assert msg.kind == "delete" and msg.ids == ("x", "y")
+        assert msg.batch is None
+        assert _decode(_encode(GeoMessage("clear", "live"))).kind == "clear"
+
+
+class TestSameProcessBus:
+    def test_publish_poll_apply(self, tmp_path):
+        bus = FileBus(str(tmp_path))
+        producer = LiveDataStore(bus=FileBus(str(tmp_path), group="prod"))
+        producer.create_schema(parse_spec("live", SPEC))
+        consumer = LiveDataStore(bus=bus)
+        consumer.create_schema(parse_spec("live", SPEC))
+        producer.write("live", make_batch(["a", "b"], [0, 1], [0, 1]))
+        assert consumer.count("live") == 0  # nothing until poll
+        assert consumer.poll() == 1
+        assert consumer.count("live") == 2
+        producer.delete("live", ["a"])
+        consumer.poll()
+        assert {str(i) for i in
+                consumer.query("INCLUDE", "live").ids} == {"b"}
+
+    def test_offsets_checkpoint_and_resume(self, tmp_path):
+        bus = FileBus(str(tmp_path), group="g1")
+        store = LiveDataStore(bus=bus)
+        store.create_schema(parse_spec("live", SPEC))
+        store.write("live", make_batch(["a"], [0], [0]))
+        bus.poll()
+        assert bus.offset("live") == 1
+        # a NEW consumer in the same group resumes past message 1
+        bus2 = FileBus(str(tmp_path), group="g1")
+        assert bus2.offset("live") == 1
+        store2 = LiveDataStore(bus=bus2)
+        store2.create_schema(parse_spec("live", SPEC))
+        assert store2.poll() == 0
+        # a different group replays from the beginning
+        bus3 = FileBus(str(tmp_path), group="g2")
+        store3 = LiveDataStore(bus=bus3)
+        store3.create_schema(parse_spec("live", SPEC))
+        assert store3.poll() == 1
+        assert store3.count("live") == 1
+
+    def test_no_double_delivery_after_auto_create(self, tmp_path):
+        prod = LiveDataStore(bus=FileBus(str(tmp_path), group="p"))
+        prod.create_schema(parse_spec("live", SPEC))
+        cons_bus = FileBus(str(tmp_path), group="c")
+        cons = LiveDataStore(bus=cons_bus)
+        cons_bus.subscribe("live", cons._on_message)
+        events = []
+        prod.write("live", make_batch(["a"], [0], [0]))
+        cons_bus.poll()  # triggers auto-create; must not re-subscribe
+        cons.add_listener("live", lambda m: events.append(m.kind))
+        prod.write("live", make_batch(["b"], [1], [1]))
+        cons_bus.poll()
+        assert events == ["create"]  # one delivery, not two
+        assert cons.count("live") == 2
+
+    def test_stale_claim_skipped(self, tmp_path):
+        bus = FileBus(str(tmp_path))
+        store = LiveDataStore(bus=bus)
+        store.create_schema(parse_spec("live", SPEC))
+        store.write("live", make_batch(["a"], [0], [0]))
+        # simulate a dead producer: claimed sequence 2, never wrote it
+        topic = tmp_path / "topics" / "live"
+        stale = topic / f"{2:012d}.msg"
+        stale.touch()
+        old = os.path.getmtime(stale) - 60
+        os.utime(stale, (old, old))
+        store.write("live", make_batch(["b"], [1], [1]))  # becomes seq 3
+        assert bus.poll() == 2  # both real messages; stale one skipped
+        assert store.count("live") == 2
+
+    def test_poll_max_messages_cap(self, tmp_path):
+        bus = FileBus(str(tmp_path))
+        got = []
+        bus.subscribe("t1", got.append)
+        bus.subscribe("t2", got.append)
+        pub = FileBus(str(tmp_path), group="w")
+        for t in ("t1", "t2"):
+            for _ in range(5):
+                pub.publish(t, GeoMessage("clear", t))
+        assert bus.poll(max_messages=3) == 3
+        assert len(got) == 3
+        assert bus.poll() == 7  # the rest
+
+    def test_consumer_auto_creates_schema(self, tmp_path):
+        prod = LiveDataStore(bus=FileBus(str(tmp_path), group="p"))
+        prod.create_schema(parse_spec("live", SPEC))
+        prod.write("live", make_batch(["a"], [0], [0]))
+        cons_bus = FileBus(str(tmp_path), group="c")
+        cons = LiveDataStore(bus=cons_bus)
+        # subscribe without create: schema arrives with the message
+        cons_bus.subscribe("live", cons._on_message)
+        cons_bus.poll()
+        assert cons.count("live") == 1
+        assert cons.get_schema("live").geom_field == "geom"
+
+
+_WRITER = r"""
+import sys
+import numpy as np
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.store.filebus import FileBus
+from geomesa_tpu.store.live import LiveDataStore
+
+root, n = sys.argv[1], int(sys.argv[2])
+store = LiveDataStore(bus=FileBus(root, group="writer"))
+sft = parse_spec("live", "name:String,dtg:Date,*geom:Point:srid=4326")
+store.create_schema(sft)
+ms = int(np.datetime64("2024-01-01", "ms").astype(np.int64))
+for k in range(3):
+    ids = [f"w{k}-{i}" for i in range(n)]
+    store.write_dict("live", ids, {
+        "name": [f"x{i}" for i in range(n)],
+        "dtg": np.full(n, ms),
+        "geom": (np.linspace(0, 10, n), np.linspace(0, 10, n)),
+    })
+store.delete("live", ["w0-0"])
+print("WROTE")
+"""
+
+
+class TestCrossProcess:
+    def test_writer_subprocess_feeds_reader(self, tmp_path):
+        root = str(tmp_path / "bus")
+        reader = LiveDataStore(bus=FileBus(root, group="reader"))
+        reader.create_schema(parse_spec("live", SPEC))
+
+        env = dict(os.environ,
+                   PYTHONPATH=os.pathsep.join(
+                       [os.path.dirname(os.path.dirname(__file__))]
+                       + os.environ.get("PYTHONPATH", "").split(os.pathsep)),
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", _WRITER, root, "5"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "WROTE" in proc.stdout
+
+        ok = reader.bus.wait_for(lambda: reader.count("live") == 14,
+                                 timeout_s=15)
+        assert ok, f"count={reader.count('live')}"
+        ids = {str(i) for i in reader.query("INCLUDE", "live").ids}
+        assert "w0-0" not in ids and "w2-4" in ids
+        res = reader.query("BBOX(geom, -1, -1, 5, 5)", "live")
+        assert res.n > 0

@@ -161,6 +161,36 @@ class TestSpatialJoin:
         assert int(res.column("count(*)")[0]) == want
 
 
+class TestSemantics:
+    def test_st_equals_is_exact(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("t", "name:String,*shape:Polygon"))
+        sq = "POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))"
+        other = "POLYGON ((0 0, 3 0, 3 3, 0 3, 0 0))"
+        ds.write_dict("t", ["a", "b"],
+                      {"name": ["a", "b"], "shape": [sq, other]})
+        res = SqlEngine(ds).query(
+            f"SELECT name FROM t WHERE ST_Equals(shape, "
+            f"ST_GeomFromText('{sq}'))")
+        assert [r[0] for r in res.rows()] == ["a"]
+
+    def test_count_col_skips_nulls(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("t", "v:Integer,*geom:Point"))
+        ds.write_dict("t", ["a", "b", "c"],
+                      {"v": [5, None, 7], "geom": ([0, 1, 2], [0, 1, 2])})
+        eng = SqlEngine(ds)
+        assert int(eng.query(
+            "SELECT COUNT(v) FROM t").column("count(v)")[0]) == 2
+        assert int(eng.query(
+            "SELECT COUNT(*) FROM t").column("count(*)")[0]) == 3
+
+    def test_unqualified_join_on_rejected(self):
+        with pytest.raises(SqlError, match="alias-qualified"):
+            parse_sql("SELECT COUNT(*) FROM t a JOIN t b "
+                      "ON ST_DWithin(geom, geom, 0.1)")
+
+
 class TestParserErrors:
     @pytest.mark.parametrize("bad", [
         "SELECT FROM gdelt",
