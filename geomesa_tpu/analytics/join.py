@@ -69,6 +69,25 @@ def dwithin_join(px: np.ndarray, py: np.ndarray,
     r2_hi, r2_lo = _f32_band(radius_deg, span)
     r2 = radius_deg * radius_deg
 
+    # band queries re-resolve in exact f64 on host over just the points
+    # inside the query's x-slab (sorted-x binary search, built lazily on
+    # first band), not the whole table — at large n nearly every query
+    # has >= 1 banded pair, so an O(n)-per-query host pass would
+    # dominate the device scan
+    sorted_x: list = []
+    eps = float(np.sqrt(max(r2_hi, 0.0))) - radius_deg + 1e-9
+
+    def exact_count(qj: int) -> int:
+        if not sorted_x:
+            order = np.argsort(px64, kind="stable")
+            sorted_x.append((order, px64[order]))
+        xorder, xs = sorted_x[0]
+        lo = np.searchsorted(xs, qx64[qj] - radius_deg - eps)
+        hi = np.searchsorted(xs, qx64[qj] + radius_deg + eps, side="right")
+        rows = xorder[lo:hi]
+        d2 = ((px64[rows] - qx64[qj]) ** 2 + (py64[rows] - qy64[qj]) ** 2)
+        return int((d2 <= r2).sum())
+
     counts = np.zeros(k, dtype=np.int64)
     pair_chunks: list[np.ndarray] = []
 
@@ -87,13 +106,9 @@ def dwithin_join(px: np.ndarray, py: np.ndarray,
             def_counts = np.asarray(def_counts)[: end - start]
             band_counts = np.asarray(band_counts)[: end - start]
             counts[start:end] += def_counts
-            # only queries with band pairs need exact resolution; count
-            # their band hits with host f64 over the full point set
+            # only queries with band pairs need exact resolution
             for j in np.flatnonzero(band_counts):
-                qj = start + j
-                d2 = ((px64 - qx64[qj]) ** 2 + (py64 - qy64[qj]) ** 2)
-                exact = int((d2 <= r2).sum())
-                counts[qj] = exact
+                counts[start + j] = exact_count(start + j)
             continue
         definite, maybe = _dwithin_matrices(*args)
         definite = np.array(definite)  # writable host copy

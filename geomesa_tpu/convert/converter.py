@@ -187,4 +187,10 @@ def converter_for(sft: SimpleFeatureType, config: dict):
         cls = {"xml": XmlConverter, "fixed-width": FixedWidthConverter,
                "avro": AvroConverter, "composite": CompositeConverter}[kind]
         return cls(sft, config)
+    if kind in ("shapefile", "jdbc", "osm"):
+        from .geo_formats import (JdbcConverter, OsmConverter,
+                                  ShapefileConverter)
+        cls = {"shapefile": ShapefileConverter, "jdbc": JdbcConverter,
+               "osm": OsmConverter}[kind]
+        return cls(sft, config)
     raise ValueError(f"unknown converter type: {kind}")

@@ -117,6 +117,8 @@ _FUNCTIONS: dict[str, Callable[..., Any]] = {
     "uuid": lambda: str(_uuid.uuid4()),
     "stringToBytes": lambda s: str(s).encode(),
     "toString": str,
+    # dict/tag access for record formats whose $0 is a mapping (OSM)
+    "mapValue": lambda m, k, default=None: (m or {}).get(str(k), default),
     "cacheLookup": lambda name, key, field=None: __import__(
         "geomesa_tpu.convert.enrichment", fromlist=["cache_lookup"]
     ).cache_lookup(name, key, field),

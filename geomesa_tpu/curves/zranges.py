@@ -58,6 +58,43 @@ def merge_ranges(ranges: np.ndarray) -> np.ndarray:
     return out
 
 
+_native_ready = None  # None = not probed; False = unavailable
+
+
+def _native_zranges(lows, highs, dims, max_bits, max_level,
+                    max_ranges) -> np.ndarray | None:
+    """C++ fast path (native/src/zrange.cpp) — bit-identical to the
+    Python BFS below; returns None when the native library is absent or
+    the output overflows the preallocated buffer."""
+    global _native_ready
+    if _native_ready is False:
+        return None
+    import ctypes
+    if _native_ready is None:
+        from ..native import load
+        lib = load()
+        if lib is None or not hasattr(lib, "geomesa_zranges"):
+            _native_ready = False
+            return None
+        lib.geomesa_zranges.restype = ctypes.c_int64
+        lib.geomesa_zranges.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        _native_ready = lib
+    lib = _native_ready
+    # the budget check allows one final partial expansion past
+    # max_ranges; 4x + slack comfortably bounds the merged output
+    cap = 4 * int(max_ranges) + 64
+    out = np.empty((cap, 2), dtype=np.int64)
+    p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    n = lib.geomesa_zranges(p(lows), p(highs), dims, max_bits,
+                            max_level, int(max_ranges), p(out), cap)
+    if n < 0:
+        return None
+    return out[:n].copy()
+
+
 def _interleave(coords: np.ndarray, dims: int) -> np.ndarray:
     """Interleave per-dim int arrays (coords[d] gets bit offset d)."""
     from . import zorder
@@ -92,6 +129,11 @@ def zranges(lows, highs, max_bits: int, *, precision: int = 64,
     max_level = min(max_bits, max(1, precision // dims))
     if np.any(highs < lows):
         return np.empty((0, 2), dtype=np.int64)
+
+    native = _native_zranges(lows, highs, dims, max_bits, max_level,
+                             max_ranges)
+    if native is not None:
+        return native
 
     # BFS frontier: cell origin coords in units of current cell size,
     # shape (dims, ncells). Start from the root cell.

@@ -12,10 +12,12 @@ from .mesh import (DistributedScanData, data_mesh, distributed_count,
                    distributed_density, distributed_histogram,
                    distributed_minmax, distributed_scan_mask,
                    exact_host_mask, shard_scan_data)
-from .ring import distributed_knn, ring_dwithin_counts, shard_points
+from .ring import (distributed_knn, ring_dwithin_counts, shard_points,
+                   shard_points_split)
 
 __all__ = ["DistributedScanData", "data_mesh", "distributed_count",
            "distributed_density", "distributed_histogram",
            "distributed_minmax", "distributed_scan_mask",
            "exact_host_mask", "shard_scan_data",
-           "distributed_knn", "ring_dwithin_counts", "shard_points"]
+           "distributed_knn", "ring_dwithin_counts", "shard_points",
+           "shard_points_split"]

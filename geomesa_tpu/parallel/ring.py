@@ -30,7 +30,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["ring_dwithin_counts", "distributed_knn", "shard_points"]
+__all__ = ["ring_dwithin_counts", "distributed_knn", "shard_points",
+           "shard_points_split"]
 
 
 def shard_points(x: np.ndarray, y: np.ndarray, mesh: Mesh, fill=1e9):
@@ -119,6 +120,53 @@ def ring_dwithin_counts(lx, ly, lvalid, rx, ry, rvalid, mesh: Mesh,
     return np.asarray(sure), np.asarray(bandc)
 
 
+def shard_points_split(x: np.ndarray, y: np.ndarray, mesh: Mesh,
+                       fill=1e9):
+    """Two-float sharded coords: ((xhi, xlo, yhi, ylo), valid, n).
+
+    The (hi, lo) pairs reconstruct f64 to ~1e-12 deg on host, so exact
+    re-ranks never need a full host coordinate copy — candidate coords
+    travel back with the candidates themselves (tiny transfers), which
+    is what keeps distributed KNN distributed at 50M+ rows."""
+    from ..scan.zscan import split_two_float
+    n = len(x)
+    k = mesh.devices.size
+    n_padded = ((n + k - 1) // k) * k
+    pad = n_padded - n
+
+    def padded(a):
+        a = np.asarray(a, np.float64)
+        return np.concatenate([a, np.full(pad, fill)]) if pad else a
+
+    xhi, xlo = split_two_float(padded(x))
+    yhi, ylo = split_two_float(padded(y))
+    valid = np.ones(n_padded, dtype=bool)
+    valid[n:] = False
+    sharding = NamedSharding(mesh, P("data"))
+    put = functools.partial(jax.device_put, device=sharding)
+    return ((put(xhi), put(xlo), put(yhi), put(ylo)), put(valid), n)
+
+
+@functools.lru_cache(maxsize=32)
+def _knn_prune_split_fn(mesh: Mesh, k: int):
+    """Shard-local prune that also ships each candidate's two-float
+    coords back — the exact re-rank needs only these 4k floats per
+    shard, not the whole table."""
+    def body(xhi, xlo, yhi, ylo, pvalid, q):
+        d2 = (xhi - q[0]) ** 2 + (yhi - q[1]) ** 2
+        d2 = jnp.where(pvalid, d2, jnp.float32(np.inf))
+        neg_top, idx = lax.top_k(-d2, k)
+        shard = lax.axis_index("data")
+        gids = shard.astype(jnp.int32) * xhi.shape[0] + idx.astype(jnp.int32)
+        take = lambda a: jnp.take(a, idx)
+        return (-neg_top, gids, take(xhi), take(xlo), take(yhi), take(ylo))
+
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P("data"),) * 5 + (P(),),
+        out_specs=(P("data"),) * 6))
+
+
 @functools.lru_cache(maxsize=32)
 def _knn_prune_fn(mesh: Mesh, k: int):
     def body(px, py, pvalid, q):
@@ -140,9 +188,10 @@ def _knn_prune_fn(mesh: Mesh, k: int):
 def distributed_knn(px, py, pvalid, mesh: Mesh, n: int,
                     qx: float, qy: float, k: int,
                     host_x: np.ndarray | None = None,
-                    host_y: np.ndarray | None = None) -> np.ndarray:
+                    host_y: np.ndarray | None = None,
+                    split=None) -> np.ndarray:
     """k nearest rows to (qx, qy): device prune to k candidates per
-    shard, all_gather, exact f64 re-rank on host.
+    shard, gather the tiny candidate sets, exact re-rank on host.
 
     Each shard over-fetches (2k + 16 candidates, clamped to the shard
     length) so f32 ranking ties at the k-th boundary don't drop a true
@@ -150,15 +199,36 @@ def distributed_knn(px, py, pvalid, mesh: Mesh, n: int,
     points of one shard land inside the f32 error band of the k-th
     distance (vanishing for real data; the reference's geohash-spiral
     KNN is likewise approximate at its precision floor,
-    knn/KNNQuery.scala:27). Host re-rank uses exact f64 coords when
-    provided (else the f32 device distances). Returns global row
-    indices, nearest first.
+    knn/KNNQuery.scala:27).
+
+    Exact re-rank sources, in preference order:
+    - ``split`` (from shard_points_split, pass px=py=None): candidates
+      return WITH their two-float coords, reconstructed host-side to
+      ~1e-12 deg — no host coordinate copy at any scale;
+    - ``host_x/host_y``: full f64 host arrays (small tables only);
+    - neither: the f32 device distances rank as-is.
+    Returns global row indices, nearest first.
     """
     kk = min(k, max(n, 1))
-    shard_len = px.shape[0] // mesh.devices.size
+    size = mesh.devices.size
+    shard_len = (split[0] if split is not None else px).shape[0] // size
     fetch = min(2 * kk + 16, max(shard_len, 1))
-    fn = _knn_prune_fn(mesh, fetch)
     q = jnp.asarray(np.array([qx, qy], np.float32))
+    if split is not None:
+        fn = _knn_prune_split_fn(mesh, fetch)
+        dists, gids, cxh, cxl, cyh, cyl = fn(*split, pvalid, q)
+        dists = np.asarray(dists)
+        gids = np.asarray(gids)
+        mask = (dists < np.inf) & (gids < n)
+        keep = gids[mask]
+        cx = (np.asarray(cxh, np.float64)
+              + np.asarray(cxl, np.float64))[mask]
+        cy = (np.asarray(cyh, np.float64)
+              + np.asarray(cyl, np.float64))[mask]
+        d2 = (cx - qx) ** 2 + (cy - qy) ** 2
+        order = np.argsort(d2, kind="stable")
+        return keep[order][:kk]
+    fn = _knn_prune_fn(mesh, fetch)
     dists, gids = fn(px, py, pvalid, q)
     dists = np.asarray(dists)
     gids = np.asarray(gids)

@@ -135,6 +135,14 @@ class FileBus:
     def publish(self, topic: str, msg: GeoMessage):
         d = self._topic_dir(topic)
         raw = _encode(msg)
+        # the payload is fully written (and fsynced) BEFORE any sequence
+        # number is claimed, so the empty-claim window is just a rename
+        # — a producer can no longer stall mid-write holding a claim
+        tmp = os.path.join(d, f".payload.{os.getpid()}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(raw)
+            f.flush()
+            os.fsync(f.fileno())
         # cached next sequence avoids an O(topic-size) listdir per
         # publish; contention falls through to the O_EXCL retry loop
         seq = self._next_seq.get(topic)
@@ -142,7 +150,6 @@ class FileBus:
             seq = self._last_seq(topic) + 1
         while True:
             name = f"{seq:0{_SEQ_DIGITS}d}.msg"
-            tmp = os.path.join(d, f".{name}.{os.getpid()}.tmp")
             try:
                 # claim the sequence number atomically across processes
                 fd = os.open(os.path.join(d, name),
@@ -151,12 +158,6 @@ class FileBus:
                 seq += 1
                 continue
             try:
-                # write the payload beside it, then swap into place so a
-                # concurrent reader never sees a partial message
-                with open(tmp, "wb") as f:
-                    f.write(raw)
-                    f.flush()
-                    os.fsync(f.fileno())
                 os.replace(tmp, os.path.join(d, name))
             finally:
                 os.close(fd)

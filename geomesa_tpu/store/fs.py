@@ -47,6 +47,114 @@ def _safe_partition(name) -> str:
     return "/".join(segs)
 
 
+def _filter_props(f: ast.Filter) -> set:
+    """Attribute names referenced anywhere in a filter tree."""
+    out: set = set()
+    stack = [f]
+    while stack:
+        node = stack.pop()
+        prop = getattr(node, "prop", None)
+        if prop:
+            out.add(prop)
+        stack.extend(getattr(node, "children", ()) or ())
+        child = getattr(node, "child", None)
+        if child is not None:
+            stack.append(child)
+    return out
+
+
+def _pushdown_expr(f: ast.Filter, sft: SimpleFeatureType):
+    """Filter AST -> a CONSERVATIVE pyarrow dataset expression (matches
+    a superset of the filter), or None when nothing is pushable.
+
+    The analog of geomesa-fs's FilterConverter (fs/parquet
+    FilterConverter: CQL -> parquet predicate pushdown): row groups
+    whose column statistics cannot match are never read, and
+    non-matching rows are dropped at scan time. Exactness is unaffected
+    — the in-memory engine re-evaluates the full filter over whatever
+    loads. AND may drop unpushable conjuncts; OR is pushed only when
+    every branch is pushable.
+    """
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    geom = sft.geom_field
+    point_geom = geom is not None and sft.is_points
+
+    def lit(prop, v):
+        type_name = next((at.type.name for at in sft.attributes
+                          if at.name == prop), None)
+        if type_name == "Date":
+            from ..filters.helper import to_millis
+            return pa.scalar(np.datetime64(to_millis(v), "ms"))
+        return v
+
+    def conv(f):
+        if isinstance(f, ast.And):
+            parts = [p for p in (conv(c) for c in f.children)
+                     if p is not None]
+            if not parts:
+                return None
+            e = parts[0]
+            for p in parts[1:]:
+                e = e & p
+            return e
+        if isinstance(f, ast.Or):
+            parts = [conv(c) for c in f.children]
+            if not parts or any(p is None for p in parts):
+                return None
+            e = parts[0]
+            for p in parts[1:]:
+                e = e | p
+            return e
+        if isinstance(f, ast.BBox) and point_geom and f.prop == geom:
+            gx, gy = pc.field(geom, "x"), pc.field(geom, "y")
+            return ((gx >= f.xmin) & (gx <= f.xmax)
+                    & (gy >= f.ymin) & (gy <= f.ymax))
+        if isinstance(f, (ast.Intersects, ast.Within, ast.DWithin)) \
+                and point_geom and f.prop == geom:
+            from ..filters.helper import dwithin_degrees
+            env = f.geom.envelope
+            pad = (dwithin_degrees(f.geom, f.distance, f.units)
+                   if isinstance(f, ast.DWithin) else 0.0)
+            gx, gy = pc.field(geom, "x"), pc.field(geom, "y")
+            return ((gx >= env.xmin - pad) & (gx <= env.xmax + pad)
+                    & (gy >= env.ymin - pad) & (gy <= env.ymax + pad))
+        if isinstance(f, ast.Compare):
+            fld = pc.field(f.prop)
+            v = lit(f.prop, f.value)
+            return {
+                ast.CompareOp.EQ: lambda: fld == v,
+                ast.CompareOp.NE: lambda: fld != v,
+                ast.CompareOp.LT: lambda: fld < v,
+                ast.CompareOp.GT: lambda: fld > v,
+                ast.CompareOp.LE: lambda: fld <= v,
+                ast.CompareOp.GE: lambda: fld >= v,
+            }[f.op]()
+        if isinstance(f, ast.Between):
+            fld = pc.field(f.prop)
+            return (fld >= lit(f.prop, f.lo)) & (fld <= lit(f.prop, f.hi))
+        if isinstance(f, ast.InList):
+            return pc.field(f.prop).isin(
+                [lit(f.prop, v) for v in f.values])
+        if isinstance(f, ast.During):
+            fld = pc.field(f.prop)
+            return ((fld > pa.scalar(np.datetime64(f.start, "ms")))
+                    & (fld < pa.scalar(np.datetime64(f.end, "ms"))))
+        if isinstance(f, ast.Before):
+            return pc.field(f.prop) < pa.scalar(np.datetime64(f.time, "ms"))
+        if isinstance(f, ast.After):
+            return pc.field(f.prop) > pa.scalar(np.datetime64(f.time, "ms"))
+        if isinstance(f, ast.IsNull):
+            return pc.field(f.prop).is_null()
+        return None  # LIKE, NOT, fids, exotic spatial: not pushed
+
+    try:
+        return conv(f)
+    except Exception:
+        return None  # a column the files lack, bad literal, ...
+
+
 class _FsTypeState:
     def __init__(self, sft: SimpleFeatureType, scheme: PartitionScheme,
                  root: str):
@@ -173,20 +281,37 @@ class FileSystemDataStore:
                              if f.endswith(".parquet"))
         return files
 
-    def _load(self, st: _FsTypeState, files: list[str]) -> InMemoryDataStore:
-        key = frozenset(files)
+    def _load(self, st: _FsTypeState, files: list[str],
+              expr=None, props: list[str] | None = None
+              ) -> InMemoryDataStore:
+        key = (frozenset(files), None if expr is None else str(expr),
+               None if props is None else tuple(props))
         if key in st.cache:
+            st.cache[key] = st.cache.pop(key)  # LRU recency refresh
             return st.cache[key]
-        import pyarrow.parquet as pq
+        import pyarrow.dataset as pds
+        sft = st.sft
+        columns = None
+        if props is not None:
+            keep = set(props)
+            sft = SimpleFeatureType(
+                sft.type_name, [a for a in sft.attributes
+                                if a.name in keep], sft.user_data)
+            columns = ["__fid__"] + [a.name for a in sft.attributes]
         ds = InMemoryDataStore()
-        ds.create_schema(st.sft)
-        for path in files:
-            table = pq.read_table(path)
+        ds.create_schema(sft)
+        if files:
+            dataset = pds.dataset(files)
+            # row-group statistics pruning + row-level predicate and
+            # column projection happen inside the parquet scan
+            table = dataset.to_table(filter=expr, columns=columns)
             for rb in table.to_batches():
-                ds.write(st.sft.type_name,
-                         FeatureBatch.from_arrow(st.sft, rb))
-        # bound the cache: keep the latest two pruned sets per type
-        if len(st.cache) >= 2:
+                if rb.num_rows:
+                    ds.write(sft.type_name,
+                             FeatureBatch.from_arrow(sft, rb))
+        # bounded LRU: pushdown makes keys (files, filter, columns), so
+        # a rotation of several recurring queries must stay resident
+        if len(st.cache) >= 8:
             st.cache.pop(next(iter(st.cache)))
         st.cache[key] = ds
         return ds
@@ -206,11 +331,24 @@ class FileSystemDataStore:
             return QueryResult(np.empty(0, dtype=object), None, ex,
                                FilterStrategy("empty", None, None))
         files = self._files_for(st, parts)
-        mem = self._load(st, files)
+        expr = _pushdown_expr(q.filter, st.sft)
+        props = None
+        if q.properties is not None:
+            need = _filter_props(q.filter) | set(q.properties)
+            if st.sft.geom_field:
+                need.add(st.sft.geom_field)
+            if st.sft.dtg_field:
+                need.add(st.sft.dtg_field)
+            if q.sort_by:
+                need.add(q.sort_by)
+            props = [a.name for a in st.sft.attributes if a.name in need]
+        mem = self._load(st, files, expr, props)
         res = mem.query(q, explain_out=explain_out)
         res.explain(f"Partitions scanned: "
                     f"{'all' if parts is None else len(parts)}; "
-                    f"files: {len(files)}")
+                    f"files: {len(files)}; parquet pushdown: "
+                    f"{'yes' if expr is not None else 'no'}"
+                    + (f"; columns: {len(props)}" if props else ""))
         return res
 
     def count(self, type_name: str) -> int:

@@ -122,7 +122,11 @@ class LiveDataStore:
 
     def _on_message(self, msg: GeoMessage):
         t = msg.type_name
-        if t not in self._mem.get_type_names() and msg.batch is not None:
+        if t not in self._mem.get_type_names():
+            if msg.batch is None:
+                # delete/clear for a type this cache never saw: a no-op
+                # (nothing to remove), not an error that wedges polling
+                return
             # consumer side of a cross-process bus: the schema travels
             # with the message (self-describing wire format). The topic
             # is already subscribed — this message arrived through it —

@@ -128,6 +128,17 @@ class TestSameProcessBus:
         assert len(got) == 3
         assert bus.poll() == 7  # the rest
 
+    def test_delete_for_unknown_type_is_noop(self, tmp_path):
+        prod = LiveDataStore(bus=FileBus(str(tmp_path), group="p"))
+        prod.create_schema(parse_spec("live", SPEC))
+        prod.delete("live", ["ghost"])       # arrives before any create
+        prod.write("live", make_batch(["a"], [0], [0]))
+        cons_bus = FileBus(str(tmp_path), group="c")
+        cons = LiveDataStore(bus=cons_bus)
+        cons_bus.subscribe("live", cons._on_message)
+        assert cons_bus.poll() == 2          # delete no-op, create applied
+        assert cons.count("live") == 1
+
     def test_consumer_auto_creates_schema(self, tmp_path):
         prod = LiveDataStore(bus=FileBus(str(tmp_path), group="p"))
         prod.create_schema(parse_spec("live", SPEC))

@@ -86,6 +86,55 @@ class TestFsStore:
         expect = int(((x >= -10) & (x <= 10) & (y >= -10) & (y <= 10)).sum())
         assert res.n == expect
 
+    def test_parquet_predicate_pushdown(self, tmp_path):
+        # row filtering happens inside the parquet scan: the loaded
+        # memory store holds only a superset of matches, not the table
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("events", "kind:String,dtg:Date,*geom:Point")
+        write_sample(ds)
+        ecql = "BBOX(geom, -20, -10, 20, 10) AND kind = 'k1'"
+        res = ds.query(ecql, "events")
+        st = ds._state("events")
+        loaded = next(iter(st.cache.values()))
+        assert loaded.count("events") < 5000  # pushdown trimmed the scan
+        assert loaded.count("events") >= res.n
+        # exactness vs an unfiltered store
+        ds2 = FileSystemDataStore(str(tmp_path))
+        full = ds2._load(ds2._state("events"),
+                         ds2._files_for(ds2._state("events"), None))
+        want = set(full.query(ecql, "events").ids.astype(str))
+        assert set(res.ids.astype(str)) == want and res.n > 0
+
+    def test_parquet_column_projection(self, tmp_path):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("events", "kind:String,dtg:Date,*geom:Point")
+        write_sample(ds)
+        res = ds.query(Query("events", "kind = 'k2'",
+                             properties=["kind"]))
+        assert res.n > 0
+        assert set(res.batch.columns) == {"kind"}
+        st = ds._state("events")
+        loaded = next(iter(st.cache.values()))
+        # only the referenced columns were read from parquet
+        assert set(loaded.get_schema("events").attribute_names()
+                   if hasattr(loaded.get_schema("events"),
+                              "attribute_names")
+                   else [a.name for a in
+                         loaded.get_schema("events").attributes]) \
+            <= {"kind", "dtg", "geom"}
+
+    def test_pushdown_with_unpushable_residual(self, tmp_path):
+        # LIKE is not pushed; result must still be exact
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("events", "kind:String,dtg:Date,*geom:Point")
+        write_sample(ds)
+        ecql = "kind LIKE 'k%' AND BBOX(geom, -90, -45, 90, 45)"
+        res = ds.query(ecql, "events")
+        full = ds._load(ds._state("events"),
+                        ds._files_for(ds._state("events"), None))
+        want = set(full.query(ecql, "events").ids.astype(str))
+        assert set(res.ids.astype(str)) == want and res.n > 0
+
     def test_reopen_from_disk(self, tmp_path):
         ds = FileSystemDataStore(str(tmp_path))
         ds.create_schema("events", "kind:String,dtg:Date,*geom:Point")

@@ -1,0 +1,137 @@
+"""Shapefile / JDBC / OSM converter inputs (geomesa-convert-osm,
+-jdbc, and the tools shapefile ingest analogs)."""
+
+import sqlite3
+import struct
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert import converter_for
+from geomesa_tpu.convert.geo_formats import read_shapefile
+from geomesa_tpu.features import parse_spec
+
+
+def write_point_shapefile(path, points, attrs):
+    """Minimal ESRI .shp/.dbf writer for test fixtures (points only)."""
+    recs = b""
+    for i, (x, y) in enumerate(points):
+        content = struct.pack("<i2d", 1, x, y)
+        recs += struct.pack(">2i", i + 1, len(content) // 2) + content
+    total_words = (100 + len(recs)) // 2
+    hdr = struct.pack(">i5i", 9994, 0, 0, 0, 0, 0)
+    hdr += struct.pack(">i", total_words)
+    hdr += struct.pack("<2i", 1000, 1)
+    xs = [p[0] for p in points] or [0]
+    ys = [p[1] for p in points] or [0]
+    hdr += struct.pack("<8d", min(xs), min(ys), max(xs), max(ys),
+                       0, 0, 0, 0)
+    with open(path, "wb") as f:
+        f.write(hdr + recs)
+    # matching dbf with one C field and one N field
+    names = [a[0] for a in attrs]
+    n = len(attrs)
+    fdesc = b""
+    fdesc += b"NAME" + b"\x00" * 7 + b"C" + b"\x00" * 4 + bytes([16, 0]) \
+        + b"\x00" * 14
+    fdesc += b"SIZE" + b"\x00" * 7 + b"N" + b"\x00" * 4 + bytes([8, 0]) \
+        + b"\x00" * 14
+    rec_len = 1 + 16 + 8
+    hdr_len = 32 + len(fdesc) + 1
+    dbf = struct.pack("<B3BIHH", 3, 24, 1, 1, n, hdr_len, rec_len)
+    dbf += b"\x00" * 20 + fdesc + b"\x0D"
+    for name, size in attrs:
+        dbf += b" " + name.encode().ljust(16)[:16] \
+            + str(size).rjust(8).encode()[:8]
+    with open(path[:-4] + ".dbf", "wb") as f:
+        f.write(dbf)
+
+
+class TestShapefile:
+    def test_read_points_with_attrs(self, tmp_path):
+        shp = str(tmp_path / "pts.shp")
+        write_point_shapefile(shp, [(10.5, 20.25), (-30.0, 45.5)],
+                              [("alpha", 7), ("beta", 42)])
+        rows = list(read_shapefile(shp))
+        assert rows[0][0] == "POINT (10.5 20.25)"
+        assert rows[0][1] == "alpha" and rows[0][2] == 7
+        assert rows[1][1] == "beta" and rows[1][2] == 42
+
+    def test_converter_ingest(self, tmp_path):
+        shp = str(tmp_path / "pts.shp")
+        write_point_shapefile(shp, [(1.0, 2.0), (3.0, 4.0)],
+                              [("a", 1), ("b", 2)])
+        sft = parse_spec("t", "name:String,size:Integer,*geom:Point")
+        conv = converter_for(sft, {
+            "type": "shapefile", "id-field": "$2",
+            "fields": [
+                {"name": "name", "transform": "$2"},
+                {"name": "size", "transform": "$3::int"},
+                {"name": "geom", "transform": "geometry($1)"},
+            ]})
+        batch, ctx = conv.process(shp)
+        assert ctx.success == 2 and ctx.failure == 0
+        assert batch.ids.tolist() == ["a", "b"]
+        assert batch.col("geom").x.tolist() == [1.0, 3.0]
+
+    def test_polygon_wkt_grouping(self):
+        from geomesa_tpu.convert.geo_formats import _polygon_wkt
+        outer = [(0, 0), (0, 10), (10, 10), (10, 0), (0, 0)]  # clockwise
+        hole = [(2, 2), (4, 2), (4, 4), (2, 4), (2, 2)]       # ccw
+        wkt = _polygon_wkt([outer, hole])
+        from geomesa_tpu.geometry import parse_wkt
+        g = parse_wkt(wkt)
+        assert g.contains_points(np.array([1.0]), np.array([1.0]))[0]
+        assert not g.contains_points(np.array([3.0]), np.array([3.0]))[0]
+
+
+class TestJdbc:
+    def test_query_ingest(self, tmp_path):
+        db = str(tmp_path / "t.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE obs (name TEXT, lon REAL, lat REAL)")
+        conn.executemany("INSERT INTO obs VALUES (?,?,?)",
+                         [("x", 1.0, 2.0), ("y", 3.0, 4.0)])
+        conn.commit()
+        conn.close()
+        sft = parse_spec("t", "name:String,*geom:Point")
+        conv = converter_for(sft, {
+            "type": "jdbc",
+            "query": "SELECT name, lon, lat FROM obs ORDER BY name",
+            "id-field": "$1",
+            "fields": [
+                {"name": "name", "transform": "$1"},
+                {"name": "geom",
+                 "transform": "point($2::double, $3::double)"},
+            ]})
+        batch, ctx = conv.process(db)
+        assert ctx.success == 2
+        assert batch.col("geom").y.tolist() == [2.0, 4.0]
+
+
+OSM = """<osm version="0.6">
+  <node id="1" lat="50.1" lon="8.6"><tag k="name" v="stop-a"/></node>
+  <node id="2" lat="50.2" lon="8.7"/>
+  <node id="3" lat="50.3" lon="8.8"/>
+  <way id="10"><nd ref="1"/><nd ref="2"/><nd ref="3"/>
+    <tag k="highway" v="primary"/></way>
+</osm>"""
+
+
+class TestOsm:
+    def test_nodes_and_ways(self):
+        sft = parse_spec("t", "kind:String,name:String,*geom:Geometry")
+        conv = converter_for(sft, {
+            "type": "osm", "id-field": "concat($2, '/', $1)",
+            "fields": [
+                {"name": "kind", "transform": "$2"},
+                {"name": "name", "transform": "mapValue($0, 'name')"},
+                {"name": "geom", "transform": "geometry($3)"},
+            ]})
+        batch, ctx = conv.process(OSM)
+        assert ctx.success == 4  # 3 nodes + 1 way
+        feats = {batch.ids[i]: batch.feature(i) for i in range(batch.n)}
+        assert feats["node/1"]["name"] == "stop-a"
+        assert feats["node/2"]["name"] is None
+        way = feats["way/10"]["geom"]
+        assert way.envelope.xmin == 8.6 and way.envelope.xmax == 8.8

@@ -1,0 +1,87 @@
+"""Native z-range decomposition: the C++ path must be bit-identical to
+the Python BFS (same algorithm, differential-tested here)."""
+
+import numpy as np
+import pytest
+
+import importlib
+
+# the curves package re-exports the zranges FUNCTION; we need the module
+zr = importlib.import_module("geomesa_tpu.curves.zranges")
+from geomesa_tpu.native import load  # noqa: E402
+
+
+def python_zranges(lows, highs, max_bits, precision=64, max_ranges=None):
+    """Force the pure-Python path regardless of native availability."""
+    saved = zr._native_ready
+    zr._native_ready = False
+    try:
+        return zr.zranges(lows, highs, max_bits, precision=precision,
+                          max_ranges=max_ranges)
+    finally:
+        zr._native_ready = saved
+
+
+needs_native = pytest.mark.skipif(
+    load() is None or not hasattr(load(), "geomesa_zranges"),
+    reason="native toolchain unavailable")
+
+
+@needs_native
+class TestNativeParity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_z2_random_boxes(self, seed):
+        rng = np.random.default_rng(seed)
+        m = (1 << 31) - 1
+        for _ in range(20):
+            lo = rng.integers(0, m, 2)
+            hi = lo + rng.integers(0, m // 4, 2)
+            hi = np.minimum(hi, m)
+            for mr in (16, 200, 2000):
+                a = zr.zranges(lo, hi, 31, max_ranges=mr)
+                b = python_zranges(lo, hi, 31, max_ranges=mr)
+                assert np.array_equal(a, b), (lo, hi, mr)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_z3_random_boxes(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        m = (1 << 21) - 1
+        for _ in range(20):
+            lo = rng.integers(0, m, 3)
+            hi = np.minimum(lo + rng.integers(0, m // 3, 3), m)
+            for mr, prec in ((64, 64), (2000, 48)):
+                a = zr.zranges(lo, hi, 21, precision=prec, max_ranges=mr)
+                b = python_zranges(lo, hi, 21, precision=prec,
+                                   max_ranges=mr)
+                assert np.array_equal(a, b), (lo, hi, mr, prec)
+
+    def test_edges(self):
+        m2 = (1 << 31) - 1
+        cases = [
+            ([0, 0], [m2, m2]),            # whole domain
+            ([5, 5], [5, 5]),              # single cell
+            ([0, 0], [0, m2]),             # full column
+            ([m2, m2], [m2, m2]),          # far corner
+        ]
+        for lo, hi in cases:
+            a = zr.zranges(lo, hi, 31, max_ranges=100)
+            b = python_zranges(lo, hi, 31, max_ranges=100)
+            assert np.array_equal(a, b), (lo, hi)
+
+    def test_empty_box(self):
+        a = zr.zranges([10, 10], [5, 20], 31)
+        assert len(a) == 0
+
+    def test_covering_property(self):
+        # every z key of points inside the box falls in some range
+        rng = np.random.default_rng(9)
+        from geomesa_tpu.curves.zorder import z2_encode
+        lo = np.array([1000, 2000])
+        hi = np.array([300000, 450000])
+        r = zr.zranges(lo, hi, 31, max_ranges=64)
+        xs = rng.integers(lo[0], hi[0] + 1, 500)
+        ys = rng.integers(lo[1], hi[1] + 1, 500)
+        z = z2_encode(xs, ys).astype(np.int64)
+        inside = ((z[:, None] >= r[None, :, 0])
+                  & (z[:, None] <= r[None, :, 1])).any(axis=1)
+        assert inside.all()

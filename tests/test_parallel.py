@@ -113,6 +113,25 @@ class TestRingCollectives:
         want = np.argsort(d2, kind="stable")[:k]
         assert np.array_equal(np.sort(got), np.sort(want))
 
+    def test_distributed_knn_split_no_host_copy(self, setup):
+        # exact re-rank from two-float candidate coords: no host x/y
+        from geomesa_tpu.parallel import (distributed_knn,
+                                          shard_points_split)
+        mesh, _, _, _, _ = setup
+        rng = np.random.default_rng(23)
+        n = 40_003
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        split, valid, _ = shard_points_split(x, y, mesh)
+        qx, qy, k = -77.1, 38.9, 64
+        got = distributed_knn(None, None, valid, mesh, n, qx, qy, k,
+                              split=split)
+        d2 = (x - qx) ** 2 + (y - qy) ** 2
+        want = np.argsort(d2, kind="stable")[:k]
+        assert np.array_equal(np.sort(got), np.sort(want))
+        # ordering is nearest-first under exact distances
+        assert np.array_equal(got, want)
+
     def test_distributed_histogram_and_minmax(self, setup):
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
