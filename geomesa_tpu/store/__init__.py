@@ -2,6 +2,7 @@
 flagship execution engine; fs (Parquet + partition pruning), live
 (streaming bus) and lambda (two-tier) layer on top of it."""
 
+from .api import DataStore
 from .memory import InMemoryDataStore, QueryResult
 from .fs import FileSystemDataStore
 from .live import GeoMessage, LiveDataStore, MessageBus
@@ -10,7 +11,8 @@ from .mesh_store import DistributedDataStore
 from .partitions import (AttributeScheme, CompositeScheme, DateTimeScheme,
                          PartitionScheme, Z2Scheme, scheme_from_config)
 
-__all__ = ["InMemoryDataStore", "QueryResult", "FileSystemDataStore",
+__all__ = ["DataStore", "InMemoryDataStore", "QueryResult",
+           "FileSystemDataStore",
            "DistributedDataStore",
            "GeoMessage", "LiveDataStore", "MessageBus", "LambdaDataStore",
            "AttributeScheme", "CompositeScheme", "DateTimeScheme",

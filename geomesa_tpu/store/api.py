@@ -1,0 +1,80 @@
+"""The DataStore SPI: the pluggable-backend contract.
+
+The reference's public surface is the GeoTools DataStore SPI — every
+backend (Accumulo, HBase, Cassandra, fs, memory, Kafka, Lambda)
+implements the same schema/write/query interface, and backends plug
+into the planner core through IndexAdapter's small abstract member set
+(/root/reference/geomesa-index-api/src/main/scala/org/locationtech/
+geomesa/index/index/IndexAdapter.scala:24-102, GeoMesaDataStore.scala:38).
+
+Here the contract is this ABC: a backend supplies schema management,
+batch writes, and ``query`` (a ``Query`` in, a ``QueryResult`` of ids +
+columns out). The planner/kernel core is shared — memory, filesystem,
+live, lambda and mesh-distributed stores are all implementations, and
+``tests/test_datastore_contract.py`` runs the same black-box battery
+over every one of them (the TestGeoMesaDataStore pattern of the
+reference's index-api test suite).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Iterator
+
+from ..features.batch import FeatureBatch
+from ..features.sft import SimpleFeatureType
+from ..index.api import Query
+
+__all__ = ["DataStore"]
+
+
+class DataStore(abc.ABC):
+    """Pluggable datastore contract (GeoTools DataStore SPI analog)."""
+
+    # -- schema management ---------------------------------------------------
+
+    @abc.abstractmethod
+    def create_schema(self, sft: SimpleFeatureType | str,
+                      spec: str | None = None):
+        """Register a feature type (sft object, or name + spec string)."""
+
+    @abc.abstractmethod
+    def get_schema(self, type_name: str) -> SimpleFeatureType:
+        """The schema for a type; KeyError if absent."""
+
+    @abc.abstractmethod
+    def get_type_names(self) -> list[str]:
+        """All registered type names."""
+
+    # -- writes ----------------------------------------------------------------
+
+    @abc.abstractmethod
+    def write(self, type_name: str, batch: FeatureBatch, **kwargs):
+        """Append a feature batch."""
+
+    def write_dict(self, type_name: str, ids, data: dict[str, Any],
+                   **kwargs):
+        """Convenience: build a batch from {attribute: array} and write."""
+        self.write(type_name,
+                   FeatureBatch.from_dict(self.get_schema(type_name),
+                                          ids, data), **kwargs)
+
+    # -- queries -----------------------------------------------------------
+
+    @abc.abstractmethod
+    def query(self, q: Query | str, type_name: str | None = None,
+              explain_out=None):
+        """Run a query; returns a QueryResult (ids, batch, explain,
+        plan). A string argument is ECQL and requires type_name."""
+
+    @abc.abstractmethod
+    def count(self, type_name: str) -> int:
+        """Total stored features of a type."""
+
+    # -- shared conveniences -------------------------------------------------
+
+    def features(self, type_name: str,
+                 ecql: str = "INCLUDE") -> Iterator[dict]:
+        """Iterate matching features as dicts (reader-style access)."""
+        res = self.query(ecql, type_name)
+        return res.features()

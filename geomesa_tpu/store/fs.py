@@ -27,6 +27,7 @@ from ..features.batch import FeatureBatch
 from ..features.sft import SimpleFeatureType, parse_spec
 from ..filters import ast
 from ..index.api import Query
+from .api import DataStore
 from .memory import InMemoryDataStore, QueryResult
 from .partitions import (DateTimeScheme, PartitionScheme, Z2Scheme,
                          scheme_from_config)
@@ -169,7 +170,7 @@ class _FsTypeState:
         return os.path.join(self.root, "data")
 
 
-class FileSystemDataStore:
+class FileSystemDataStore(DataStore):
     """Parquet-backed datastore with the same query surface as the
     in-memory store."""
 
@@ -248,10 +249,6 @@ class FileSystemDataStore:
             import pyarrow as pa
             pq.write_table(pa.Table.from_batches([sub.to_arrow()]), path)
         st.cache.clear()
-
-    def write_dict(self, type_name: str, ids, data: dict[str, Any]):
-        st = self._state(type_name)
-        self.write(type_name, FeatureBatch.from_dict(st.sft, ids, data))
 
     # -- partitions --------------------------------------------------------
 

@@ -17,6 +17,7 @@ import numpy as np
 
 from ..features.sft import SimpleFeatureType, parse_spec
 from ..index.api import Query
+from .api import DataStore
 from .live import LiveDataStore, MessageBus
 from .memory import InMemoryDataStore, QueryResult
 
@@ -27,7 +28,7 @@ LAMBDA_QUERY_PERSISTENT = "LAMBDA_QUERY_PERSISTENT"
 LAMBDA_QUERY_TRANSIENT = "LAMBDA_QUERY_TRANSIENT"
 
 
-class LambdaDataStore:
+class LambdaDataStore(DataStore):
     def __init__(self, persistent=None, bus: MessageBus | None = None,
                  persist_after_millis: int = 3_600_000):
         self.transient = LiveDataStore(bus)
@@ -43,13 +44,19 @@ class LambdaDataStore:
             self.persistent.create_schema(sft)
 
     def get_schema(self, type_name: str) -> SimpleFeatureType:
-        return self.transient.get_schema(type_name)
+        try:
+            return self.transient.get_schema(type_name)
+        except KeyError:
+            # types living only in a user-supplied persistent tier are
+            # still part of this store's surface
+            return self.persistent.get_schema(type_name)
+
+    def get_type_names(self) -> list[str]:
+        return sorted(set(self.transient.get_type_names())
+                      | set(self.persistent.get_type_names()))
 
     def write(self, type_name: str, batch, timestamp_ms=None):
         self.transient.write(type_name, batch, timestamp_ms)
-
-    def write_dict(self, type_name: str, ids, data, timestamp_ms=None):
-        self.transient.write_dict(type_name, ids, data, timestamp_ms)
 
     def delete(self, type_name: str, ids):
         self.transient.delete(type_name, ids)

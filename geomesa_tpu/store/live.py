@@ -26,6 +26,7 @@ import numpy as np
 from ..features.batch import FeatureBatch
 from ..features.sft import SimpleFeatureType, parse_spec
 from ..index.api import Query
+from .api import DataStore
 from .memory import InMemoryDataStore, QueryResult
 
 __all__ = ["GeoMessage", "MessageBus", "LiveDataStore"]
@@ -57,7 +58,7 @@ class MessageBus:
             fn(msg)
 
 
-class LiveDataStore:
+class LiveDataStore(DataStore):
     """Streaming store over a MessageBus: publish mutations, query the
     live cache."""
 
@@ -95,12 +96,6 @@ class LiveDataStore:
         ts = timestamp_ms if timestamp_ms is not None else int(time.time() * 1000)
         self.bus.publish(type_name, GeoMessage("create", type_name, batch,
                                                timestamp_ms=ts))
-
-    def write_dict(self, type_name: str, ids, data: dict[str, Any],
-                   timestamp_ms: int | None = None):
-        sft = self._mem.get_schema(type_name)
-        self.write(type_name, FeatureBatch.from_dict(sft, ids, data),
-                   timestamp_ms)
 
     def delete(self, type_name: str, ids):
         self.bus.publish(type_name, GeoMessage(

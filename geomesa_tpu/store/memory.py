@@ -33,6 +33,7 @@ from ..filters.helper import extract_geometries, extract_intervals
 from ..geometry import Envelope
 from ..index.api import Explainer, FilterStrategy, Query, QueryHints
 from ..index.planner import decide_strategy
+from .api import DataStore
 from ..scan import gscan, zscan
 from ..stats import DataStoreStats, parse_stat
 from ..utils.properties import SystemProperty
@@ -283,7 +284,7 @@ class _TypeState:
         return self.pallas_data
 
 
-class InMemoryDataStore:
+class InMemoryDataStore(DataStore):
     """A GeoTools-DataStore-shaped API over device-resident batches."""
 
     def __init__(self, audit=None):
@@ -325,12 +326,6 @@ class InMemoryDataStore:
         # auto-maintained stats, the write-side StatsCombiner analog
         # (accumulo/data/stats/StatsCombiner.scala)
         self.stats.observe(st.sft, batch)
-
-    def write_dict(self, type_name: str, ids, data: dict[str, Any],
-                   visibilities=None):
-        st = self._state(type_name)
-        self.write(type_name, FeatureBatch.from_dict(st.sft, ids, data),
-                   visibilities)
 
     def delete(self, type_name: str, ids):
         self._state(type_name).delete(set(map(str, ids)))

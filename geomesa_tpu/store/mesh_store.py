@@ -30,6 +30,7 @@ from ..filters import ast
 from ..filters.evaluate import evaluate
 from ..filters.helper import extract_geometries
 from ..index.api import Explainer, FilterStrategy, Query, QueryHints
+from .api import DataStore
 from ..index.planner import decide_strategy
 from ..parallel import (DistributedScanData, data_mesh, distributed_count,
                         distributed_density, distributed_histogram,
@@ -55,7 +56,7 @@ class _MeshTypeState:
         return 0 if self.batch is None else self.batch.n
 
 
-class DistributedDataStore:
+class DistributedDataStore(DataStore):
     """Point-type datastore sharded over a device mesh.
 
     Extent (non-point) types belong on the single-device store for now;
@@ -93,10 +94,6 @@ class DistributedDataStore:
         st = self._state(type_name)
         st.batch = batch if st.batch is None else st.batch.concat(batch)
         st.dirty = True
-
-    def write_dict(self, type_name: str, ids, data):
-        st = self._state(type_name)
-        self.write(type_name, FeatureBatch.from_dict(st.sft, ids, data))
 
     def count(self, type_name: str) -> int:
         return self._state(type_name).n

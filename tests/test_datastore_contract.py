@@ -1,0 +1,88 @@
+"""DataStore SPI conformance: the same black-box battery runs against
+every backend (the reference's TestGeoMesaDataStore pattern — the
+planner/query contract is tested without caring which storage sits
+underneath; geomesa-index-api test strategy, SURVEY.md section 4)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.store import (DataStore, DistributedDataStore,
+                               FileSystemDataStore, InMemoryDataStore,
+                               LambdaDataStore, LiveDataStore)
+from geomesa_tpu.store.api import DataStore as ABCDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+SPEC = "name:String:index=true,val:Integer,dtg:Date,*geom:Point:srid=4326"
+N = 3_000
+
+
+def _populate(ds, type_name="t"):
+    rng = np.random.default_rng(55)
+    ds.create_schema(parse_spec(type_name, SPEC))
+    ds.write_dict(type_name, [f"f{i}" for i in range(N)], {
+        "name": [f"n{i % 10}" for i in range(N)],
+        "val": rng.integers(0, 100, N),
+        "dtg": rng.integers(MS("2019-01-01"), MS("2019-03-01"), N),
+        "geom": (rng.uniform(-180, 180, N), rng.uniform(-90, 90, N)),
+    })
+    return ds
+
+
+@pytest.fixture(params=["memory", "fs", "live", "lambda", "mesh"])
+def store(request, tmp_path):
+    kind = request.param
+    if kind == "memory":
+        yield _populate(InMemoryDataStore())
+    elif kind == "fs":
+        yield _populate(FileSystemDataStore(str(tmp_path)))
+    elif kind == "live":
+        yield _populate(LiveDataStore())
+    elif kind == "lambda":
+        yield _populate(LambdaDataStore())
+    else:
+        from geomesa_tpu.parallel import data_mesh
+        yield _populate(DistributedDataStore(data_mesh()))
+
+
+class TestContract:
+    def test_is_spi_instance(self, store):
+        assert isinstance(store, ABCDataStore)
+        assert isinstance(store, DataStore)
+
+    def test_schema_roundtrip(self, store):
+        sft = store.get_schema("t")
+        assert sft.geom_field == "geom" and sft.dtg_field == "dtg"
+        assert "t" in store.get_type_names()
+
+    def test_count(self, store):
+        assert store.count("t") == N
+
+    def test_bbox_query_ids_exact(self, store):
+        res = store.query("BBOX(geom, -60, -30, 60, 30)", "t")
+        # brute-force oracle via the full scan of the same store
+        full = store.query("INCLUDE", "t")
+        x = np.array([f["geom"].x for f in full.features()])
+        y = np.array([f["geom"].y for f in full.features()])
+        ids = np.asarray(full.ids, dtype=object)
+        m = (x >= -60) & (x <= 60) & (y >= -30) & (y <= 30)
+        assert set(res.ids.astype(str)) == set(ids[m].astype(str))
+        assert res.n > 0
+
+    def test_attribute_query(self, store):
+        res = store.query("name = 'n3'", "t")
+        assert res.n == sum(1 for i in range(N) if i % 10 == 3)
+
+    def test_spatio_temporal(self, store):
+        ecql = ("BBOX(geom, -120, -60, 120, 60) AND "
+                "dtg DURING 2019-01-10T00:00:00Z/2019-01-20T00:00:00Z")
+        res = store.query(ecql, "t")
+        assert 0 < res.n < N
+        for f in list(res.features())[:10]:
+            assert -120 <= f["geom"].x <= 120
+
+    def test_unknown_type_raises_keyerror(self, store):
+        # the documented SPI contract: KeyError for absent types
+        with pytest.raises(KeyError):
+            store.get_schema("nope")
