@@ -8,6 +8,8 @@ from .fs import FileSystemDataStore
 from .live import GeoMessage, LiveDataStore, MessageBus
 from .lambda_store import LambdaDataStore
 from .mesh_store import DistributedDataStore
+from .stream import (FileTailSource, IterableSource, StreamDataStore,
+                     StreamSource)
 from .partitions import (AttributeScheme, CompositeScheme, DateTimeScheme,
                          PartitionScheme, Z2Scheme, scheme_from_config)
 
@@ -15,5 +17,7 @@ __all__ = ["DataStore", "InMemoryDataStore", "QueryResult",
            "FileSystemDataStore",
            "DistributedDataStore",
            "GeoMessage", "LiveDataStore", "MessageBus", "LambdaDataStore",
+           "StreamSource", "StreamDataStore", "FileTailSource",
+           "IterableSource",
            "AttributeScheme", "CompositeScheme", "DateTimeScheme",
            "PartitionScheme", "Z2Scheme", "scheme_from_config"]

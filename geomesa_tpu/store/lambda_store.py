@@ -55,7 +55,19 @@ class LambdaDataStore(DataStore):
         return sorted(set(self.transient.get_type_names())
                       | set(self.persistent.get_type_names()))
 
+    def _transient_has(self, type_name: str) -> bool:
+        return type_name in self.transient.get_type_names()
+
     def write(self, type_name: str, batch, timestamp_ms=None):
+        if not self._transient_has(type_name):
+            if type_name in self.persistent.get_type_names():
+                # persistent-only type: register it in the transient
+                # tier so the write lands in the cache (not a silent
+                # publish to a topic nobody consumes)
+                self.transient.create_schema(
+                    self.persistent.get_schema(type_name))
+            else:
+                raise KeyError(f"no such schema: {type_name}")
         self.transient.write(type_name, batch, timestamp_ms)
 
     def delete(self, type_name: str, ids):
@@ -82,7 +94,9 @@ class LambdaDataStore(DataStore):
             q = Query(type_name, q)
         if q.hints.get(LAMBDA_QUERY_TRANSIENT):
             return self.transient.query(q, explain_out=explain_out)
-        if q.hints.get(LAMBDA_QUERY_PERSISTENT):
+        if q.hints.get(LAMBDA_QUERY_PERSISTENT) \
+                or not self._transient_has(q.type_name):
+            # persistent-only types answer from that tier alone
             return self.persistent.query(q, explain_out=explain_out)
         # run the tiers unsorted/unlimited; sort + limit re-apply on the
         # union (per-tier limits would be wrong)

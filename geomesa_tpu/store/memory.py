@@ -48,6 +48,13 @@ _REAPER = ThreadManagement()
 # stack selection (AccumuloIndexAdapter.scanConfig choosing iterators)
 SCAN_KERNEL = SystemProperty("geomesa.scan.kernel", "xla")
 
+# index-pruned candidate sets at or below this size evaluate exactly on
+# host in f64 (one vectorized pass over the gathered rows) instead of a
+# device round trip — per-query latency is then index-search +
+# candidate-sized work, not dispatch-floor bound. Larger candidate sets
+# ride the device kernels where HBM bandwidth wins.
+HOST_SCAN_ROWS = SystemProperty("geomesa.scan.host.rows", "2000000")
+
 __all__ = ["InMemoryDataStore", "QueryResult"]
 
 
@@ -670,7 +677,16 @@ class InMemoryDataStore(DataStore):
             explain(f"Boundary recheck: {len(cand)} candidate(s)")
             return zscan.exact_patch(mask, cand, x, y, millis, sq)
 
-        if rows is not None:
+        if rows is not None and len(rows) <= int(HOST_SCAN_ROWS.get()):
+            # small candidate set: exact f64 host evaluation needs no
+            # two-float machinery, no boundary patch and no device
+            # round trip — the reference's tablet-local iterator work,
+            # collapsed to one vectorized pass over the gathered rows
+            explain(f"Index-pruned host scan: {len(rows)} candidate "
+                    f"row(s) of {st.n}, {len(boxes)} box(es), "
+                    f"{len(intervals)} interval(s)")
+            idx = self._host_exact_scan(st, rows, sq)
+        elif rows is not None:
             explain(f"Index-pruned device scan: {len(rows)} candidate "
                     f"row(s) of {st.n}, {len(boxes)} box(es), "
                     f"{len(intervals)} interval(s)")
@@ -704,6 +720,23 @@ class InMemoryDataStore(DataStore):
                     idx = idx[keep]
             explain("Exact geometry predicate applied")
         return idx
+
+    @staticmethod
+    def _host_exact_scan(st: _TypeState, rows: np.ndarray,
+                         sq: "zscan.ScanQuery") -> np.ndarray:
+        """Exact f64 spatio-temporal evaluation over candidate rows —
+        zscan.exact_patch with EVERY candidate as a boundary case, so
+        the semantics are the boundary patch's by construction."""
+        batch = st.batch
+        col = batch.col(st.sft.geom_field)
+        x = col.x[rows]
+        y = col.y[rows]
+        dtg = st.sft.dtg_field
+        ms = (batch.col(dtg).millis[rows] if dtg is not None
+              else np.zeros(len(rows), dtype=np.int64))
+        keep = zscan.exact_patch(np.zeros(len(rows), dtype=bool),
+                                 np.arange(len(rows)), x, y, ms, sq)
+        return np.sort(rows[keep])
 
     def _device_extent_scan(self, st: _TypeState, q: Query,
                             strategy: FilterStrategy,

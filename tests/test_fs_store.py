@@ -263,6 +263,21 @@ class TestReviewRegressions:
                           visibilities=["admin&&bad"])
         assert ds.count("t") == 0
 
+    def test_lambda_persistent_only_type_surface(self):
+        from geomesa_tpu.store import InMemoryDataStore
+        p = InMemoryDataStore()
+        p.create_schema("only_p", "v:Integer,*geom:Point")
+        p.write_dict("only_p", ["a"], {"v": [1], "geom": ([0.0], [0.0])})
+        lam = LambdaDataStore(persistent=p)
+        assert "only_p" in lam.get_type_names()
+        assert lam.count("only_p") == 1          # queries reach the tier
+        lam.write_dict("only_p", ["b"],
+                       {"v": [2], "geom": ([1.0], [1.0])})
+        assert lam.count("only_p") == 2          # writes are not dropped
+        with pytest.raises(KeyError):
+            lam.write_dict("ghost", ["x"],
+                           {"v": [1], "geom": ([0.0], [0.0])})
+
     def test_lambda_stale_persistent_version_hidden(self):
         ds = LambdaDataStore(persist_after_millis=10)
         ds.create_schema("s", "status:String,*geom:Point")

@@ -123,7 +123,7 @@ class TestStoreQueries:
     def test_explain(self, store):
         res = store.query("BBOX(geom, 0, 0, 1, 1)", "people")
         assert "Selected" in res.explain.text
-        assert "device scan" in res.explain.text.lower()
+        assert "scan" in res.explain.text.lower()
 
 
 class TestStoreLifecycle:

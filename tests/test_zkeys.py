@@ -136,6 +136,22 @@ class TestPrunedVsDense:
         finally:
             SCAN_BLOCK_THRESHOLD.set(None)
 
+    def test_device_gather_variant_parity(self, ds):
+        # force the device gather path (normally reserved for large
+        # candidate sets) and check it matches the host exact path
+        from geomesa_tpu.store.memory import HOST_SCAN_ROWS
+        ecql = ("BBOX(geom, 10, 10, 12, 12) AND "
+                "dtg DURING 2017-03-01T00:00:00Z/2017-03-08T00:00:00Z")
+        res_host, text_host = self._explained(ds, ecql)
+        assert "Index-pruned host scan" in text_host
+        HOST_SCAN_ROWS.set("0")
+        try:
+            res_dev, text_dev = self._explained(ds, ecql)
+            assert "Index-pruned device scan" in text_dev
+        finally:
+            HOST_SCAN_ROWS.set(None)
+        assert _ids(res_host) == _ids(res_dev) == _oracle(ds, ecql)
+
     def test_results_match_dense_after_delete(self, ds):
         ds2 = _mkstore(n=2000, seed=11)
         ds2.delete("pts", [f"f{i}" for i in range(0, 2000, 3)])
