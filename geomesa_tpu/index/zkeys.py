@@ -36,7 +36,8 @@ from ..curves.sfc import z2sfc, z3sfc
 from ..curves.timebin import TimePeriod
 from ..utils.properties import SystemProperty
 
-__all__ = ["ZKeyIndex", "multi_arange", "SCAN_BLOCK_THRESHOLD"]
+__all__ = ["ZKeyIndex", "multi_arange", "prune_candidates",
+           "SCAN_BLOCK_THRESHOLD"]
 
 # candidate-fraction above which an indexed scan falls back to the dense
 # full-batch kernel (gather cost crossover)
@@ -62,6 +63,23 @@ def multi_arange(starts: np.ndarray, stops: np.ndarray) -> np.ndarray:
     out[0] = starts[0]
     out[ends[:-1]] = starts[1:] - (starts[:-1] + counts[:-1] - 1)
     return np.cumsum(out)
+
+
+def prune_candidates(zindex, index_name: str, boxes, intervals,
+                     max_rows: int | None) -> np.ndarray | None:
+    """THE pruning policy, shared by the single-device and mesh stores:
+    pick the z3 or z2 order for the strategy, skip pruning for
+    unconstrained (whole-world, no-time) queries, and bail to a dense
+    scan when the candidate set exceeds ``max_rows``. Returns candidate
+    row indices or None (caller runs the dense path)."""
+    whole_world = list(boxes) == [(-180.0, -90.0, 180.0, 90.0)]
+    if zindex is None or (whole_world and not intervals):
+        return None
+    if index_name == "z3" and intervals:
+        return zindex.candidates_z3(boxes, intervals, max_rows=max_rows)
+    if not whole_world:
+        return zindex.candidates_z2(boxes, max_rows=max_rows)
+    return None
 
 
 class ZKeyIndex:

@@ -649,16 +649,10 @@ class InMemoryDataStore(DataStore):
         # z-range pruning (Z3IndexKeySpace.getRanges analog): candidate
         # rows from the sorted key index, gathered device scan; dense
         # full-batch kernel when the candidate set is a large fraction
-        rows = None
-        whole_world = boxes == [(-180.0, -90.0, 180.0, 90.0)]
-        if st.zindex is not None and not (whole_world and not intervals):
-            from ..index.zkeys import SCAN_BLOCK_THRESHOLD
-            max_rows = int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n)
-            if strategy.index == "z3" and intervals:
-                rows = st.zindex.candidates_z3(boxes, intervals,
-                                               max_rows=max_rows)
-            elif not whole_world:
-                rows = st.zindex.candidates_z2(boxes, max_rows=max_rows)
+        from ..index.zkeys import SCAN_BLOCK_THRESHOLD, prune_candidates
+        rows = prune_candidates(
+            st.zindex, strategy.index, boxes, intervals,
+            int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n))
 
         def patch_boundaries(mask, xhi, yhi, sel):
             """Exact f64 recheck of rows whose hi-cell touches a query

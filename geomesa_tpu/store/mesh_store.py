@@ -34,8 +34,8 @@ from .api import DataStore
 from ..index.planner import decide_strategy
 from ..parallel import (DistributedScanData, data_mesh, distributed_count,
                         distributed_density, distributed_histogram,
-                        distributed_knn, exact_host_mask, shard_points,
-                        shard_scan_data)
+                        distributed_knn, exact_host_mask,
+                        shard_points_split, shard_scan_data)
 from ..scan import zscan
 from .memory import (QueryResult, _intervals_ms, _is_envelope, _needs_exact,
                      _spatial_only, _walk)
@@ -48,7 +48,9 @@ class _MeshTypeState:
         self.sft = sft
         self.batch: FeatureBatch | None = None
         self.data: DistributedScanData | None = None
-        self.points = None  # (xj, yj, valid, n) for KNN
+        self.split = None    # two-float sharded coords for KNN
+        self.valid = None
+        self.zindex = None   # host sorted z-key index (range pruning)
         self.dirty = False
 
     @property
@@ -107,7 +109,9 @@ class DistributedDataStore(DataStore):
             return
         if st.batch is None or st.batch.n == 0:
             st.data = None
-            st.points = None
+            st.split = None
+            st.valid = None
+            st.zindex = None
             st.dirty = False
             return
         col = st.batch.col(st.sft.geom_field)
@@ -115,7 +119,13 @@ class DistributedDataStore(DataStore):
         millis = (st.batch.col(dtg).millis if dtg is not None
                   else np.zeros(st.batch.n, dtype=np.int64))
         st.data = shard_scan_data(col.x, col.y, millis, self.mesh)
-        st.points = shard_points(col.x, col.y, self.mesh)
+        st.split, st.valid, _ = shard_points_split(col.x, col.y, self.mesh)
+        # the same host z-key index the single-device engine prunes
+        # with: selective queries skip the mesh scan entirely
+        from ..index.zkeys import ZKeyIndex
+        st.zindex = ZKeyIndex(col.x, col.y,
+                              millis if dtg is not None else None,
+                              st.sft.z3_interval)
         st.dirty = False
 
     # -- queries ----------------------------------------------------------
@@ -162,9 +172,7 @@ class DistributedDataStore(DataStore):
                            np.asarray(strategy.primary.ids, dtype=str))
         else:
             sq = self._scan_query(st, strategy)
-            mask = exact_host_mask(st.data, sq)
-            explain(f"Distributed scan over {self.mesh.devices.size} "
-                    f"device(s)")
+            mask = self._pruned_or_distributed(st, strategy, sq, explain)
             primary = strategy.primary or ast.Include()
             geoms = extract_geometries(primary, st.sft.geom_field)
             if _needs_exact(geoms, primary):
@@ -207,6 +215,34 @@ class DistributedDataStore(DataStore):
         batch = st.batch.take(idx)
         explain(f"Hits: {len(ids)}").pop()
         return QueryResult(ids, batch, explain, strategy)
+
+    def _pruned_or_distributed(self, st: _MeshTypeState,
+                               strategy: FilterStrategy,
+                               sq: zscan.ScanQuery,
+                               explain: Explainer) -> np.ndarray:
+        """z-index pruning + host fast path for selective queries (the
+        single-device engine's crossover); wide scans fan out over the
+        mesh. Returns a bool[n] mask."""
+        from ..index.zkeys import SCAN_BLOCK_THRESHOLD, prune_candidates
+        from .memory import HOST_SCAN_ROWS, InMemoryDataStore
+        boxes = [tuple(b) for b in sq.host_boxes]
+        intervals = [tuple(iv) for iv in sq.host_intervals]
+        # the mesh has no gathered-candidate device path, so pruning is
+        # only worthwhile up to the host fast-path size
+        max_rows = min(int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n),
+                       int(HOST_SCAN_ROWS.get()))
+        rows = prune_candidates(st.zindex, strategy.index, boxes,
+                                intervals, max_rows)
+        if rows is not None:
+            explain(f"Index-pruned host scan: {len(rows)} candidate "
+                    f"row(s) of {st.n}")
+            idx = InMemoryDataStore._host_exact_scan(st, rows, sq)
+            mask = np.zeros(st.n, dtype=bool)
+            mask[idx] = True
+            return mask
+        explain(f"Distributed scan over {self.mesh.devices.size} "
+                f"device(s)")
+        return exact_host_mask(st.data, sq)
 
     def query_count(self, q: Query | str, type_name: str | None = None) -> int:
         """Count without gathering a mask: psum over ICI + host boundary
@@ -279,8 +315,6 @@ class DistributedDataStore(DataStore):
         if st.n == 0:
             return np.empty(0, dtype=object)
         self._ensure_sharded(st)
-        xj, yj, valid, n = st.points
-        col = st.batch.col(st.sft.geom_field)
-        idx = distributed_knn(xj, yj, valid, self.mesh, n, qx, qy, k,
-                              host_x=col.x, host_y=col.y)
+        idx = distributed_knn(None, None, st.valid, self.mesh, st.n,
+                              qx, qy, k, split=st.split)
         return st.batch.ids[idx]

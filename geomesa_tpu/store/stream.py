@@ -51,6 +51,10 @@ class FileTailSource(StreamSource):
     def poll(self) -> list[str]:
         if not os.path.exists(self.path):
             return []
+        if os.path.getsize(self.path) < self._offset:
+            # the feed was truncated/rotated in place: restart from the
+            # top instead of silently tailing past EOF forever
+            self._offset = 0
         # binary mode: the offset is in BYTES, so multi-byte characters
         # never desynchronize the tail position
         with open(self.path, "rb") as f:
@@ -116,10 +120,13 @@ class StreamDataStore(DataStore):
         if not records:
             self._live.expire(self.sft.type_name)
             return 0
-        if all(isinstance(r, str) for r in records):
-            payload: Any = "\n".join(records) + "\n"
-        else:
-            payload = records
+        # converters consume text streams: string records join as
+        # lines; structured records (dicts/lists from a queue source)
+        # serialize to JSON lines for the json converter
+        import json as _json
+        payload: Any = "\n".join(
+            r if isinstance(r, str) else _json.dumps(r)
+            for r in records) + "\n"
         batch, ctx = self.converter.process(payload)
         if batch.n:
             self._live.write(self.sft.type_name, batch)

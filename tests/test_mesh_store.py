@@ -90,6 +90,27 @@ class TestDistributedStore:
         want = list(mem.query(qd).ids.astype(str))
         assert got == want
 
+    def test_selective_query_uses_pruned_host_path(self, stores):
+        from geomesa_tpu.index.api import Query
+        dist, mem = stores
+        ecql = ("BBOX(geom, 5, 5, 7, 7) AND "
+                "dtg DURING 2019-02-01T00:00:00Z/2019-02-08T00:00:00Z")
+        lines = []
+        res = dist.query(Query("pts", ecql), explain_out=lines.append)
+        assert any("Index-pruned host scan" in ln for ln in lines), lines
+        want = set(mem.query(ecql, "pts").ids.astype(str))
+        assert set(res.ids.astype(str)) == want
+
+    def test_wide_query_uses_distributed_scan(self, stores):
+        from geomesa_tpu.index.api import Query
+        dist, mem = stores
+        ecql = "BBOX(geom, -180, -90, 180, 0)"
+        lines = []
+        res = dist.query(Query("pts", ecql), explain_out=lines.append)
+        assert any("Distributed scan" in ln for ln in lines), lines
+        want = set(mem.query(ecql, "pts").ids.astype(str))
+        assert set(res.ids.astype(str)) == want
+
     def test_rejects_extent_types(self):
         ds = DistributedDataStore()
         with pytest.raises(ValueError):

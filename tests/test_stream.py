@@ -62,6 +62,43 @@ class TestFileTail:
         assert events == ["create"]
 
 
+class TestRotation:
+    def test_truncated_feed_restarts(self, tmp_path):
+        path = str(tmp_path / "feed.csv")
+        src = FileTailSource(path)
+        with open(path, "w") as f:
+            f.write(L1 + "\n" + L2 + "\n")
+        assert len(src.poll()) == 2
+        with open(path, "w") as f:   # rotation: smaller file, same path
+            f.write(L3 + "\n")
+        assert src.poll() == [L3]
+
+
+class TestDictRecords:
+    def test_dict_records_via_json_converter(self):
+        conf = {"type": "json", "id-field": "$1",
+                "fields": [
+                    {"path": "$.id"},
+                    {"name": "name", "path": "$.name"},
+                    {"name": "count", "path": "$.c",
+                     "transform": "$3::int"},
+                    {"name": "dtg", "path": "$.t",
+                     "transform": "isoDate($4)"},
+                    {"path": "$.x"},
+                    {"path": "$.y"},
+                    {"name": "geom",
+                     "transform": "point($5::double, $6::double)"},
+                ]}
+        recs = [{"id": "a", "name": "x", "c": 1,
+                 "t": "2021-01-01T00:00:00Z", "x": 1.0, "y": 2.0},
+                {"id": "b", "name": "y", "c": 2,
+                 "t": "2021-01-02T00:00:00Z", "x": 3.0, "y": 4.0}]
+        store = StreamDataStore("obs", conf, IterableSource(iter(recs)),
+                                spec=SPEC)
+        assert store.tick() == 2
+        assert store.count("obs") == 2
+
+
 class TestIterableSource:
     def test_drain_in_batches(self):
         src = IterableSource(iter([L1, L2, L3]), batch=2)
