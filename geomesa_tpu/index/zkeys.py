@@ -67,18 +67,23 @@ def multi_arange(starts: np.ndarray, stops: np.ndarray) -> np.ndarray:
 
 def prune_candidates(zindex, index_name: str, boxes, intervals,
                      max_rows: int | None) -> np.ndarray | None:
-    """THE pruning policy, shared by the single-device and mesh stores:
-    pick the z3 or z2 order for the strategy, skip pruning for
-    unconstrained (whole-world, no-time) queries, and bail to a dense
-    scan when the candidate set exceeds ``max_rows``. Returns candidate
-    row indices or None (caller runs the dense path)."""
+    """THE pruning policy, shared by every store and index family
+    (z2/z3 point orders, xz2/xz3 extent orders): pick the
+    spatio-temporal or spatial-only order for the strategy, skip
+    pruning for unconstrained (whole-world, no-time) queries, and bail
+    to a dense scan when the candidate set exceeds ``max_rows``.
+    Returns candidate row indices or None (caller runs the dense path)."""
     whole_world = list(boxes) == [(-180.0, -90.0, 180.0, 90.0)]
     if zindex is None or (whole_world and not intervals):
         return None
-    if index_name == "z3" and intervals:
-        return zindex.candidates_z3(boxes, intervals, max_rows=max_rows)
+    if index_name in ("z3", "xz3") and intervals:
+        fn = getattr(zindex, f"candidates_{index_name}", None)
+        return None if fn is None else fn(boxes, intervals,
+                                          max_rows=max_rows)
     if not whole_world:
-        return zindex.candidates_z2(boxes, max_rows=max_rows)
+        spatial = "xz2" if index_name.startswith("xz") else "z2"
+        fn = getattr(zindex, f"candidates_{spatial}", None)
+        return None if fn is None else fn(boxes, max_rows=max_rows)
     return None
 
 

@@ -227,12 +227,16 @@ class _TypeState:
         col = self.batch.col(geom) if geom else None
         if not isinstance(col, PointColumn):
             # extent geometries: device bbox tristate scan (XZ analog)
+            # plus a host XZ-key index for range pruning
             self.scan_data = None
             if col is not None:
                 millis = (self.batch.col(dtg).millis
                           if dtg is not None else None)
                 self.extent_data = gscan.build_extent_data(
                     col.bounds, millis)
+                from ..index.xzkeys import XZKeyIndex
+                self.zindex = XZKeyIndex(col.bounds, millis,
+                                         self.sft.z3_interval)
             self.dirty = False
             return
         x = col.x
@@ -752,6 +756,21 @@ class InMemoryDataStore(DataStore):
             [(-180.0, -90.0, 180.0, 90.0)]
         intervals = (_intervals_ms(primary, dtg)
                      if dtg is not None and strategy.index == "xz3" else [])
+
+        # XZ-key pruning (XZ2/XZ3IndexKeySpace analog): selective
+        # queries evaluate only the candidate extents, exactly, on host
+        from ..index.zkeys import SCAN_BLOCK_THRESHOLD, prune_candidates
+        max_rows = min(int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n),
+                       int(HOST_SCAN_ROWS.get()))
+        rows = prune_candidates(st.zindex, strategy.index, boxes,
+                                intervals, max_rows)
+        if rows is not None:
+            explain(f"XZ-pruned host scan: {len(rows)} candidate "
+                    f"row(s) of {st.n}")
+            if not len(rows):
+                return rows
+            keep = evaluate(primary, batch.take(rows))
+            return np.sort(rows[keep])
 
         eq = gscan.extent_query(boxes, intervals)
         state = gscan.extent_tristate(st.extent_data, eq)

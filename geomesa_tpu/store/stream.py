@@ -47,19 +47,29 @@ class FileTailSource(StreamSource):
     def __init__(self, path: str):
         self.path = path
         self._offset = 0
+        self._inode: int | None = None
 
     def poll(self) -> list[str]:
-        if not os.path.exists(self.path):
-            return []
-        if os.path.getsize(self.path) < self._offset:
-            # the feed was truncated/rotated in place: restart from the
-            # top instead of silently tailing past EOF forever
+        try:
+            stat = os.stat(self.path)  # one syscall: no exists/size race
+        except OSError:
+            return []  # mid-rotation: try again next poll
+        if (stat.st_size < self._offset
+                or (self._inode is not None
+                    and stat.st_ino != self._inode)):
+            # truncated in place, or replaced by a new file (rename
+            # rotation): restart from the top instead of tailing a
+            # stale offset into unrelated bytes
             self._offset = 0
+        self._inode = stat.st_ino
         # binary mode: the offset is in BYTES, so multi-byte characters
         # never desynchronize the tail position
-        with open(self.path, "rb") as f:
-            f.seek(self._offset)
-            chunk = f.read()
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._offset)
+                chunk = f.read()
+        except OSError:
+            return []
         if not chunk:
             return []
         # hold back a trailing partial line until its newline arrives
@@ -122,11 +132,20 @@ class StreamDataStore(DataStore):
             return 0
         # converters consume text streams: string records join as
         # lines; structured records (dicts/lists from a queue source)
-        # serialize to JSON lines for the json converter
+        # serialize to JSON lines for the json converter. Records that
+        # serialize to nothing sane become bad-record lines the
+        # converter counts as failures, not a dead pipeline.
         import json as _json
-        payload: Any = "\n".join(
-            r if isinstance(r, str) else _json.dumps(r)
-            for r in records) + "\n"
+
+        def as_line(r) -> str:
+            if isinstance(r, str):
+                return r
+            try:
+                return _json.dumps(r)
+            except (TypeError, ValueError):
+                return str(r)
+
+        payload: Any = "\n".join(as_line(r) for r in records) + "\n"
         batch, ctx = self.converter.process(payload)
         if batch.n:
             self._live.write(self.sft.type_name, batch)

@@ -1,0 +1,135 @@
+"""XZ extent index: pruned candidate scans must exactly match the dense
+tristate path and the reference evaluator (XZ2/XZ3IndexKeySpace
+analog)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.filters import evaluate, parse_ecql
+from geomesa_tpu.index.api import Query
+from geomesa_tpu.index.xzkeys import XZKeyIndex
+from geomesa_tpu.store import InMemoryDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+SPEC = "name:String,dtg:Date,*track:LineString"
+
+N = 20_000
+
+
+def make_lines(rng, n, lon=(-175, 175), lat=(-85, 85), span=2.0):
+    cx = rng.uniform(*lon, n)
+    cy = rng.uniform(*lat, n)
+    dx = rng.uniform(0.05, span, n)
+    dy = rng.uniform(0.05, span, n)
+    return [f"LINESTRING ({cx[i]-dx[i]} {cy[i]-dy[i]}, "
+            f"{cx[i]} {cy[i]}, {cx[i]+dx[i]} {cy[i]+dy[i]})"
+            for i in range(n)]
+
+
+@pytest.fixture(scope="module")
+def ds():
+    rng = np.random.default_rng(77)
+    store = InMemoryDataStore()
+    store.create_schema(parse_spec("trk", SPEC))
+    store.write_dict("trk", [f"t{i}" for i in range(N)], {
+        "name": [f"n{i % 7}" for i in range(N)],
+        "dtg": rng.integers(MS("2018-01-01"), MS("2018-06-01"), N),
+        "track": make_lines(rng, N),
+    })
+    return store
+
+
+def _oracle(ds, ecql):
+    batch = ds._state("trk").batch
+    return set(batch.ids[evaluate(parse_ecql(ecql), batch)].astype(str))
+
+
+QUERIES = [
+    "BBOX(track, 10, 10, 14, 14)",
+    "BBOX(track, -170, -80, -160, -70)",
+    ("BBOX(track, 0, 0, 8, 8) AND "
+     "dtg DURING 2018-02-01T00:00:00Z/2018-02-15T00:00:00Z"),
+    "INTERSECTS(track, POLYGON ((20 20, 30 20, 25 30, 20 20)))",
+    ("INTERSECTS(track, POLYGON ((20 20, 30 20, 25 30, 20 20))) AND "
+     "dtg DURING 2018-03-01T00:00:00Z/2018-04-01T00:00:00Z"),
+]
+
+
+class TestXZPrunedVsDense:
+    @pytest.mark.parametrize("ecql", QUERIES)
+    def test_pruned_matches_oracle(self, ds, ecql):
+        lines = []
+        res = ds.query(Query("trk", ecql), explain_out=lines.append)
+        assert any("XZ-pruned host scan" in ln for ln in lines), lines
+        assert set(res.ids.astype(str)) == _oracle(ds, ecql)
+        assert res.n > 0
+
+    @pytest.mark.parametrize("ecql", QUERIES)
+    def test_dense_variant_parity(self, ds, ecql):
+        from geomesa_tpu.index.zkeys import SCAN_BLOCK_THRESHOLD
+        SCAN_BLOCK_THRESHOLD.set("0.0")  # force dense tristate
+        try:
+            lines = []
+            res = ds.query(Query("trk", ecql), explain_out=lines.append)
+            assert any("Device extent scan" in ln for ln in lines), lines
+        finally:
+            SCAN_BLOCK_THRESHOLD.set(None)
+        assert set(res.ids.astype(str)) == _oracle(ds, ecql)
+
+    def test_wide_query_stays_dense(self, ds):
+        lines = []
+        ecql = "BBOX(track, -180, -90, 180, 90)"
+        res = ds.query(Query("trk", ecql), explain_out=lines.append)
+        assert not any("XZ-pruned" in ln for ln in lines)
+        assert res.n == N
+
+    def test_big_extents_still_found(self):
+        # a geometry much larger than the query box indexes at a coarse
+        # cell; the covering ranges must still include it
+        ds2 = InMemoryDataStore()
+        ds2.create_schema(parse_spec("trk", SPEC))
+        ds2.write_dict("trk", ["big", "small"], {
+            "name": ["a", "b"],
+            "dtg": [MS("2018-01-05")] * 2,
+            "track": ["LINESTRING (-60 -40, 60 40)",
+                      "LINESTRING (1.0 1.0, 1.1 1.1)"],
+        })
+        res = ds2.query("BBOX(track, 0.5, 0.2, 1.5, 1.2)", "trk")
+        assert set(res.ids.astype(str)) == {"big", "small"}
+
+    def test_out_of_domain_extent_remains_candidate(self):
+        ds2 = InMemoryDataStore()
+        ds2.create_schema(parse_spec("trk", SPEC))
+        ds2.write_dict("trk", ["wide", "in"], {
+            "name": ["a", "b"],
+            "dtg": [MS("2018-01-05")] * 2,
+            # crosses the domain edge: lenient-indexed
+            "track": ["LINESTRING (-190 10, -170 12)",
+                      "LINESTRING (-171 11, -170.5 11.5)"],
+        })
+        res = ds2.query("BBOX(track, -175, 9, -169, 13)", "trk")
+        assert set(res.ids.astype(str)) == {"wide", "in"}
+
+
+class TestXZKeyIndexUnit:
+    def test_candidates_superset(self):
+        rng = np.random.default_rng(5)
+        n = 5_000
+        xmin = rng.uniform(-170, 165, n)
+        ymin = rng.uniform(-80, 75, n)
+        bounds = np.stack([xmin, ymin,
+                           xmin + rng.uniform(0.1, 4, n),
+                           ymin + rng.uniform(0.1, 4, n)], axis=1)
+        idx = XZKeyIndex(bounds, None)
+        box = (20.0, 20.0, 40.0, 35.0)
+        rows = idx.candidates_xz2([box])
+        hit = ((bounds[:, 0] <= box[2]) & (bounds[:, 2] >= box[0])
+               & (bounds[:, 1] <= box[3]) & (bounds[:, 3] >= box[1]))
+        assert set(np.flatnonzero(hit)) <= set(rows.tolist())
+        assert len(rows) < n  # actually pruned
+
+    def test_max_rows_abort(self):
+        bounds = np.tile([0.0, 0.0, 1.0, 1.0], (100, 1))
+        idx = XZKeyIndex(bounds, None)
+        assert idx.candidates_xz2([(-10, -10, 10, 10)], max_rows=5) is None
