@@ -21,7 +21,7 @@ import numpy as np
 from ..curves import timebin
 from ..curves.timebin import TimePeriod
 from ..curves.xz import xz2sfc, xz3sfc
-from .zkeys import multi_arange
+from .zkeys import binned_candidate_positions, multi_arange
 
 __all__ = ["XZKeyIndex"]
 
@@ -114,54 +114,19 @@ class XZKeyIndex:
             return None
         ubins, seg_offsets, codes_sorted, perm = built
         sfc = xz3sfc(period=self.period)
-        cap = timebin.max_date_millis(self.period) - 1
-        by_bin: dict[int, list[float]] = {}
-        for lo_ms, hi_ms in intervals_ms:
-            if hi_ms < lo_ms:
-                continue
-            lo_ms = min(max(int(lo_ms), 0), cap)
-            hi_ms = min(max(int(hi_ms), 0), cap)
-            bs, los, his = timebin.bins_of_interval(lo_ms, hi_ms,
-                                                    self.period)
-            for b, lo, hi in zip(bs.tolist(), los.tolist(), his.tolist()):
-                cur = by_bin.get(b)
-                if cur is None:
-                    by_bin[b] = [lo, hi]
-                else:
-                    cur[0] = min(cur[0], lo)
-                    cur[1] = max(cur[1], hi)
-        if not by_bin:
+
+        def range_fn(key):
+            lo_off, hi_off = key
+            return sfc.ranges(
+                [(bx[0], bx[1], float(lo_off),
+                  bx[2], bx[3], float(hi_off)) for bx in boxes],
+                max_ranges=max_ranges)
+
+        pos = binned_candidate_positions(
+            ubins, seg_offsets, codes_sorted, intervals_ms, self.period,
+            range_fn, max_rows,
+            base_total=len(self._escape))  # escapes count against cap
+        if pos is None:
             return None
-        range_cache: dict[tuple, np.ndarray] = {}
-        pieces = []
-        total = len(self._escape)  # escape rows count against the cap
-        if max_rows is not None and total > max_rows:
-            return None
-        for b in sorted(by_bin):
-            i = int(np.searchsorted(ubins, b))
-            if i >= len(ubins) or int(ubins[i]) != b:
-                continue
-            s, e = int(seg_offsets[i]), int(seg_offsets[i + 1])
-            key = tuple(by_bin[b])
-            ranges = range_cache.get(key)
-            if ranges is None:
-                lo_off, hi_off = key
-                ranges = sfc.ranges(
-                    [(bx[0], bx[1], float(lo_off),
-                      bx[2], bx[3], float(hi_off)) for bx in boxes],
-                    max_ranges=max_ranges)
-                range_cache[key] = ranges
-            if len(ranges) == 0:
-                continue
-            seg = codes_sorted[s:e]
-            los = s + np.searchsorted(seg, ranges[:, 0], side="left")
-            his = s + np.searchsorted(seg, ranges[:, 1], side="right")
-            total += int(np.sum(his - los))
-            if max_rows is not None and total > max_rows:
-                return None
-            pos = multi_arange(los, his)
-            if len(pos):
-                pieces.append(pos)
-        cand = (perm[np.concatenate(pieces)] if pieces
-                else np.empty(0, dtype=np.int64))
+        cand = perm[pos] if len(pos) else np.empty(0, dtype=np.int64)
         return np.unique(np.concatenate([cand, self._escape]))

@@ -65,6 +65,68 @@ def multi_arange(starts: np.ndarray, stops: np.ndarray) -> np.ndarray:
     return np.cumsum(out)
 
 
+def binned_candidate_positions(ubins, seg_offsets, keys_sorted,
+                               intervals_ms, period, range_fn,
+                               max_rows: int | None,
+                               base_total: int = 0) -> np.ndarray | None:
+    """Shared per-time-bin fan-out (Z3IndexKeySpace.getRanges:100-136):
+    clamp intervals into the indexable range (monotone, matching the
+    lenient keys), union per-bin offset hulls, and binary-search each
+    bin's covering ranges (``range_fn((lo_off, hi_off))``) inside its
+    sorted segment. Returns positions into the sorted order, an empty
+    array when nothing matches, or None when the interval set is empty
+    or the candidate count (plus ``base_total``) exceeds ``max_rows``.
+    Used by both the z3 point index and the xz3 extent index."""
+    cap = timebin.max_date_millis(period) - 1
+    by_bin: dict[int, list[int]] = {}
+    for lo_ms, hi_ms in intervals_ms:
+        if hi_ms < lo_ms:
+            continue
+        lo_ms = min(max(int(lo_ms), 0), cap)
+        hi_ms = min(max(int(hi_ms), 0), cap)
+        bs, los, his = timebin.bins_of_interval(lo_ms, hi_ms, period)
+        for b, lo, hi in zip(bs.tolist(), los.tolist(), his.tolist()):
+            cur = by_bin.get(b)
+            if cur is None:
+                by_bin[b] = [lo, hi]
+            else:
+                # over-approximate disjoint unions with the hull; the
+                # exact re-check downstream handles every candidate
+                cur[0] = min(cur[0], lo)
+                cur[1] = max(cur[1], hi)
+    if not by_bin:
+        return None
+    if max_rows is not None and base_total > max_rows:
+        return None
+    range_cache: dict[tuple, np.ndarray] = {}
+    pieces: list[np.ndarray] = []
+    total = base_total
+    for b in sorted(by_bin):
+        i = int(np.searchsorted(ubins, b))
+        if i >= len(ubins) or int(ubins[i]) != b:
+            continue
+        s, e = int(seg_offsets[i]), int(seg_offsets[i + 1])
+        key = tuple(by_bin[b])
+        ranges = range_cache.get(key)
+        if ranges is None:
+            ranges = range_fn(key)
+            range_cache[key] = ranges
+        if len(ranges) == 0:
+            continue
+        seg = keys_sorted[s:e]
+        los = s + np.searchsorted(seg, ranges[:, 0], side="left")
+        his = s + np.searchsorted(seg, ranges[:, 1], side="right")
+        total += int(np.sum(his - los))
+        if max_rows is not None and total > max_rows:
+            return None
+        pos = multi_arange(los, his)
+        if len(pos):
+            pieces.append(pos)
+    if not pieces:
+        return np.empty(0, dtype=np.int64)
+    return np.concatenate(pieces)
+
+
 def prune_candidates(zindex, index_name: str, boxes, intervals,
                      max_rows: int | None) -> np.ndarray | None:
     """THE pruning policy, shared by every store and index family
@@ -236,61 +298,15 @@ class ZKeyIndex:
             return None
         ubins, seg_offsets, z_sorted, perm = built
         sfc = z3sfc(self.period)
-
-        # per-bin inclusive offset bounds, unioned across intervals.
-        # Interval bounds clamp into the indexable range EXACTLY like the
-        # lenient point keys do (to_binned(lenient=True) in _build_z3):
-        # clamp is monotone, so t in [lo,hi] => clamp(t) in
-        # [clamp(lo), clamp(hi)] and clamped point keys stay candidates.
-        cap = timebin.max_date_millis(self.period) - 1
-        by_bin: dict[int, list[int]] = {}
-        for lo_ms, hi_ms in intervals_ms:
-            if hi_ms < lo_ms:
-                continue
-            lo_ms = min(max(int(lo_ms), 0), cap)
-            hi_ms = min(max(int(hi_ms), 0), cap)
-            bs, los, his = timebin.bins_of_interval(lo_ms, hi_ms,
-                                                    self.period)
-            for b, lo, hi in zip(bs.tolist(), los.tolist(), his.tolist()):
-                cur = by_bin.get(b)
-                if cur is None:
-                    by_bin[b] = [lo, hi]
-                else:
-                    # over-approximate disjoint unions with the hull; the
-                    # exact kernel re-checks every candidate anyway
-                    cur[0] = min(cur[0], lo)
-                    cur[1] = max(cur[1], hi)
-        if not by_bin:
+        pos = binned_candidate_positions(
+            ubins, seg_offsets, z_sorted, intervals_ms, self.period,
+            lambda key: sfc.ranges(boxes, [key], max_ranges=max_ranges),
+            max_rows)
+        if pos is None:
             return None
-
-        range_cache: dict[tuple[int, int], np.ndarray] = {}
-        pieces: list[np.ndarray] = []
-        total = 0
-        for b in sorted(by_bin):
-            # locate this bin's segment in the sorted order
-            i = int(np.searchsorted(ubins, b))
-            if i >= len(ubins) or int(ubins[i]) != b:
-                continue
-            s, e = int(seg_offsets[i]), int(seg_offsets[i + 1])
-            key = tuple(by_bin[b])
-            ranges = range_cache.get(key)
-            if ranges is None:
-                ranges = sfc.ranges(boxes, [key], max_ranges=max_ranges)
-                range_cache[key] = ranges
-            if len(ranges) == 0:
-                continue
-            seg = z_sorted[s:e]
-            los = s + np.searchsorted(seg, ranges[:, 0], side="left")
-            his = s + np.searchsorted(seg, ranges[:, 1], side="right")
-            total += int(np.sum(his - los))
-            if max_rows is not None and total > max_rows:
-                return None
-            pos = multi_arange(los, his)
-            if len(pos):
-                pieces.append(pos)
-        if not pieces:
+        if not len(pos):
             return np.empty(0, dtype=np.int64)
-        return perm[np.concatenate(pieces)].astype(np.int64)
+        return perm[pos].astype(np.int64)
 
     def candidates_z2(self, boxes, *, max_rows: int | None = None,
                       max_ranges: int | None = None) -> np.ndarray | None:

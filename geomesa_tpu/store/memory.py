@@ -55,6 +55,12 @@ SCAN_KERNEL = SystemProperty("geomesa.scan.kernel", "xla")
 # ride the device kernels where HBM bandwidth wins.
 HOST_SCAN_ROWS = SystemProperty("geomesa.scan.host.rows", "2000000")
 
+# the extent pruned path re-checks candidates with per-geometry exact
+# predicates (Python-loop scale, not the vectorized point math), so its
+# crossover back to the dense device tristate sits much lower
+EXTENT_HOST_SCAN_ROWS = SystemProperty("geomesa.scan.extent.host.rows",
+                                       "50000")
+
 __all__ = ["InMemoryDataStore", "QueryResult"]
 
 
@@ -761,7 +767,7 @@ class InMemoryDataStore(DataStore):
         # queries evaluate only the candidate extents, exactly, on host
         from ..index.zkeys import SCAN_BLOCK_THRESHOLD, prune_candidates
         max_rows = min(int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n),
-                       int(HOST_SCAN_ROWS.get()))
+                       int(EXTENT_HOST_SCAN_ROWS.get()))
         rows = prune_candidates(st.zindex, strategy.index, boxes,
                                 intervals, max_rows)
         if rows is not None:

@@ -52,8 +52,8 @@ class FileTailSource(StreamSource):
     def poll(self) -> list[str]:
         try:
             stat = os.stat(self.path)  # one syscall: no exists/size race
-        except OSError:
-            return []  # mid-rotation: try again next poll
+        except FileNotFoundError:
+            return []  # not created yet / mid-rotation: retry next poll
         if (stat.st_size < self._offset
                 or (self._inode is not None
                     and stat.st_ino != self._inode)):
@@ -68,8 +68,10 @@ class FileTailSource(StreamSource):
             with open(self.path, "rb") as f:
                 f.seek(self._offset)
                 chunk = f.read()
-        except OSError:
-            return []
+        except FileNotFoundError:
+            return []  # removed between stat and open (rotation)
+        # other OSErrors (EACCES, EISDIR, ...) are real
+        # misconfigurations and must surface, not silently no-op
         if not chunk:
             return []
         # hold back a trailing partial line until its newline arrives

@@ -106,6 +106,18 @@ class GeoMesaWebServer:
             return 200, "application/json", _j(stat.to_json_object())
         if len(parts) == 2 and parts[0] == "density":
             return self._density(parts[1], params)
+        if parts == ["sql"]:
+            # POST body or ?q= : a SELECT with ST_* predicates/joins
+            stmt = (body.decode() if method == "POST" and body
+                    else params.get("q", [""])[0])
+            if not stmt.strip():
+                return 400, "application/json", _j(
+                    {"error": "missing SQL statement"})
+            from ..sql import SqlEngine
+            res = SqlEngine(self.store).query(stmt)
+            return 200, "application/json", _j(
+                {"columns": res.names,
+                 "rows": [list(r) for r in res.rows()]})
         if parts == ["audit"]:
             if self.audit is None:
                 return 200, "application/json", _j([])

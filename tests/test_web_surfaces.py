@@ -105,6 +105,26 @@ class TestRest:
         with urllib.request.urlopen(req) as r:
             assert r.status == 200
 
+    def test_sql_endpoint(self, server):
+        import urllib.parse
+        q = urllib.parse.quote(
+            "SELECT name, age FROM people WHERE "
+            "ST_Contains(ST_MakeBBOX(-100, 25, -60, 50), geom) "
+            "AND age < 3 ORDER BY age")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/rest/sql?q={q}") as r:
+            out = json.loads(r.read())
+        assert out["columns"] == ["name", "age"]
+        assert [row[1] for row in out["rows"]] == [0, 1, 2]
+
+    def test_sql_endpoint_post(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/rest/sql",
+            data=b"SELECT COUNT(*) FROM people", method="POST")
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert out["rows"][0][0] == 100
+
     def test_bad_cql_is_400(self, server):
         try:
             _get(server, "/rest/query/people?cql=%3C%3C%3C")
