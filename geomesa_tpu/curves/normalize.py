@@ -54,7 +54,11 @@ class NormalizedDimension:
         """
         x = np.asarray(x, dtype=np.float64)
         normalizer = self.bins / (self.max - self.min)
-        out = np.floor((x - self.min) * normalizer).astype(np.int64)
+        prod = np.floor((x - self.min) * normalizer)
+        # NaN (null coordinates) maps to bin 0 explicitly — the old
+        # NaN->int cast produced the same value via truncation but with
+        # a RuntimeWarning and int-cast UB semantics
+        out = np.where(np.isnan(prod), 0.0, prod).astype(np.int64)
         # float rounding can push in-bounds values just below max up to
         # `bins`; clamp rather than wrap (int32 overflow would silently
         # produce a wrong z key for points at the domain edge)

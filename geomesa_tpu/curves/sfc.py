@@ -24,6 +24,59 @@ from .timebin import TimePeriod
 __all__ = ["Z2SFC", "Z3SFC", "z2sfc", "z3sfc"]
 
 
+_native_enc = None  # None = unprobed, False = unavailable
+
+
+def _native_encoder():
+    """ctypes handle to the fused C++ encoder (native/src/zencode.cpp),
+    or None. One pass over the inputs instead of ~30 numpy temporaries —
+    the index-build hot loop at 100M rows."""
+    global _native_enc
+    if _native_enc is False:
+        return None
+    if _native_enc is None:
+        import ctypes
+        from ..native import load
+        lib = load()
+        if lib is None or not hasattr(lib, "geomesa_z3_encode"):
+            _native_enc = False
+            return None
+        dp = ctypes.POINTER(ctypes.c_double)
+        ip = ctypes.POINTER(ctypes.c_int64)
+        lib.geomesa_z2_encode.restype = None
+        lib.geomesa_z2_encode.argtypes = [dp, dp, ctypes.c_int64, ip]
+        lib.geomesa_z3_encode.restype = None
+        lib.geomesa_z3_encode.argtypes = [dp, dp, dp, ctypes.c_int64,
+                                          ctypes.c_double, ip]
+        _native_enc = lib
+    return _native_enc
+
+
+def _native_index(fn_name: str, arrays, extra=()) -> np.ndarray | None:
+    """Run a native encoder over EQUAL-LENGTH 1-D inputs; None when the
+    native library is absent or the inputs need numpy broadcasting
+    (scalars / mismatched lengths must take the numpy path — the C
+    kernel would read out of bounds)."""
+    if any(np.ndim(a) != 1 for a in arrays):
+        return None
+    lengths = {len(a) for a in arrays}
+    if len(lengths) != 1:
+        return None
+    lib = _native_encoder()
+    if lib is None:
+        return None
+    import ctypes
+    cast = [np.ascontiguousarray(a, dtype=np.float64) for a in arrays]
+    n = len(cast[0])
+    out = np.empty(n, dtype=np.int64)
+    ptr = ctypes.POINTER(ctypes.c_double)
+    getattr(lib, fn_name)(
+        *[a.ctypes.data_as(ptr) for a in cast], n, *extra,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    # zero-copy dtype parity with the numpy path (uint64)
+    return out.view(np.uint64)
+
+
 def _bounded(dims_and_values, lenient: bool, what: str):
     """Shared strict/lenient bounds handling: raise on out-of-bounds
     values unless lenient, in which case clamp (Z3SFC.scala:33-50)."""
@@ -48,6 +101,10 @@ class Z2SFC:
         self.lat = normalized_lat(precision)
 
     def index(self, x, y, lenient: bool = False) -> np.ndarray:
+        if lenient and self.precision == zorder.Z2_BITS:
+            out = _native_index("geomesa_z2_encode", (x, y))
+            if out is not None:
+                return out
         x, y = _bounded([(self.lon, x), (self.lat, y)], lenient, "z2 index")
         return zorder.z2_encode(self.lon.normalize(x), self.lat.normalize(y))
 
@@ -88,6 +145,12 @@ class Z3SFC:
 
     def index(self, x, y, t, lenient: bool = False) -> np.ndarray:
         """x/y doubles, t = offset within the time bin (not epoch millis)."""
+        if lenient and self.precision == zorder.Z3_BITS:
+            import ctypes
+            out = _native_index("geomesa_z3_encode", (x, y, t),
+                                extra=(ctypes.c_double(self.time.max),))
+            if out is not None:
+                return out
         x, y, t = _bounded([(self.lon, x), (self.lat, y), (self.time, t)],
                            lenient, "z3 index")
         return zorder.z3_encode(self.lon.normalize(x), self.lat.normalize(y),

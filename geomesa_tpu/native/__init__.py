@@ -23,7 +23,7 @@ _BUILD = os.path.join(_DIR, "_build")
 _lock = threading.Lock()
 _cache: dict = {}
 
-_SOURCES = ["feature_codec.cpp", "zrange.cpp"]
+_SOURCES = ["feature_codec.cpp", "zrange.cpp", "zencode.cpp"]
 
 
 def _source_files() -> list:

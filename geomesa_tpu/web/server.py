@@ -13,6 +13,7 @@ stdlib http server — no framework dependency:
     GET  /rest/query/{type}?cql=&maxFeatures=&format=json|geojson|arrow
     GET  /rest/stats/{type}?stat=MinMax(attr)&cql=
     GET  /rest/density/{type}?bbox=x0,y0,x1,y1&width=&height=&cql=
+    GET  /rest/sql?q=SELECT...  (or POST /rest/sql, body = statement)
     GET  /rest/audit?type=&since=
 
 Queries run the normal planner/scan path; arrow responses stream IPC

@@ -1,0 +1,98 @@
+"""Native fused z-encoders: bit-identical to the numpy
+normalize+interleave pipeline, including NaN and clamp edges."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curves import sfc as sfc_mod
+from geomesa_tpu.curves import timebin, zorder
+from geomesa_tpu.curves.sfc import z2sfc, z3sfc
+from geomesa_tpu.native import load
+
+needs_native = pytest.mark.skipif(
+    load() is None or not hasattr(load(), "geomesa_z3_encode"),
+    reason="native toolchain unavailable")
+
+
+def numpy_z3(sfc, x, y, t):
+    """Force the pure-numpy path."""
+    saved = sfc_mod._native_enc
+    sfc_mod._native_enc = False
+    try:
+        return sfc.index(x, y, t, lenient=True)
+    finally:
+        sfc_mod._native_enc = saved
+
+
+def numpy_z2(sfc, x, y):
+    saved = sfc_mod._native_enc
+    sfc_mod._native_enc = False
+    try:
+        return sfc.index(x, y, lenient=True)
+    finally:
+        sfc_mod._native_enc = saved
+
+
+@needs_native
+class TestNativeEncodeParity:
+    def test_z3_random_and_edges(self):
+        sfc = z3sfc("week")
+        tmax = float(timebin.max_offset(timebin.TimePeriod.WEEK))
+        rng = np.random.default_rng(2)
+        x = np.concatenate([rng.uniform(-200, 200, 50_000),
+                            [-180.0, 180.0, 0.0, np.nan, 179.9999999,
+                             -180.0000001, 1e300, -1e300]])
+        y = np.concatenate([rng.uniform(-100, 100, 50_000),
+                            [-90.0, 90.0, 0.0, 1.0, np.nan, 89.999999,
+                             -90.5, 0.0]])
+        t = np.concatenate([rng.uniform(-1e3, tmax * 1.1, 50_000),
+                            [0.0, tmax, tmax / 2, 1.0, 2.0, np.nan,
+                             -5.0, tmax + 100]])
+        a = sfc.index(x, y, t, lenient=True)
+        b = numpy_z3(sfc, x, y, t)
+        assert a.dtype == b.dtype == np.uint64
+        assert np.array_equal(a, b)
+
+    def test_z2_random_and_edges(self):
+        sfc = z2sfc()
+        rng = np.random.default_rng(3)
+        x = np.concatenate([rng.uniform(-200, 200, 50_000),
+                            [-180.0, 180.0, np.nan, 179.99999999999]])
+        y = np.concatenate([rng.uniform(-100, 100, 50_000),
+                            [-90.0, 90.0, 45.0, np.nan]])
+        a = sfc.index(x, y, lenient=True)
+        b = numpy_z2(sfc, x, y)
+        assert np.array_equal(a, b)
+
+    def test_scalar_broadcast_falls_back_to_numpy(self):
+        # mixed scalar/array inputs must broadcast via numpy, never
+        # reach the C kernel (which would read out of bounds)
+        x = np.array([10.0, 20.0, 30.0])
+        a = z2sfc().index(x, 5.0, lenient=True)
+        b = numpy_z2(z2sfc(), x, np.full(3, 5.0))
+        assert np.array_equal(a, b)
+        sfc = z3sfc("week")
+        a3 = sfc.index(x, 5.0, 100.0, lenient=True)
+        b3 = numpy_z3(sfc, x, np.full(3, 5.0), np.full(3, 100.0))
+        assert np.array_equal(a3, b3)
+
+    def test_mismatched_lengths_fall_back(self):
+        # numpy raises a broadcast error either way: equal behavior
+        with pytest.raises(ValueError):
+            z2sfc().index(np.array([1.0, 2.0]), np.array([1.0, 2.0, 3.0]),
+                          lenient=True)
+
+    def test_strict_path_unchanged(self):
+        # non-lenient calls must keep raising on out-of-bounds
+        with pytest.raises(ValueError):
+            z2sfc().index(np.array([200.0]), np.array([0.0]))
+
+    def test_roundtrip_through_decode(self):
+        sfc = z3sfc("day")
+        x = np.array([-75.1, 10.5])
+        y = np.array([38.2, -20.0])
+        t = np.array([1000.0, 2000.0])
+        z = sfc.index(x, y, t, lenient=True)
+        xi, yi, ti = zorder.z3_decode(z)
+        assert np.all(np.abs(sfc.lon.denormalize(xi) - x) < 1e-3)
+        assert np.all(np.abs(sfc.lat.denormalize(yi) - y) < 1e-3)
