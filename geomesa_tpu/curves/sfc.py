@@ -36,20 +36,16 @@ def _native_encoder():
         return None
     if _native_enc is None:
         import ctypes
-        from ..native import load
-        lib = load()
-        if lib is None or not hasattr(lib, "geomesa_z3_encode"):
-            _native_enc = False
-            return None
+        from ..native import symbols
         dp = ctypes.POINTER(ctypes.c_double)
         ip = ctypes.POINTER(ctypes.c_int64)
-        lib.geomesa_z2_encode.restype = None
-        lib.geomesa_z2_encode.argtypes = [dp, dp, ctypes.c_int64, ip]
-        lib.geomesa_z3_encode.restype = None
-        lib.geomesa_z3_encode.argtypes = [dp, dp, dp, ctypes.c_int64,
-                                          ctypes.c_double, ip]
-        _native_enc = lib
-    return _native_enc
+        lib = symbols({
+            "geomesa_z2_encode": (None, [dp, dp, ctypes.c_int64, ip]),
+            "geomesa_z3_encode": (None, [dp, dp, dp, ctypes.c_int64,
+                                         ctypes.c_double, ip]),
+        })
+        _native_enc = lib if lib is not None else False
+    return _native_enc or None
 
 
 def _native_index(fn_name: str, arrays, extra=()) -> np.ndarray | None:

@@ -71,17 +71,17 @@ def _native_zranges(lows, highs, dims, max_bits, max_level,
         return None
     import ctypes
     if _native_ready is None:
-        from ..native import load
-        lib = load()
-        if lib is None or not hasattr(lib, "geomesa_zranges"):
-            _native_ready = False
+        from ..native import symbols
+        ip = ctypes.POINTER(ctypes.c_int64)
+        lib = symbols({
+            "geomesa_zranges": (
+                ctypes.c_int64,
+                [ip, ip, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                 ctypes.c_int64, ip, ctypes.c_int64]),
+        })
+        _native_ready = lib if lib is not None else False
+        if _native_ready is False:
             return None
-        lib.geomesa_zranges.restype = ctypes.c_int64
-        lib.geomesa_zranges.argtypes = [
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
-        _native_ready = lib
     lib = _native_ready
     # the budget check allows one final partial expansion past
     # max_ranges; 4x + slack comfortably bounds the merged output

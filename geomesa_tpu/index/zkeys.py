@@ -65,6 +65,80 @@ def multi_arange(starts: np.ndarray, stops: np.ndarray) -> np.ndarray:
     return np.cumsum(out)
 
 
+_native_sort = None  # None = unprobed, False = unavailable
+
+
+def _native_sort_lib():
+    """ctypes handle to the native sort (native/src/zsort.cpp): counting
+    sort by bin + per-segment pair sort, replacing two indirect
+    O(N log N) argsorts in np.lexsort. Tie order matches lexsort."""
+    global _native_sort
+    if _native_sort is False:
+        return None
+    if _native_sort is None:
+        import ctypes
+        from ..native import symbols
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib = symbols({
+            "geomesa_sort_bin_z": (
+                ctypes.c_int64,
+                [i32p, i64p, ctypes.c_int64, ctypes.c_int64, i32p, i64p,
+                 i64p]),
+            "geomesa_sort_z": (
+                ctypes.c_int64, [i64p, ctypes.c_int64, i32p, i64p]),
+        })
+        _native_sort = lib if lib is not None else False
+    return _native_sort or None
+
+
+def _i32p(a):
+    import ctypes
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i64p(a):
+    import ctypes
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _native_sort_bin_z(bins: np.ndarray, z: np.ndarray):
+    """(z_sorted, perm, ubins, seg_offsets) or None. The counting sort
+    exports its per-bin prefix sums, so segment boundaries come back
+    for free — no bins gather / np.unique pass afterwards."""
+    lib = _native_sort_lib()
+    if lib is None or not len(bins):
+        return None
+    bins = np.ascontiguousarray(bins, dtype=np.int32)
+    z = np.ascontiguousarray(z, dtype=np.int64)
+    max_bin = int(bins.max())
+    perm = np.empty(len(z), dtype=np.int32)
+    z_sorted = np.empty(len(z), dtype=np.int64)
+    offsets = np.empty(max_bin + 2, dtype=np.int64)
+    rc = lib.geomesa_sort_bin_z(_i32p(bins), _i64p(z), len(z),
+                                max_bin, _i32p(perm), _i64p(z_sorted),
+                                _i64p(offsets))
+    if rc != 0:
+        return None
+    counts = np.diff(offsets)
+    present = counts > 0
+    ubins = np.flatnonzero(present).astype(bins.dtype)
+    seg_offsets = np.append(offsets[:-1][present], len(z))
+    return z_sorted, perm, ubins, seg_offsets
+
+
+def _native_sort_z(z: np.ndarray):
+    lib = _native_sort_lib()
+    if lib is None or not len(z):
+        return None
+    z = np.ascontiguousarray(z, dtype=np.int64)
+    perm = np.empty(len(z), dtype=np.int32)
+    z_sorted = np.empty(len(z), dtype=np.int64)
+    rc = lib.geomesa_sort_z(_i64p(z), len(z), _i32p(perm),
+                            _i64p(z_sorted))
+    return None if rc != 0 else (z_sorted, perm)
+
+
 def binned_candidate_positions(ubins, seg_offsets, keys_sorted,
                                intervals_ms, period, range_fn,
                                max_rows: int | None,
@@ -189,12 +263,17 @@ class ZKeyIndex:
                                        lenient=True)
         z = sfc.index(self._x, self._y, offs.astype(np.float64),
                       lenient=True).astype(np.int64)
-        perm = np.lexsort((z, bins)).astype(self._perm_dtype())
-        bins_sorted = bins[perm]
-        z_sorted = z[perm]
-        # per-bin contiguous segments in the sorted order
-        ubins, seg_starts = np.unique(bins_sorted, return_index=True)
-        seg_offsets = np.append(seg_starts, self.n)
+        self._perm_dtype()  # enforce the row cap
+        sorted_nat = _native_sort_bin_z(bins, z)
+        if sorted_nat is not None:
+            z_sorted, perm, ubins, seg_offsets = sorted_nat
+        else:
+            perm = np.lexsort((z, bins)).astype(np.int32)
+            bins_sorted = bins[perm]
+            z_sorted = z[perm]
+            # per-bin contiguous segments in the sorted order
+            ubins, seg_starts = np.unique(bins_sorted, return_index=True)
+            seg_offsets = np.append(seg_starts, self.n)
         self._z3 = (ubins, seg_offsets, z_sorted, perm)
         return self._z3
 
@@ -202,8 +281,13 @@ class ZKeyIndex:
         if self._z2 is not None:
             return self._z2
         z = z2sfc().index(self._x, self._y, lenient=True).astype(np.int64)
-        perm = np.argsort(z, kind="stable").astype(self._perm_dtype())
-        self._z2 = (z[perm], perm)
+        self._perm_dtype()  # enforce the row cap
+        sorted_nat = _native_sort_z(z)
+        if sorted_nat is not None:
+            self._z2 = sorted_nat  # (z_sorted, perm)
+        else:
+            perm = np.argsort(z, kind="stable").astype(np.int32)
+            self._z2 = (z[perm], perm)
         return self._z2
 
     # -- incremental maintenance -------------------------------------------

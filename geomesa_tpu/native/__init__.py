@@ -23,7 +23,8 @@ _BUILD = os.path.join(_DIR, "_build")
 _lock = threading.Lock()
 _cache: dict = {}
 
-_SOURCES = ["feature_codec.cpp", "zrange.cpp", "zencode.cpp"]
+_SOURCES = ["feature_codec.cpp", "zrange.cpp", "zencode.cpp",
+            "zsort.cpp"]
 
 
 def _source_files() -> list:
@@ -47,6 +48,24 @@ def load() -> "ctypes.CDLL | None":
         lib = _build_and_load()
         _cache["lib"] = lib
         return lib
+
+
+def symbols(signatures: dict) -> "ctypes.CDLL | None":
+    """Load the library and configure the given symbols, or None when
+    the library or any symbol is unavailable.
+
+    ``signatures`` maps symbol name -> (restype, argtypes). The single
+    probe point for every native fast path (zranges/zencode/zsort/...)."""
+    lib = load()
+    if lib is None:
+        return None
+    for name, (restype, argtypes) in signatures.items():
+        fn = getattr(lib, name, None)
+        if fn is None:
+            return None
+        fn.restype = restype
+        fn.argtypes = argtypes
+    return lib
 
 
 def _build_and_load():

@@ -1,0 +1,87 @@
+// Sorted-order construction for the z-key indexes.
+//
+// np.lexsort((z, bins)) at 100M rows costs two indirect O(N log N)
+// argsorts; time bins are small non-negative ints, so a counting sort
+// by bin (O(N), stable) followed by a per-segment sort of (z, idx)
+// pairs does the same work with one cache-friendly pass per segment.
+// Tie order matches lexsort's stability: pairs sort by (z, original
+// index), and the bin scatter preserves input order within each bin.
+//
+// Exported (ctypes):
+//   geomesa_sort_bin_z(bins i32[n], z i64[n], n, max_bin,
+//                      perm_out i32[n], z_sorted_out i64[n],
+//                      offsets_out i64[max_bin+2]) -> 0/-1
+//     offsets_out[b] = start of bin b's segment (prefix sums), so the
+//     caller derives per-bin boundaries without re-scanning the array
+//   geomesa_sort_z(z i64[n], n, perm_out i32[n], z_sorted_out i64[n])
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+struct Pair {
+    int64_t z;
+    int32_t idx;
+};
+
+inline bool pair_less(const Pair& a, const Pair& b) {
+    return a.z != b.z ? a.z < b.z : a.idx < b.idx;
+}
+
+}  // namespace
+
+extern "C" int64_t geomesa_sort_bin_z(const int32_t* bins,
+                                      const int64_t* z, int64_t n,
+                                      int64_t max_bin,
+                                      int32_t* perm_out,
+                                      int64_t* z_sorted_out,
+                                      int64_t* offsets_out) {
+    if (n < 0 || max_bin < 0 || max_bin > (1 << 20)) return -1;
+    const size_t nb = (size_t)max_bin + 2;
+    for (size_t b = 0; b < nb; ++b) offsets_out[b] = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        const int32_t b = bins[i];
+        if (b < 0 || b > max_bin) return -1;
+        ++offsets_out[(size_t)b + 1];
+    }
+    for (size_t b = 1; b < nb; ++b) offsets_out[b] += offsets_out[b - 1];
+
+    std::vector<Pair> pairs((size_t)n);
+    {
+        std::vector<int64_t> cursor(offsets_out, offsets_out + nb - 1);
+        for (int64_t i = 0; i < n; ++i) {
+            const int64_t pos = cursor[(size_t)bins[i]]++;
+            pairs[(size_t)pos].z = z[i];
+            pairs[(size_t)pos].idx = (int32_t)i;
+        }
+    }
+    for (size_t b = 0; b + 1 < nb; ++b) {
+        const int64_t s = offsets_out[b], e = offsets_out[b + 1];
+        if (e - s > 1)
+            std::sort(pairs.begin() + s, pairs.begin() + e, pair_less);
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        z_sorted_out[i] = pairs[(size_t)i].z;
+        perm_out[i] = pairs[(size_t)i].idx;
+    }
+    return 0;
+}
+
+extern "C" int64_t geomesa_sort_z(const int64_t* z, int64_t n,
+                                  int32_t* perm_out,
+                                  int64_t* z_sorted_out) {
+    if (n < 0) return -1;
+    std::vector<Pair> pairs((size_t)n);
+    for (int64_t i = 0; i < n; ++i) {
+        pairs[(size_t)i].z = z[i];
+        pairs[(size_t)i].idx = (int32_t)i;
+    }
+    std::sort(pairs.begin(), pairs.end(), pair_less);
+    for (int64_t i = 0; i < n; ++i) {
+        z_sorted_out[i] = pairs[(size_t)i].z;
+        perm_out[i] = pairs[(size_t)i].idx;
+    }
+    return 0;
+}

@@ -179,6 +179,37 @@ class TestOutOfRangeDates:
         assert _ids(ds.query(Query("pts", ecql))) == _oracle(ds, ecql)
 
 
+class TestNativeSortParity:
+    @pytest.mark.skipif(
+        __import__("geomesa_tpu.native", fromlist=["load"]).load() is None,
+        reason="native toolchain unavailable")
+    def test_native_sort_identical_to_lexsort(self):
+        from geomesa_tpu.index import zkeys as zk
+        rng = np.random.default_rng(31)
+        n = 200_000
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        # few bins + many duplicate z keys to stress tie stability
+        ms = rng.integers(MS("2017-01-01"), MS("2017-01-22"), n)
+        x[: n // 4] = 10.0  # forced duplicates
+        y[: n // 4] = 10.0
+        a = zk.ZKeyIndex(x, y, ms)
+        a._build_z3()
+        a._build_z2()
+        saved = zk._native_sort
+        zk._native_sort = False
+        try:
+            b = zk.ZKeyIndex(x, y, ms)
+            b._build_z3()
+            b._build_z2()
+        finally:
+            zk._native_sort = saved
+        for pa, pb in zip(a._z3, b._z3):
+            assert np.array_equal(pa, pb)
+        for pa, pb in zip(a._z2, b._z2):
+            assert np.array_equal(pa, pb)
+
+
 class TestZKeyIndexUnit:
     def test_candidates_superset_of_matches(self):
         rng = np.random.default_rng(0)
