@@ -71,6 +71,13 @@ class DataStore(abc.ABC):
     def count(self, type_name: str) -> int:
         """Total stored features of a type."""
 
+    def query_count(self, q: Query | str,
+                    type_name: str | None = None) -> int:
+        """Matching-feature count. Default materializes the result;
+        backends override with count-only fast paths (the EXACT_COUNT
+        / geomesa.force.count shape of the reference)."""
+        return self.query(q, type_name).n
+
     # -- shared conveniences -------------------------------------------------
 
     def features(self, type_name: str,

@@ -452,21 +452,12 @@ class InMemoryDataStore(DataStore):
                 out.append(f"attr:{a.name}")
         return out
 
-    def query(self, q: Query | str, type_name: str | None = None,
-              explain_out=None) -> QueryResult:
-        if isinstance(q, str):
-            if type_name is None:
-                raise ValueError("type_name required with a filter string")
-            q = Query(type_name, q)
-        st = self._state(q.type_name)
-        explain = Explainer(explain_out)
-        explain.push(f"Planning '{q.type_name}' "
-                     f"filter={q.filter}")
-        if st.batch is None or st.n == 0:
-            explain("Store is empty").pop()
-            return QueryResult(np.empty(0, dtype=object), None, explain,
-                               FilterStrategy("empty", None, None))
-
+    def _matching_rows(self, q: Query, st: _TypeState,
+                       explain: Explainer):
+        """The shared row-selection pipeline: plan (under the timeout
+        reaper), scan, visibility, sampling. Returns (idx, strategy,
+        t_plan, t_scan0); query() materializes from it, query_count()
+        just counts — one pipeline, no drift between the two."""
         # query timeout enforcement at stage boundaries
         # (ThreadManagement analog; geomesa.query.timeout property)
         from ..utils.properties import QUERY_TIMEOUT
@@ -515,6 +506,25 @@ class InMemoryDataStore(DataStore):
                               dtype=object).astype(str)
             idx = idx[sample_mask(len(idx), float(rate), by)]
             explain(f"Sampling applied: rate={rate}")
+        return idx, strategy, t_plan, t_scan0
+
+    def query(self, q: Query | str, type_name: str | None = None,
+              explain_out=None) -> QueryResult:
+        if isinstance(q, str):
+            if type_name is None:
+                raise ValueError("type_name required with a filter string")
+            q = Query(type_name, q)
+        st = self._state(q.type_name)
+        explain = Explainer(explain_out)
+        explain.push(f"Planning '{q.type_name}' "
+                     f"filter={q.filter}")
+        if st.batch is None or st.n == 0:
+            explain("Store is empty").pop()
+            return QueryResult(np.empty(0, dtype=object), None, explain,
+                               FilterStrategy("empty", None, None))
+        import time as _time
+        idx, strategy, t_plan, t_scan0 = self._matching_rows(q, st,
+                                                             explain)
         if q.sort_by is not None:
             from .common import sort_order
             idx = idx[sort_order(st.batch, q.sort_by, q.sort_desc, idx)]
@@ -534,6 +544,33 @@ class InMemoryDataStore(DataStore):
                               round((_time.perf_counter() - t_scan0) * 1000, 3),
                               len(ids))
         return QueryResult(ids, batch, explain, strategy)
+
+    def query_count(self, q: Query | str,
+                    type_name: str | None = None) -> int:
+        """Count without materializing ids or columns: the shared
+        row-selection pipeline (plan, scan, visibility, sampling, all
+        under the timeout reaper), then just the length. Skips the
+        object-array id gather and per-column result copies."""
+        if isinstance(q, str):
+            if type_name is None:
+                raise ValueError("type_name required with a filter string")
+            q = Query(type_name, q)
+        st = self._state(q.type_name)
+        if st.batch is None or st.n == 0:
+            return 0
+        import time as _time
+        explain = Explainer()
+        explain.push(f"Counting '{q.type_name}' filter={q.filter}")
+        idx, _, t_plan, t_scan0 = self._matching_rows(q, st, explain)
+        n = len(idx)
+        if q.max_features is not None:
+            n = min(n, q.max_features)
+        if self.audit is not None:
+            self.audit.record(q.type_name, str(q.filter), q.hints,
+                              round(t_plan * 1000, 3),
+                              round((_time.perf_counter() - t_scan0)
+                                    * 1000, 3), n)
+        return n
 
     def _execute(self, st: _TypeState, q: Query, strategy: FilterStrategy,
                  explain: Explainer) -> np.ndarray:

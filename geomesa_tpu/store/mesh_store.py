@@ -260,7 +260,12 @@ class DistributedDataStore(DataStore):
         geoms = extract_geometries(primary, st.sft.geom_field)
         if (strategy.index not in ("z2", "z3")
                 or strategy.secondary is not None
-                or _needs_exact(geoms, primary)):
+                or _needs_exact(geoms, primary)
+                or q.hints.get(QueryHints.SAMPLING) is not None
+                or q.max_features is not None
+                or q.auths is not None):
+            # row-limiting/sampling/visibility stages need the full
+            # query pipeline for counts to match query().n
             return int(self.query(q).n)
         return distributed_count(st.data, self._scan_query(st, strategy))
 

@@ -168,8 +168,9 @@ def cmd_export(args) -> int:
 
 
 def cmd_count(args) -> int:
-    _, res = _query(args)
-    print(res.n)
+    ds = _store(args)
+    from ..index.api import Query
+    print(ds.query_count(Query(args.name, args.cql or "INCLUDE")))
     return 0
 
 

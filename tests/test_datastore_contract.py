@@ -82,6 +82,16 @@ class TestContract:
         for f in list(res.features())[:10]:
             assert -120 <= f["geom"].x <= 120
 
+    def test_query_count_matches_query(self, store):
+        ecql = "BBOX(geom, -60, -30, 60, 30) AND val < 50"
+        assert store.query_count(ecql, "t") == store.query(ecql, "t").n
+
+    def test_query_count_honors_sampling(self, store):
+        from geomesa_tpu.index.api import Query, QueryHints
+        q = Query("t", "BBOX(geom, -60, -30, 60, 30)",
+                  hints={QueryHints.SAMPLING: 0.25})
+        assert store.query_count(q) == store.query(q).n
+
     def test_unknown_type_raises_keyerror(self, store):
         # the documented SPI contract: KeyError for absent types
         with pytest.raises(KeyError):
