@@ -201,6 +201,27 @@ def binned_candidate_positions(ubins, seg_offsets, keys_sorted,
     return np.concatenate(pieces)
 
 
+def search_rows(zindex, index_name: str, boxes, intervals,
+                host_cap: int | None, block_cap: int | None):
+    """THE store-level fast-path policy (single copy for every store):
+    whole-world gate, then one range decomposition via
+    ``zindex.query_rows`` serving both tiers — ("exact", rows) under
+    ``host_cap``, ("candidates", rows) under ``block_cap``,
+    (None, None) for the dense path. Indexes without query_rows (the XZ
+    extent family runs its own exact stage) fall back to
+    prune_candidates."""
+    whole_world = list(boxes) == [(-180.0, -90.0, 180.0, 90.0)]
+    if zindex is None or (whole_world
+                          and not (index_name == "z3" and intervals)):
+        return None, None
+    qr = getattr(zindex, "query_rows", None)
+    if qr is None:
+        rows = prune_candidates(zindex, index_name, boxes, intervals,
+                                block_cap)
+        return ("candidates", rows) if rows is not None else (None, None)
+    return qr(index_name, boxes, intervals, host_cap, block_cap)
+
+
 def prune_candidates(zindex, index_name: str, boxes, intervals,
                      max_rows: int | None) -> np.ndarray | None:
     """THE pruning policy, shared by every store and index family
@@ -241,6 +262,13 @@ class ZKeyIndex:
         self.n = len(self._x)
         self._z3 = None  # (ubins, seg_offsets, z_sorted, perm)
         self._z2 = None  # (z_sorted, perm)
+        # sorted-order coordinate copies, built on first search_*: the
+        # candidate positions from range decomposition are CONTIGUOUS
+        # runs in sorted order, so evaluating on x[perm]/y[perm] copies
+        # turns the hot candidate pass from random gathers over the
+        # full columns into sequential slices
+        self._z3_coords = None  # (xs, ys, ms) in z3 order
+        self._z2_coords = None  # (xs, ys) in z2 order
 
     # -- build -------------------------------------------------------------
 
@@ -312,6 +340,9 @@ class ZKeyIndex:
         out._perm_dtype()  # enforce the row cap before any merge work
         out._z3 = self._merged_z3(x, y, millis) if self._z3 else None
         out._z2 = self._merged_z2(x, y) if self._z2 else None
+        # sorted coord copies rebuild lazily against the merged perm
+        out._z3_coords = None
+        out._z2_coords = None
         return out
 
     def _merged_z2(self, x, y):
@@ -364,6 +395,95 @@ class ZKeyIndex:
         ubins2 = new_bins[seg_starts]
         seg_offsets2 = np.append(seg_starts, len(new_bins))
         return (ubins2, seg_offsets2, new_z, new_perm)
+
+    # -- exact search (host fast path) -------------------------------------
+
+    @staticmethod
+    def _eval_sorted(xs, ys, ms, pos, boxes, intervals_ms) -> np.ndarray:
+        """Exact f64 evaluation over sorted-order positions; identical
+        semantics to zscan.exact_patch (inclusive box bounds, inclusive
+        [lo, hi] millis intervals). Returns keep mask over pos."""
+        x = xs[pos]
+        y = ys[pos]
+        keep = np.zeros(len(pos), dtype=bool)
+        for xmin, ymin, xmax, ymax in boxes:
+            keep |= ((x >= xmin) & (x <= xmax)
+                     & (y >= ymin) & (y <= ymax))
+        if intervals_ms and ms is not None:
+            m = ms[pos]
+            tk = np.zeros(len(pos), dtype=bool)
+            for lo, hi in intervals_ms:
+                tk |= (m >= lo) & (m <= hi)
+            keep &= tk
+        return keep
+
+    def query_rows(self, index_name: str, boxes, intervals_ms,
+                   host_cap: int | None, block_cap: int | None,
+                   max_ranges: int | None = None):
+        """ONE range decomposition serving both tiers: returns
+        ("exact", rows) when the candidate positions fit ``host_cap``
+        (exact evaluation over sorted-order coordinate copies —
+        sequential access), ("candidates", rows) when they fit only
+        ``block_cap`` (caller runs the gathered device scan), or
+        (None, None) for the dense path."""
+        use_z3 = index_name == "z3" and bool(intervals_ms)
+        if use_z3:
+            built = self._build_z3()
+            if built is None:
+                return None, None
+            ubins, seg_offsets, z_sorted, perm = built
+            sfc = z3sfc(self.period)
+            pos = binned_candidate_positions(
+                ubins, seg_offsets, z_sorted, intervals_ms, self.period,
+                lambda key: sfc.ranges(boxes, [key],
+                                       max_ranges=max_ranges),
+                block_cap)
+        else:
+            z_sorted, perm = self._build_z2()
+            ranges = z2sfc().ranges(boxes, max_ranges=max_ranges)
+            los = np.searchsorted(z_sorted, ranges[:, 0], side="left")
+            his = np.searchsorted(z_sorted, ranges[:, 1], side="right")
+            if block_cap is not None \
+                    and int(np.sum(his - los)) > block_cap:
+                pos = None
+            else:
+                pos = multi_arange(los, his)
+        if pos is None:
+            return None, None
+        if not len(pos):
+            return "exact", np.empty(0, dtype=np.int64)
+        if host_cap is not None and len(pos) > host_cap:
+            return "candidates", perm[pos].astype(np.int64)
+        if use_z3:
+            if self._z3_coords is None:
+                self._z3_coords = (self._x[perm], self._y[perm],
+                                   None if self._millis is None
+                                   else self._millis[perm])
+            xs, ys, ms = self._z3_coords
+            ivals = intervals_ms
+        else:
+            if self._z2_coords is None:
+                self._z2_coords = (self._x[perm], self._y[perm])
+            xs, ys = self._z2_coords
+            ms, ivals = None, []
+        keep = self._eval_sorted(xs, ys, ms, pos, boxes, ivals)
+        return "exact", np.sort(perm[pos[keep]].astype(np.int64))
+
+    def search_z3(self, boxes, intervals_ms, *,
+                  max_rows: int | None = None,
+                  max_ranges: int | None = None) -> np.ndarray | None:
+        """EXACT matching rows via the z3 order (None over max_rows)."""
+        kind, rows = self.query_rows("z3", boxes, intervals_ms,
+                                     max_rows, max_rows,
+                                     max_ranges=max_ranges)
+        return rows if kind == "exact" else None
+
+    def search_z2(self, boxes, *, max_rows: int | None = None,
+                  max_ranges: int | None = None) -> np.ndarray | None:
+        """EXACT matching rows for a pure-spatial query (z2 order)."""
+        kind, rows = self.query_rows("z2", boxes, [], max_rows, max_rows,
+                                     max_ranges=max_ranges)
+        return rows if kind == "exact" else None
 
     # -- candidates --------------------------------------------------------
 

@@ -693,13 +693,19 @@ class InMemoryDataStore(DataStore):
 
         sq = zscan.make_query(boxes, intervals)
 
-        # z-range pruning (Z3IndexKeySpace.getRanges analog): candidate
-        # rows from the sorted key index, gathered device scan; dense
-        # full-batch kernel when the candidate set is a large fraction
-        from ..index.zkeys import SCAN_BLOCK_THRESHOLD, prune_candidates
-        rows = prune_candidates(
-            st.zindex, strategy.index, boxes, intervals,
-            int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n))
+        # z-range pruning (Z3IndexKeySpace.getRanges analog): the host
+        # fast path resolves selective queries EXACTLY inside the index
+        # (sequential passes over sorted-order coordinate copies); wider
+        # candidate sets fall to the gathered device scan, and beyond
+        # the block threshold to the dense full-batch kernel. One
+        # decomposition serves all tiers (zkeys.search_rows).
+        from ..index.zkeys import SCAN_BLOCK_THRESHOLD, search_rows
+        block_cap = int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n)
+        host_cap = min(block_cap, int(HOST_SCAN_ROWS.get()))
+        kind, res_rows = search_rows(st.zindex, strategy.index, boxes,
+                                     intervals, host_cap, block_cap)
+        idx_exact = res_rows if kind == "exact" else None
+        rows = res_rows if kind == "candidates" else None
 
         def patch_boundaries(mask, xhi, yhi, sel):
             """Exact f64 recheck of rows whose hi-cell touches a query
@@ -718,15 +724,15 @@ class InMemoryDataStore(DataStore):
             explain(f"Boundary recheck: {len(cand)} candidate(s)")
             return zscan.exact_patch(mask, cand, x, y, millis, sq)
 
-        if rows is not None and len(rows) <= int(HOST_SCAN_ROWS.get()):
-            # small candidate set: exact f64 host evaluation needs no
-            # two-float machinery, no boundary patch and no device
-            # round trip — the reference's tablet-local iterator work,
-            # collapsed to one vectorized pass over the gathered rows
-            explain(f"Index-pruned host scan: {len(rows)} candidate "
-                    f"row(s) of {st.n}, {len(boxes)} box(es), "
+        if idx_exact is not None:
+            # selective query resolved exactly inside the index: no
+            # two-float machinery, no boundary patch, no device round
+            # trip — the reference's tablet-local iterator work as one
+            # sequential pass (search_z3/search_z2)
+            explain(f"Index-pruned host scan: {len(idx_exact)} hit(s) "
+                    f"of {st.n}, {len(boxes)} box(es), "
                     f"{len(intervals)} interval(s)")
-            idx = self._host_exact_scan(st, rows, sq)
+            idx = idx_exact
         elif rows is not None:
             explain(f"Index-pruned device scan: {len(rows)} candidate "
                     f"row(s) of {st.n}, {len(boxes)} box(es), "

@@ -223,20 +223,19 @@ class DistributedDataStore(DataStore):
         """z-index pruning + host fast path for selective queries (the
         single-device engine's crossover); wide scans fan out over the
         mesh. Returns a bool[n] mask."""
-        from ..index.zkeys import SCAN_BLOCK_THRESHOLD, prune_candidates
-        from .memory import HOST_SCAN_ROWS, InMemoryDataStore
+        from ..index.zkeys import SCAN_BLOCK_THRESHOLD, search_rows
+        from .memory import HOST_SCAN_ROWS
         boxes = [tuple(b) for b in sq.host_boxes]
         intervals = [tuple(iv) for iv in sq.host_intervals]
         # the mesh has no gathered-candidate device path, so pruning is
         # only worthwhile up to the host fast-path size
         max_rows = min(int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n),
                        int(HOST_SCAN_ROWS.get()))
-        rows = prune_candidates(st.zindex, strategy.index, boxes,
-                                intervals, max_rows)
-        if rows is not None:
-            explain(f"Index-pruned host scan: {len(rows)} candidate "
-                    f"row(s) of {st.n}")
-            idx = InMemoryDataStore._host_exact_scan(st, rows, sq)
+        kind, idx = search_rows(st.zindex, strategy.index, boxes,
+                                intervals, max_rows, max_rows)
+        if kind == "exact":
+            explain(f"Index-pruned host scan: {len(idx)} hit(s) "
+                    f"of {st.n}")
             mask = np.zeros(st.n, dtype=bool)
             mask[idx] = True
             return mask
@@ -249,6 +248,8 @@ class DistributedDataStore(DataStore):
         adjustment (exact). Falls back to query() when the plan needs
         residual/exact predicates."""
         if isinstance(q, str):
+            if type_name is None:
+                raise ValueError("type_name required with a filter string")
             q = Query(type_name, q)
         st = self._state(q.type_name)
         if st.n == 0:
