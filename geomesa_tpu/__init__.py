@@ -22,10 +22,39 @@ Layer map (mirrors SURVEY.md section 1):
 - :mod:`geomesa_tpu.scan`     -- L6 pushdown scan/aggregation kernels
 - :mod:`geomesa_tpu.parallel` -- mesh/sharding + distributed scans
 - :mod:`geomesa_tpu.analytics`-- L7 ST_* kernels, joins, KNN, processes
-- :mod:`geomesa_tpu.store`    -- L5 datastores (memory / fs / live)
+- :mod:`geomesa_tpu.store`    -- L5 datastores (memory / fs / live /
+                                 lambda / mesh / stream), DataStore SPI
+- :mod:`geomesa_tpu.sql`      -- L7 SQL surface with ST_* pushdown
 - :mod:`geomesa_tpu.convert`  -- L8 ingest converters
 - :mod:`geomesa_tpu.tools`    -- L9 CLI
 - :mod:`geomesa_tpu.security` -- LX visibility / authorizations
+- :mod:`geomesa_tpu.native`   -- C++ fast paths (codec, z ranges,
+                                 fused z encode, index sort)
+
+Convenience re-exports: the common entry points are importable from the
+package root (``geomesa_tpu.InMemoryDataStore`` etc.).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
+
+from .features.sft import parse_spec  # noqa: E402
+from .index.api import Query, QueryHints  # noqa: E402
+
+__all__ = ["parse_spec", "Query", "QueryHints", "DataStore",
+           "InMemoryDataStore", "FileSystemDataStore", "LiveDataStore",
+           "LambdaDataStore", "DistributedDataStore", "StreamDataStore",
+           "SqlEngine", "__version__"]
+
+
+def __getattr__(name):
+    # stores/sql import jax and the full stack; keep `import geomesa_tpu`
+    # light by resolving the heavyweight exports lazily
+    if name in ("DataStore", "InMemoryDataStore", "FileSystemDataStore",
+                "LiveDataStore", "LambdaDataStore", "DistributedDataStore",
+                "StreamDataStore"):
+        from . import store
+        return getattr(store, name)
+    if name == "SqlEngine":
+        from .sql import SqlEngine
+        return SqlEngine
+    raise AttributeError(f"module 'geomesa_tpu' has no attribute {name!r}")

@@ -427,6 +427,10 @@ class ZKeyIndex:
         ``block_cap`` (caller runs the gathered device scan), or
         (None, None) for the dense path."""
         use_z3 = index_name == "z3" and bool(intervals_ms)
+        # the z2 order cannot evaluate time: with intervals present but
+        # no z3 order in play, results may only be CANDIDATES (the
+        # caller's scan re-checks time), never "exact"
+        exact_ok = use_z3 or not intervals_ms
         if use_z3:
             built = self._build_z3()
             if built is None:
@@ -450,6 +454,8 @@ class ZKeyIndex:
                 pos = multi_arange(los, his)
         if pos is None:
             return None, None
+        if not exact_ok:
+            return "candidates", perm[pos].astype(np.int64)
         if not len(pos):
             return "exact", np.empty(0, dtype=np.int64)
         if host_cap is not None and len(pos) > host_cap:
@@ -469,21 +475,6 @@ class ZKeyIndex:
         keep = self._eval_sorted(xs, ys, ms, pos, boxes, ivals)
         return "exact", np.sort(perm[pos[keep]].astype(np.int64))
 
-    def search_z3(self, boxes, intervals_ms, *,
-                  max_rows: int | None = None,
-                  max_ranges: int | None = None) -> np.ndarray | None:
-        """EXACT matching rows via the z3 order (None over max_rows)."""
-        kind, rows = self.query_rows("z3", boxes, intervals_ms,
-                                     max_rows, max_rows,
-                                     max_ranges=max_ranges)
-        return rows if kind == "exact" else None
-
-    def search_z2(self, boxes, *, max_rows: int | None = None,
-                  max_ranges: int | None = None) -> np.ndarray | None:
-        """EXACT matching rows for a pure-spatial query (z2 order)."""
-        kind, rows = self.query_rows("z2", boxes, [], max_rows, max_rows,
-                                     max_ranges=max_ranges)
-        return rows if kind == "exact" else None
 
     # -- candidates --------------------------------------------------------
 

@@ -728,7 +728,7 @@ class InMemoryDataStore(DataStore):
             # selective query resolved exactly inside the index: no
             # two-float machinery, no boundary patch, no device round
             # trip — the reference's tablet-local iterator work as one
-            # sequential pass (search_z3/search_z2)
+            # sequential pass (zkeys.ZKeyIndex.query_rows)
             explain(f"Index-pruned host scan: {len(idx_exact)} hit(s) "
                     f"of {st.n}, {len(boxes)} box(es), "
                     f"{len(intervals)} interval(s)")
@@ -767,23 +767,6 @@ class InMemoryDataStore(DataStore):
                     idx = idx[keep]
             explain("Exact geometry predicate applied")
         return idx
-
-    @staticmethod
-    def _host_exact_scan(st: _TypeState, rows: np.ndarray,
-                         sq: "zscan.ScanQuery") -> np.ndarray:
-        """Exact f64 spatio-temporal evaluation over candidate rows —
-        zscan.exact_patch with EVERY candidate as a boundary case, so
-        the semantics are the boundary patch's by construction."""
-        batch = st.batch
-        col = batch.col(st.sft.geom_field)
-        x = col.x[rows]
-        y = col.y[rows]
-        dtg = st.sft.dtg_field
-        ms = (batch.col(dtg).millis[rows] if dtg is not None
-              else np.zeros(len(rows), dtype=np.int64))
-        keep = zscan.exact_patch(np.zeros(len(rows), dtype=bool),
-                                 np.arange(len(rows)), x, y, ms, sq)
-        return np.sort(rows[keep])
 
     def _device_extent_scan(self, st: _TypeState, q: Query,
                             strategy: FilterStrategy,

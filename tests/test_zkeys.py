@@ -179,6 +179,25 @@ class TestOutOfRangeDates:
         assert _ids(ds.query(Query("pts", ecql))) == _oracle(ds, ecql)
 
 
+class TestQueryRowsContract:
+    def test_z2_tier_never_claims_exact_with_intervals(self):
+        # the z2 order cannot evaluate time: intervals outside the z3
+        # tier must demote results to candidates (caller re-checks)
+        rng = np.random.default_rng(8)
+        n = 20_000
+        zi = ZKeyIndex(rng.uniform(-180, 180, n),
+                       rng.uniform(-90, 90, n),
+                       rng.integers(MS("2017-01-01"), MS("2017-02-01"), n))
+        boxes = [(-10.0, -10.0, 10.0, 10.0)]
+        iv = [(MS("2017-01-05"), MS("2017-01-06"))]
+        kind, rows = zi.query_rows("z2", boxes, iv, n, n)
+        assert kind == "candidates"
+        # and the z3 tier with the same inputs resolves exactly
+        kind3, rows3 = zi.query_rows("z3", boxes, iv, n, n)
+        assert kind3 == "exact"
+        assert set(rows3.tolist()) <= set(rows.tolist())
+
+
 class TestNativeSortParity:
     @pytest.mark.skipif(
         __import__("geomesa_tpu.native", fromlist=["load"]).load() is None,
