@@ -338,18 +338,20 @@ class ZKeyIndex:
         out.period = self.period
         out.n = len(out._x)
         out._perm_dtype()  # enforce the row cap before any merge work
-        out._z3 = self._merged_z3(x, y, millis) if self._z3 else None
-        out._z2 = self._merged_z2(x, y) if self._z2 else None
-        # sorted coord copies rebuild lazily against the merged perm
-        out._z3_coords = None
-        out._z2_coords = None
+        # built coord copies merge via the same inserts (delta-sized
+        # sort + O(N) memcpy); unbuilt ones stay lazy
+        out._z3, out._z3_coords = (self._merged_z3(x, y, millis)
+                                   if self._z3 else (None, None))
+        out._z2, out._z2_coords = (self._merged_z2(x, y)
+                                   if self._z2 else (None, None))
         return out
 
     def _merged_z2(self, x, y):
+        """Returns ((z_sorted, perm), coords_or_None)."""
         z_sorted, perm = self._z2
-        dz = z2sfc().index(np.asarray(x, dtype=np.float64),
-                           np.asarray(y, dtype=np.float64),
-                           lenient=True).astype(np.int64)
+        x = np.asarray(x, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64)
+        dz = z2sfc().index(x, y, lenient=True).astype(np.int64)
         dorder = np.argsort(dz, kind="stable")
         dzs = dz[dorder]
         # side="right": appended rows land after equal existing keys,
@@ -358,16 +360,23 @@ class ZKeyIndex:
         new_z = np.insert(z_sorted, pos, dzs)
         new_perm = np.insert(perm, pos,
                              (dorder + self.n).astype(perm.dtype))
-        return (new_z, new_perm)
+        coords = None
+        if self._z2_coords is not None:
+            xs, ys = self._z2_coords
+            coords = (np.insert(xs, pos, x[dorder]),
+                      np.insert(ys, pos, y[dorder]))
+        return (new_z, new_perm), coords
 
     def _merged_z3(self, x, y, millis):
+        """Returns ((ubins, seg_offsets, z_sorted, perm), coords)."""
         ubins, seg_offsets, z_sorted, perm = self._z3
         sfc = z3sfc(self.period)
-        dbins, doffs = timebin.to_binned(
-            np.asarray(millis, dtype=np.int64), self.period, lenient=True)
-        dz = sfc.index(np.asarray(x, dtype=np.float64),
-                       np.asarray(y, dtype=np.float64),
-                       doffs.astype(np.float64), lenient=True).astype(np.int64)
+        x = np.asarray(x, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64)
+        millis = np.asarray(millis, dtype=np.int64)
+        dbins, doffs = timebin.to_binned(millis, self.period, lenient=True)
+        dz = sfc.index(x, y, doffs.astype(np.float64),
+                       lenient=True).astype(np.int64)
         dorder = np.lexsort((dz, dbins))
         dbs, dzs = dbins[dorder], dz[dorder]
         pos = np.empty(len(dbs), dtype=np.int64)
@@ -394,7 +403,15 @@ class ZKeyIndex:
         seg_starts = np.concatenate([[0], steps])
         ubins2 = new_bins[seg_starts]
         seg_offsets2 = np.append(seg_starts, len(new_bins))
-        return (ubins2, seg_offsets2, new_z, new_perm)
+        coords = None
+        if self._z3_coords is not None:
+            xs, ys, ms = self._z3_coords
+            coords = (
+                np.insert(xs, pos, x[dorder]),
+                np.insert(ys, pos, y[dorder]),
+                None if ms is None else np.insert(ms, pos,
+                                                  millis[dorder]))
+        return (ubins2, seg_offsets2, new_z, new_perm), coords
 
     # -- exact search (host fast path) -------------------------------------
 

@@ -10,7 +10,9 @@ stdlib http server — no framework dependency:
     POST /rest/schemas/{type}   body=spec   -> create schema
     GET  /rest/schemas/{type}               -> {"name":..., "spec":...}
     DELETE /rest/schemas/{type}
-    GET  /rest/query/{type}?cql=&maxFeatures=&format=json|geojson|arrow
+    GET  /rest/query/{type}?cql=&maxFeatures=&sortBy=&sortOrder=
+         &sampling=&sampleBy=&index=&auths=&format=json|geojson|arrow
+         (the trailing params are the ViewParams-style hint mappings)
     GET  /rest/stats/{type}?stat=MinMax(attr)&cql=
     GET  /rest/density/{type}?bbox=x0,y0,x1,y1&width=&height=&cql=
     GET  /rest/sql?q=SELECT...  (or POST /rest/sql, body = statement)
@@ -30,7 +32,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 import numpy as np
 
 from .. import __version__ as _version
-from ..index.api import Query
+from ..index.api import Query, QueryHints
 
 __all__ = ["GeoMesaWebServer"]
 
@@ -135,6 +137,20 @@ class GeoMesaWebServer:
         q = Query(name, cql)
         if "maxFeatures" in params:
             q.max_features = int(params["maxFeatures"][0])
+        if "sortBy" in params:
+            q.sort_by = params["sortBy"][0]
+            q.sort_desc = (params.get("sortOrder", ["asc"])[0]
+                           .lower() == "desc")
+        # ViewParams analog (index/geotools ViewParams:28): URL params
+        # map onto per-query hints
+        if "sampling" in params:
+            q.hints[QueryHints.SAMPLING] = float(params["sampling"][0])
+        if "sampleBy" in params:
+            q.hints[QueryHints.SAMPLE_BY] = params["sampleBy"][0]
+        if "index" in params:
+            q.hints[QueryHints.QUERY_INDEX] = params["index"][0]
+        if "auths" in params:
+            q.auths = [a for a in params["auths"][0].split(",") if a]
         if fmt == "arrow":
             from ..arrow.io import write_ipc
             res = self.store.query(q)

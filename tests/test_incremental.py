@@ -79,6 +79,26 @@ class TestZKeyMerge:
             want = fresh.candidates_z3(boxes, [iv])
             assert np.array_equal(np.sort(got), np.sort(want))
 
+    def test_sorted_coords_merge_with_extend(self):
+        # coord copies built before extend must stay consistent with
+        # the merged perm (exact queries keep matching a fresh index)
+        rng = np.random.default_rng(14)
+        n, d = 30_000, 2_000
+        x = rng.uniform(-180, 180, n + d)
+        y = rng.uniform(-90, 90, n + d)
+        ms = rng.integers(MS("2019-01-01"), MS("2019-03-01"), n + d)
+        base = ZKeyIndex(x[:n], y[:n], ms[:n])
+        boxes = [(-20.0, -20.0, 20.0, 20.0)]
+        iv = [(MS("2019-01-10"), MS("2019-02-10"))]
+        base.query_rows("z3", boxes, iv, n, n)   # builds z3 + coords
+        assert base._z3_coords is not None
+        merged = base.extend(x[n:], y[n:], ms[n:])
+        assert merged._z3_coords is not None     # merged, not dropped
+        fresh = ZKeyIndex(x, y, ms)
+        got = merged.query_rows("z3", boxes, iv, n + d, n + d)[1]
+        want = fresh.query_rows("z3", boxes, iv, n + d, n + d)[1]
+        assert np.array_equal(got, want)
+
     def test_sort_invariant_after_merge(self):
         rng = np.random.default_rng(13)
         x = rng.uniform(-180, 180, 5_000)

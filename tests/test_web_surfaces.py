@@ -105,6 +105,20 @@ class TestRest:
         with urllib.request.urlopen(req) as r:
             assert r.status == 200
 
+    def test_viewparams_hints(self, server):
+        # sortBy/sortOrder + sampling map onto query hints (ViewParams)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/rest/query/people"
+                "?cql=age%20%3C%2010&sortBy=age&sortOrder=desc") as r:
+            out = json.loads(r.read())
+        ages = [f["age"] for f in out["features"]]
+        assert ages == sorted(ages, reverse=True) and len(ages) == 10
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/rest/query/people"
+                "?cql=INCLUDE&sampling=0.1") as r:
+            out = json.loads(r.read())
+        assert 0 < out["count"] < 100
+
     def test_sql_endpoint(self, server):
         import urllib.parse
         q = urllib.parse.quote(
