@@ -64,13 +64,55 @@ EXTENT_HOST_SCAN_ROWS = SystemProperty("geomesa.scan.extent.host.rows",
 __all__ = ["InMemoryDataStore", "QueryResult"]
 
 
-@dataclasses.dataclass
+class _LazyBatch:
+    """Deferred result materialization: the source batch snapshot (the
+    columnar arrays are immutable — writes build new objects) plus the
+    matched rows. The column copies happen only if a caller actually
+    reads ``result.batch`` — id-only consumers (counts, exactness
+    checks, bench loops) never pay them. The reference's feature
+    readers are lazy in the same way (KryoBufferSimpleFeature)."""
+
+    def __init__(self, source: FeatureBatch, idx: np.ndarray,
+                 properties):
+        self.source = source
+        self.idx = idx
+        self.properties = properties
+
+    def materialize(self) -> FeatureBatch:
+        batch = self.source.take(self.idx)
+        if self.properties is not None:
+            cols = {p: batch.columns[p] for p in self.properties}
+            batch = FeatureBatch(
+                _project_sft(self.source.sft, self.properties),
+                batch.ids, cols)
+        return batch
+
+
 class QueryResult:
-    """Result of a feature query."""
-    ids: np.ndarray                  # object array of matched feature ids
-    batch: FeatureBatch | None       # projected features (None = ids only)
-    explain: Explainer
-    plan: FilterStrategy
+    """Result of a feature query.
+
+    ``batch`` materializes lazily when the store handed over a
+    _LazyBatch; id-only consumers never pay the column copies. ``None``
+    means the store/type held no data at all — a zero-hit query still
+    yields an (empty) batch.
+    """
+
+    def __init__(self, ids: np.ndarray, batch, explain: Explainer,
+                 plan: FilterStrategy):
+        self.ids = ids
+        self._batch = batch          # FeatureBatch | None | _LazyBatch
+        self.explain = explain
+        self.plan = plan
+
+    @property
+    def batch(self) -> FeatureBatch | None:
+        if isinstance(self._batch, _LazyBatch):
+            self._batch = self._batch.materialize()
+        return self._batch
+
+    @batch.setter
+    def batch(self, value):
+        self._batch = value
 
     @property
     def n(self) -> int:
@@ -80,6 +122,10 @@ class QueryResult:
         if self.batch is None:
             return iter(())
         return (self.batch.feature(i) for i in range(self.batch.n))
+
+    def __repr__(self) -> str:
+        return (f"QueryResult(n={self.n}, "
+                f"plan={self.plan.index if self.plan else None})")
 
 
 class _TypeState:
@@ -532,11 +578,20 @@ class InMemoryDataStore(DataStore):
             idx = idx[:q.max_features]
 
         ids = st.batch.ids[idx]
-        batch = st.batch.take(idx)
         if q.properties is not None:
-            cols = {p: batch.columns[p] for p in q.properties}
-            batch = FeatureBatch(
-                _project_sft(st.sft, q.properties), batch.ids, cols)
+            # validate projection names NOW: errors belong to query(),
+            # not to whenever (or whether) .batch is first read
+            missing = [p for p in q.properties
+                       if p not in st.batch.columns]
+            if missing:
+                raise KeyError(f"unknown propert"
+                               f"{'ies' if len(missing) > 1 else 'y'}: "
+                               f"{', '.join(missing)}")
+        batch: Any = _LazyBatch(st.batch, idx, q.properties)
+        if len(idx) <= 10_000:
+            # small results materialize eagerly: the copy is trivial and
+            # an unread result must not pin the multi-GB table snapshot
+            batch = batch.materialize()
         explain(f"Hits: {len(ids)}").pop()
         if self.audit is not None:
             self.audit.record(q.type_name, str(q.filter), q.hints,
