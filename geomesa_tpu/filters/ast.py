@@ -39,6 +39,23 @@ class Filter:
         return Not(self)
 
 
+def walk(f: Filter):
+    """Yield every node of a filter tree (the one tree traversal —
+    property collectors across the stores/SQL layers build on this)."""
+    yield f
+    for c in getattr(f, "children", ()) or ():
+        yield from walk(c)
+    child = getattr(f, "child", None)
+    if child is not None:
+        yield from walk(child)
+
+
+def props_of(f: Filter) -> set:
+    """Attribute names referenced anywhere in a filter tree."""
+    return {p for node in walk(f)
+            if (p := getattr(node, "prop", None))}
+
+
 @dataclasses.dataclass(frozen=True)
 class Include(Filter):
     """Matches everything (Filter.INCLUDE)."""

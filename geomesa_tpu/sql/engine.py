@@ -71,35 +71,22 @@ def _map_props(f: ast.Filter, fix) -> ast.Filter:
     if isinstance(f, ast.Not):
         return ast.Not(_map_props(f.child, fix))
     if hasattr(f, "prop"):
-        return dataclasses.replace(f, prop=fix(f.prop)) \
-            if dataclasses.is_dataclass(f) else _rebuild(f, fix)
+        # every Filter node (incl. SpatialPredicate subclasses, which
+        # inherit the parent's dataclass fields) is a dataclass
+        return dataclasses.replace(f, prop=fix(f.prop))
     return f
-
-
-def _rebuild(f: ast.Filter, fix):
-    # SpatialPredicate subclasses are dataclass-free: rebuild by type
-    return type(f)(fix(f.prop), f.geom)
 
 
 def _qualifier_of(f: ast.Filter) -> set[str]:
     """Table qualifiers referenced by the filter (empty = unqualified)."""
     out: set[str] = set()
-    for node in _walk(f):
+    for node in ast.walk(f):
         prop = getattr(node, "prop", None)
         if prop and "." in prop:
             out.add(prop.split(".", 1)[0])
         elif prop:
             out.add("")
     return out
-
-
-def _walk(f: ast.Filter):
-    yield f
-    for c in getattr(f, "children", ()) or ():
-        yield from _walk(c)
-    child = getattr(f, "child", None)
-    if child is not None:
-        yield from _walk(child)
 
 
 def _centroids(batch: FeatureBatch, geom_field: str):
@@ -293,7 +280,13 @@ class SqlEngine:
             if "." not in it.expr:
                 raise ValueError(f"join columns must be qualified: {it.expr}")
             q, col = it.expr.split(".", 1)
-            res, idx = (lres, li) if q == la else (rres, ri)
+            if q == la:
+                res, idx = lres, li
+            elif q == ra:
+                res, idx = rres, ri
+            else:
+                raise ValueError(f"unknown table qualifier {q!r} "
+                                 f"(tables: {la!r}, {ra!r})")
             if col in ("__fid__", "id"):
                 add(it.name if it.alias else it.expr, res.ids[idx])
             else:

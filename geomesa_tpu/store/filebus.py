@@ -174,6 +174,7 @@ class FileBus:
         subscribers, in sequence order; checkpoints offsets. Returns the
         number of messages delivered."""
         delivered = 0
+        advanced = False
         # snapshot: a subscriber may register new topics mid-delivery
         # (consumer-side schema auto-create)
         for topic, fns in list(self._subs.items()):
@@ -187,30 +188,36 @@ class FileBus:
                 try:
                     with open(path, "rb") as f:
                         raw = f.read()
-                    if not raw:
-                        if (time.time() - os.path.getmtime(path)
-                                > self.STALE_CLAIM_S):
-                            # aborted publish (producer died between
-                            # claim and payload swap): skip it rather
-                            # than wedging the topic forever
-                            self._offsets[topic] = seq
-                            continue
-                        # claimed but not yet swapped in by the writer:
-                        # stop here, retry from this offset next poll
-                        break
-                    msg = _decode(raw)
-                except (FileNotFoundError, json.JSONDecodeError,
-                        ValueError):
+                    if raw:
+                        msg = _decode(raw)
+                    else:
+                        msg = None
+                except FileNotFoundError:
+                    break  # racing a writer: retry next poll
+                except (json.JSONDecodeError, ValueError, KeyError):
+                    msg = None  # corrupt payload: treat like a claim
+                if msg is None:
+                    if (time.time() - os.path.getmtime(path)
+                            > self.STALE_CLAIM_S):
+                        # aborted publish or corrupt persisted message:
+                        # messages appear atomically via rename, so it
+                        # cannot self-heal — skip past it rather than
+                        # wedging every later message on the topic
+                        self._offsets[topic] = seq
+                        advanced = True
+                        continue
+                    # fresh: may still be mid-swap; retry next poll
                     break
                 for fn in fns:
                     fn(msg)
                 self._offsets[topic] = seq
+                advanced = True
                 delivered += 1
                 if max_messages is not None and delivered >= max_messages:
                     break
             if max_messages is not None and delivered >= max_messages:
                 break
-        if delivered:
+        if advanced:
             self._save_offsets()
         return delivered
 

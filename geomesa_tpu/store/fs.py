@@ -48,22 +48,6 @@ def _safe_partition(name) -> str:
     return "/".join(segs)
 
 
-def _filter_props(f: ast.Filter) -> set:
-    """Attribute names referenced anywhere in a filter tree."""
-    out: set = set()
-    stack = [f]
-    while stack:
-        node = stack.pop()
-        prop = getattr(node, "prop", None)
-        if prop:
-            out.add(prop)
-        stack.extend(getattr(node, "children", ()) or ())
-        child = getattr(node, "child", None)
-        if child is not None:
-            stack.append(child)
-    return out
-
-
 def _pushdown_expr(f: ast.Filter, sft: SimpleFeatureType):
     """Filter AST -> a CONSERVATIVE pyarrow dataset expression (matches
     a superset of the filter), or None when nothing is pushable.
@@ -331,13 +315,17 @@ class FileSystemDataStore(DataStore):
         expr = _pushdown_expr(q.filter, st.sft)
         props = None
         if q.properties is not None:
-            need = _filter_props(q.filter) | set(q.properties)
+            need = ast.props_of(q.filter) | set(q.properties)
             if st.sft.geom_field:
                 need.add(st.sft.geom_field)
             if st.sft.dtg_field:
                 need.add(st.sft.dtg_field)
             if q.sort_by:
                 need.add(q.sort_by)
+            from ..index.api import QueryHints
+            sample_by = q.hints.get(QueryHints.SAMPLE_BY)
+            if sample_by:
+                need.add(sample_by)
             props = [a.name for a in st.sft.attributes if a.name in need]
         mem = self._load(st, files, expr, props)
         res = mem.query(q, explain_out=explain_out)

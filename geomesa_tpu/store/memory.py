@@ -958,22 +958,13 @@ def _needs_exact(geoms, primary: ast.Filter) -> bool:
     geometry predicate must run on surviving candidates."""
     return any(not _is_envelope(g) for g in geoms) or any(
         isinstance(c, (ast.DWithin, ast.SpatialPredicate))
-        for c in _walk(primary))
+        for c in ast.walk(primary))
 
 
 def _is_envelope(g) -> bool:
     from ..filters.helper import _is_box
     from ..geometry import Polygon
     return isinstance(g, Polygon) and not g.holes and _is_box(g)
-
-
-def _walk(f: ast.Filter):
-    yield f
-    for c in getattr(f, "children", ()) or ():
-        yield from _walk(c)
-    child = getattr(f, "child", None)
-    if child is not None:
-        yield from _walk(child)
 
 
 def _spatial_only(f: ast.Filter, geom: str) -> ast.Filter | None:

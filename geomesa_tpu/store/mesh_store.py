@@ -38,7 +38,7 @@ from ..parallel import (DistributedScanData, data_mesh, distributed_count,
                         shard_points_split, shard_scan_data)
 from ..scan import zscan
 from .memory import (QueryResult, _intervals_ms, _is_envelope, _needs_exact,
-                     _spatial_only, _walk)
+                     _spatial_only)
 
 __all__ = ["DistributedDataStore"]
 

@@ -141,7 +141,9 @@ class StreamDataStore(DataStore):
 
         def as_line(r) -> str:
             if isinstance(r, str):
-                return r
+                # records read from file handles keep their newline;
+                # strip so the join never produces blank "bad records"
+                return r.rstrip("\r\n")
             try:
                 return _json.dumps(r)
             except (TypeError, ValueError):

@@ -115,6 +115,26 @@ class TestSameProcessBus:
         assert bus.poll() == 2  # both real messages; stale one skipped
         assert store.count("live") == 2
 
+    def test_corrupt_message_skipped_after_grace(self, tmp_path):
+        bus = FileBus(str(tmp_path))
+        store = LiveDataStore(bus=bus)
+        store.create_schema(parse_spec("live", SPEC))
+        store.write("live", make_batch(["a"], [0], [0]))
+        bus.poll()
+        # a corrupt persisted message (crash mid-disk-write) at seq 2
+        topic = tmp_path / "topics" / "live"
+        bad = topic / f"{2:012d}.msg"
+        bad.write_bytes(b"\x00\x01garbage")
+        old = os.path.getmtime(bad) - 60
+        os.utime(bad, (old, old))
+        store.write("live", make_batch(["b"], [1], [1]))  # seq 3
+        assert bus.poll() == 1          # skips the corpse, delivers b
+        assert store.count("live") == 2
+        assert bus.offset("live") == 3
+        # the skip checkpoints even when nothing else delivers
+        bus2 = FileBus(str(tmp_path), group=bus.group)
+        assert bus2.offset("live") == 3
+
     def test_poll_max_messages_cap(self, tmp_path):
         bus = FileBus(str(tmp_path))
         got = []

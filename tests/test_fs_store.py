@@ -123,6 +123,16 @@ class TestFsStore:
                          loaded.get_schema("events").attributes]) \
             <= {"kind", "dtg", "geom"}
 
+    def test_projection_with_sample_by(self, tmp_path):
+        from geomesa_tpu.index.api import QueryHints
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("events", "kind:String,dtg:Date,*geom:Point")
+        write_sample(ds)
+        res = ds.query(Query("events", "INCLUDE", properties=["kind"],
+                             hints={QueryHints.SAMPLING: 0.5,
+                                    QueryHints.SAMPLE_BY: "kind"}))
+        assert 0 < res.n < 5000  # sampled, and the SAMPLE_BY col loaded
+
     def test_pushdown_with_unpushable_residual(self, tmp_path):
         # LIKE is not pushed; result must still be exact
         ds = FileSystemDataStore(str(tmp_path))

@@ -185,6 +185,11 @@ class TestSemantics:
         assert int(eng.query(
             "SELECT COUNT(*) FROM t").column("count(*)")[0]) == 3
 
+    def test_unknown_join_qualifier_rejected(self, engine):
+        with pytest.raises(ValueError, match="unknown table qualifier"):
+            engine.query("SELECT z.zid, c.name FROM zones z JOIN gdelt g "
+                         "ON ST_Contains(z.area, g.geom)")
+
     def test_unqualified_join_on_rejected(self):
         with pytest.raises(SqlError, match="alias-qualified"):
             parse_sql("SELECT COUNT(*) FROM t a JOIN t b "
