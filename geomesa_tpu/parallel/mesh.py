@@ -152,7 +152,7 @@ def _exact_count_adjustment(data: DistributedScanData,
     dev = np.zeros(len(cand), dtype=bool)
     xhi, xlo = zscan.split_two_float(data.host_x[cand])
     yhi, ylo = zscan.split_two_float(data.host_y[cand])
-    boxes = np.asarray(q.boxes)
+    boxes = q.boxes_np
     for i in range(q.n_boxes):
         b = boxes[i]
         dev |= (((xhi > b[0]) | ((xhi == b[0]) & (xlo >= b[1])))

@@ -93,12 +93,12 @@ def build_pallas_data(x: np.ndarray, y: np.ndarray,
 def pallas_query_tables(q: ScanQuery) -> tuple[jax.Array, jax.Array]:
     """ScanQuery -> (boxes (K,8) f32, times (B,4) i32) with invalid
     slots folded into impossible bounds (no validity masks needed)."""
-    boxes = np.array(q.boxes, np.float32, copy=True)
-    valid = np.asarray(q.box_valid)
+    boxes = np.array(q.boxes_np, np.float32, copy=True)
+    valid = q.box_valid_np
     boxes[~valid, 0] = np.inf    # xmin_hi = +inf -> never >= it
     boxes[~valid, 2] = -np.inf
-    times = np.array(q.times, np.int32, copy=True)
-    tvalid = np.asarray(q.time_valid)
+    times = np.array(q.times_np, np.int32, copy=True)
+    tvalid = q.time_valid_np
     times[~tvalid, 0] = np.iinfo(np.int32).max  # day_lo -> never after
     times[~tvalid, 2] = np.iinfo(np.int32).min
     return jnp.asarray(boxes), jnp.asarray(times)

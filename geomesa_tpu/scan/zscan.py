@@ -133,26 +133,72 @@ def extend_scan_data(data: DeviceScanData, x, y, millis,
         data.n + d)
 
 
-@dataclasses.dataclass
 class ScanQuery:
-    """Padded, device-ready query: K spatial boxes + B time intervals.
+    """Padded query: K spatial boxes + B time intervals.
 
     boxes: (K, 8) f32 [xmin_hi, xmin_lo, xmax_hi, xmax_lo,
                        ymin_hi, ymin_lo, ymax_hi, ymax_lo]
     box_valid: (K,) bool
     times: (B, 4) i32 [day_lo, ms_lo, day_hi, ms_hi], inclusive bounds
     time_valid: (B,) bool; time_any: no time constraint at all
+
+    The device arrays upload LAZILY on first access: selective queries
+    resolved entirely on host (the index fast path) never touch the
+    device, so building a ScanQuery must not cost device_put round
+    trips. ``host_*`` fields are the exact f64/i64 originals for
+    boundary rechecks and host evaluation.
     """
-    boxes: jax.Array
-    box_valid: jax.Array
-    times: jax.Array
-    time_valid: jax.Array
-    time_any: bool
-    # host copies for the boundary recheck
-    n_boxes: int
-    host_boxes: np.ndarray       # (n_boxes, 4) f64 xmin ymin xmax ymax
-    host_box_his: np.ndarray     # (n_boxes, 4) f32 xmin_hi xmax_hi ymin_hi ymax_hi
-    host_intervals: np.ndarray   # (n_intervals, 2) i64 inclusive millis
+
+    def __init__(self, boxes: np.ndarray, box_valid: np.ndarray,
+                 times: np.ndarray, time_valid: np.ndarray,
+                 time_any: bool, n_boxes: int, host_boxes: np.ndarray,
+                 host_box_his: np.ndarray, host_intervals: np.ndarray):
+        self._np = (np.asarray(boxes), np.asarray(box_valid),
+                    np.asarray(times), np.asarray(time_valid))
+        self._dev = None
+        self.time_any = time_any
+        self.n_boxes = n_boxes
+        self.host_boxes = host_boxes
+        self.host_box_his = host_box_his
+        self.host_intervals = host_intervals
+
+    def _device(self):
+        if self._dev is None:
+            self._dev = tuple(jnp.asarray(a) for a in self._np)
+        return self._dev
+
+    @property
+    def boxes(self) -> jax.Array:
+        return self._device()[0]
+
+    @property
+    def box_valid(self) -> jax.Array:
+        return self._device()[1]
+
+    @property
+    def times(self) -> jax.Array:
+        return self._device()[2]
+
+    @property
+    def time_valid(self) -> jax.Array:
+        return self._device()[3]
+
+    @property
+    def boxes_np(self) -> np.ndarray:
+        """Padded boxes as host numpy (no device round trip)."""
+        return self._np[0]
+
+    @property
+    def box_valid_np(self) -> np.ndarray:
+        return self._np[1]
+
+    @property
+    def times_np(self) -> np.ndarray:
+        return self._np[2]
+
+    @property
+    def time_valid_np(self) -> np.ndarray:
+        return self._np[3]
 
 
 def next_pow2(n: int) -> int:
@@ -198,8 +244,7 @@ def make_query(boxes_f64, intervals_ms) -> ScanQuery:
         tvalid[i] = True
 
     host_iv = np.asarray(intervals_ms, dtype=np.int64).reshape(-1, 2)
-    return ScanQuery(jnp.asarray(boxes), jnp.asarray(valid),
-                     jnp.asarray(times), jnp.asarray(tvalid), time_any,
+    return ScanQuery(boxes, valid, times, tvalid, time_any,
                      len(boxes_f64), host_boxes, host_his, host_iv)
 
 
