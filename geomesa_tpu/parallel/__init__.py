@@ -8,16 +8,18 @@ over ICI. Ring pipelines (ring.py) cover the join/KNN shapes the
 reference runs on Spark executors.
 """
 
-from .mesh import (DistributedScanData, data_mesh, distributed_count,
-                   distributed_density, distributed_histogram,
-                   distributed_minmax, distributed_scan_mask,
-                   exact_host_mask, shard_scan_data)
+from .mesh import (DistributedExtentData, DistributedScanData, data_mesh,
+                   distributed_count, distributed_density,
+                   distributed_histogram, distributed_minmax,
+                   distributed_scan_mask, distributed_tristate,
+                   exact_host_mask, shard_extent_data, shard_scan_data)
 from .ring import (distributed_knn, ring_dwithin_counts, shard_points,
                    shard_points_split)
 
-__all__ = ["DistributedScanData", "data_mesh", "distributed_count",
-           "distributed_density", "distributed_histogram",
-           "distributed_minmax", "distributed_scan_mask",
-           "exact_host_mask", "shard_scan_data",
+__all__ = ["DistributedExtentData", "DistributedScanData", "data_mesh",
+           "distributed_count", "distributed_density",
+           "distributed_histogram", "distributed_minmax",
+           "distributed_scan_mask", "distributed_tristate",
+           "exact_host_mask", "shard_extent_data", "shard_scan_data",
            "distributed_knn", "ring_dwithin_counts", "shard_points",
            "shard_points_split"]

@@ -26,7 +26,8 @@ from ..scan import zscan
 __all__ = ["data_mesh", "DistributedScanData", "shard_scan_data",
            "distributed_scan_mask", "distributed_count",
            "distributed_density", "distributed_histogram",
-           "distributed_minmax"]
+           "distributed_minmax", "DistributedExtentData",
+           "shard_extent_data", "distributed_tristate"]
 
 
 def data_mesh(n_devices: int | None = None) -> Mesh:
@@ -257,6 +258,92 @@ def distributed_minmax(values: jax.Array, mask: jax.Array,
     (MinMax sketch merge, utils/stats/MinMax.scala analog)."""
     vmin, vmax = _minmax_fn(mesh)(values, mask)
     return float(vmin), float(vmax)
+
+
+@dataclasses.dataclass
+class DistributedExtentData:
+    """Mesh-sharded per-feature bboxes for the XZ-analog extent scan
+    (outward-rounded f32, pad rows valid=False) + optional exact time
+    columns — the distributed counterpart of gscan.ExtentScanData."""
+    bxmin: jax.Array
+    bymin: jax.Array
+    bxmax: jax.Array
+    bymax: jax.Array
+    valid: jax.Array
+    tday: jax.Array
+    tms: jax.Array
+    has_time: bool
+    n: int
+    n_padded: int
+    mesh: Mesh
+
+
+def shard_extent_data(bounds: np.ndarray, millis: np.ndarray | None,
+                      mesh: Mesh) -> DistributedExtentData:
+    """(n, 4) f64 bounds [xmin ymin xmax ymax] (NaN rows = null geoms)
+    -> evenly-sharded outward-rounded f32 device columns."""
+    from ..scan.gscan import _round_out
+    bounds = np.asarray(bounds, np.float64)
+    n = len(bounds)
+    k = mesh.devices.size
+    n_padded = ((n + k - 1) // k) * k
+    pad = n_padded - n
+    valid = ~np.isnan(bounds[:, 0])
+    safe = np.where(valid[:, None], bounds, 0.0)
+    xmin, xmax = _round_out(safe[:, 0], safe[:, 2])
+    ymin, ymax = _round_out(safe[:, 1], safe[:, 3])
+
+    def prep(a, fill, dtype):
+        a = np.asarray(a, dtype)
+        if pad:
+            a = np.concatenate([a, np.full(pad, fill, dtype)])
+        return a
+
+    has_time = millis is not None
+    if has_time:
+        millis = np.asarray(millis, np.int64)
+        tday = (millis // zscan.MILLIS_PER_DAY).astype(np.int32)
+        tms = (millis - tday.astype(np.int64)
+               * zscan.MILLIS_PER_DAY).astype(np.int32)
+    else:
+        tday = np.zeros(n, np.int32)
+        tms = np.zeros(n, np.int32)
+
+    sharding = NamedSharding(mesh, P("data"))
+    put = functools.partial(jax.device_put, device=sharding)
+    return DistributedExtentData(
+        put(prep(xmin, 0, np.float32)), put(prep(ymin, 0, np.float32)),
+        put(prep(xmax, 0, np.float32)), put(prep(ymax, 0, np.float32)),
+        put(prep(valid, False, bool)),
+        put(prep(tday, 0, np.int32)), put(prep(tms, 0, np.int32)),
+        has_time, n, n_padded, mesh)
+
+
+@functools.lru_cache(maxsize=32)
+def _tristate_fn(mesh: Mesh, time_any: bool, has_time: bool):
+    from ..scan import gscan
+
+    def body(bxmin, bymin, bxmax, bymax, valid, tday, tms,
+             outer, inner, bvalid, times, tvalid):
+        return gscan._tristate_body(bxmin, bymin, bxmax, bymax, valid,
+                                    tday, tms, outer, inner, bvalid,
+                                    times, tvalid, time_any, has_time)
+
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P("data"),) * 7 + (P(),) * 5,
+        out_specs=P("data")))
+
+
+def distributed_tristate(data: DistributedExtentData, q) -> np.ndarray:
+    """Shard-local extent tristate classification over the mesh;
+    returns host int8[n] (0=OUT, 1=MAYBE, 2=IN) with padding dropped.
+    Same exactness contract as gscan.extent_tristate — the MAYBE band
+    goes to the caller's exact host predicate."""
+    fn = _tristate_fn(data.mesh, q.time_any, data.has_time)
+    out = fn(data.bxmin, data.bymin, data.bxmax, data.bymax, data.valid,
+             data.tday, data.tms,
+             q.outer, q.inner, q.box_valid, q.times, q.time_valid)
+    return np.asarray(out)[:data.n]
 
 
 def distributed_density(data: DistributedScanData, q: zscan.ScanQuery,

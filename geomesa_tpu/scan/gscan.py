@@ -146,10 +146,9 @@ def extent_query(boxes_f64, intervals_ms=None) -> ExtentQuery:
                        jnp.asarray(tvalid), time_any)
 
 
-@functools.partial(jax.jit, static_argnames=("time_any", "has_time"))
-def _tristate_kernel(bxmin, bymin, bxmax, bymax, valid, tday, tms,
-                     outer, inner, box_valid, times, time_valid,
-                     time_any: bool, has_time: bool):
+def _tristate_body(bxmin, bymin, bxmax, bymax, valid, tday, tms,
+                   outer, inner, box_valid, times, time_valid,
+                   time_any: bool, has_time: bool):
     ob = outer[None, :, :]
     # overlap with outward-rounded envelope: false => definitely disjoint
     overlap = ((bxmax[:, None] >= ob[..., 0]) & (bxmin[:, None] <= ob[..., 2])
@@ -174,6 +173,10 @@ def _tristate_kernel(bxmin, bymin, bxmax, bymax, valid, tday, tms,
               | ((tday[:, None] == tx[..., 2]) & (tms[:, None] <= tx[..., 3])))
     t_ok = jnp.any(after & before & time_valid[None, :], axis=1)
     return jnp.where(t_ok, state, _OUT)
+
+
+_tristate_kernel = functools.partial(
+    jax.jit, static_argnames=("time_any", "has_time"))(_tristate_body)
 
 
 def extent_tristate(data: ExtentScanData, q: ExtentQuery) -> np.ndarray:

@@ -234,6 +234,29 @@ class FileSystemDataStore(DataStore):
             pq.write_table(pa.Table.from_batches([sub.to_arrow()]), path)
         st.cache.clear()
 
+    def delete(self, type_name: str, ids):
+        """Remove features by id: rewrite every parquet file that holds
+        any of them (delete + compaction in one step — the reference's
+        fs storage likewise rewrites data files on modify)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        import pyarrow.parquet as pq
+        st = self._state(type_name)
+        value_set = pa.array([str(i) for i in ids], pa.string())
+        for f in self._files_for(st, None):
+            table = pq.read_table(f)
+            hit = pc.is_in(pc.cast(table.column("__fid__"), pa.string()),
+                           value_set=value_set)
+            n_hit = pc.sum(hit).as_py() or 0
+            if not n_hit:
+                continue
+            kept = table.filter(pc.invert(hit))
+            if kept.num_rows:
+                pq.write_table(kept, f)
+            else:
+                os.remove(f)
+        st.cache.clear()
+
     # -- partitions --------------------------------------------------------
 
     def partitions(self, type_name: str) -> list[str]:

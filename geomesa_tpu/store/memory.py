@@ -188,6 +188,14 @@ class _TypeState:
         self._pending.append((batch, vis))
         self._pending_n += batch.n
 
+    def has_point_scan(self) -> bool:
+        """Whether a device point-scan structure is built (subclasses
+        redefine what that structure is — e.g. mesh-sharded segments)."""
+        return self.scan_data is not None
+
+    def has_extent_scan(self) -> bool:
+        return self.extent_data is not None
+
     def flush(self):
         """Materialize pending appends: one concat for the burst, then
         incremental index maintenance when the index is already built."""
@@ -196,7 +204,7 @@ class _TypeState:
         delta = FeatureBatch.concat_all([b for b, _ in self._pending])
         base = self._batch
         can_merge = (base is not None and not self.dirty
-                     and self.scan_data is not None
+                     and self.has_point_scan()
                      and self.zindex is not None)
         # build everything BEFORE mutating state: a MemoryError on the
         # big concat must leave the store consistent (batch/vis/pending
@@ -224,6 +232,20 @@ class _TypeState:
         dtg = self.sft.dtg_field
         dmillis = (delta.col(dtg).millis if dtg is not None
                    else np.zeros(delta.n, dtype=np.int64))
+        # device first: when it declines (segment-cap compaction), the
+        # O(n) zindex sorted-run merge must not have been paid for
+        # nothing; dirty stays True throughout, so a failure at any
+        # point still rebuilds on the next read
+        if not self._extend_device_index(col, dmillis):
+            return  # stays dirty: next read rebuilds (compaction)
+        self.zindex = self.zindex.extend(
+            col.x, col.y, dmillis if dtg is not None else None)
+        self.dirty = False
+
+    def _extend_device_index(self, col: PointColumn,
+                             dmillis: np.ndarray) -> bool:
+        """Append the delta to the device scan structures; False leaves
+        the state dirty so the next read rebuilds from scratch."""
         dxhi, dxlo = zscan.split_two_float(col.x)
         dyhi, dylo = zscan.split_two_float(col.y)
         scan_data = zscan.extend_scan_data(
@@ -232,21 +254,18 @@ class _TypeState:
         if scan_data is None:
             # capacity exhausted: rebuild once with power-of-two
             # headroom, then future bursts append in place again
+            dtg = self.sft.dtg_field
             gcol = self._batch.col(self.sft.geom_field)
             fmillis = (self._batch.col(dtg).millis if dtg is not None
                        else np.zeros(self._batch.n, dtype=np.int64))
             scan_data = zscan.build_scan_data(
                 gcol.x, gcol.y, fmillis,
                 cap=zscan.next_pow2(self._batch.n + 1))
-        host_xhi = np.concatenate([self.host_xhi, dxhi])
-        host_yhi = np.concatenate([self.host_yhi, dyhi])
-        zindex = self.zindex.extend(
-            col.x, col.y, dmillis if dtg is not None else None)
-        # all three structures built: publish atomically
-        self.scan_data, self.host_xhi, self.host_yhi = \
-            scan_data, host_xhi, host_yhi
-        self.zindex = zindex
-        self.dirty = False
+        # all structures built: publish atomically
+        self.scan_data = scan_data
+        self.host_xhi = np.concatenate([self.host_xhi, dxhi])
+        self.host_yhi = np.concatenate([self.host_yhi, dyhi])
+        return True
 
     def delete(self, ids: set[str]):
         # dirty first: the flush skips merge work the delete is about to
@@ -266,12 +285,11 @@ class _TypeState:
     def ensure_index(self):
         """(Re)build device arrays if writes happened."""
         self.flush()  # may maintain the index incrementally
-        if not self.dirty and (self.scan_data is not None
-                               or self.extent_data is not None):
+        if not self.dirty and (self.has_point_scan()
+                               or self.has_extent_scan()):
             return
         if self.batch is None or self.batch.n == 0:
-            self.scan_data = None
-            self.extent_data = None
+            self._clear_device_index()
             self.dirty = False
             return
         geom = self.sft.geom_field
@@ -280,12 +298,11 @@ class _TypeState:
         if not isinstance(col, PointColumn):
             # extent geometries: device bbox tristate scan (XZ analog)
             # plus a host XZ-key index for range pruning
-            self.scan_data = None
+            self._clear_device_index()
             if col is not None:
                 millis = (self.batch.col(dtg).millis
                           if dtg is not None else None)
-                self.extent_data = gscan.build_extent_data(
-                    col.bounds, millis)
+                self._build_extent_index(col.bounds, millis)
                 from ..index.xzkeys import XZKeyIndex
                 self.zindex = XZKeyIndex(col.bounds, millis,
                                          self.sft.z3_interval)
@@ -297,9 +314,7 @@ class _TypeState:
             millis = self.batch.col(dtg).millis
         else:
             millis = np.zeros(len(x), dtype=np.int64)
-        self.scan_data = zscan.build_scan_data(x, y, millis)
-        self.host_xhi = np.asarray(self.scan_data.xhi)
-        self.host_yhi = np.asarray(self.scan_data.yhi)
+        self._build_point_index(x, y, millis)
         # host sorted z-key index for range pruning (lazy per curve);
         # Z3IndexKeySpace.getRanges analog feeding the gathered scan
         from ..index.zkeys import ZKeyIndex
@@ -307,6 +322,18 @@ class _TypeState:
                                 millis if dtg is not None else None,
                                 self.sft.z3_interval)
         self.dirty = False
+
+    def _clear_device_index(self):
+        self.scan_data = None
+        self.extent_data = None
+
+    def _build_point_index(self, x, y, millis):
+        self.scan_data = zscan.build_scan_data(x, y, millis)
+        self.host_xhi = np.asarray(self.scan_data.xhi)
+        self.host_yhi = np.asarray(self.scan_data.yhi)
+
+    def _build_extent_index(self, bounds, millis):
+        self.extent_data = gscan.build_extent_data(bounds, millis)
 
     def attr_index(self, name: str):
         """Sorted attribute index for one column, built on first use
@@ -363,7 +390,10 @@ class InMemoryDataStore(DataStore):
             sft = parse_spec(sft, spec or "")
         if sft.type_name in self._types:
             raise ValueError(f"schema {sft.type_name!r} already exists")
-        self._types[sft.type_name] = _TypeState(sft)
+        self._types[sft.type_name] = self._new_state(sft)
+
+    def _new_state(self, sft: SimpleFeatureType) -> _TypeState:
+        return _TypeState(sft)
 
     def get_schema(self, type_name: str) -> SimpleFeatureType:
         return self._state(type_name).sft
@@ -642,9 +672,9 @@ class InMemoryDataStore(DataStore):
         if strategy.index in ("z3", "z2", "xz3", "xz2"):
             st.ensure_index()
 
-        if strategy.index in ("z3", "z2") and st.scan_data is not None:
+        if strategy.index in ("z3", "z2") and st.has_point_scan():
             idx = self._device_scan(st, q, strategy, explain)
-        elif strategy.index in ("xz3", "xz2") and st.extent_data is not None:
+        elif strategy.index in ("xz3", "xz2") and st.has_extent_scan():
             idx = self._device_extent_scan(st, q, strategy, explain)
         elif strategy.index == "id" and strategy.primary is not None:
             idx = np.flatnonzero(
@@ -762,23 +792,6 @@ class InMemoryDataStore(DataStore):
         idx_exact = res_rows if kind == "exact" else None
         rows = res_rows if kind == "candidates" else None
 
-        def patch_boundaries(mask, xhi, yhi, sel):
-            """Exact f64 recheck of rows whose hi-cell touches a query
-            bound; sel=None means full-table arrays, else a row subset
-            (rows outside a pruned candidate set are provably outside
-            the query in exact f64, so patching the subset is exact)."""
-            cand = zscan.boundary_candidates(xhi, yhi, sq)
-            if not len(cand):
-                return mask
-            col = batch.col(geom)
-            x, y = col.x, col.y
-            millis = (batch.col(dtg).millis if dtg is not None
-                      else np.zeros(st.n, dtype=np.int64))
-            if sel is not None:
-                x, y, millis = x[sel], y[sel], millis[sel]
-            explain(f"Boundary recheck: {len(cand)} candidate(s)")
-            return zscan.exact_patch(mask, cand, x, y, millis, sq)
-
         if idx_exact is not None:
             # selective query resolved exactly inside the index: no
             # two-float machinery, no boundary patch, no device round
@@ -789,26 +802,11 @@ class InMemoryDataStore(DataStore):
                     f"{len(intervals)} interval(s)")
             idx = idx_exact
         elif rows is not None:
-            explain(f"Index-pruned device scan: {len(rows)} candidate "
-                    f"row(s) of {st.n}, {len(boxes)} box(es), "
-                    f"{len(intervals)} interval(s)")
-            sub = zscan.scan_mask_at(st.scan_data, sq, rows)
-            sub = patch_boundaries(sub, st.host_xhi[rows],
-                                   st.host_yhi[rows], rows)
-            idx = np.sort(rows[sub])
-        elif SCAN_KERNEL.get() == "pallas":
-            from ..scan.pallas_scan import pallas_scan_mask
-            explain(f"Pallas device scan: {len(boxes)} box(es), "
-                    f"{len(intervals)} interval(s), n={st.n}")
-            mask = pallas_scan_mask(st.pallas(), sq)
-            mask = patch_boundaries(mask, st.host_xhi, st.host_yhi, None)
-            idx = np.flatnonzero(mask)
+            idx = self._scan_gathered(st, sq, rows, explain,
+                                      len(boxes), len(intervals))
         else:
-            explain(f"Device scan: {len(boxes)} box(es), "
-                    f"{len(intervals)} interval(s), n={st.n}")
-            mask = np.asarray(zscan.scan_mask(st.scan_data, sq))[:st.n]
-            mask = patch_boundaries(mask, st.host_xhi, st.host_yhi, None)
-            idx = np.flatnonzero(mask)
+            idx = self._scan_dense(st, sq, explain,
+                                   len(boxes), len(intervals))
 
         # non-envelope query geometries need the exact predicate too
         if _needs_exact(geoms, primary):
@@ -822,6 +820,55 @@ class InMemoryDataStore(DataStore):
                     idx = idx[keep]
             explain("Exact geometry predicate applied")
         return idx
+
+    def _patch_mask(self, st: _TypeState, mask, xhi, yhi, sel,
+                    sq: zscan.ScanQuery, explain: Explainer):
+        """Exact f64 recheck of rows whose hi-cell touches a query
+        bound; sel=None means full-table arrays, else a row subset
+        (rows outside a pruned candidate set are provably outside
+        the query in exact f64, so patching the subset is exact)."""
+        cand = zscan.boundary_candidates(xhi, yhi, sq)
+        if not len(cand):
+            return mask
+        batch = st.batch
+        dtg = st.sft.dtg_field
+        col = batch.col(st.sft.geom_field)
+        x, y = col.x, col.y
+        millis = (batch.col(dtg).millis if dtg is not None
+                  else np.zeros(st.n, dtype=np.int64))
+        if sel is not None:
+            x, y, millis = x[sel], y[sel], millis[sel]
+        explain(f"Boundary recheck: {len(cand)} candidate(s)")
+        return zscan.exact_patch(mask, cand, x, y, millis, sq)
+
+    def _scan_gathered(self, st: _TypeState, sq: zscan.ScanQuery,
+                       rows: np.ndarray, explain: Explainer,
+                       nb: int, ni: int) -> np.ndarray:
+        """Index-pruned candidate tier: fused kernel over just the
+        gathered rows + boundary patch on the subset."""
+        explain(f"Index-pruned device scan: {len(rows)} candidate "
+                f"row(s) of {st.n}, {nb} box(es), {ni} interval(s)")
+        sub = zscan.scan_mask_at(st.scan_data, sq, rows)
+        sub = self._patch_mask(st, sub, st.host_xhi[rows],
+                               st.host_yhi[rows], rows, sq, explain)
+        return np.sort(rows[sub])
+
+    def _scan_dense(self, st: _TypeState, sq: zscan.ScanQuery,
+                    explain: Explainer, nb: int, ni: int) -> np.ndarray:
+        """Dense full-batch tier: the flag-selected XLA or Pallas
+        kernel + full-table boundary patch."""
+        if SCAN_KERNEL.get() == "pallas":
+            from ..scan.pallas_scan import pallas_scan_mask
+            explain(f"Pallas device scan: {nb} box(es), "
+                    f"{ni} interval(s), n={st.n}")
+            mask = pallas_scan_mask(st.pallas(), sq)
+        else:
+            explain(f"Device scan: {nb} box(es), "
+                    f"{ni} interval(s), n={st.n}")
+            mask = np.asarray(zscan.scan_mask(st.scan_data, sq))[:st.n]
+        mask = self._patch_mask(st, mask, st.host_xhi, st.host_yhi,
+                                None, sq, explain)
+        return np.flatnonzero(mask)
 
     def _device_extent_scan(self, st: _TypeState, q: Query,
                             strategy: FilterStrategy,
@@ -860,7 +907,7 @@ class InMemoryDataStore(DataStore):
             return np.sort(rows[keep])
 
         eq = gscan.extent_query(boxes, intervals)
-        state = gscan.extent_tristate(st.extent_data, eq)
+        state = self._extent_states(st, eq)
         explain(f"Device extent scan: {len(boxes)} box(es), "
                 f"{len(intervals)} interval(s), n={st.n}")
 
@@ -885,6 +932,10 @@ class InMemoryDataStore(DataStore):
             # non-OUT row matches
             mask = state >= 1
         return np.flatnonzero(mask)
+
+    def _extent_states(self, st: _TypeState,
+                       eq: "gscan.ExtentQuery") -> np.ndarray:
+        return gscan.extent_tristate(st.extent_data, eq)
 
     def _pip_residual(self, spatial_f, col, candidates: np.ndarray,
                       explain: Explainer):

@@ -1,252 +1,202 @@
 """Mesh-distributed datastore: the multi-chip execution tier.
 
-Where InMemoryDataStore runs fused scans on one device, this store
-shards the hot columns of each point type over a ``jax.sharding.Mesh``
-and executes the same query plans with shard-local kernels + ICI
-reduces — the architectural analog of the reference's horizontal
-scaling across tablet/region servers (SURVEY.md §2.5 #2/#5: shard
-parallelism + server-side pushdown with client reduce):
+One engine, two execution tiers: this store IS the single-device
+engine (it subclasses InMemoryDataStore, inheriting the planner,
+attribute strategies, visibility filtering, deletes, residual
+compilation, LSM writes and the host z-key fast path), with the
+*device* tier swapped out — hot columns live as mesh-sharded segments
+and wide scans fan out shard-locally with ICI reduces. That mirrors
+the reference, where a single ``GeoMesaDataStore`` runs the full query
+surface over every distributed backend
+(/root/reference/geomesa-index-api/src/main/scala/org/locationtech/
+geomesa/index/geotools/GeoMesaDataStore.scala:38, with backends
+plugging in through IndexAdapter.scala:24-102 — here the "adapter" is
+the small set of scan-tier hooks this subclass overrides).
 
-- query ids/features: distributed scan mask (shard_map) gathered with
-  the exact f64 boundary patch, residual filters evaluated on host
-  candidates only;
-- count: psum on ICI, host boundary adjustment (never gathers a mask);
-- density: shard-local scatter-add grids psum-merged over ICI;
-- histogram stats: shard-local bincount + psum;
-- KNN: shard-local top-k prune + host exact re-rank.
+Execution tiers per query (same policy as the single-device store):
 
-The host batch stays resident as the source of truth for residual
-predicates and attribute materialization (the "record table" role);
-device shards hold the scan-hot columns (the "index tables").
+- selective queries resolve EXACTLY inside the host z-key index
+  (index-space candidates, never an O(n) mask);
+- mid-size candidate sets evaluate exactly on host over just the
+  gathered candidate rows;
+- wide scans run the fused kernel shard-locally on every device
+  (shard_map) with the exact f64 boundary patch on the gathered
+  verdict; counts/density/histograms reduce over ICI with psum and
+  never materialize row sets at all.
+
+Writes are LSM-style at BOTH levels: host appends buffer and merge
+into the sorted z-key index incrementally, and the device tier appends
+delta-sized sharded SEGMENTS (re-shard cost proportional to the burst,
+the minor-compaction shape); segments compact into one when they pile
+up. The reference gets the same write path from BatchWriter mutations
+merging into tablets at minor compaction.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from ..features.batch import FeatureBatch, PointColumn
-from ..features.sft import SimpleFeatureType, parse_spec
+from ..features.sft import SimpleFeatureType
 from ..filters import ast
-from ..filters.evaluate import evaluate
 from ..filters.helper import extract_geometries
-from ..index.api import Explainer, FilterStrategy, Query, QueryHints
-from .api import DataStore
-from ..index.planner import decide_strategy
+from ..index.api import Explainer, Query, QueryHints
 from ..parallel import (DistributedScanData, data_mesh, distributed_count,
                         distributed_density, distributed_histogram,
-                        distributed_knn, exact_host_mask,
+                        distributed_knn, distributed_tristate,
+                        exact_host_mask, shard_extent_data,
                         shard_points_split, shard_scan_data)
 from ..scan import zscan
-from .memory import (QueryResult, _intervals_ms, _is_envelope, _needs_exact,
-                     _spatial_only)
+from .memory import (HOST_SCAN_ROWS, InMemoryDataStore, _TypeState,
+                     _geom_centroids, _intervals_ms, _needs_exact)
 
 __all__ = ["DistributedDataStore"]
 
-
-class _MeshTypeState:
-    def __init__(self, sft: SimpleFeatureType):
-        self.sft = sft
-        self.batch: FeatureBatch | None = None
-        self.data: DistributedScanData | None = None
-        self.split = None    # two-float sharded coords for KNN
-        self.valid = None
-        self.zindex = None   # host sorted z-key index (range pruning)
-        self.dirty = False
-
-    @property
-    def n(self) -> int:
-        return 0 if self.batch is None else self.batch.n
+# segment count that triggers compaction (one full re-shard): bounds
+# per-query scan dispatches while keeping write bursts O(delta)
+MAX_SEGMENTS = 8
 
 
-class DistributedDataStore(DataStore):
-    """Point-type datastore sharded over a device mesh.
+class _MeshTypeState(_TypeState):
+    """Per-type state whose device tier is a list of mesh-sharded
+    segments (LSM runs): writes append delta-sized segments, reads scan
+    every segment, compaction re-shards into one."""
 
-    Extent (non-point) types belong on the single-device store for now;
-    this tier is the 100M+-row scan engine (BASELINE.md target shape).
-    """
+    def __init__(self, sft: SimpleFeatureType, mesh):
+        super().__init__(sft)
+        self.mesh = mesh
+        self.segments: list[DistributedScanData] = []
+        self.ext_segments: list = []   # DistributedExtentData runs
+        self._knn_splits: list = []    # per-segment two-float shards
 
-    def __init__(self, mesh=None):
+    # -- device-tier hooks -------------------------------------------------
+
+    def has_point_scan(self) -> bool:
+        return bool(self.segments)
+
+    def has_extent_scan(self) -> bool:
+        return bool(self.ext_segments)
+
+    def _clear_device_index(self):
+        self.segments = []
+        self.ext_segments = []
+        self._knn_splits = []
+
+    def _build_point_index(self, x, y, millis):
+        self.segments = [shard_scan_data(x, y, millis, self.mesh)]
+        self.ext_segments = []
+        self._knn_splits = [None]
+
+    def _build_extent_index(self, bounds, millis):
+        self.ext_segments = [shard_extent_data(bounds, millis, self.mesh)]
+        self.segments = []
+        self._knn_splits = []
+
+    def _extend_device_index(self, col, dmillis) -> bool:
+        """Write burst -> one new delta-sized sharded segment (cost
+        proportional to the delta); False once MAX_SEGMENTS runs have
+        piled up, leaving the state dirty so the next read compacts
+        (full re-shard)."""
+        if len(self.segments) >= MAX_SEGMENTS:
+            return False
+        self.segments.append(
+            shard_scan_data(col.x, col.y, dmillis, self.mesh))
+        self._knn_splits.append(None)
+        return True
+
+    def segment_offsets(self) -> list[int]:
+        offs = [0]
+        for seg in self.segments:
+            offs.append(offs[-1] + seg.n)
+        return offs
+
+
+class DistributedDataStore(InMemoryDataStore):
+    """Full-featured datastore sharded over a device mesh — the scale
+    tier for 100M+-row tables (BASELINE.md north-star shape), with the
+    complete single-device query surface."""
+
+    def __init__(self, mesh=None, audit=None):
+        super().__init__(audit=audit)
         self.mesh = mesh if mesh is not None else data_mesh()
-        self._types: dict[str, _MeshTypeState] = {}
 
-    # -- schema / writes --------------------------------------------------
+    def _new_state(self, sft: SimpleFeatureType) -> _MeshTypeState:
+        return _MeshTypeState(sft, self.mesh)
 
-    def create_schema(self, sft: SimpleFeatureType | str,
-                      spec: str | None = None):
-        if isinstance(sft, str):
-            sft = parse_spec(sft, spec)
-        if sft.geom_field is None or not sft.is_points:
-            raise ValueError("DistributedDataStore requires a point "
-                             "geometry type")
-        self._types[sft.type_name] = _MeshTypeState(sft)
+    # -- scan tiers over the sharded segments ------------------------------
 
-    def get_schema(self, type_name: str) -> SimpleFeatureType:
-        return self._state(type_name).sft
-
-    def get_type_names(self) -> list[str]:
-        return sorted(self._types)
-
-    def _state(self, type_name: str) -> _MeshTypeState:
-        try:
-            return self._types[type_name]
-        except KeyError:
-            raise KeyError(f"unknown feature type '{type_name}'") from None
-
-    def write(self, type_name: str, batch: FeatureBatch):
-        st = self._state(type_name)
-        st.batch = batch if st.batch is None else st.batch.concat(batch)
-        st.dirty = True
-
-    def count(self, type_name: str) -> int:
-        return self._state(type_name).n
-
-    # -- sharding ---------------------------------------------------------
-
-    def _ensure_sharded(self, st: _MeshTypeState):
-        """(Re)shard the hot columns after writes — the re-balance that
-        tablet splits do continuously happens here at scan boundaries."""
-        if not st.dirty and st.data is not None:
-            return
-        if st.batch is None or st.batch.n == 0:
-            st.data = None
-            st.split = None
-            st.valid = None
-            st.zindex = None
-            st.dirty = False
-            return
+    def _scan_gathered(self, st: _MeshTypeState, sq: zscan.ScanQuery,
+                       rows: np.ndarray, explain: Explainer,
+                       nb: int, ni: int) -> np.ndarray:
+        """Candidate sets between the host cap and the block threshold
+        evaluate exactly on host in f64 over just the gathered rows —
+        index-space work, never an O(n) mask. (A cross-shard device
+        gather would pay an all-gather of the candidate set for no
+        arithmetic advantage at this tier.)"""
+        explain(f"Index-pruned host candidate scan: {len(rows)} "
+                f"candidate row(s) of {st.n}, {nb} box(es), "
+                f"{ni} interval(s)")
         col = st.batch.col(st.sft.geom_field)
-        dtg = st.sft.dtg_field
-        millis = (st.batch.col(dtg).millis if dtg is not None
-                  else np.zeros(st.batch.n, dtype=np.int64))
-        st.data = shard_scan_data(col.x, col.y, millis, self.mesh)
-        st.split, st.valid, _ = shard_points_split(col.x, col.y, self.mesh)
-        # the same host z-key index the single-device engine prunes
-        # with: selective queries skip the mesh scan entirely
-        from ..index.zkeys import ZKeyIndex
-        st.zindex = ZKeyIndex(col.x, col.y,
-                              millis if dtg is not None else None,
-                              st.sft.z3_interval)
-        st.dirty = False
+        x, y = col.x[rows], col.y[rows]
+        keep = np.zeros(len(rows), dtype=bool)
+        for xmin, ymin, xmax, ymax in sq.host_boxes:
+            keep |= (x >= xmin) & (x <= xmax) & (y >= ymin) & (y <= ymax)
+        if not sq.time_any:
+            ms = st.batch.col(st.sft.dtg_field).millis[rows]
+            tk = np.zeros(len(rows), dtype=bool)
+            for lo, hi in sq.host_intervals:
+                tk |= (ms >= lo) & (ms <= hi)
+            keep &= tk
+        return np.sort(rows[keep])
 
-    # -- queries ----------------------------------------------------------
+    def _scan_dense(self, st: _MeshTypeState, sq: zscan.ScanQuery,
+                    explain: Explainer, nb: int, ni: int) -> np.ndarray:
+        """Dense tier: the fused kernel shard-locally on every device,
+        per segment, with the exact f64 boundary patch."""
+        explain(f"Distributed scan over {self.mesh.devices.size} "
+                f"device(s), {len(st.segments)} segment(s), n={st.n}, "
+                f"{nb} box(es), {ni} interval(s)")
+        masks = [exact_host_mask(seg, sq) for seg in st.segments]
+        return np.flatnonzero(np.concatenate(masks))
 
-    def _scan_query(self, st: _MeshTypeState,
-                    strategy: FilterStrategy) -> zscan.ScanQuery:
+    def _extent_states(self, st: _MeshTypeState, eq) -> np.ndarray:
+        return np.concatenate([distributed_tristate(seg, eq)
+                               for seg in st.ext_segments])
+
+    # -- aggregate pushdown (psum over ICI) --------------------------------
+
+    def _psum_plan(self, st: _MeshTypeState, q: Query):
+        """(strategy, boxes, intervals) when the plan's result is fully
+        decided by the shard-local kernel — pure z envelope scan, no
+        residual, no visibility, no sampling/limit stages — else None
+        (caller takes the shared row pipeline)."""
+        from ..index.planner import decide_strategy
+        strategy = decide_strategy(st.sft, q, self._indices(st.sft), st.n,
+                                   stats=self.stats.get(q.type_name),
+                                   explain=Explainer())
         primary = (strategy.primary if strategy.primary is not None
                    else ast.Include())
-        geom = st.sft.geom_field
-        dtg = st.sft.dtg_field
-        geoms = extract_geometries(primary, geom)
+        geoms = extract_geometries(primary, st.sft.geom_field)
+        if (strategy.index not in ("z2", "z3")
+                or strategy.secondary is not None
+                or _needs_exact(geoms, primary)
+                or st.has_vis or q.auths is not None
+                or q.hints.get(QueryHints.SAMPLING) is not None
+                or q.max_features is not None):
+            return None
         boxes = [g.envelope.as_tuple() for g in geoms] or \
             [(-180.0, -90.0, 180.0, 90.0)]
-        intervals = (_intervals_ms(primary, dtg)
-                     if dtg is not None and strategy.index == "z3" else [])
-        return zscan.make_query(boxes, intervals)
+        intervals = (_intervals_ms(primary, st.sft.dtg_field)
+                     if st.sft.dtg_field is not None
+                     and strategy.index == "z3" else [])
+        return strategy, boxes, intervals
 
-    def _plan(self, q: Query, st: _MeshTypeState, explain: Explainer):
-        indices = ["z3", "z2"] if st.sft.dtg_field is not None else ["z2"]
-        indices.append("id")
-        return decide_strategy(st.sft, q, indices, st.n, explain=explain)
-
-    def query(self, q: Query | str, type_name: str | None = None,
-              explain_out=None) -> QueryResult:
-        if isinstance(q, str):
-            if type_name is None:
-                raise ValueError("type_name required with a filter string")
-            q = Query(type_name, q)
-        st = self._state(q.type_name)
-        explain = Explainer(explain_out)
-        explain.push(f"Distributed planning '{q.type_name}' "
-                     f"filter={q.filter} mesh={self.mesh.devices.size}dev")
-        if st.n == 0:
-            explain("Store is empty").pop()
-            return QueryResult(np.empty(0, dtype=object), None, explain,
-                               FilterStrategy("empty", None, None))
-        self._ensure_sharded(st)
-        strategy = self._plan(q, st, explain)
-
-        if strategy.index == "empty":
-            mask = np.zeros(st.n, dtype=bool)
-        elif strategy.index == "id" and strategy.primary is not None:
-            mask = np.isin(st.batch.ids.astype(str),
-                           np.asarray(strategy.primary.ids, dtype=str))
-        else:
-            sq = self._scan_query(st, strategy)
-            mask = self._pruned_or_distributed(st, strategy, sq, explain)
-            primary = strategy.primary or ast.Include()
-            geoms = extract_geometries(primary, st.sft.geom_field)
-            if _needs_exact(geoms, primary):
-                cand = np.flatnonzero(mask)
-                spatial_f = _spatial_only(primary, st.sft.geom_field)
-                if spatial_f is not None and len(cand):
-                    keep = evaluate(spatial_f, st.batch.take(cand))
-                    mask = np.zeros(st.n, dtype=bool)
-                    mask[cand[keep]] = True
-                    explain(f"Exact predicate on {len(cand)} candidate(s)")
-
-        if strategy.secondary is not None:
-            cand = np.flatnonzero(mask)
-            if len(cand):
-                keep = evaluate(strategy.secondary, st.batch.take(cand))
-                mask = np.zeros(st.n, dtype=bool)
-                mask[cand[keep]] = True
-            explain(f"Residual filter applied: {strategy.secondary}")
-
-        idx = np.flatnonzero(mask)
-        rate = q.hints.get(QueryHints.SAMPLING)
-        if rate is not None and len(idx):
-            from ..scan.aggregations import sample_mask
-            by_attr = q.hints.get(QueryHints.SAMPLE_BY)
-            by = None
-            if by_attr is not None:
-                col = st.batch.col(by_attr)
-                by = np.array([col.value(int(i)) or "" for i in idx],
-                              dtype=object).astype(str)
-            idx = idx[sample_mask(len(idx), float(rate), by)]
-            explain(f"Sampling applied: rate={rate}")
-        if q.sort_by is not None:
-            from .common import sort_order
-            idx = idx[sort_order(st.batch, q.sort_by, q.sort_desc, idx)]
-            explain(f"Sorted by {q.sort_by}"
-                    f"{' desc' if q.sort_desc else ''}")
-        if q.max_features is not None:
-            idx = idx[: q.max_features]
-        ids = st.batch.ids[idx]
-        batch = st.batch.take(idx)
-        explain(f"Hits: {len(ids)}").pop()
-        return QueryResult(ids, batch, explain, strategy)
-
-    def _pruned_or_distributed(self, st: _MeshTypeState,
-                               strategy: FilterStrategy,
-                               sq: zscan.ScanQuery,
-                               explain: Explainer) -> np.ndarray:
-        """z-index pruning + host fast path for selective queries (the
-        single-device engine's crossover); wide scans fan out over the
-        mesh. Returns a bool[n] mask."""
-        from ..index.zkeys import SCAN_BLOCK_THRESHOLD, search_rows
-        from .memory import HOST_SCAN_ROWS
-        boxes = [tuple(b) for b in sq.host_boxes]
-        intervals = [tuple(iv) for iv in sq.host_intervals]
-        # the mesh has no gathered-candidate device path, so pruning is
-        # only worthwhile up to the host fast-path size
-        max_rows = min(int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n),
-                       int(HOST_SCAN_ROWS.get()))
-        kind, idx = search_rows(st.zindex, strategy.index, boxes,
-                                intervals, max_rows, max_rows)
-        if kind == "exact":
-            explain(f"Index-pruned host scan: {len(idx)} hit(s) "
-                    f"of {st.n}")
-            mask = np.zeros(st.n, dtype=bool)
-            mask[idx] = True
-            return mask
-        explain(f"Distributed scan over {self.mesh.devices.size} "
-                f"device(s)")
-        return exact_host_mask(st.data, sq)
-
-    def query_count(self, q: Query | str, type_name: str | None = None) -> int:
-        """Count without gathering a mask: psum over ICI + host boundary
-        adjustment (exact). Falls back to query() when the plan needs
-        residual/exact predicates."""
+    def query_count(self, q: Query | str,
+                    type_name: str | None = None) -> int:
+        """Counts never materialize row sets on the dense tier: the
+        selective path counts inside the host z-key index; wide
+        psum-eligible scans reduce over ICI (server-side aggregate ->
+        client reduce, SURVEY §2.5#5) with the exact host boundary
+        adjustment. Every other plan shape takes the shared pipeline."""
         if isinstance(q, str):
             if type_name is None:
                 raise ValueError("type_name required with a filter string")
@@ -254,44 +204,55 @@ class DistributedDataStore(DataStore):
         st = self._state(q.type_name)
         if st.n == 0:
             return 0
-        self._ensure_sharded(st)
-        explain = Explainer()
-        strategy = self._plan(q, st, explain)
-        primary = strategy.primary or ast.Include()
-        geoms = extract_geometries(primary, st.sft.geom_field)
-        if (strategy.index not in ("z2", "z3")
-                or strategy.secondary is not None
-                or _needs_exact(geoms, primary)
-                or q.hints.get(QueryHints.SAMPLING) is not None
-                or q.max_features is not None
-                or q.auths is not None):
-            # row-limiting/sampling/visibility stages need the full
-            # query pipeline for counts to match query().n
-            return int(self.query(q).n)
-        return distributed_count(st.data, self._scan_query(st, strategy))
+        st.ensure_index()
+        plan = self._psum_plan(st, q) if st.segments else None
+        if plan is None:
+            return super().query_count(q)
+        strategy, boxes, intervals = plan
+        import time as _time
+        t0 = _time.perf_counter()
+        from ..index.zkeys import SCAN_BLOCK_THRESHOLD, search_rows
+        host_cap = min(int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n),
+                       int(HOST_SCAN_ROWS.get()))
+        kind, rows = search_rows(st.zindex, strategy.index, boxes,
+                                 intervals, host_cap, host_cap)
+        if kind == "exact":
+            n = len(rows)
+        else:
+            sq = zscan.make_query(boxes, intervals)
+            n = sum(distributed_count(seg, sq) for seg in st.segments)
+        if self.audit is not None:
+            self.audit.record(q.type_name, str(q.filter), q.hints, 0.0,
+                              round((_time.perf_counter() - t0) * 1000, 3),
+                              n)
+        return n
 
-    def density(self, type_name: str, ecql, bbox, width: int, height: int):
-        """Heatmap grid via shard-local scatter-add + psum."""
+    def density(self, type_name: str, ecql, bbox, width: int, height: int,
+                weight_attr: str | None = None) -> np.ndarray:
+        """Heatmap grid: shard-local scatter-add psum-merged over ICI
+        (DensityScan -> client-reduce shape) for psum-eligible plans;
+        the shared host-binned path otherwise."""
         st = self._state(type_name)
-        if st.n == 0:
-            return np.zeros((height, width), dtype=np.float32)
-        self._ensure_sharded(st)
+        if st.n == 0 or weight_attr is not None:
+            return super().density(type_name, ecql, bbox, width, height,
+                                   weight_attr)
+        st.ensure_index()
         q = Query(type_name, ecql)
-        explain = Explainer()
-        strategy = self._plan(q, st, explain)
-        if strategy.index in ("z2", "z3") and strategy.secondary is None:
-            sq = self._scan_query(st, strategy)
-            return distributed_density(st.data, sq, bbox, width, height)
-        # residual-bearing plans: exact mask, host binning
-        res = self.query(q)
-        from ..scan.aggregations import density_grid
-        col = res.batch.col(st.sft.geom_field)
-        return density_grid(col.x, col.y, np.ones(len(col.x), bool),
-                            bbox, width, height)
+        plan = self._psum_plan(st, q) if st.segments else None
+        if plan is None:
+            return super().density(type_name, ecql, bbox, width, height,
+                                   weight_attr)
+        _, boxes, intervals = plan
+        sq = zscan.make_query(boxes, intervals)
+        grid = np.zeros((height, width), dtype=np.float32)
+        for seg in st.segments:
+            grid += distributed_density(seg, sq, bbox, width, height)
+        return grid
 
     def histogram(self, type_name: str, attribute: str, nbins: int,
                   lo: float, hi: float) -> np.ndarray:
-        """Distributed attribute histogram (psum-merged)."""
+        """Distributed attribute histogram: shard-local bincount merged
+        over ICI with psum (StatsCombiner merge analog)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -299,7 +260,6 @@ class DistributedDataStore(DataStore):
         st = self._state(type_name)
         if st.n == 0:
             return np.zeros(nbins, dtype=np.int64)
-        self._ensure_sharded(st)
         vals = st.batch.col(attribute)
         v = np.asarray(getattr(vals, "values", getattr(vals, "millis", None)),
                        np.float64)
@@ -315,12 +275,33 @@ class DistributedDataStore(DataStore):
                                      self.mesh, nbins, lo, hi)
 
     def knn(self, type_name: str, qx: float, qy: float, k: int) -> np.ndarray:
-        """k nearest feature ids via the distributed prune + exact
-        host re-rank."""
+        """k nearest feature ids: shard-local top-k prune per segment
+        (candidates travel with their two-float coords), exact f64
+        re-rank across segment candidates on host."""
         st = self._state(type_name)
         if st.n == 0:
             return np.empty(0, dtype=object)
-        self._ensure_sharded(st)
-        idx = distributed_knn(None, None, st.valid, self.mesh, st.n,
-                              qx, qy, k, split=st.split)
-        return st.batch.ids[idx]
+        st.ensure_index()
+        if not st.segments:
+            # extent / geometry-less types: exact centroid ranking
+            x, y, valid = _geom_centroids(st.batch, st.sft.geom_field)
+            d2 = np.where(valid, (x - qx) ** 2 + (y - qy) ** 2, np.inf)
+            return st.batch.ids[np.argsort(d2, kind="stable")[:k]]
+        col = st.batch.col(st.sft.geom_field)
+        offs = st.segment_offsets()
+        cands = []
+        for i in range(len(st.segments)):
+            split = st._knn_splits[i]
+            if split is None:
+                lo, hi = offs[i], offs[i + 1]
+                split = shard_points_split(col.x[lo:hi], col.y[lo:hi],
+                                           self.mesh)
+                st._knn_splits[i] = split
+            sp, valid, n = split
+            idx = distributed_knn(None, None, valid, self.mesh, n,
+                                  qx, qy, k, split=sp)
+            cands.append(np.asarray(idx, dtype=np.int64) + offs[i])
+        cand = np.concatenate(cands)
+        d2 = (col.x[cand] - qx) ** 2 + (col.y[cand] - qy) ** 2
+        order = np.argsort(d2, kind="stable")
+        return st.batch.ids[cand[order][:k]]

@@ -96,3 +96,64 @@ class TestContract:
         # the documented SPI contract: KeyError for absent types
         with pytest.raises(KeyError):
             store.get_schema("nope")
+
+    def test_delete(self, store):
+        # every backend supports id deletes (GeoMesaFeatureWriter remove)
+        victims = [f"f{i}" for i in range(0, 100)]
+        store.delete("t", victims)
+        assert store.count("t") == N - 100
+        res = store.query("INCLUDE", "t")
+        assert res.n == N - 100
+        assert not (set(victims) & set(res.ids.astype(str)))
+        # deleted rows stay gone through the indexed path too
+        bbox = store.query("BBOX(geom, -60, -30, 60, 30)", "t")
+        assert not (set(victims) & set(bbox.ids.astype(str)))
+
+    def test_extent_geometries(self, store):
+        # non-point (xz-indexed) schemas run on every backend
+        store.create_schema(parse_spec(
+            "ext", "name:String,dtg:Date,*geom:Geometry:srid=4326"))
+        wkts = ["POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))",
+                "POLYGON ((20 20, 30 20, 30 30, 20 30, 20 20))",
+                "LINESTRING (5 5, 25 25)",
+                "POLYGON ((-50 -50, -40 -50, -40 -40, -50 -40, -50 -50))"]
+        store.write_dict("ext", [f"g{i}" for i in range(len(wkts))], {
+            "name": [f"n{i}" for i in range(len(wkts))],
+            "dtg": [MS("2020-01-01")] * len(wkts),
+            "geom": wkts,
+        })
+        # g0's polygon and g2's line (which starts at (5 5)) hit the box
+        res = store.query("BBOX(geom, 1, 1, 9, 9)", "ext")
+        assert set(res.ids.astype(str)) == {"g0", "g2"}
+        res = store.query(
+            "INTERSECTS(geom, POLYGON ((4 4, 26 4, 26 26, 4 26, 4 4)))",
+            "ext")
+        assert set(res.ids.astype(str)) == {"g0", "g1", "g2"}
+        assert store.query("INCLUDE", "ext").n == len(wkts)
+
+    def test_visibilities(self, store):
+        # visibility labels enforce row-level access on backends whose
+        # write path carries them (the Accumulo column-visibility model)
+        import inspect
+        from geomesa_tpu.features.batch import FeatureBatch
+        from geomesa_tpu.index.api import Query
+        if "visibilities" not in inspect.signature(store.write).parameters:
+            pytest.skip("backend write path has no visibility labels")
+        store.create_schema(parse_spec("vis", SPEC))
+        n = 40
+        rng = np.random.default_rng(9)
+        batch = FeatureBatch.from_dict(store.get_schema("vis"),
+            [f"v{i}" for i in range(n)], {
+                "name": [f"n{i}" for i in range(n)],
+                "val": rng.integers(0, 100, n),
+                "dtg": rng.integers(MS("2019-01-01"), MS("2019-03-01"), n),
+                "geom": (rng.uniform(-90, 90, n), rng.uniform(-45, 45, n)),
+            })
+        vis = ["admin&ops" if i % 4 == 0 else
+               ("admin" if i % 2 == 0 else None) for i in range(n)]
+        store.write("vis", batch, visibilities=vis)
+        assert store.query(Query("vis", "INCLUDE", auths=[])).n == n // 2
+        assert store.query(Query("vis", "INCLUDE",
+                                 auths=["admin"])).n == n - n // 4
+        assert store.query(Query("vis", "INCLUDE",
+                                 auths=["admin", "ops"])).n == n

@@ -111,10 +111,119 @@ class TestDistributedStore:
         want = set(mem.query(ecql, "pts").ids.astype(str))
         assert set(res.ids.astype(str)) == want
 
-    def test_rejects_extent_types(self):
+    def test_extent_types_supported(self):
+        # round-2 VERDICT: the mesh tier must run the full query
+        # surface, extent (xz) geometries included
         ds = DistributedDataStore()
-        with pytest.raises(ValueError):
-            ds.create_schema(parse_spec("z", "*geom:Polygon:srid=4326"))
+        mem = InMemoryDataStore()
+        wkts = [
+            "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))",
+            "POLYGON ((20 20, 30 20, 30 30, 20 30, 20 20))",
+            "POLYGON ((-50 -50, -40 -50, -40 -40, -50 -40, -50 -50))",
+            "LINESTRING (5 5, 25 25)",
+        ]
+        for s in (ds, mem):
+            s.create_schema(parse_spec("z", "*geom:Geometry:srid=4326"))
+            s.write_dict("z", [f"g{i}" for i in range(len(wkts))],
+                         {"geom": wkts})
+        for ecql in ("BBOX(geom, 1, 1, 9, 9)",
+                     "INTERSECTS(geom, POLYGON ((4 4, 26 4, 26 26, 4 26, 4 4)))",
+                     "INCLUDE"):
+            got = set(ds.query(ecql, "z").ids.astype(str))
+            want = set(mem.query(ecql, "z").ids.astype(str))
+            assert got == want, ecql
+
+    def test_visibility_filtering(self):
+        ds = DistributedDataStore()
+        ds.create_schema(parse_spec("v", SPEC))
+        n = 50
+        rng = np.random.default_rng(3)
+        from geomesa_tpu.features.batch import FeatureBatch
+        batch = FeatureBatch.from_dict(ds.get_schema("v"),
+            [f"f{i}" for i in range(n)],
+            {"name": [f"n{i}" for i in range(n)],
+             "age": rng.integers(0, 9, n),
+             "dtg": rng.integers(0, 10 ** 12, n),
+             "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))})
+        vis = ["admin" if i % 2 else None for i in range(n)]
+        ds.write("v", batch, visibilities=vis)
+        from geomesa_tpu.index.api import Query
+        assert ds.query(Query("v", "INCLUDE", auths=[])).n == n // 2
+        assert ds.query(Query("v", "INCLUDE", auths=["admin"])).n == n
+
+    def test_delete(self):
+        # deletes flow through the inherited LSM state on a fresh store
+        ds = DistributedDataStore()
+        ds.create_schema(parse_spec("d", SPEC))
+        rng = np.random.default_rng(5)
+        n = 1000
+        ds.write_dict("d", [f"f{i}" for i in range(n)], {
+            "name": [f"n{i % 3}" for i in range(n)],
+            "age": rng.integers(0, 100, n),
+            "dtg": rng.integers(0, 10 ** 12, n),
+            "geom": (rng.uniform(-90, 90, n), rng.uniform(-45, 45, n))})
+        assert ds.query("INCLUDE", "d").n == n
+        ds.delete("d", [f"f{i}" for i in range(0, n, 2)])
+        assert ds.count("d") == n // 2
+        res = ds.query("INCLUDE", "d")
+        assert res.n == n // 2
+        assert all(int(s[1:]) % 2 == 1 for s in res.ids.astype(str))
+
+    def test_write_burst_appends_segment_not_reshard(self):
+        # round-2 VERDICT weak #1: re-shard cost must be proportional
+        # to the delta — a write burst appends a delta-sized segment
+        # and leaves the base segment object untouched
+        ds = DistributedDataStore()
+        ds.create_schema(parse_spec("w", SPEC))
+        rng = np.random.default_rng(7)
+
+        def mkdata(n, seed0):
+            return {"name": [f"n{i % 3}" for i in range(n)],
+                    "age": rng.integers(0, 100, n),
+                    "dtg": rng.integers(0, 10 ** 12, n),
+                    "geom": (rng.uniform(-90, 90, n),
+                             rng.uniform(-45, 45, n))}
+
+        n0 = 10_000
+        ds.write_dict("w", [f"a{i}" for i in range(n0)], mkdata(n0, 0))
+        ds.query("BBOX(geom, -180, -90, 180, 0)", "w")  # build
+        st = ds._state("w")
+        assert len(st.segments) == 1
+        base_seg = st.segments[0]
+
+        n1 = 500
+        ds.write_dict("w", [f"b{i}" for i in range(n1)], mkdata(n1, 1))
+        res = ds.query("BBOX(geom, -180, -90, 180, 0)", "w")
+        assert len(st.segments) == 2
+        assert st.segments[0] is base_seg          # base not re-uploaded
+        assert st.segments[1].n == n1              # delta-sized segment
+        # and results stay exact across segments
+        mem = InMemoryDataStore()
+        mem.create_schema(parse_spec("w", SPEC))
+        b = st.batch
+        mem.write("w", b)
+        want = set(mem.query("BBOX(geom, -180, -90, 180, 0)", "w")
+                   .ids.astype(str))
+        assert set(res.ids.astype(str)) == want
+
+    def test_segment_compaction_after_many_bursts(self):
+        ds = DistributedDataStore()
+        ds.create_schema(parse_spec("c", SPEC))
+        rng = np.random.default_rng(11)
+        total = 0
+        for j in range(12):  # > MAX_SEGMENTS bursts
+            n = 200
+            ds.write_dict("c", [f"f{total + i}" for i in range(n)], {
+                "name": [f"n{i % 3}" for i in range(n)],
+                "age": rng.integers(0, 100, n),
+                "dtg": rng.integers(0, 10 ** 12, n),
+                "geom": (rng.uniform(-90, 90, n), rng.uniform(-45, 45, n))})
+            total += n
+            ds.query("BBOX(geom, -180, -90, 180, 0)", "c")
+        st = ds._state("c")
+        from geomesa_tpu.store.mesh_store import MAX_SEGMENTS
+        assert len(st.segments) <= MAX_SEGMENTS
+        assert ds.query("INCLUDE", "c").n == total
 
     def test_empty_store(self):
         ds = DistributedDataStore()
