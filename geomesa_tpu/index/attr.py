@@ -5,7 +5,12 @@ The reference's attribute index stores rows keyed by
 predicates with key-range scans, then joins the matching ids back to the
 record table (/root/reference/geomesa-accumulo/geomesa-accumulo-datastore/
 src/main/scala/org/locationtech/geomesa/accumulo/index/AttributeIndex.scala:386-395,
-AttributeIndexKeySpace value-to-bytes encoding).
+AttributeIndexKeySpace value-to-bytes encoding). The [secondary date]
+tier is reproduced here: when the schema has a default date, keys sort
+by (value, millis), and equality scans narrow their slice with the
+filter's date bounds before the positional join — the reference's
+secondary key-space range tightening
+(geomesa-index-api/.../index/AttributeIndex.scala:40,124-158).
 
 Columnar analog: one sorted permutation per indexed attribute. Typed
 bounds from ``extract_attribute_bounds`` binary-search into the sorted
@@ -33,9 +38,10 @@ __all__ = ["AttributeKeyIndex"]
 
 
 class AttributeKeyIndex:
-    """Sorted permutation over one column; bounds -> candidate rows."""
+    """Sorted permutation over one column — keyed (value, date) when a
+    secondary date column is supplied; bounds -> candidate rows."""
 
-    def __init__(self, col: Column):
+    def __init__(self, col: Column, date_millis: np.ndarray | None = None):
         if isinstance(col, NumericColumn):
             keys = col.values
             self._kind = "num"
@@ -53,7 +59,13 @@ class AttributeKeyIndex:
         else:
             raise TypeError(f"cannot index {type(col).__name__}")
         rows = np.flatnonzero(col.valid)  # nulls are not indexed
-        order = np.argsort(keys[rows], kind="stable")
+        if date_millis is not None:
+            dm = np.asarray(date_millis, np.int64)[rows]
+            order = np.lexsort((dm, keys[rows]))
+            self.sorted_millis = dm[order]
+        else:
+            order = np.argsort(keys[rows], kind="stable")
+            self.sorted_millis = None
         self.sorted_keys = keys[rows][order]
         self.sorted_rows = rows[order]
 
@@ -90,9 +102,21 @@ class AttributeKeyIndex:
 
     # -- query --------------------------------------------------------------
 
+    @staticmethod
+    def _is_point_bound(b) -> bool:
+        """An equality bound [v, v]: its slice holds ONE value, so the
+        (value, date) composite order is date-sorted within it and the
+        secondary tier can range-scan the date."""
+        return (b.lower.is_bounded and b.upper.is_bounded
+                and b.lower.inclusive and b.upper.inclusive
+                and b.lower.value == b.upper.value)
+
     def candidates(self, bounds: FilterValues,
-                   max_rows: int | None = None) -> np.ndarray | None:
-        """Sorted row indices whose value falls in any of the bounds.
+                   max_rows: int | None = None,
+                   intervals_ms=None) -> np.ndarray | None:
+        """Sorted row indices whose value falls in any of the bounds,
+        with equality slices narrowed to ``intervals_ms`` (inclusive
+        [lo, hi] epoch-millis pairs) via the secondary date key.
 
         Returns None when the bounds cannot be answered by range scans
         (empty/unbounded extraction), or when the candidate set exceeds
@@ -109,14 +133,28 @@ class AttributeKeyIndex:
         for b in bounds:
             lo = self._pos(b.lower, lower=True)
             hi = self._pos(b.upper, lower=False)
-            if hi > lo:
-                total += hi - lo
-                if max_rows is not None and total > max_rows:
-                    return None
-                slices.append(self.sorted_rows[lo:hi])
+            if hi <= lo:
+                continue
+            if (intervals_ms and self.sorted_millis is not None
+                    and self._is_point_bound(b)):
+                seg = self.sorted_millis[lo:hi]
+                for iv_lo, iv_hi in intervals_ms:
+                    s = lo + int(np.searchsorted(seg, iv_lo, side="left"))
+                    e = lo + int(np.searchsorted(seg, iv_hi, side="right"))
+                    if e > s:
+                        total += e - s
+                        if max_rows is not None and total > max_rows:
+                            return None
+                        slices.append(self.sorted_rows[s:e])
+                continue
+            total += hi - lo
+            if max_rows is not None and total > max_rows:
+                return None
+            slices.append(self.sorted_rows[lo:hi])
         if not slices:
             return np.empty(0, dtype=np.int64)
         rows = np.concatenate(slices)
         # OR'd bounds are union-merged upstream but may still touch after
-        # code-space rounding; unique sorts + dedupes in one pass
+        # code-space rounding (and date intervals may overlap); unique
+        # sorts + dedupes in one pass
         return np.unique(rows)

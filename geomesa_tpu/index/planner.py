@@ -86,6 +86,20 @@ def _stats_cost(sft: SimpleFeatureType, s: FilterStrategy, stats,
         return float(max(n_features, 1))
     if s.primary is None:
         return float(max(n_features, 1))
+    if s.index.startswith("attr:"):
+        cost = heuristic_cost(sft, s, n_features)
+        # secondary (value, date) tiering: an equality scan narrowed by
+        # the residual's date bounds touches only the matching time
+        # bins, so its cost scales with the temporal selectivity
+        # (AttributeIndex.scala:124-158 secondary key-space tightening)
+        if (sft.dtg_field is not None and s.secondary is not None
+                and isinstance(s.primary, ast.Compare)
+                and s.primary.op == ast.CompareOp.EQ):
+            iv = extract_intervals(s.secondary, sft.dtg_field)
+            frac = stats.temporal_fraction(iv)
+            if frac is not None:
+                cost *= max(frac, 1e-3)
+        return cost
     try:
         est = stats.estimate_count(s.primary)
     except Exception:

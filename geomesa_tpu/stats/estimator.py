@@ -107,6 +107,36 @@ class StatsEstimator:
             mass += int(arr.sum() if cells is None else arr[cells].sum())
         return mass / total_mass
 
+    def temporal_fraction(self, intervals) -> float | None:
+        """Fraction of observed mass inside the date intervals (time-bin
+        resolution, from the z3 histogram) — the cost-model view of the
+        attribute index's secondary (value, date) narrowing. ``intervals``
+        is a FilterValues of date Bounds; None when not estimable."""
+        if (self.z3 is None or self.z3.is_empty
+                or not intervals or intervals.disjoint):
+            return None
+        hist = self.z3
+        total = sum(int(a.sum()) for a in hist.bins.values())
+        if total == 0:
+            return None
+        from ..filters.helper import to_millis
+        sel_bins: set[int] = set()
+        for b in intervals:
+            if not (b.lower.is_bounded and b.upper.is_bounded):
+                return None
+            try:
+                lo, hi = to_millis(b.lower.value), to_millis(b.upper.value)
+            except Exception:
+                return None
+            # bins_of_interval handles out-of-range intervals itself
+            # (wholly pre-epoch -> no bins); pre-clamping here would
+            # collapse them onto a spurious bin 0
+            bins, _, _ = timebin.bins_of_interval(lo, hi, hist.period)
+            sel_bins.update(bins.tolist())
+        mass = sum(int(hist.bins[b].sum())
+                   for b in sel_bins if b in hist.bins)
+        return mass / total
+
     def _cells_for_boxes(self, sfc, hist: Z3Histogram, boxes) -> np.ndarray:
         """Indices of coarse z cells whose z-range intersects the boxes'
         z-ranges over the whole period (cells are leading z bits)."""

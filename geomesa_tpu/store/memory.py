@@ -337,13 +337,18 @@ class _TypeState:
 
     def attr_index(self, name: str):
         """Sorted attribute index for one column, built on first use
-        (AttributeIndex analog; see index/attr.py)."""
+        (AttributeIndex analog; see index/attr.py). Keys are (value,
+        date) composites when the schema has a default date, so
+        equality scans narrow by the filter's date bounds."""
         self.flush()  # cached indexes must cover pending rows
         if name not in self.attr_idx:
             from ..index.attr import AttributeKeyIndex
+            dtg = self.sft.dtg_field
+            date_millis = (self.batch.col(dtg).millis
+                           if dtg is not None and dtg != name else None)
             try:
                 self.attr_idx[name] = AttributeKeyIndex(
-                    self.batch.col(name))
+                    self.batch.col(name), date_millis=date_millis)
             except TypeError:
                 self.attr_idx[name] = None  # unindexable column type
         return self.attr_idx[name]
@@ -736,10 +741,22 @@ class InMemoryDataStore(DataStore):
         attr = strategy.index.split(":", 1)[1]
         aidx = st.attr_index(attr)
         rows = None
+        intervals = []
         if aidx is not None:
             bounds = extract_attribute_bounds(strategy.primary, attr)
+            # secondary date tiering: the residual's date bounds narrow
+            # equality slices inside the (value, date) composite order
+            dtg = st.sft.dtg_field
+            if (dtg is not None and strategy.secondary is not None
+                    and aidx.sorted_millis is not None):
+                intervals = _intervals_ms(strategy.secondary, dtg,
+                                          lo_unbounded=-(2 ** 62))
             max_rows = int(float(SCAN_BLOCK_THRESHOLD.get()) * st.n)
-            rows = aidx.candidates(bounds, max_rows=max_rows)
+            rows = aidx.candidates(bounds, max_rows=max_rows,
+                                   intervals_ms=intervals)
+            # the secondary tier only engages on equality slices
+            narrowed = bool(intervals) and any(
+                aidx._is_point_bound(b) for b in bounds)
         if rows is None:
             from ..scan import residual
             if residual.is_compilable(strategy.primary, st.batch):
@@ -752,7 +769,7 @@ class InMemoryDataStore(DataStore):
                     f"host scan for {strategy.index}")
             return np.flatnonzero(evaluate(strategy.primary, st.batch))
         explain(f"Attribute index scan: {len(rows)} candidate row(s) "
-                f"of {st.n}")
+                f"of {st.n}" + (" (date-narrowed)" if narrowed else ""))
         if not len(rows):
             return rows
         keep = evaluate(strategy.primary, st.batch.take(rows))
@@ -987,14 +1004,19 @@ def _geom_centroids(batch: FeatureBatch, geom_field: str):
     return x, y, col.valid
 
 
-def _intervals_ms(primary: ast.Filter, dtg: str) -> list[tuple[int, int]]:
+def _intervals_ms(primary: ast.Filter, dtg: str,
+                  lo_unbounded: int = 0) -> list[tuple[int, int]]:
     """Extract inclusive [lo, hi] epoch-millis intervals for the device
     kernels, applying the reference's exclusive-bound adjustment
-    (FilterHelper.scala:267-307 rounding semantics)."""
+    (FilterHelper.scala:267-307 rounding semantics). ``lo_unbounded``
+    is the open-lower sentinel: 0 for the z3 kernels (the index domain
+    floor), a large negative for raw-millis consumers (pre-epoch dates
+    are representable there)."""
     from ..filters.helper import to_millis as _to_millis
     out = []
     for b in extract_intervals(primary, dtg):
-        lo = _to_millis(b.lower.value) if b.lower.is_bounded else 0
+        lo = _to_millis(b.lower.value) if b.lower.is_bounded \
+            else lo_unbounded
         hi = _to_millis(b.upper.value) if b.upper.is_bounded else 2**62
         if b.lower.is_bounded and not b.lower.inclusive:
             lo += 1

@@ -135,17 +135,15 @@ class DistributedDataStore(InMemoryDataStore):
         explain(f"Index-pruned host candidate scan: {len(rows)} "
                 f"candidate row(s) of {st.n}, {nb} box(es), "
                 f"{ni} interval(s)")
+        from ..index.zkeys import ZKeyIndex
         col = st.batch.col(st.sft.geom_field)
-        x, y = col.x[rows], col.y[rows]
-        keep = np.zeros(len(rows), dtype=bool)
-        for xmin, ymin, xmax, ymax in sq.host_boxes:
-            keep |= (x >= xmin) & (x <= xmax) & (y >= ymin) & (y <= ymax)
-        if not sq.time_any:
-            ms = st.batch.col(st.sft.dtg_field).millis[rows]
-            tk = np.zeros(len(rows), dtype=bool)
-            for lo, hi in sq.host_intervals:
-                tk |= (ms >= lo) & (ms <= hi)
-            keep &= tk
+        intervals = [] if sq.time_any else \
+            [tuple(iv) for iv in sq.host_intervals]
+        ms = (st.batch.col(st.sft.dtg_field).millis
+              if intervals else None)
+        boxes = [tuple(b) for b in sq.host_boxes]
+        keep = ZKeyIndex._eval_sorted(col.x, col.y, ms, rows, boxes,
+                                      intervals)
         return np.sort(rows[keep])
 
     def _scan_dense(self, st: _MeshTypeState, sq: zscan.ScanQuery,
@@ -281,9 +279,11 @@ class DistributedDataStore(InMemoryDataStore):
         st = self._state(type_name)
         if st.n == 0:
             return np.empty(0, dtype=object)
+        if st.sft.geom_field is None:
+            raise ValueError("knn requires a geometry field")
         st.ensure_index()
         if not st.segments:
-            # extent / geometry-less types: exact centroid ranking
+            # extent types: exact centroid ranking on host
             x, y, valid = _geom_centroids(st.batch, st.sft.geom_field)
             d2 = np.where(valid, (x - qx) ** 2 + (y - qy) ** 2, np.inf)
             return st.batch.ids[np.argsort(d2, kind="stable")[:k]]

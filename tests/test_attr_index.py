@@ -174,3 +174,72 @@ class TestIndexMaintenance:
             parse_ecql("s > 'c' AND s <= 'e'"), "s")
         rows = idx.candidates(bounds)
         assert rows.tolist() == [1]
+
+
+class TestSecondaryDateTier:
+    """(value, date) composite keys: equality scans narrow with the
+    filter's date bounds (AttributeIndex.scala:40,124-158 analog)."""
+
+    def test_unit_equality_narrowing(self):
+        sft = parse_spec("u", "tag:String,when:Date,*geom:Point:srid=4326")
+        n = 1000
+        rng = np.random.default_rng(3)
+        tags = np.array(["a", "b", "c"], object)[rng.integers(0, 3, n)]
+        millis = rng.integers(0, 10_000, n).astype(np.int64)
+        batch = FeatureBatch.from_dict(sft, [str(i) for i in range(n)], {
+            "tag": tags.tolist(), "when": millis,
+            "geom": (np.zeros(n), np.zeros(n))})
+        idx = AttributeKeyIndex(batch.col("tag"),
+                                date_millis=batch.col("when").millis)
+        bounds = extract_attribute_bounds(parse_ecql("tag = 'b'"), "tag")
+        rows = idx.candidates(bounds, intervals_ms=[(2000, 4000)])
+        want = np.flatnonzero((tags == "b") & (millis >= 2000)
+                              & (millis <= 4000))
+        assert np.array_equal(rows, want)
+        # range bounds keep the full slice (date order only holds
+        # within one value)
+        rb = extract_attribute_bounds(parse_ecql("tag >= 'b'"), "tag")
+        rows2 = idx.candidates(rb, intervals_ms=[(2000, 4000)])
+        assert np.array_equal(rows2,
+                              np.sort(np.flatnonzero(tags >= "b")))
+        # IN-list bounds are per-value equalities: each narrows
+        il = extract_attribute_bounds(parse_ecql("tag IN ('a','c')"), "tag")
+        rows3 = idx.candidates(il, intervals_ms=[(0, 100)])
+        want3 = np.flatnonzero((tags != "b") & (millis <= 100))
+        assert np.array_equal(rows3, want3)
+
+    def test_store_equality_scan_is_date_narrowed(self, store):
+        import re
+        from geomesa_tpu.index.api import QueryHints
+        ecql = ("name = 'tag042' AND "
+                "when DURING 2020-03-01T00:00:00Z/2020-03-08T00:00:00Z")
+        lines = []
+        q = Query("recs", ecql,
+                  hints={QueryHints.QUERY_INDEX: "attr:name"})
+        res = store.query(q, explain_out=lines.append)
+        want = store.query(Query("recs", ecql,
+                                 hints={QueryHints.QUERY_INDEX: "z3"}))
+        assert set(res.ids.astype(str)) == set(want.ids.astype(str))
+        ln = next(l for l in lines if "Attribute index scan" in l)
+        assert "date-narrowed" in ln
+        m = int(re.search(r"(\d+) candidate", ln).group(1))
+        # candidates == exactly the (value AND date-range) rows: the
+        # composite range scan does not touch the rest of the value run
+        assert m == res.n
+        all_value_rows = store.query(
+            Query("recs", "name = 'tag042'",
+                  hints={QueryHints.QUERY_INDEX: "attr:name"})).n
+        assert m < all_value_rows
+
+    def test_cost_model_sees_narrowing(self, store):
+        from geomesa_tpu.index.planner import decide_strategy
+        st = store._state("recs")
+        stats = store.stats.get("recs")
+        narrow = decide_strategy(
+            st.sft,
+            Query("recs", "name = 'tag042' AND when DURING "
+                  "2020-03-01T00:00:00Z/2020-03-08T00:00:00Z"),
+            ["attr:name"], st.n, stats=stats)
+        wide = decide_strategy(st.sft, Query("recs", "name = 'tag042'"),
+                               ["attr:name"], st.n, stats=stats)
+        assert narrow.cost < wide.cost
