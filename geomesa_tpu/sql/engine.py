@@ -89,6 +89,78 @@ def _qualifier_of(f: ast.Filter) -> set[str]:
     return out
 
 
+def _null_truth(f: ast.Filter):
+    """Three-valued truth of a filter over an all-NULL row (SQL
+    semantics for a LEFT join's NULL-extended side): True / False /
+    None (UNKNOWN — excluded by WHERE)."""
+    if isinstance(f, ast.IsNull):
+        return True
+    if isinstance(f, ast.Include):
+        return True
+    if isinstance(f, ast.Exclude):
+        return False
+    if isinstance(f, ast.Not):
+        v = _null_truth(f.child)
+        return None if v is None else not v
+    if isinstance(f, ast.And):
+        vals = [_null_truth(c) for c in f.children]
+        if any(v is False for v in vals):
+            return False
+        return None if any(v is None for v in vals) else True
+    if isinstance(f, ast.Or):
+        vals = [_null_truth(c) for c in f.children]
+        if any(v is True for v in vals):
+            return True
+        return None if any(v is None for v in vals) else False
+    return None  # comparisons / LIKE / IN / spatial on NULL: UNKNOWN
+
+
+def _factorize(col) -> tuple[np.ndarray, None]:
+    """Column -> non-negative int64 dictionary codes; nulls form their
+    own group (SQL GROUP BY collates NULLs together). Code 0 is the
+    null group."""
+    from ..features.batch import (BoolColumn, DateColumn, NumericColumn,
+                                  StringColumn)
+    valid = np.asarray(col.valid)
+    n = len(valid)
+    if isinstance(col, StringColumn):
+        return col.codes.astype(np.int64) + 1, None  # -1 nulls -> 0
+    if isinstance(col, BoolColumn):
+        codes = np.where(valid, col.values.astype(np.int64) + 1, 0)
+        return codes, None
+    vals = getattr(col, "values", None)
+    if vals is None:
+        vals = getattr(col, "millis", None)
+    if vals is None:
+        raise ValueError(f"cannot GROUP BY column {col.name!r}")
+    vals = np.asarray(vals)
+    codes = np.zeros(n, dtype=np.int64)
+    if valid.any():
+        _, inv = np.unique(vals[valid], return_inverse=True)
+        codes[valid] = inv.astype(np.int64) + 1
+    return codes, None
+
+
+def _order_limit(out: SqlResult, order: str | None, desc: bool,
+                 limit: int | None) -> SqlResult:
+    """Post-aggregation ORDER BY / LIMIT over result columns (grouped
+    queries sort their OUTPUT, not the source rows)."""
+    if order is not None:
+        if order not in out.columns:
+            raise ValueError(f"ORDER BY column {order!r} is not in the "
+                             f"select list")
+        vals = out.columns[order]
+        idx = sorted(range(out.n),
+                     key=lambda i: (vals[i] is None, vals[i]),
+                     reverse=desc)
+        out = SqlResult(out.names, {k: v[idx]
+                                    for k, v in out.columns.items()})
+    if limit is not None and out.n > limit:
+        out = SqlResult(out.names, {k: v[:limit]
+                                    for k, v in out.columns.items()})
+    return out
+
+
 def _centroids(batch: FeatureBatch, geom_field: str):
     col = batch.col(geom_field)
     if isinstance(col, PointColumn):
@@ -105,7 +177,9 @@ class SqlEngine:
 
     def query(self, text: str) -> SqlResult:
         sel = parse_sql(text)
-        if sel.join is not None:
+        if sel.joins:
+            if sel.group_by is not None:
+                raise ValueError("GROUP BY over joins is not supported")
             return self._join_query(sel)
         return self._single_table(sel)
 
@@ -116,12 +190,28 @@ class SqlEngine:
                  if sel.where is not None else ast.Include())
         aggs = [i for i in sel.items if i.agg]
         plain = [i for i in sel.items if not i.agg]
-        if aggs and plain:
-            raise ValueError("cannot mix aggregates and plain columns "
-                             "(no GROUP BY support)")
         order = sel.order_by
         if order and "." in order:
             order = order.split(".", 1)[1]
+        if sel.group_by is not None:
+            keys = [k.split(".", 1)[1] if "." in k else k
+                    for k in sel.group_by]
+            for it in plain:
+                name = it.expr.split(".")[-1]
+                if name not in keys:
+                    raise ValueError(f"column {it.expr!r} must appear in "
+                                     f"GROUP BY or an aggregate")
+            res = self.store.query(Query(sel.table, where))
+            out = self._grouped(sel.items, keys, res.batch)
+            # output names may keep the qualifier ('g.name'): accept
+            # the raw ORDER BY target when the stripped one is absent
+            if sel.order_by is not None and order not in out.columns \
+                    and sel.order_by in out.columns:
+                order = sel.order_by
+            return _order_limit(out, order, sel.order_desc, sel.limit)
+        if aggs and plain:
+            raise ValueError("cannot mix aggregates and plain columns "
+                             "without GROUP BY")
         q = Query(sel.table, where,
                   sort_by=None if aggs else order,
                   sort_desc=sel.order_desc,
@@ -130,6 +220,72 @@ class SqlEngine:
         if aggs:
             return self._aggregate(aggs, res.batch, res.n)
         return self._project(plain, res.batch, res.ids, sel.alias)
+
+    def _grouped(self, items: list[SelectItem], keys: list[str],
+                 batch) -> SqlResult:
+        """Grouped aggregation (GeoMesaSparkSQL.scala:212 grouped
+        relations): factorize the key columns into dictionary codes,
+        combine into one group id, and run vectorized segment reduces
+        (bincount / min.at / max.at) per aggregate — the columnar
+        analog of a per-group shuffle."""
+        names = [it.name for it in items]
+        if batch is None or batch.n == 0:
+            return SqlResult(names, {n: np.empty(0, object)
+                                     for n in names})
+        n = batch.n
+        gid = np.zeros(n, dtype=np.int64)
+        for k in keys:
+            codes, _ = _factorize(batch.col(k))
+            gid = gid * (int(codes.max()) + 1) + codes
+            # re-compact so multi-key composites never overflow int64
+            _, gid = np.unique(gid, return_inverse=True)
+        uniq, rep, ginv = np.unique(gid, return_index=True,
+                                    return_inverse=True)
+        ng = len(uniq)
+        cols: dict[str, np.ndarray] = {}
+        for it in items:
+            if not it.agg:
+                key = it.expr.split(".")[-1]
+                col = batch.col(key)
+                cols[it.name] = np.array([col.value(int(i)) for i in rep],
+                                         dtype=object)
+                continue
+            if it.agg == "count" and it.expr == "*":
+                cols[it.name] = np.bincount(ginv, minlength=ng) \
+                    .astype(np.int64)
+                continue
+            col = batch.col(it.expr.split(".")[-1])
+            valid = np.asarray(col.valid)
+            if it.agg == "count":
+                cols[it.name] = np.bincount(
+                    ginv, weights=valid.astype(np.float64),
+                    minlength=ng).astype(np.int64)
+                continue
+            vals = getattr(col, "values", None)
+            if vals is None:
+                vals = getattr(col, "millis", None)
+            if vals is None:
+                raise ValueError(f"cannot aggregate column {it.expr}")
+            vals = np.asarray(vals, np.float64)
+            nvalid = np.bincount(ginv, weights=valid.astype(np.float64),
+                                 minlength=ng)
+            if it.agg in ("sum", "avg"):
+                s = np.bincount(ginv, weights=np.where(valid, vals, 0.0),
+                                minlength=ng)
+                out = s if it.agg == "sum" else \
+                    np.divide(s, nvalid, out=np.zeros(ng),
+                              where=nvalid > 0)
+            else:
+                fill = np.inf if it.agg == "min" else -np.inf
+                out = np.full(ng, fill)
+                op = np.minimum if it.agg == "min" else np.maximum
+                op.at(out, ginv[valid], vals[valid])
+            # SQL semantics: a group with no non-null values yields NULL
+            res = np.empty(ng, dtype=object)
+            for g in range(ng):
+                res[g] = None if nvalid[g] == 0 else out[g]
+            cols[it.name] = res
+        return SqlResult(names, cols)
 
     def _aggregate(self, items: list[SelectItem], batch, n: int) -> SqlResult:
         names, cols = [], {}
@@ -192,50 +348,112 @@ class SqlEngine:
     # -- joins -------------------------------------------------------------
 
     def _join_query(self, sel: SqlSelect) -> SqlResult:
-        join = sel.join
-        left_alias, right_alias = sel.alias, join.alias
-        # push single-side WHERE conjuncts below the join
-        left_f: list[ast.Filter] = []
-        right_f: list[ast.Filter] = []
+        """Chained spatial joins (GeoMesaJoinRelation.buildScan analog,
+        SQLRules.scala:270-360): each JOIN anchors to one preceding
+        alias, runs a device join kernel, and expands the result rows;
+        LEFT joins NULL-extend unmatched anchor rows. Single-side WHERE
+        conjuncts push below the join — except conjuncts on a LEFT
+        join's right side, which SQL applies AFTER NULL extension, so
+        they evaluate post-join under three-valued logic."""
+        aliases = [sel.alias] + [j.alias for j in sel.joins]
+        if len(set(aliases)) != len(aliases):
+            raise ValueError("duplicate table aliases in join")
+        tables = {sel.alias: sel.table}
+        for j in sel.joins:
+            tables[j.alias] = j.table
+        outer_aliases = {j.alias for j in sel.joins if j.outer}
+
+        side_f: dict[str, list[ast.Filter]] = {a: [] for a in aliases}
+        deferred: list[tuple[str, ast.Filter]] = []
         if sel.where is not None:
             conjuncts = (list(sel.where.children)
                          if isinstance(sel.where, ast.And) else [sel.where])
             for c in conjuncts:
                 quals = _qualifier_of(c)
-                if quals <= {left_alias}:
-                    left_f.append(_strip_qualifier(c, left_alias))
-                elif quals <= {right_alias}:
-                    right_f.append(_strip_qualifier(c, right_alias))
+                if len(quals) != 1 or "" in quals:
+                    raise ValueError("WHERE conjuncts must reference "
+                                     "exactly one aliased table")
+                a = next(iter(quals))
+                if a not in side_f:
+                    raise ValueError(f"unknown table qualifier {a!r} "
+                                     f"(tables: {aliases})")
+                if a in outer_aliases:
+                    deferred.append((a, _strip_qualifier(c, a)))
                 else:
-                    raise ValueError(
-                        "WHERE conjuncts must reference one side only")
+                    side_f[a].append(_strip_qualifier(c, a))
 
-        def side(table, fs):
+        results = {}
+        for a in aliases:
+            fs = side_f[a]
             f = (ast.And(fs) if len(fs) > 1 else fs[0]) if fs \
                 else ast.Include()
-            return self.store.query(Query(table, f))
+            results[a] = self.store.query(Query(tables[a], f))
 
-        lres = side(sel.table, left_f)
-        rres = side(join.table, right_f)
-        if lres.batch is None or rres.batch is None \
-                or lres.n == 0 or rres.n == 0:
-            pairs = np.empty((0, 2), dtype=np.int64)
-        else:
-            pairs = self._join_pairs(sel, join, lres, rres)
-        return self._project_join(sel, lres, rres, pairs,
-                                  left_alias, right_alias)
+        rows: dict[str, np.ndarray] = {
+            sel.alias: np.arange(results[sel.alias].n, dtype=np.int64)}
+        for j in sel.joins:
+            rows = self._apply_join(j, results, rows)
+        for a, f in deferred:
+            keep = self._post_join_mask(f, results[a], rows[a])
+            rows = {k: v[keep] for k, v in rows.items()}
+        return self._project_join(sel, results, rows)
 
-    def _join_pairs(self, sel: SqlSelect, join: SqlJoin, lres, rres):
-        """Pairs (left_row, right_row) from the device join kernels."""
-        from ..analytics.join import contains_join, dwithin_join
+    def _apply_join(self, join: SqlJoin, results,
+                    rows: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        """Expand the current result rows by one join: match the new
+        table against its anchor alias, repeat matched rows, and (for
+        LEFT joins) keep unmatched anchor rows with a -1 (NULL) index."""
         a_alias, a_col = join.left_prop.split(".", 1)   # first ON arg
         b_alias, b_col = join.right_prop.split(".", 1)  # second ON arg
-        sides = {sel.alias: lres, join.alias: rres}
-        if a_alias not in sides or b_alias not in sides:
-            raise ValueError("ON predicate must reference both tables")
-        a_res, b_res = sides[a_alias], sides[b_alias]
-        a_is_left = a_alias == sel.alias
+        new = join.alias
+        if a_alias == new and b_alias in rows:
+            anchor = b_alias
+            flip = True    # pairs arrive (new, anchor)
+        elif b_alias == new and a_alias in rows:
+            anchor = a_alias
+            flip = False   # pairs arrive (anchor, new)
+        else:
+            raise ValueError(
+                f"ON must reference {new!r} and one preceding table")
+        if a_alias not in results or b_alias not in results:
+            raise ValueError("ON predicate must reference joined tables")
+        pairs = self._join_pairs(join, results[a_alias], a_col,
+                                 results[b_alias], b_col)
+        if flip and len(pairs):
+            pairs = pairs[:, ::-1]
 
+        from ..index.zkeys import multi_arange
+        order = np.argsort(pairs[:, 0], kind="stable") if len(pairs) \
+            else np.empty(0, np.int64)
+        pa = pairs[order, 0] if len(pairs) else np.empty(0, np.int64)
+        pb = pairs[order, 1] if len(pairs) else np.empty(0, np.int64)
+        a_idx = rows[anchor]
+        starts = np.searchsorted(pa, a_idx, side="left")
+        ends = np.searchsorted(pa, a_idx, side="right")
+        cnt = ends - starts
+        cnt[a_idx < 0] = 0  # NULL-extended anchors match nothing
+        out_cnt = np.maximum(cnt, 1) if join.outer else cnt
+        rep = np.repeat(np.arange(len(a_idx), dtype=np.int64), out_cnt)
+        total = int(out_cnt.sum())
+        new_idx = np.full(total, -1, dtype=np.int64)
+        off = np.cumsum(out_cnt) - out_cnt
+        has = cnt > 0
+        if has.any():
+            dest = multi_arange(off[has], off[has] + cnt[has])
+            src = multi_arange(starts[has], ends[has])
+            new_idx[dest] = pb[src]
+        out = {k: v[rep] for k, v in rows.items()}
+        out[new] = new_idx
+        return out
+
+    def _join_pairs(self, join: SqlJoin, a_res, a_col: str,
+                    b_res, b_col: str) -> np.ndarray:
+        """(a_row, b_row) match pairs in ON-argument order, from the
+        tiled device join kernels (analytics/join.py)."""
+        if (a_res.n == 0 or b_res.n == 0
+                or a_res.batch is None or b_res.batch is None):
+            return np.empty((0, 2), dtype=np.int64)
+        from ..analytics.join import contains_join, dwithin_join
         if join.kind == "dwithin":
             ax, ay = _centroids(a_res.batch, a_col)
             bx, by = _centroids(b_res.batch, b_col)
@@ -252,20 +470,54 @@ class SqlEngine:
             # contains_join pairs are (point_idx, poly_idx) = (b, a)
             if len(pairs):
                 pairs = pairs[:, ::-1]
-        if not a_is_left and len(pairs):
-            pairs = pairs[:, ::-1]
+        if not len(pairs):
+            return np.empty((0, 2), dtype=np.int64)
         return pairs
 
-    def _project_join(self, sel: SqlSelect, lres, rres, pairs,
-                      la: str, ra: str) -> SqlResult:
-        li = pairs[:, 0] if len(pairs) else np.empty(0, np.int64)
-        ri = pairs[:, 1] if len(pairs) else np.empty(0, np.int64)
+    def _post_join_mask(self, f: ast.Filter, res,
+                        idx: np.ndarray) -> np.ndarray:
+        """WHERE conjunct on a LEFT join's right side, applied after
+        NULL extension: matched rows evaluate normally, NULL-extended
+        rows take the conjunct's three-valued truth on an all-NULL row
+        (only IS NULL-style predicates survive)."""
+        from ..filters.evaluate import evaluate
+        keep = np.zeros(len(idx), dtype=bool)
+        matched = idx >= 0
+        if matched.any() and res.batch is not None:
+            row_ok = np.asarray(evaluate(f, res.batch), dtype=bool)
+            keep[matched] = row_ok[idx[matched]]
+        keep[~matched] = _null_truth(f) is True
+        return keep
+
+    def _project_join(self, sel: SqlSelect, results,
+                      rows: dict[str, np.ndarray]) -> SqlResult:
         aggs = [i for i in sel.items if i.agg]
+        nrows = len(next(iter(rows.values()))) if rows else 0
         if aggs:
             if any(i.agg != "count" for i in aggs):
                 raise ValueError("join aggregates support COUNT only")
-            return SqlResult([aggs[0].name],
-                             {aggs[0].name: np.array([len(pairs)])})
+            cols = {}
+            for it in aggs:
+                if it.expr == "*":
+                    cols[it.name] = np.array([nrows])
+                    continue
+                # COUNT(col) skips NULLs — including LEFT-join
+                # NULL-extended rows
+                if "." not in it.expr:
+                    raise ValueError(
+                        f"join columns must be qualified: {it.expr}")
+                q, col = it.expr.split(".", 1)
+                if q not in rows:
+                    raise ValueError(f"unknown table qualifier {q!r}")
+                idx = rows[q]
+                m = idx >= 0
+                if col in ("__fid__", "id"):
+                    cols[it.name] = np.array([int(m.sum())])
+                else:
+                    valid = np.asarray(results[q].batch.col(col).valid)
+                    cols[it.name] = np.array(
+                        [int(valid[idx[m]].sum())])
+            return SqlResult([it.name for it in aggs], cols)
         names, cols = [], {}
 
         def add(name, arr):
@@ -275,26 +527,23 @@ class SqlEngine:
         star = any(i.expr == "*" for i in sel.items)
         items = sel.items
         if star:
-            items = [SelectItem(f"{la}.__fid__"), SelectItem(f"{ra}.__fid__")]
+            items = [SelectItem(f"{a}.__fid__") for a in rows]
         for it in items:
             if "." not in it.expr:
                 raise ValueError(f"join columns must be qualified: {it.expr}")
             q, col = it.expr.split(".", 1)
-            if q == la:
-                res, idx = lres, li
-            elif q == ra:
-                res, idx = rres, ri
-            else:
+            if q not in rows:
                 raise ValueError(f"unknown table qualifier {q!r} "
-                                 f"(tables: {la!r}, {ra!r})")
+                                 f"(tables: {list(rows)})")
+            res, idx = results[q], rows[q]
+            out = np.empty(len(idx), dtype=object)
+            m = idx >= 0
             if col in ("__fid__", "id"):
-                add(it.name if it.alias else it.expr, res.ids[idx])
+                out[m] = res.ids[idx[m]]
             else:
                 c = res.batch.col(col)
-                add(it.name if it.alias else it.expr,
-                    np.array([c.value(int(i)) for i in idx], dtype=object))
-        out = SqlResult(names, cols)
-        if sel.limit is not None and out.n > sel.limit:
-            out = SqlResult(names, {k: v[:sel.limit]
-                                    for k, v in cols.items()})
-        return out
+                out[m] = [c.value(int(i)) for i in idx[m]]
+            add(it.name if it.alias else it.expr, out)
+        result = SqlResult(names, cols)
+        order = sel.order_by
+        return _order_limit(result, order, sel.order_desc, sel.limit)

@@ -64,6 +64,7 @@ class SqlJoin:
     distance: float | None    # for dwithin (degrees)
     left_prop: str            # qualified 'alias.col' (first ON arg)
     right_prop: str           # qualified 'alias.col' (second ON arg)
+    outer: bool = False       # LEFT [OUTER] JOIN
 
 
 @dataclasses.dataclass
@@ -71,11 +72,12 @@ class SqlSelect:
     items: list[SelectItem]
     table: str
     alias: str
-    join: SqlJoin | None
+    joins: list[SqlJoin]
     where: ast.Filter | None  # props qualified when a join is present
     order_by: str | None
     order_desc: bool
     limit: int | None
+    group_by: list[str] | None = None
 
 
 _TOKEN_RE = re.compile(r"""
@@ -159,9 +161,10 @@ def _num(v: str) -> float:
         else f
 
 
-_RESERVED = {"FROM", "JOIN", "ON", "WHERE", "ORDER", "LIMIT", "AND", "OR",
-             "NOT", "AS", "BY", "ASC", "DESC", "BETWEEN", "IN", "LIKE",
-             "ILIKE", "IS", "NULL", "TRUE", "FALSE", "INNER"}
+_RESERVED = {"FROM", "JOIN", "ON", "WHERE", "ORDER", "GROUP", "LIMIT",
+             "AND", "OR", "NOT", "AS", "BY", "ASC", "DESC", "BETWEEN",
+             "IN", "LIKE", "ILIKE", "IS", "NULL", "TRUE", "FALSE",
+             "INNER", "LEFT", "OUTER"}
 
 
 class _Parser:
@@ -175,14 +178,29 @@ class _Parser:
         items = self._items()
         self.t.expect("word", "FROM")
         table, alias = self._table_ref()
-        join = None
-        if self.t.take_word("INNER"):
-            pass
-        if self.t.take_word("JOIN"):
-            join = self._join()
+        joins = []
+        while True:
+            if self.t.take_word("LEFT"):
+                self.t.take_word("OUTER")
+                self.t.expect("word", "JOIN")
+                joins.append(self._join(outer=True))
+            elif self.t.take_word("INNER"):
+                self.t.expect("word", "JOIN")
+                joins.append(self._join())
+            elif self.t.take_word("JOIN"):
+                joins.append(self._join())
+            else:
+                break
         where = None
         if self.t.take_word("WHERE"):
             where = self._expr()
+        group_by = None
+        if self.t.take_word("GROUP"):
+            self.t.expect("word", "BY")
+            group_by = [self._name()]
+            while self.t.peek()[0] == "comma":
+                self.t.next()
+                group_by.append(self._name())
         order_by, desc = None, False
         if self.t.take_word("ORDER"):
             self.t.expect("word", "BY")
@@ -197,8 +215,8 @@ class _Parser:
         k, v = self.t.peek()
         if k is not None:
             raise SqlError(f"unexpected trailing input: {v!r}")
-        return SqlSelect(items, table, alias, join, where,
-                         order_by, desc, limit)
+        return SqlSelect(items, table, alias, joins, where,
+                         order_by, desc, limit, group_by)
 
     def _table_ref(self) -> tuple[str, str]:
         name = self._name()
@@ -210,7 +228,7 @@ class _Parser:
             alias = self._name()
         return name, alias
 
-    def _join(self) -> SqlJoin:
+    def _join(self, outer: bool = False) -> SqlJoin:
         table, alias = self._table_ref()
         self.t.expect("word", "ON")
         fn = self._name().upper()
@@ -233,7 +251,7 @@ class _Parser:
         else:
             raise SqlError(f"unsupported join predicate {fn}")
         self.t.expect("rparen")
-        return SqlJoin(table, alias, kind, distance, a, b)
+        return SqlJoin(table, alias, kind, distance, a, b, outer)
 
     def _items(self) -> list[SelectItem]:
         items = [self._item()]

@@ -211,3 +211,261 @@ class TestParserErrors:
     def test_trailing_garbage(self):
         with pytest.raises(SqlError):
             parse_sql("SELECT * FROM t WHERE a = 1 GARBAGE MORE")
+
+
+class TestGroupBy:
+    """Grouped aggregation vs brute-force oracles
+    (GeoMesaSparkSQL.scala:212 grouped relations)."""
+
+    def _oracle(self, store, key_fn, val_fn=None):
+        st = store._state("gdelt")
+        batch = st.batch
+        groups = {}
+        for i in range(batch.n):
+            groups.setdefault(key_fn(batch, i), []).append(
+                None if val_fn is None else val_fn(batch, i))
+        return groups
+
+    def test_count_by_name(self, store, engine):
+        res = engine.query(
+            "SELECT name, COUNT(*) AS n FROM gdelt GROUP BY name")
+        want = self._oracle(store,
+                            lambda b, i: b.col("name").value(i))
+        got = dict(zip(res.column("name"), res.column("n")))
+        assert {k: len(v) for k, v in want.items()} == \
+            {k: int(v) for k, v in got.items()}
+
+    def test_sum_avg_min_max(self, store, engine):
+        res = engine.query(
+            "SELECT name, SUM(val) AS s, AVG(val) AS a, MIN(val) AS lo, "
+            "MAX(val) AS hi FROM gdelt GROUP BY name")
+        want = self._oracle(store, lambda b, i: b.col("name").value(i),
+                            lambda b, i: b.col("val").value(i))
+        by_name = {res.column("name")[i]: i for i in range(res.n)}
+        for k, vals in want.items():
+            i = by_name[k]
+            assert int(res.column("s")[i]) == sum(vals)
+            assert abs(float(res.column("a")[i])
+                       - sum(vals) / len(vals)) < 1e-9
+            assert int(res.column("lo")[i]) == min(vals)
+            assert int(res.column("hi")[i]) == max(vals)
+
+    def test_group_by_with_where_and_order(self, store, engine):
+        res = engine.query(
+            "SELECT name, COUNT(*) AS n FROM gdelt "
+            "WHERE ST_Contains(ST_MakeBBOX(-30, -20, 40, 35), geom) "
+            "GROUP BY name ORDER BY n DESC LIMIT 5")
+        ecql = store.query("BBOX(geom, -30, -20, 40, 35)", "gdelt")
+        names = [ecql.batch.col("name").value(i)
+                 for i in range(ecql.batch.n)]
+        import collections
+        top = collections.Counter(names).most_common()
+        assert res.n == 5
+        got = list(zip(res.column("name"), [int(v) for v in
+                                            res.column("n")]))
+        # counts must match the oracle's (ties may reorder names)
+        assert [c for _, c in got] == [c for _, c in top[:5]]
+        for name, c in got:
+            assert dict(top)[name] == c
+
+    def test_multi_key_group(self, store, engine):
+        res = engine.query(
+            "SELECT name, val, COUNT(*) AS n FROM gdelt "
+            "WHERE val < 3 GROUP BY name, val")
+        st = store._state("gdelt")
+        b = st.batch
+        import collections
+        want = collections.Counter(
+            (b.col("name").value(i), b.col("val").value(i))
+            for i in range(b.n) if b.col("val").value(i) < 3)
+        got = {(res.column("name")[i], int(res.column("val")[i])):
+               int(res.column("n")[i]) for i in range(res.n)}
+        assert got == {k: v for k, v in want.items()}
+
+    def test_plain_column_must_be_grouped(self, engine):
+        with pytest.raises(ValueError):
+            engine.query("SELECT val, COUNT(*) FROM gdelt GROUP BY name")
+
+    def test_rest_sql_group_by(self, store):
+        import json
+        import urllib.request
+        from geomesa_tpu.web import GeoMesaWebServer
+        srv = GeoMesaWebServer(store).start()
+        try:
+            url = (f"http://127.0.0.1:{srv.port}/rest/sql?q="
+                   "SELECT%20name,%20COUNT(*)%20AS%20n%20FROM%20gdelt"
+                   "%20GROUP%20BY%20name%20ORDER%20BY%20n%20DESC"
+                   "%20LIMIT%203")
+            with urllib.request.urlopen(url) as r:
+                assert r.status == 200
+                body = json.loads(r.read())
+        finally:
+            srv.stop()
+        assert len(body["rows"]) == 3
+        assert body["columns"] == ["name", "n"]
+
+
+class TestJoinDepth:
+    """LEFT joins, chained joins, pushdown matrix vs brute force
+    (GeoMesaSparkSQL.scala:312-360)."""
+
+    def _zone_of(self, store):
+        """point row -> set of zone rows containing it (brute force)."""
+        gd = store._state("gdelt").batch
+        zn = store._state("zones").batch
+        gx = gd.col("geom").x
+        gy = gd.col("geom").y
+        out = {}
+        for zi in range(zn.n):
+            poly = zn.col("area").geoms[zi]
+            hit = poly.contains_points(gx, gy)
+            for pi in np.flatnonzero(hit):
+                out.setdefault(int(pi), set()).add(zi)
+        return out
+
+    def test_left_join_null_extends(self, store, engine):
+        res = engine.query(
+            "SELECT g.__fid__ AS fid, z.zid AS zid FROM gdelt g "
+            "LEFT JOIN zones z ON ST_Contains(z.area, g.geom) "
+            "ORDER BY fid")
+        zmap = self._zone_of(store)
+        gd = store._state("gdelt").batch
+        zn = store._state("zones").batch
+        want = []
+        for pi in range(gd.n):
+            zs = zmap.get(pi)
+            if zs is None:
+                want.append((str(gd.ids[pi]), None))
+            else:
+                for zi in sorted(zs):
+                    want.append((str(gd.ids[pi]),
+                                 zn.col("zid").value(zi)))
+        got = sorted(zip(res.column("fid").astype(str),
+                         [None if v is None else int(v)
+                          for v in res.column("zid")]),
+                     key=lambda p: (p[0], p[1] is None,
+                                    -1 if p[1] is None else p[1]))
+        want = sorted([(f, None if z is None else int(z))
+                       for f, z in want],
+                      key=lambda p: (p[0], p[1] is None,
+                                     -1 if p[1] is None else p[1]))
+        assert got == want
+
+    def test_left_join_where_right_is_null(self, store, engine):
+        # IS NULL on the right side keeps exactly the unmatched rows
+        res = engine.query(
+            "SELECT g.__fid__ AS fid FROM gdelt g "
+            "LEFT JOIN zones z ON ST_Contains(z.area, g.geom) "
+            "WHERE z.zid IS NULL")
+        zmap = self._zone_of(store)
+        gd = store._state("gdelt").batch
+        want = {str(gd.ids[pi]) for pi in range(gd.n) if pi not in zmap}
+        assert set(res.column("fid").astype(str)) == want
+
+    def test_left_join_where_right_filter(self, store, engine):
+        # non-IS-NULL right filter after a LEFT join behaves like SQL:
+        # NULL-extended rows fail the predicate and drop out
+        res = engine.query(
+            "SELECT g.__fid__ AS fid, z.zid AS zid FROM gdelt g "
+            "LEFT JOIN zones z ON ST_Contains(z.area, g.geom) "
+            "WHERE z.zid < 4")
+        zmap = self._zone_of(store)
+        gd = store._state("gdelt").batch
+        zn = store._state("zones").batch
+        want = set()
+        for pi, zs in zmap.items():
+            for zi in zs:
+                if zn.col("zid").value(zi) < 4:
+                    want.add((str(gd.ids[pi]), zn.col("zid").value(zi)))
+        got = {(f, int(z)) for f, z in zip(res.column("fid").astype(str),
+                                           res.column("zid"))}
+        assert got == want
+
+    def test_chained_joins(self, store, engine):
+        # three-table chain: points in zones, zones near beacons
+        rng = np.random.default_rng(5)
+        if "beacons" not in store.get_type_names():
+            store.create_schema(parse_spec("beacons",
+                                           "bid:Integer,*loc:Point"))
+            store.write_dict("beacons", [f"b{i}" for i in range(40)], {
+                "bid": np.arange(40),
+                "loc": (rng.uniform(-150, 150, 40),
+                        rng.uniform(-70, 70, 40))})
+        res = engine.query(
+            "SELECT g.__fid__ AS fid, z.zid AS zid, b.bid AS bid "
+            "FROM gdelt g "
+            "JOIN zones z ON ST_Contains(z.area, g.geom) "
+            "JOIN beacons b ON ST_DWithin(z.area, b.loc, 10.0) "
+            "WHERE g.val < 50")
+        gd = store._state("gdelt").batch
+        zn = store._state("zones").batch
+        bc = store._state("beacons").batch
+        zmap = self._zone_of(store)
+        # zone centroid within 10 deg of beacon
+        zb = {}
+        bx, by = bc.col("loc").x, bc.col("loc").y
+        for zi in range(zn.n):
+            bb = zn.col("area").bounds[zi]
+            cx, cy = (bb[0] + bb[2]) / 2, (bb[1] + bb[3]) / 2
+            near = np.flatnonzero((bx - cx) ** 2 + (by - cy) ** 2
+                                  <= 100.0)
+            zb[zi] = set(int(i) for i in near)
+        vals = gd.col("val")
+        want = set()
+        for pi, zs in zmap.items():
+            if vals.value(pi) >= 50:
+                continue
+            for zi in zs:
+                for bi in zb[zi]:
+                    want.add((str(gd.ids[pi]), zi, bi))
+        got = {(f, int(z), int(b)) for f, z, b in
+               zip(res.column("fid").astype(str), res.column("zid"),
+                   res.column("bid"))}
+        assert got == want
+
+    def test_pushdown_asymmetric_where(self, store, engine):
+        # both sides filtered, inner join: pushdown must not change ids
+        res = engine.query(
+            "SELECT g.__fid__ AS fid, z.zid AS zid FROM gdelt g "
+            "JOIN zones z ON ST_Contains(z.area, g.geom) "
+            "WHERE g.val < 100 AND z.zid >= 6")
+        zmap = self._zone_of(store)
+        gd = store._state("gdelt").batch
+        zn = store._state("zones").batch
+        want = set()
+        for pi, zs in zmap.items():
+            if gd.col("val").value(pi) >= 100:
+                continue
+            for zi in zs:
+                if zn.col("zid").value(zi) >= 6:
+                    want.add((str(gd.ids[pi]), zn.col("zid").value(zi)))
+        got = {(f, int(z)) for f, z in zip(res.column("fid").astype(str),
+                                           res.column("zid"))}
+        assert got == want
+
+
+class TestJoinAggregates:
+    def test_left_join_count_col_skips_nulls(self, store, engine):
+        total = engine.query(
+            "SELECT COUNT(*) AS n FROM gdelt g "
+            "LEFT JOIN zones z ON ST_Contains(z.area, g.geom)")
+        matched = engine.query(
+            "SELECT COUNT(z.zid) AS n FROM gdelt g "
+            "LEFT JOIN zones z ON ST_Contains(z.area, g.geom)")
+        inner = engine.query(
+            "SELECT COUNT(*) AS n FROM gdelt g "
+            "JOIN zones z ON ST_Contains(z.area, g.geom)")
+        assert int(matched.column("n")[0]) == int(inner.column("n")[0])
+        assert int(total.column("n")[0]) > int(matched.column("n")[0])
+
+    def test_group_by_over_join_raises(self, engine):
+        with pytest.raises(ValueError):
+            engine.query("SELECT g.name, COUNT(*) FROM gdelt g "
+                         "JOIN zones z ON ST_Contains(z.area, g.geom) "
+                         "GROUP BY g.name")
+
+    def test_grouped_order_by_qualified_key(self, engine):
+        res = engine.query("SELECT g.name, COUNT(*) AS n FROM gdelt g "
+                           "GROUP BY g.name ORDER BY g.name LIMIT 4")
+        names = list(res.column("g.name"))
+        assert names == sorted(names) and len(names) == 4
