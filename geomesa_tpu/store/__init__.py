@@ -6,6 +6,8 @@ from .api import DataStore
 from .memory import InMemoryDataStore, QueryResult
 from .fs import FileSystemDataStore
 from .live import GeoMessage, LiveDataStore, MessageBus
+from .filebus import FileBus
+from .socketbus import SocketBroker, SocketBus
 from .lambda_store import LambdaDataStore
 from .mesh_store import DistributedDataStore
 from .stream import (FileTailSource, IterableSource, StreamDataStore,
@@ -17,6 +19,7 @@ __all__ = ["DataStore", "InMemoryDataStore", "QueryResult",
            "FileSystemDataStore",
            "DistributedDataStore",
            "GeoMessage", "LiveDataStore", "MessageBus", "LambdaDataStore",
+           "FileBus", "SocketBroker", "SocketBus",
            "StreamSource", "StreamDataStore", "FileTailSource",
            "IterableSource",
            "AttributeScheme", "CompositeScheme", "DateTimeScheme",

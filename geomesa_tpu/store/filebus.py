@@ -41,6 +41,33 @@ __all__ = ["FileBus"]
 _SEQ_DIGITS = 12
 
 
+def segment_name(seq: int) -> str:
+    """Canonical log-segment file name — shared with SocketBroker's
+    durable tier so the two transports read each other's logs."""
+    return f"{seq:0{_SEQ_DIGITS}d}.msg"
+
+
+def write_bytes_atomic(path: str, raw: bytes):
+    """Durable atomic write: tmp + fsync + rename (readers never see a
+    partial file). Tmp names are pid+thread-unique (the broker persists
+    from handler threads)."""
+    import threading
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "wb") as f:
+        f.write(raw)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_json_atomic(path: str, obj):
+    import threading
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
 def _encode(msg: GeoMessage) -> bytes:
     header: dict = {"kind": msg.kind, "type_name": msg.type_name,
                     "ids": list(msg.ids), "timestamp_ms": msg.timestamp_ms}
@@ -105,11 +132,7 @@ class FileBus:
             self._offsets = {}
 
     def _save_offsets(self):
-        path = self._offsets_path()
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(self._offsets, f)
-        os.replace(tmp, path)
+        write_json_atomic(self._offsets_path(), self._offsets)
 
     def offset(self, topic: str) -> int:
         return self._offsets.get(topic, 0)

@@ -1,0 +1,253 @@
+"""Network live tier: producers and consumers interoperate over TCP
+sockets (the KafkaDataStore network pub/sub contract), with
+consumer-group offsets held broker-side (ZookeeperOffsetManager role),
+long-poll wakeups, and a FileBus-layout durable log behind the broker."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.store import SocketBroker, SocketBus
+from geomesa_tpu.store.filebus import FileBus
+from geomesa_tpu.store.live import GeoMessage, LiveDataStore
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+
+def make_batch(ids, xs, ys):
+    sft = parse_spec("live", SPEC)
+    n = len(ids)
+    return FeatureBatch.from_dict(sft, ids, {
+        "name": [f"n{i}" for i in range(n)],
+        "dtg": np.full(n, MS("2024-01-01")),
+        "geom": (np.asarray(xs, float), np.asarray(ys, float)),
+    })
+
+
+@pytest.fixture
+def broker():
+    b = SocketBroker().start()
+    yield b
+    b.stop()
+
+
+class TestSocketBus:
+    def test_publish_poll_apply(self, broker):
+        producer = LiveDataStore(
+            bus=SocketBus(broker.host, broker.port, group="prod"))
+        producer.create_schema(parse_spec("live", SPEC))
+        cons_bus = SocketBus(broker.host, broker.port, group="cons")
+        consumer = LiveDataStore(bus=cons_bus)
+        consumer.create_schema(parse_spec("live", SPEC))
+        producer.write("live", make_batch(["a", "b"], [0, 1], [0, 1]))
+        assert consumer.count("live") == 0  # nothing until poll
+        assert consumer.poll() == 1
+        assert consumer.count("live") == 2
+        producer.delete("live", ["a"])
+        consumer.poll()
+        assert {str(i) for i in
+                consumer.query("INCLUDE", "live").ids} == {"b"}
+
+    def test_offsets_resume_across_reconnect(self, broker):
+        bus = SocketBus(broker.host, broker.port, group="g1")
+        store = LiveDataStore(bus=bus)
+        store.create_schema(parse_spec("live", SPEC))
+        store.write("live", make_batch(["a"], [0], [0]))
+        bus.poll()
+        assert bus.offset("live") == 1
+        # a NEW connection in the same group resumes past message 1
+        bus2 = SocketBus(broker.host, broker.port, group="g1")
+        assert bus2.offset("live") == 1
+        store2 = LiveDataStore(bus=bus2)
+        store2.create_schema(parse_spec("live", SPEC))
+        assert store2.poll() == 0
+        # a different group replays from the beginning
+        bus3 = SocketBus(broker.host, broker.port, group="g2")
+        store3 = LiveDataStore(bus=bus3)
+        store3.create_schema(parse_spec("live", SPEC))
+        assert store3.poll() == 1
+        assert store3.count("live") == 1
+
+    def test_consumer_auto_creates_schema(self, broker):
+        prod = LiveDataStore(
+            bus=SocketBus(broker.host, broker.port, group="p"))
+        prod.create_schema(parse_spec("live", SPEC))
+        prod.write("live", make_batch(["a"], [0], [0]))
+        cons_bus = SocketBus(broker.host, broker.port, group="c")
+        cons = LiveDataStore(bus=cons_bus)
+        # subscribe without create: schema arrives with the message
+        cons_bus.subscribe("live", cons._on_message)
+        cons_bus.poll()
+        assert cons.count("live") == 1
+        assert cons.get_schema("live").geom_field == "geom"
+
+    def test_long_poll_wakes_on_publish(self, broker):
+        cons_bus = SocketBus(broker.host, broker.port, group="lp")
+        got = []
+        cons_bus.subscribe("t", got.append)
+        result = {}
+
+        def consume():
+            t0 = time.monotonic()
+            n = cons_bus.poll(wait_s=10.0)
+            result["n"] = n
+            result["waited"] = time.monotonic() - t0
+
+        th = threading.Thread(target=consume)
+        th.start()
+        time.sleep(0.3)  # consumer is parked in the broker
+        pub = SocketBus(broker.host, broker.port, group="pub")
+        pub.publish("t", GeoMessage("clear", "t"))
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert result["n"] == 1 and len(got) == 1
+        # woke on publish, did not sleep out the full 10s window
+        assert result["waited"] < 5.0
+
+    def test_poll_max_messages_cap(self, broker):
+        bus = SocketBus(broker.host, broker.port, group="cap")
+        got = []
+        bus.subscribe("t1", got.append)
+        bus.subscribe("t2", got.append)
+        pub = SocketBus(broker.host, broker.port, group="w")
+        for t in ("t1", "t2"):
+            for _ in range(5):
+                pub.publish(t, GeoMessage("clear", t))
+        assert bus.poll(max_messages=3) == 3
+        assert len(got) == 3
+        assert bus.poll() == 7  # the rest
+
+
+class TestDurableLog:
+    def test_broker_restart_replays_filebus_layout(self, tmp_path):
+        root = str(tmp_path / "log")
+        b1 = SocketBroker(root=root).start()
+        try:
+            bus = SocketBus(b1.host, b1.port, group="g")
+            bus.publish("live", GeoMessage(
+                "create", "live", make_batch(["a"], [0], [0]),
+                timestamp_ms=1))
+            bus.publish("live", GeoMessage("delete", "live", ids=("x",)))
+        finally:
+            b1.stop()
+        # the durable log is FileBus-readable (same segment layout)
+        fb = FileBus(root, group="fbreader")
+        seen = []
+        fb.subscribe("live", seen.append)
+        assert fb.poll() == 2
+        assert [m.kind for m in seen] == ["create", "delete"]
+        # a restarted broker replays the log and keeps group offsets
+        b2 = SocketBroker(root=root).start()
+        try:
+            bus2 = SocketBus(b2.host, b2.port, group="g2")
+            store = LiveDataStore(bus=bus2)
+            store.create_schema(parse_spec("live", SPEC))
+            assert bus2.poll() == 2
+            assert store.count("live") == 1
+        finally:
+            b2.stop()
+
+
+_WRITER = r"""
+import sys
+import numpy as np
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.store.socketbus import SocketBus
+from geomesa_tpu.store.live import LiveDataStore
+
+host, port, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+store = LiveDataStore(bus=SocketBus(host, port, group="writer"))
+sft = parse_spec("live", "name:String,dtg:Date,*geom:Point:srid=4326")
+store.create_schema(sft)
+ms = int(np.datetime64("2024-01-01", "ms").astype(np.int64))
+for k in range(3):
+    ids = [f"w{k}-{i}" for i in range(n)]
+    store.write_dict("live", ids, {
+        "name": [f"x{i}" for i in range(n)],
+        "dtg": np.full(n, ms),
+        "geom": (np.linspace(0, 10, n), np.linspace(0, 10, n)),
+    })
+store.delete("live", ["w0-0"])
+print("WROTE")
+"""
+
+
+class TestCrossProcess:
+    def test_writer_subprocess_feeds_reader_over_tcp(self, broker):
+        reader = LiveDataStore(
+            bus=SocketBus(broker.host, broker.port, group="reader"))
+        reader.create_schema(parse_spec("live", SPEC))
+
+        env = dict(os.environ,
+                   PYTHONPATH=os.pathsep.join(
+                       [os.path.dirname(os.path.dirname(__file__))]
+                       + os.environ.get("PYTHONPATH", "").split(os.pathsep)),
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", _WRITER, broker.host,
+             str(broker.port), "5"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "WROTE" in proc.stdout
+
+        ok = reader.bus.wait_for(lambda: reader.count("live") == 14,
+                                 timeout_s=15)
+        assert ok, f"count={reader.count('live')}"
+        ids = {str(i) for i in reader.query("INCLUDE", "live").ids}
+        assert "w0-0" not in ids and "w2-4" in ids
+        res = reader.query("BBOX(geom, -1, -1, 5, 5)", "live")
+        assert res.n > 0
+
+
+class TestLongPollSharpEdges:
+    def test_wakes_on_publish_to_any_subscribed_topic(self, broker):
+        cons = SocketBus(broker.host, broker.port, group="multi")
+        got = []
+        cons.subscribe("t1", got.append)
+        cons.subscribe("t2", got.append)
+        result = {}
+
+        def consume():
+            t0 = time.monotonic()
+            result["n"] = cons.poll(wait_s=10.0)
+            result["waited"] = time.monotonic() - t0
+
+        th = threading.Thread(target=consume)
+        th.start()
+        time.sleep(0.3)
+        pub = SocketBus(broker.host, broker.port, group="p")
+        pub.publish("t2", GeoMessage("clear", "t2"))  # NOT the first topic
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert result["n"] == 1 and len(got) == 1
+        assert result["waited"] < 5.0
+
+    def test_same_bus_publish_does_not_block_behind_parked_poll(
+            self, broker):
+        bus = SocketBus(broker.host, broker.port, group="shared")
+        got = []
+        bus.subscribe("t", got.append)
+        result = {}
+
+        def consume():
+            t0 = time.monotonic()
+            result["n"] = bus.poll(wait_s=10.0)
+            result["waited"] = time.monotonic() - t0
+
+        th = threading.Thread(target=consume)
+        th.start()
+        time.sleep(0.3)
+        t0 = time.monotonic()
+        bus.publish("t", GeoMessage("clear", "t"))  # same SocketBus
+        publish_s = time.monotonic() - t0
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert publish_s < 2.0, "publish serialized behind parked poll"
+        assert result["n"] == 1 and result["waited"] < 5.0
