@@ -95,6 +95,44 @@ def max_date_millis(period: TimePeriod) -> int:
     return int(_epoch_ms(np.datetime64(n, "Y")))
 
 
+_native_binned = None  # None = unprobed, False = unavailable
+
+
+def _native_to_binned(millis: np.ndarray, period: TimePeriod):
+    """Fused native clamp+divide for DAY/WEEK (native/src/zbuild.cpp):
+    numpy int64 division scalar-loops, so the constant-divisor C++
+    multiply-shift is ~10x faster on big columns. None when the
+    library is absent or the period is calendar-based."""
+    global _native_binned
+    if _native_binned is False or period not in (TimePeriod.DAY,
+                                                 TimePeriod.WEEK):
+        return None
+    import ctypes
+    if _native_binned is None:
+        from ..native import symbols
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib = symbols({
+            "geomesa_binned": (ctypes.c_int64,
+                               [i64p, ctypes.c_int64, ctypes.c_int32,
+                                i32p, i64p]),
+        })
+        _native_binned = lib if lib is not None else False
+        if _native_binned is False:
+            return None
+    millis = np.ascontiguousarray(millis, dtype=np.int64)
+    n = len(millis)
+    bins = np.empty(n, dtype=np.int32)
+    offs = np.empty(n, dtype=np.int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    rc = _native_binned.geomesa_binned(
+        millis.ctypes.data_as(i64p), n,
+        0 if period is TimePeriod.DAY else 1,
+        bins.ctypes.data_as(i32p), offs.ctypes.data_as(i64p))
+    return None if rc != 0 else (bins, offs)
+
+
 def to_binned(millis, period: TimePeriod, lenient: bool = False):
     """Vectorized epoch-millis -> (bins:int32, offsets:int64).
 
@@ -103,6 +141,10 @@ def to_binned(millis, period: TimePeriod, lenient: bool = False):
     """
     period = TimePeriod.parse(period)
     millis = np.asarray(millis, dtype=np.int64)
+    if lenient and millis.ndim == 1 and len(millis) >= 4096:
+        out = _native_to_binned(millis, period)
+        if out is not None:
+            return out
     lo, hi = 0, max_date_millis(period)
     if lenient:
         millis = np.clip(millis, lo, hi - 1)

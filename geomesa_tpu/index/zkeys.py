@@ -139,6 +139,48 @@ def _native_sort_z(z: np.ndarray):
     return None if rc != 0 else (z_sorted, perm)
 
 
+_native_build = None  # None = unprobed, False = unavailable
+_PERIOD_CODE = {timebin.TimePeriod.DAY: 0, timebin.TimePeriod.WEEK: 1}
+
+
+def _native_encode_binned_z3(x, y, millis, period):
+    """(bins:int32, z:int64) from the fused native clamp+bin+encode
+    pass (native/src/zbuild.cpp), or None when the native library is
+    absent or the period needs calendar binning (MONTH/YEAR)."""
+    global _native_build
+    code = _PERIOD_CODE.get(timebin.TimePeriod.parse(period))
+    if code is None or _native_build is False or not len(x):
+        return None
+    import ctypes
+    if _native_build is None:
+        from ..native import symbols
+        dp = ctypes.POINTER(ctypes.c_double)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib = symbols({
+            "geomesa_encode_binned_z3": (
+                ctypes.c_int64,
+                [dp, dp, i64p, ctypes.c_int64, ctypes.c_int32,
+                 ctypes.c_double, i32p, i64p]),
+        })
+        _native_build = lib if lib is not None else False
+        if _native_build is False:
+            return None
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    y = np.ascontiguousarray(y, dtype=np.float64)
+    millis = np.ascontiguousarray(millis, dtype=np.int64)
+    n = len(x)
+    if len(y) != n or len(millis) != n:
+        return None
+    bins = np.empty(n, dtype=np.int32)
+    z = np.empty(n, dtype=np.int64)
+    dptr = ctypes.POINTER(ctypes.c_double)
+    rc = _native_build.geomesa_encode_binned_z3(
+        x.ctypes.data_as(dptr), y.ctypes.data_as(dptr), _i64p(millis),
+        n, code, float(z3sfc(period).time.max), _i32p(bins), _i64p(z))
+    return None if rc != 0 else (bins, z)
+
+
 def binned_candidate_positions(ubins, seg_offsets, keys_sorted,
                                intervals_ms, period, range_fn,
                                max_rows: int | None,
@@ -286,11 +328,16 @@ class ZKeyIndex:
     def _build_z3(self):
         if self._z3 is not None or self._millis is None:
             return self._z3
-        sfc = z3sfc(self.period)
-        bins, offs = timebin.to_binned(self._millis, self.period,
-                                       lenient=True)
-        z = sfc.index(self._x, self._y, offs.astype(np.float64),
-                      lenient=True).astype(np.int64)
+        fused = _native_encode_binned_z3(self._x, self._y, self._millis,
+                                         self.period)
+        if fused is not None:
+            bins, z = fused
+        else:
+            sfc = z3sfc(self.period)
+            bins, offs = timebin.to_binned(self._millis, self.period,
+                                           lenient=True)
+            z = sfc.index(self._x, self._y, offs.astype(np.float64),
+                          lenient=True).astype(np.int64)
         self._perm_dtype()  # enforce the row cap
         sorted_nat = _native_sort_bin_z(bins, z)
         if sorted_nat is not None:
@@ -317,6 +364,43 @@ class ZKeyIndex:
             perm = np.argsort(z, kind="stable").astype(np.int32)
             self._z2 = (z[perm], perm)
         return self._z2
+
+    # -- persistence (fs-store index sidecars) -----------------------------
+
+    def state_dict(self) -> dict:
+        """Built sort orders as plain arrays, for persistence next to
+        the backing data (the fs store's index sidecars — the analog of
+        the reference keeping its index *tables* durable while this
+        design keeps device columns in insertion order plus a sorted
+        host permutation). Only materialized orders are exported; the
+        coordinate copies are cheap gathers and are rebuilt on demand."""
+        out: dict = {}
+        if self._z3 is not None:
+            ubins, seg_offsets, z_sorted, perm = self._z3
+            out.update(z3_ubins=ubins, z3_seg_offsets=seg_offsets,
+                       z3_zsorted=z_sorted, z3_perm=perm)
+        if self._z2 is not None:
+            z_sorted, perm = self._z2
+            out.update(z2_zsorted=z_sorted, z2_perm=perm)
+        return out
+
+    def load_state(self, state: dict) -> bool:
+        """Install persisted sort orders (possibly memory-mapped).
+        Returns False — installing nothing — when the arrays don't
+        cover this table's rows (stale sidecar after writes)."""
+        ok = False
+        if "z3_zsorted" in state and self._millis is not None:
+            z_sorted, perm = state["z3_zsorted"], state["z3_perm"]
+            if len(z_sorted) == self.n and len(perm) == self.n:
+                self._z3 = (state["z3_ubins"], state["z3_seg_offsets"],
+                            z_sorted, perm)
+                ok = True
+        if "z2_zsorted" in state:
+            z_sorted, perm = state["z2_zsorted"], state["z2_perm"]
+            if len(z_sorted) == self.n and len(perm) == self.n:
+                self._z2 = (z_sorted, perm)
+                ok = True
+        return ok
 
     # -- incremental maintenance -------------------------------------------
 

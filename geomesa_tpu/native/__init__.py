@@ -24,7 +24,7 @@ _lock = threading.Lock()
 _cache: dict = {}
 
 _SOURCES = ["feature_codec.cpp", "zrange.cpp", "zencode.cpp",
-            "zsort.cpp"]
+            "zsort.cpp", "zbuild.cpp"]
 
 
 def _source_files() -> list:
@@ -79,7 +79,7 @@ def _build_and_load():
         os.makedirs(_BUILD, exist_ok=True)
         tmp = so + f".tmp{os.getpid()}"
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-               "-o", tmp] + srcs
+               "-pthread", "-o", tmp] + srcs
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
             os.replace(tmp, so)

@@ -1,0 +1,124 @@
+// Fused index-build kernels: epoch-millis binning and binned z3
+// encoding in ONE pass over the inputs.
+//
+// The index build at 100M rows is bandwidth-bound on host passes:
+// numpy's int64 division in to_binned() alone walks the column several
+// times (and scalar-loops the divide), then the z encode reads the
+// coordinates again. These kernels fuse clamp + bin-split + normalize
+// + interleave so each input byte is read once and each output byte
+// written once, with semantics matching curves/timebin.py::to_binned
+// (lenient) and curves/sfc.py::Z3SFC.index(lenient=True) EXACTLY —
+// parity enforced by tests/test_native_zencode.py.
+//
+// Only DAY and WEEK periods are handled natively (compile-time-constant
+// divisors become multiply-shift); MONTH/YEAR calendar binning stays on
+// the numpy datetime64 path.
+//
+// Exported (ctypes):
+//   geomesa_binned(millis i64[n], n, period_code {0=day,1=week},
+//                  bins_out i32[n], offs_out i64[n]) -> 0/-1
+//   geomesa_encode_binned_z3(x f64[n], y f64[n], millis i64[n], n,
+//                  period_code, t_max, bins_out i32[n], z_out i64[n])
+//                  -> 0/-1
+
+#include <cmath>
+#include <cstdint>
+
+namespace {
+
+constexpr int64_t MS_DAY = 86'400'000;
+constexpr int64_t MS_WEEK = 7 * MS_DAY;
+constexpr int64_t MAX_BIN = 32767;  // Short.MaxValue bins (BinnedTime)
+
+inline uint64_t split3(uint64_t v) {
+    v &= 0x1FFFFFULL;
+    v = (v | (v << 32)) & 0x1F00000000FFFFULL;
+    v = (v | (v << 16)) & 0x1F0000FF0000FFULL;
+    v = (v | (v << 8)) & 0x100F00F00F00F00FULL;
+    v = (v | (v << 4)) & 0x10C30C30C30C30C3ULL;
+    v = (v | (v << 2)) & 0x1249249249249249ULL;
+    return v;
+}
+
+inline uint64_t norm(double v, double lo, double hi, double normalizer,
+                     uint64_t max_index) {
+    if (std::isnan(v)) return 0;            // numpy cast chain -> bin 0
+    if (v < lo) v = lo;                     // lenient clamp
+    if (v > hi) v = hi;
+    double f = std::floor((v - lo) * normalizer);
+    int64_t i = (int64_t)f;
+    if (i < 0) i = 0;
+    return (uint64_t)i > max_index ? max_index : (uint64_t)i;
+}
+
+// Constant-divisor bin split (the compiler lowers the divisions to
+// multiply-shift). Returns the clamped (bin, offset-in-bin) pair.
+template <bool WEEK>
+inline void bin_split(int64_t ms, int32_t* bin, int64_t* off) {
+    constexpr int64_t period = WEEK ? MS_WEEK : MS_DAY;
+    constexpr int64_t hi = (MAX_BIN + 1) * period - 1;  // lenient clamp
+    if (ms < 0) ms = 0;
+    if (ms > hi) ms = hi;
+    const int64_t b = ms / period;
+    *bin = (int32_t)b;
+    const int64_t rem = ms - b * period;
+    *off = WEEK ? rem / 1000 : rem;
+}
+
+template <bool WEEK>
+void binned_loop(const int64_t* millis, int64_t n, int32_t* bins_out,
+                 int64_t* offs_out) {
+    for (int64_t i = 0; i < n; ++i)
+        bin_split<WEEK>(millis[i], &bins_out[i], &offs_out[i]);
+}
+
+template <bool WEEK>
+void encode_loop(const double* x, const double* y, const int64_t* millis,
+                 int64_t n, double t_max, int32_t* bins_out,
+                 int64_t* z_out) {
+    const double bins = 2097152.0;  // 2^21
+    const double nx = bins / 360.0;
+    const double ny = bins / 180.0;
+    const double nt = bins / t_max;
+    const uint64_t mi = (1ULL << 21) - 1;
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t b;
+        int64_t off;
+        bin_split<WEEK>(millis[i], &b, &off);
+        bins_out[i] = b;
+        const uint64_t xi = norm(x[i], -180.0, 180.0, nx, mi);
+        const uint64_t yi = norm(y[i], -90.0, 90.0, ny, mi);
+        const uint64_t ti = norm((double)off, 0.0, t_max, nt, mi);
+        z_out[i] = (int64_t)(split3(xi) | (split3(yi) << 1)
+                             | (split3(ti) << 2));
+    }
+}
+
+}  // namespace
+
+extern "C" int64_t geomesa_binned(const int64_t* millis, int64_t n,
+                                  int32_t period_code, int32_t* bins_out,
+                                  int64_t* offs_out) {
+    if (n < 0) return -1;
+    if (period_code == 0)
+        binned_loop<false>(millis, n, bins_out, offs_out);
+    else if (period_code == 1)
+        binned_loop<true>(millis, n, bins_out, offs_out);
+    else
+        return -1;
+    return 0;
+}
+
+extern "C" int64_t geomesa_encode_binned_z3(
+    const double* x, const double* y, const int64_t* millis, int64_t n,
+    int32_t period_code, double t_max, int32_t* bins_out,
+    int64_t* z_out) {
+    if (n < 0 || !(t_max > 0.0)) return -1;
+    if (period_code == 0)
+        encode_loop<false>(x, y, millis, n, t_max, bins_out, z_out);
+    else if (period_code == 1)
+        encode_loop<true>(x, y, millis, n, t_max, bins_out, z_out);
+    else
+        return -1;
+    return 0;
+}

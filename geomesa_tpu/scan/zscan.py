@@ -81,11 +81,18 @@ def _split_time(millis) -> tuple[np.ndarray, np.ndarray]:
 
 
 def build_scan_data(x: np.ndarray, y: np.ndarray, millis: np.ndarray,
-                    device=None, cap: int | None = None) -> DeviceScanData:
+                    device=None, cap: int | None = None,
+                    xy_split=None) -> DeviceScanData:
     """Host f64 coords + epoch millis -> device arrays, zero-padded to
-    ``cap`` rows when given (capacity headroom for in-place appends)."""
-    xhi, xlo = split_two_float(x)
-    yhi, ylo = split_two_float(y)
+    ``cap`` rows when given (capacity headroom for in-place appends).
+    ``xy_split`` passes precomputed (xhi, xlo, yhi, ylo) pairs so a
+    caller that also needs host copies splits once (and never fetches
+    them back off the device — a 2x column transfer at 100M rows)."""
+    if xy_split is not None:
+        xhi, xlo, yhi, ylo = xy_split
+    else:
+        xhi, xlo = split_two_float(x)
+        yhi, ylo = split_two_float(y)
     tday, tms = _split_time(millis)
     n = len(xhi)
     if cap is not None and cap > n:

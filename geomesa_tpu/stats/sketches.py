@@ -556,10 +556,16 @@ class Z3Histogram(Stat):
         sfc = z3sfc(self.period)
         z = sfc.index(x, y, np.minimum(offs, int(sfc.time.max)), lenient=True)
         cell = (z >> np.uint64(self._shift)).astype(np.int64)
-        for b in np.unique(tbins).tolist():
-            sel = tbins == b
+        # one fused bincount over (time bin, cell) composite keys; the
+        # dense count grid is (max_bin+1) x length ints — a few MB —
+        # and replaces a per-bin mask + bincount pass over the column
+        key = tbins.astype(np.int64) * self.length + cell
+        grid = np.bincount(
+            key, minlength=(int(tbins.max()) + 1) * self.length
+        ).reshape(-1, self.length)
+        for b in np.flatnonzero(grid.any(axis=1)).tolist():
             arr = self.bins.setdefault(b, np.zeros(self.length, dtype=np.int64))
-            arr += np.bincount(cell[sel], minlength=self.length)
+            arr += grid[b]
 
     def count(self, time_bin: int, cell: int) -> int:
         arr = self.bins.get(time_bin)

@@ -148,10 +148,16 @@ class _FsTypeState:
         self.root = root
         # cache: frozenset(partition files) -> loaded memory store
         self.cache: dict[frozenset, InMemoryDataStore] = {}
+        # load-key digest -> memory store awaiting sidecar persistence
+        self.pending_sidecar: dict[str, InMemoryDataStore] = {}
 
     @property
     def data_dir(self) -> str:
         return os.path.join(self.root, "data")
+
+    @property
+    def index_dir(self) -> str:
+        return os.path.join(self.root, "index")
 
 
 class FileSystemDataStore(DataStore):
@@ -233,6 +239,7 @@ class FileSystemDataStore(DataStore):
             import pyarrow as pa
             pq.write_table(pa.Table.from_batches([sub.to_arrow()]), path)
         st.cache.clear()
+        st.pending_sidecar.clear()
 
     def delete(self, type_name: str, ids):
         """Remove features by id: rewrite every parquet file that holds
@@ -256,6 +263,7 @@ class FileSystemDataStore(DataStore):
             else:
                 os.remove(f)
         st.cache.clear()
+        st.pending_sidecar.clear()
 
     # -- partitions --------------------------------------------------------
 
@@ -285,6 +293,83 @@ class FileSystemDataStore(DataStore):
                              if f.endswith(".parquet"))
         return files
 
+    # -- index sidecars ----------------------------------------------------
+    #
+    # Built z-key sort orders persist next to the Parquet data
+    # (root/<type>/index/<digest>/), keyed by a digest of the loaded
+    # file set (+ sizes/mtimes) and the pushdown key, so a reopened
+    # store memory-maps the permutation instead of re-sorting 100M keys
+    # — the durable-index-table analog of the reference's fs metadata
+    # (fs/FileMetadata; geomesa-fs keeps its indexes IN the data files'
+    # key order, here the sort order itself is the index).
+
+    _SIDECAR_CAP = 4  # LRU cap on persisted index snapshots per type
+
+    @staticmethod
+    def _sidecar_digest(st: _FsTypeState, files, expr, props) -> str:
+        import hashlib
+        h = hashlib.sha256()
+        for f in sorted(files):
+            s = os.stat(f)
+            h.update(f"{os.path.relpath(f, st.root)}|{s.st_size}|"
+                     f"{s.st_mtime_ns}\n".encode())
+        h.update(repr(None if expr is None else str(expr)).encode())
+        h.update(repr(None if props is None else tuple(props)).encode())
+        return h.hexdigest()[:24]
+
+    def _install_sidecar(self, st: _FsTypeState, digest: str,
+                         mem: InMemoryDataStore, type_name: str) -> bool:
+        d = os.path.join(st.index_dir, digest)
+        man = os.path.join(d, "manifest.json")
+        if not os.path.isfile(man):
+            return False
+        try:
+            with open(man) as fh:
+                names = json.load(fh)["arrays"]
+            state = {n: np.load(os.path.join(d, n + ".npy"),
+                                mmap_mode="r") for n in names}
+        except Exception:
+            return False  # torn/corrupt sidecar: rebuild from scratch
+        mem.warm_index(type_name, state)
+        os.utime(d)  # recency for the LRU prune
+        return True
+
+    def _flush_sidecars(self, st: _FsTypeState, type_name: str):
+        """Persist sort orders for loads whose index has since been
+        built (lazily, by a query); prune old snapshots."""
+        import shutil
+        done = []
+        for digest, mem in st.pending_sidecar.items():
+            state = mem.index_state(type_name)
+            if not state:
+                continue
+            d = os.path.join(st.index_dir, digest)
+            tmp = d + f".tmp{os.getpid()}"
+            try:
+                os.makedirs(tmp, exist_ok=True)
+                for name, arr in state.items():
+                    np.save(os.path.join(tmp, name + ".npy"),
+                            np.asarray(arr))
+                with open(os.path.join(tmp, "manifest.json"), "w") as fh:
+                    json.dump({"arrays": sorted(state)}, fh)
+                if os.path.isdir(d):
+                    shutil.rmtree(tmp)
+                else:
+                    os.rename(tmp, d)
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)
+            done.append(digest)
+        for digest in done:
+            st.pending_sidecar.pop(digest, None)
+        # LRU prune
+        if os.path.isdir(st.index_dir):
+            snaps = [os.path.join(st.index_dir, n)
+                     for n in os.listdir(st.index_dir)
+                     if ".tmp" not in n]
+            snaps.sort(key=lambda p: os.path.getmtime(p))
+            for p in snaps[:-self._SIDECAR_CAP]:
+                shutil.rmtree(p, ignore_errors=True)
+
     def _load(self, st: _FsTypeState, files: list[str],
               expr=None, props: list[str] | None = None
               ) -> InMemoryDataStore:
@@ -313,6 +398,12 @@ class FileSystemDataStore(DataStore):
                 if rb.num_rows:
                     ds.write(sft.type_name,
                              FeatureBatch.from_arrow(sft, rb))
+        # adopt a persisted index snapshot for this exact load, or mark
+        # the store for persistence once a query builds its index
+        if files:
+            digest = self._sidecar_digest(st, files, expr, props)
+            if not self._install_sidecar(st, digest, ds, sft.type_name):
+                st.pending_sidecar[digest] = ds
         # bounded LRU: pushdown makes keys (files, filter, columns), so
         # a rotation of several recurring queries must stay resident
         if len(st.cache) >= 8:
@@ -322,11 +413,32 @@ class FileSystemDataStore(DataStore):
 
     # -- queries -----------------------------------------------------------
 
+    def load_resident(self, type_name: str) -> None:
+        """Load the full table into the device-resident engine once.
+        Subsequent queries are served from it (no per-query parquet
+        scans), its z-key index persists as a sidecar, and a reopened
+        store adopts the memory-mapped sort order instead of re-sorting
+        — the intended workflow at 100M-row scale, matching the
+        reference's always-resident index tables."""
+        st = self._state(type_name)
+        self._load(st, self._files_for(st, None))
+
     def query(self, q: Query | str, type_name: str | None = None,
               explain_out=None) -> QueryResult:
         if isinstance(q, str):
             q = Query(type_name, q)
         st = self._state(q.type_name)
+        # a resident full-table store answers directly: device columns
+        # and sort orders are already built (or memory-mapped), so skip
+        # partition pruning and parquet pushdown entirely
+        files_all = self._files_for(st, None)
+        full_key = (frozenset(files_all), None, None)
+        if files_all and full_key in st.cache:
+            mem = self._load(st, files_all)
+            res = mem.query(q, explain_out=explain_out)
+            self._flush_sidecars(st, q.type_name)
+            res.explain("Served from resident full-table store")
+            return res
         parts = st.scheme.covering_partitions(st.sft, q.filter)
         if parts == []:
             from ..index.api import Explainer, FilterStrategy
@@ -352,6 +464,7 @@ class FileSystemDataStore(DataStore):
             props = [a.name for a in st.sft.attributes if a.name in need]
         mem = self._load(st, files, expr, props)
         res = mem.query(q, explain_out=explain_out)
+        self._flush_sidecars(st, q.type_name)
         res.explain(f"Partitions scanned: "
                     f"{'all' if parts is None else len(parts)}; "
                     f"files: {len(files)}; parquet pushdown: "
@@ -382,3 +495,4 @@ class FileSystemDataStore(DataStore):
             for f in files:
                 os.remove(f)
         st.cache.clear()
+        st.pending_sidecar.clear()

@@ -160,6 +160,9 @@ class _TypeState:
         # has_vis avoids an O(n) object-array scan on every query
         self.vis: np.ndarray = np.empty(0, dtype=object)
         self.has_vis = False
+        # persisted sort orders to install into the next-built zindex
+        # (fs-store index sidecars); consumed by ensure_index
+        self.zindex_warm: dict | None = None
 
     @property
     def n(self) -> int:
@@ -174,13 +177,16 @@ class _TypeState:
     def append(self, batch: FeatureBatch, visibilities=None):
         # validate everything BEFORE mutating: a failed write must not
         # leave batch/vis misaligned
-        vis = (np.asarray(visibilities, dtype=object)
-               if visibilities is not None
-               else np.full(batch.n, None, dtype=object))
+        if visibilities is None:
+            # fast path: no O(n) object scan for the common open write
+            vis = np.full(batch.n, None, dtype=object)
+            distinct = set()
+        else:
+            vis = np.asarray(visibilities, dtype=object)
+            distinct = set(v for v in vis.tolist() if v)
         if len(vis) != batch.n:
             raise ValueError("visibilities length mismatch")
         from ..security import parse_visibility
-        distinct = set(v for v in vis.tolist() if v)
         for e in distinct:
             parse_visibility(str(e))  # raises on malformed expressions
         if distinct:
@@ -321,6 +327,9 @@ class _TypeState:
         self.zindex = ZKeyIndex(x, y,
                                 millis if dtg is not None else None,
                                 self.sft.z3_interval)
+        if self.zindex_warm is not None:
+            self.zindex.load_state(self.zindex_warm)  # no-op when stale
+            self.zindex_warm = None
         self.dirty = False
 
     def _clear_device_index(self):
@@ -328,9 +337,15 @@ class _TypeState:
         self.extent_data = None
 
     def _build_point_index(self, x, y, millis):
-        self.scan_data = zscan.build_scan_data(x, y, millis)
-        self.host_xhi = np.asarray(self.scan_data.xhi)
-        self.host_yhi = np.asarray(self.scan_data.yhi)
+        # split on host ONCE and hand the pairs to the device build:
+        # fetching xhi/yhi back off the device would round-trip two
+        # full columns through the interconnect at 100M rows
+        xhi, xlo = zscan.split_two_float(x)
+        yhi, ylo = zscan.split_two_float(y)
+        self.scan_data = zscan.build_scan_data(
+            x, y, millis, xy_split=(xhi, xlo, yhi, ylo))
+        self.host_xhi = xhi
+        self.host_yhi = yhi
 
     def _build_extent_index(self, bounds, millis):
         self.extent_data = gscan.build_extent_data(bounds, millis)
@@ -427,6 +442,21 @@ class InMemoryDataStore(DataStore):
 
     def delete(self, type_name: str, ids):
         self._state(type_name).delete(set(map(str, ids)))
+
+    def warm_index(self, type_name: str, state: dict):
+        """Install persisted z-key sort orders (possibly memory-mapped)
+        to be adopted by the next index build — the fs store's sidecar
+        reopen path. Stale states (row count mismatch) are ignored."""
+        self._state(type_name).zindex_warm = state
+
+    def index_state(self, type_name: str) -> dict | None:
+        """Built z-key sort orders for persistence, or None when no
+        index has been built yet."""
+        st = self._state(type_name)
+        if st.zindex is None or not hasattr(st.zindex, "state_dict"):
+            return None
+        out = st.zindex.state_dict()
+        return out or None
 
     def count(self, type_name: str) -> int:
         return self._state(type_name).n

@@ -326,3 +326,60 @@ class TestReviewRegressions:
                            _json.dumps({"items": [1]})])
         batch, ctx = conv.process(lines)
         assert ctx.success >= 1
+
+
+class TestIndexSidecars:
+    """Persistent z-key index snapshots (root/<type>/index/<digest>):
+    a reopened store must serve a selective query from the memory-mapped
+    sort order WITHOUT re-sorting the keys."""
+
+    ECQL = ("BBOX(geom, -10, -10, 10, 10) AND "
+            "dtg DURING 2017-01-02T00:00:00Z/2017-01-05T00:00:00Z")
+
+    def test_sidecar_written_and_reused(self, tmp_path, monkeypatch):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("events", "kind:String,dtg:Date,*geom:Point")
+        write_sample(ds, n=5000)
+        expect = sorted(ds.query(self.ECQL, "events").ids.tolist())
+        idx_dir = tmp_path / "events" / "index"
+        snaps = list(idx_dir.iterdir())
+        assert len(snaps) == 1
+        assert (snaps[0] / "manifest.json").is_file()
+
+        # a fresh store must answer WITHOUT sorting: poison both sort
+        # entry points — if the sidecar is not adopted, the query dies
+        from geomesa_tpu.index import zkeys
+
+        def boom(*a, **k):
+            raise AssertionError("index was re-sorted on reopen")
+
+        ds2 = FileSystemDataStore(str(tmp_path))
+        monkeypatch.setattr(zkeys, "_native_sort_bin_z", boom)
+        monkeypatch.setattr(zkeys.np, "lexsort", boom)
+        got = ds2.query(self.ECQL, "events")
+        assert sorted(got.ids.tolist()) == expect
+
+    def test_stale_sidecar_ignored_after_write(self, tmp_path):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("events", "kind:String,dtg:Date,*geom:Point")
+        write_sample(ds, n=3000)
+        r1 = ds.query(self.ECQL, "events")
+        write_sample(ds, n=3000, seed=1)  # new files: digests change
+        ds2 = FileSystemDataStore(str(tmp_path))
+        r2 = ds2.query(self.ECQL, "events")
+        assert r2.n >= r1.n  # superset of data, correct (re-sorted) result
+        # brute-force oracle
+        mem_ids = set()
+        for f in ds2.features("events", self.ECQL):
+            mem_ids.add(f["__fid__"] if "__fid__" in f else None)
+        assert r2.n == len(list(ds2.features("events", self.ECQL)))
+
+    def test_sidecar_cap_prunes(self, tmp_path):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("events", "kind:String,dtg:Date,*geom:Point")
+        write_sample(ds, n=2000)
+        # distinct pushdown keys -> distinct digests
+        for k in range(7):
+            ds.query(f"BBOX(geom, {k}, 0, {k + 1}, 1)", "events")
+        idx_dir = tmp_path / "events" / "index"
+        assert len(list(idx_dir.iterdir())) <= FileSystemDataStore._SIDECAR_CAP

@@ -1,0 +1,137 @@
+"""Parity tests for the fused native index-build kernels
+(native/src/zbuild.cpp) and the bucketed sort (zsort.cpp): the native
+paths must agree bit-for-bit with the pure-numpy implementations they
+replace, including lexsort tie order at segment sizes that exercise the
+MSD bucket pass."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curves import timebin
+from geomesa_tpu.curves.sfc import z3sfc
+from geomesa_tpu.curves.timebin import TimePeriod
+from geomesa_tpu.index import zkeys
+
+
+def _numpy_binned(millis, period):
+    """The pre-native to_binned path (forced past the fast path)."""
+    millis = np.asarray(millis, dtype=np.int64)
+    hi = timebin.max_date_millis(period)
+    millis = np.clip(millis, 0, hi - 1)
+    if period is TimePeriod.DAY:
+        bins = millis // timebin.MILLIS_PER_DAY
+        offs = millis - bins * timebin.MILLIS_PER_DAY
+    else:
+        bins = millis // timebin.MILLIS_PER_WEEK
+        offs = (millis - bins * timebin.MILLIS_PER_WEEK) // 1000
+    return bins.astype(np.int32), offs.astype(np.int64)
+
+
+@pytest.mark.parametrize("period", [TimePeriod.DAY, TimePeriod.WEEK])
+class TestNativeBinned:
+    def test_parity_random(self, period):
+        rng = np.random.default_rng(7)
+        ms = rng.integers(-10**9, timebin.max_date_millis(period) + 10**9,
+                          100_000).astype(np.int64)
+        nb = timebin._native_to_binned(ms, period)
+        if nb is None:
+            pytest.skip("native library unavailable")
+        eb, eo = _numpy_binned(ms, period)
+        assert np.array_equal(nb[0], eb)
+        assert np.array_equal(nb[1], eo)
+
+    def test_parity_boundaries(self, period):
+        hi = timebin.max_date_millis(period)
+        ms = np.array([0, 1, hi - 1, hi, hi + 5, -1, -hi], dtype=np.int64)
+        nb = timebin._native_to_binned(ms, period)
+        if nb is None:
+            pytest.skip("native library unavailable")
+        eb, eo = _numpy_binned(ms, period)
+        assert np.array_equal(nb[0], eb)
+        assert np.array_equal(nb[1], eo)
+
+    def test_to_binned_uses_it_above_threshold(self, period):
+        rng = np.random.default_rng(3)
+        ms = rng.integers(0, timebin.max_date_millis(period),
+                          8192).astype(np.int64)
+        got = timebin.to_binned(ms, period, lenient=True)
+        eb, eo = _numpy_binned(ms, period)
+        assert np.array_equal(got[0], eb)
+        assert np.array_equal(got[1], eo)
+
+
+@pytest.mark.parametrize("period", [TimePeriod.DAY, TimePeriod.WEEK])
+class TestFusedEncode:
+    def test_parity_with_python_path(self, period):
+        rng = np.random.default_rng(11)
+        n = 50_000
+        x = rng.uniform(-200, 200, n)  # includes out-of-bounds (clamped)
+        y = rng.uniform(-100, 100, n)
+        ms = rng.integers(0, timebin.max_date_millis(period),
+                          n).astype(np.int64)
+        x[:5] = np.nan
+        fused = zkeys._native_encode_binned_z3(x, y, ms, period)
+        if fused is None:
+            pytest.skip("native library unavailable")
+        bins, z = fused
+        eb, eo = timebin.to_binned(ms, period, lenient=True)
+        sfc = z3sfc(period)
+        ez = sfc.index(x, y, eo.astype(np.float64),
+                       lenient=True).astype(np.int64)
+        assert np.array_equal(bins, eb)
+        assert np.array_equal(z, ez)
+
+    def test_month_period_declines(self, period):
+        del period
+        out = zkeys._native_encode_binned_z3(
+            np.array([1.0]), np.array([2.0]),
+            np.array([1000], dtype=np.int64), TimePeriod.MONTH)
+        assert out is None
+
+
+class TestBucketedSort:
+    """Exercise the MSD bucket path (segments > 2^15 rows) against
+    np.lexsort, including its tie stability."""
+
+    def test_bin_z_large_segments(self):
+        rng = np.random.default_rng(5)
+        n = 200_000
+        bins = rng.integers(0, 3, n).astype(np.int32)  # ~66k per segment
+        # few distinct z values -> long tie runs probing stability
+        z = rng.integers(0, 50, n).astype(np.int64) << 40
+        out = zkeys._native_sort_bin_z(bins, z)
+        if out is None:
+            pytest.skip("native library unavailable")
+        z_sorted, perm, ubins, seg_offsets = out
+        eperm = np.lexsort((z, bins)).astype(np.int32)
+        assert np.array_equal(perm, eperm)
+        assert np.array_equal(z_sorted, z[eperm])
+        assert np.array_equal(ubins, np.unique(bins))
+        counts = np.bincount(bins)
+        assert np.array_equal(np.diff(seg_offsets), counts[counts > 0])
+
+    def test_sort_z_large(self):
+        rng = np.random.default_rng(9)
+        n = 150_000
+        z = rng.integers(0, 2**62, n).astype(np.int64)
+        z[: n // 2] = z[n // 2: n // 2 * 2]  # duplicate half: tie runs
+        out = zkeys._native_sort_z(z)
+        if out is None:
+            pytest.skip("native library unavailable")
+        z_sorted, perm = out
+        eperm = np.argsort(z, kind="stable").astype(np.int32)
+        assert np.array_equal(perm, eperm)
+        assert np.array_equal(z_sorted, z[eperm])
+
+    def test_sparse_bins(self):
+        # bins with gaps: offsets must still mark empty segments
+        bins = np.array([5, 5, 900, 0, 900], dtype=np.int32)
+        z = np.array([3, 1, 2, 9, 2], dtype=np.int64)
+        out = zkeys._native_sort_bin_z(bins, z)
+        if out is None:
+            pytest.skip("native library unavailable")
+        z_sorted, perm, ubins, seg_offsets = out
+        eperm = np.lexsort((z, bins)).astype(np.int32)
+        assert np.array_equal(perm, eperm)
+        assert np.array_equal(ubins, [0, 5, 900])
+        assert np.array_equal(seg_offsets, [0, 1, 3, 5])
