@@ -26,8 +26,15 @@ _BASE_COST = {
     "xz3": 201.0,
     "z2": 400.0,
     "xz2": 401.0,
-    "fullscan": 1e9,
 }
+
+# Full-table scans are a last resort, never a tie-winner: the reference's
+# CostBasedStrategyDecider only falls back to a full scan when no index
+# applies (StrategyDecider.scala:47-64). Without the penalty, a store
+# loaded through parquet pushdown (holding only ~matching rows) costs
+# every strategy at ~n and fullscan won the tie, so no index was ever
+# built — and the fs sidecar persistence never fired (round-3 red tests).
+_FULLSCAN_PENALTY = 1e9
 
 
 def heuristic_cost(sft: SimpleFeatureType, s: FilterStrategy,
@@ -40,7 +47,7 @@ def heuristic_cost(sft: SimpleFeatureType, s: FilterStrategy,
         return base * 10
     base = _BASE_COST.get(s.index, 1e9)
     if s.index == "fullscan":
-        return float(max(n_features, 1))
+        return _FULLSCAN_PENALTY + float(max(n_features, 1))
     return base
 
 
@@ -83,7 +90,7 @@ def _stats_cost(sft: SimpleFeatureType, s: FilterStrategy, stats,
     if s.index == "empty":
         return 0.0
     if s.index == "fullscan":
-        return float(max(n_features, 1))
+        return _FULLSCAN_PENALTY + float(max(n_features, 1))
     if s.primary is None:
         return float(max(n_features, 1))
     if s.index.startswith("attr:"):

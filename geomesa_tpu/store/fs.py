@@ -407,7 +407,12 @@ class FileSystemDataStore(DataStore):
         # bounded LRU: pushdown makes keys (files, filter, columns), so
         # a rotation of several recurring queries must stay resident
         if len(st.cache) >= 8:
-            st.cache.pop(next(iter(st.cache)))
+            evicted = st.cache.pop(next(iter(st.cache)))
+            # an evicted store must not stay pinned awaiting a sidecar
+            # flush that can never come (its index will never be built)
+            st.pending_sidecar = {d: m for d, m in
+                                  st.pending_sidecar.items()
+                                  if m is not evicted}
         st.cache[key] = ds
         return ds
 
@@ -430,10 +435,21 @@ class FileSystemDataStore(DataStore):
         st = self._state(q.type_name)
         # a resident full-table store answers directly: device columns
         # and sort orders are already built (or memory-mapped), so skip
-        # partition pruning and parquet pushdown entirely
+        # partition pruning and parquet pushdown entirely. A persisted
+        # FULL-TABLE sidecar on disk also routes here: a reopened store
+        # adopts the memory-mapped sort order rather than re-sorting —
+        # the fs durable-metadata reopen path (FileMetadata analog,
+        # fs-storage-common FileBasedMetadata)
         files_all = self._files_for(st, None)
         full_key = (frozenset(files_all), None, None)
-        if files_all and full_key in st.cache:
+        resident = full_key in st.cache
+        if files_all and not resident and os.path.isdir(st.index_dir):
+            # probe only when sidecars exist at all; a pure pushdown
+            # workload never pays the stat+digest pass
+            digest = self._sidecar_digest(st, files_all, None, None)
+            resident = os.path.isfile(os.path.join(
+                st.index_dir, digest, "manifest.json"))
+        if files_all and resident:
             mem = self._load(st, files_all)
             res = mem.query(q, explain_out=explain_out)
             self._flush_sidecars(st, q.type_name)
