@@ -29,67 +29,189 @@ __all__ = ["dwithin_join", "contains_join", "knn"]
 
 
 @jax.jit
-def _dwithin_matrices(px, py, qx, qy, qvalid, r2_hi, r2_lo):
+def _dwithin_matrices(px, py, qx, qy, qvalid, r2_hi, r2_lo, nrows):
     """(n,) x (k,) -> definite-hit and uncertain-band bool matrices."""
     dx = px[:, None] - qx[None, :]
     dy = py[:, None] - qy[None, :]
     d2 = dx * dx + dy * dy                       # f32, error-banded
-    definite = (d2 <= r2_lo) & qvalid[None, :]
-    maybe = (d2 <= r2_hi) & ~definite & qvalid[None, :]
+    rv = (jnp.arange(px.shape[0]) < nrows)[:, None]
+    definite = (d2 <= r2_lo) & qvalid[None, :] & rv
+    maybe = (d2 <= r2_hi) & ~definite & qvalid[None, :] & rv
     return definite, maybe
 
 
 @jax.jit
-def _dwithin_count_reduce(px, py, qx, qy, qvalid, r2_hi, r2_lo):
-    """Counts-only form: the (n, k) matrix never leaves the device —
-    only per-query definite counts and band counts come back."""
-    definite, maybe = _dwithin_matrices(px, py, qx, qy, qvalid, r2_hi, r2_lo)
-    return (jnp.sum(definite, axis=0, dtype=jnp.int32),
-            jnp.sum(maybe, axis=0, dtype=jnp.int32))
+def _dwithin_counts_all(px, py, qxm, qym, validm, r2_hi, r2_lo, nrows):
+    """ALL query chunks in one dispatch: (nchunks, chunk) query tiles
+    map over the device sequentially; only the (nchunks, chunk) count
+    grids come back. One kernel launch per join, not one per chunk —
+    per-dispatch latency (and, under a remote-device tunnel, a network
+    round trip) otherwise dominates the scan itself."""
+    rv = (jnp.arange(px.shape[0]) < nrows)[:, None]
+
+    def one(args):
+        qx, qy, valid = args
+        dx = px[:, None] - qx[None, :]
+        dy = py[:, None] - qy[None, :]
+        d2 = dx * dx + dy * dy
+        definite = (d2 <= r2_lo) & valid[None, :] & rv
+        maybe = (d2 <= r2_hi) & ~definite & valid[None, :] & rv
+        return (jnp.sum(definite, axis=0, dtype=jnp.int32),
+                jnp.sum(maybe, axis=0, dtype=jnp.int32))
+
+    return jax.lax.map(one, (qxm, qym, validm))
+
+
+@jax.jit
+def _sorted_by_x(px, nrows):
+    """(xs, order): px sorted ascending with its permutation, padded
+    rows pushed to +inf so they land at the tail. One dispatch."""
+    key = jnp.where(jnp.arange(px.shape[0]) < nrows, px, jnp.inf)
+    order = jnp.argsort(key)
+    return key[order], order
+
+
+# device x-sort LRU keyed by the coordinate buffer identity: a store's
+# resident column re-resolves bands across many join calls, and the
+# sort is the dominant per-call cost. Strong refs keep the keys' ids
+# stable; the bound keeps pinned memory to a few tables.
+_XSORT_CACHE: list = []
+
+
+def _sorted_by_x_cached(pxj, nrows, cacheable):
+    """`cacheable` is True only for caller-owned resident arrays: a
+    per-call upload gets a fresh buffer identity every time, so caching
+    it could never hit — it would only evict store entries and pin dead
+    device copies."""
+    for i, (ref, rn, xs, order) in enumerate(_XSORT_CACHE):
+        if ref is pxj and rn == nrows:
+            _XSORT_CACHE.append(_XSORT_CACHE.pop(i))
+            return xs, order
+    xs, order = _sorted_by_x(pxj, np.int32(nrows))
+    if cacheable:
+        _XSORT_CACHE.append((pxj, nrows, xs, order))
+        if len(_XSORT_CACHE) > 4:
+            _XSORT_CACHE.pop(0)
+    return xs, order
+
+
+@functools.partial(jax.jit, static_argnames=("smax",))
+def _slab_rows(xs, order, los, smax):
+    """Row ids of up to smax sorted positions starting at each lo —
+    the x-slab candidate gather for a batch of banded queries."""
+    pos = los[:, None] + jnp.arange(smax)[None, :]
+    pos = jnp.clip(pos, 0, xs.shape[0] - 1)
+    return order[pos]
+
+
+# total padded slab-grid ids per gather dispatch (64MB of int32): wide
+# radii chunk the banded queries instead of materializing a
+# (len(banded), max_width) grid in one shot
+_SLAB_GRID_CAP = 1 << 24
+
+
+def _resolve_band_counts(pxj, px64, py64, qx64, qy64, banded,
+                         radius_deg, r2_hi, n, counts, cacheable):
+    """Exact f64 resolution of queries with in-band pairs.
+
+    The candidate set per banded query is its x-slab |x - qx| <= r+eps:
+    px sorts ON DEVICE once (f32, padded rows to +inf), a batched
+    searchsorted finds every slab, and padded gathers pull just the
+    slab row ids to the host for a vectorized f64 distance check — no
+    O(n) host work, no (k, n) band matrix. Gathers are bounded at
+    _SLAB_GRID_CAP ids each, so wide radii chunk rather than allocate
+    a queries x max-width grid."""
+    xs, order = _sorted_by_x_cached(pxj, n, cacheable)
+    # slab half-width: radius + f32 rounding of the coordinates + band
+    eps = float(np.sqrt(max(r2_hi, 0.0))) - radius_deg + 1e-4
+    w = radius_deg + eps
+    qb = qx64[banded].astype(np.float32)
+    los = np.asarray(jnp.searchsorted(xs, jnp.asarray(qb - np.float32(w)),
+                                      side="left"))
+    his = np.asarray(jnp.searchsorted(xs, jnp.asarray(qb + np.float32(w)),
+                                      side="right"))
+    widths = his - los
+    if not len(widths) or widths.max() == 0:
+        return
+    smax = 1 << int(widths.max() - 1).bit_length()  # pow2: few compiles
+    r2 = radius_deg * radius_deg
+    qchunk = max(1, _SLAB_GRID_CAP // smax)
+    for s in range(0, len(banded), qchunk):
+        sel = slice(s, s + qchunk)
+        rows = np.asarray(_slab_rows(xs, order,
+                                     jnp.asarray(los[sel]), smax))
+        for i, qj in enumerate(banded[sel]):
+            rr = rows[i, : widths[s + i]]
+            rr = rr[rr < n]
+            d2 = ((px64[rr] - qx64[qj]) ** 2
+                  + (py64[rr] - qy64[qj]) ** 2)
+            counts[qj] = int((d2 <= r2).sum())
+
+
+def _as_device_f32(px64, py64, device_xy):
+    """The join's large side on device: adopt caller-provided resident
+    f32 columns (e.g. a store's scan_data.xhi/yhi, which are exactly
+    f32(x)/f32(y) of the two-float split and may be capacity-padded
+    past n) or upload once."""
+    if device_xy is not None:
+        pxj, pyj = device_xy
+        return jnp.asarray(pxj), jnp.asarray(pyj)
+    return (jnp.asarray(px64.astype(np.float32)),
+            jnp.asarray(py64.astype(np.float32)))
 
 
 def dwithin_join(px: np.ndarray, py: np.ndarray,
                  qx: np.ndarray, qy: np.ndarray,
                  radius_deg: float, chunk: int = 256,
-                 counts_only: bool = False):
+                 counts_only: bool = False,
+                 device_xy=None):
     """Radius join: for each query point, the points within radius_deg
     (planar degrees, matching the rewritten-DWithin semantics).
 
     Returns (counts[k], pairs) where pairs is an (m, 2) int array of
     (point_idx, query_idx), or (counts, None) with counts_only.
+
+    ``device_xy`` passes already-device-resident f32 coordinate arrays
+    for the large side (possibly capacity-padded beyond len(px); padded
+    rows never match). Without it the coordinates upload per call —
+    fine for one-off joins, but a store-backed caller should hand over
+    its resident columns.
     """
     px64 = np.asarray(px, np.float64)
     py64 = np.asarray(py, np.float64)
     qx64 = np.asarray(qx, np.float64)
     qy64 = np.asarray(qy, np.float64)
-    pxj = jnp.asarray(px64.astype(np.float32))
-    pyj = jnp.asarray(py64.astype(np.float32))
+    pxj, pyj = _as_device_f32(px64, py64, device_xy)
     n, k = len(px64), len(qx64)
     span = 360.0
     r2_hi, r2_lo = _f32_band(radius_deg, span)
     r2 = radius_deg * radius_deg
 
-    # band queries re-resolve in exact f64 on host over just the points
-    # inside the query's x-slab (sorted-x binary search, built lazily on
-    # first band), not the whole table — at large n nearly every query
-    # has >= 1 banded pair, so an O(n)-per-query host pass would
-    # dominate the device scan
-    sorted_x: list = []
-    eps = float(np.sqrt(max(r2_hi, 0.0))) - radius_deg + 1e-9
-
-    def exact_count(qj: int) -> int:
-        if not sorted_x:
-            order = np.argsort(px64, kind="stable")
-            sorted_x.append((order, px64[order]))
-        xorder, xs = sorted_x[0]
-        lo = np.searchsorted(xs, qx64[qj] - radius_deg - eps)
-        hi = np.searchsorted(xs, qx64[qj] + radius_deg + eps, side="right")
-        rows = xorder[lo:hi]
-        d2 = ((px64[rows] - qx64[qj]) ** 2 + (py64[rows] - qy64[qj]) ** 2)
-        return int((d2 <= r2).sum())
-
     counts = np.zeros(k, dtype=np.int64)
     pair_chunks: list[np.ndarray] = []
+
+    if counts_only:
+        nchunks = (k + chunk - 1) // chunk
+        qxm = np.zeros((nchunks, chunk), np.float32)
+        qym = np.zeros((nchunks, chunk), np.float32)
+        validm = np.zeros((nchunks, chunk), bool)
+        qxm.ravel()[:k] = qx64
+        qym.ravel()[:k] = qy64
+        validm.ravel()[:k] = True
+        def_counts, band_counts = _dwithin_counts_all(
+            pxj, pyj, jnp.asarray(qxm), jnp.asarray(qym),
+            jnp.asarray(validm), np.float32(r2_hi), np.float32(r2_lo),
+            np.int32(n))
+        counts[:] = np.asarray(def_counts).ravel()[:k]
+        band_counts = np.asarray(band_counts).ravel()[:k]
+        # queries with in-band pairs re-resolve exactly from their
+        # device-gathered x-slab candidates (see _resolve_band_counts)
+        banded = np.flatnonzero(band_counts)
+        if len(banded):
+            _resolve_band_counts(pxj, px64, py64, qx64, qy64, banded,
+                                 radius_deg, r2_hi, n, counts,
+                                 cacheable=device_xy is not None)
+        return counts, None
 
     for start in range(0, k, chunk):
         end = min(start + chunk, k)
@@ -100,16 +222,8 @@ def dwithin_join(px: np.ndarray, py: np.ndarray,
         cqy[: end - start] = qy64[start:end]
         valid[: end - start] = True
         args = (pxj, pyj, jnp.asarray(cqx), jnp.asarray(cqy),
-                jnp.asarray(valid), np.float32(r2_hi), np.float32(r2_lo))
-        if counts_only:
-            def_counts, band_counts = _dwithin_count_reduce(*args)
-            def_counts = np.asarray(def_counts)[: end - start]
-            band_counts = np.asarray(band_counts)[: end - start]
-            counts[start:end] += def_counts
-            # only queries with band pairs need exact resolution
-            for j in np.flatnonzero(band_counts):
-                counts[start + j] = exact_count(start + j)
-            continue
+                jnp.asarray(valid), np.float32(r2_hi), np.float32(r2_lo),
+                np.int32(n))
         definite, maybe = _dwithin_matrices(*args)
         definite = np.array(definite)  # writable host copy
         maybe = np.asarray(maybe)
@@ -125,8 +239,6 @@ def dwithin_join(px: np.ndarray, py: np.ndarray,
             pair_chunks.append(
                 np.stack([pi, start + pj], axis=1).astype(np.int64))
 
-    if counts_only:
-        return counts, None
     pairs = (np.concatenate(pair_chunks, axis=0) if pair_chunks
              else np.empty((0, 2), dtype=np.int64))
     return counts, pairs
@@ -172,9 +284,12 @@ def contains_join(polygons, px: np.ndarray, py: np.ndarray,
             if len(rows) == 0:
                 continue
             poly = polygons[start + j]
-            if len(rows) >= 4096:
+            if len(rows) >= 2_000_000:
                 # dense case: device crossing-number kernel with exact
-                # host recheck only in the edge band (scan/gscan.py)
+                # host recheck only in the edge band (scan/gscan.py).
+                # Below this the vectorized host test beats the
+                # dispatch round trip (same crossover as the store's
+                # _DEVICE_PIP_ROWS)
                 from ..scan.gscan import points_in_polygon
                 hit = points_in_polygon(px[rows], py[rows], poly)
             else:
@@ -191,14 +306,17 @@ def contains_join(polygons, px: np.ndarray, py: np.ndarray,
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
-def _knn_kernel(px, py, qx, qy, k: int):
+def _knn_kernel(px, py, qx, qy, k: int, nrows=None):
     d2 = (px - qx) ** 2 + (py - qy) ** 2
+    if nrows is not None:
+        # capacity-padded resident columns: padded rows never win
+        d2 = jnp.where(jnp.arange(px.shape[0]) < nrows, d2, jnp.inf)
     neg, idx = jax.lax.top_k(-d2, k)
     return -neg, idx
 
 
 def knn(px: np.ndarray, py: np.ndarray, qx: float, qy: float,
-        k: int) -> tuple[np.ndarray, np.ndarray]:
+        k: int, device_xy=None) -> tuple[np.ndarray, np.ndarray]:
     """k nearest points to (qx, qy): full-scan distance + device top_k.
 
     The reference's KNNQuery iteratively expands a geohash spiral
@@ -207,13 +325,15 @@ def knn(px: np.ndarray, py: np.ndarray, qx: float, qy: float,
     iteration. Returns (distances_deg, indices) sorted ascending.
 
     f32 distances can tie/misorder within ~1e-5 deg; the top-(k + pad)
-    candidates re-rank on host in f64 for exact order.
+    candidates re-rank on host in f64 for exact order. ``device_xy``
+    passes resident f32 columns (see dwithin_join) so a store-backed
+    KNN never re-uploads its table.
     """
     pad = min(len(px), k + 32)
-    d2, idx = _knn_kernel(
-        jnp.asarray(np.asarray(px, np.float32)),
-        jnp.asarray(np.asarray(py, np.float32)),
-        np.float32(qx), np.float32(qy), pad)
+    pxj, pyj = _as_device_f32(np.asarray(px, np.float64),
+                              np.asarray(py, np.float64), device_xy)
+    d2, idx = _knn_kernel(pxj, pyj, np.float32(qx), np.float32(qy),
+                          pad, np.int32(len(px)))
     idx = np.asarray(idx)
     dx = np.asarray(px, np.float64)[idx] - qx
     dy = np.asarray(py, np.float64)[idx] - qy

@@ -30,6 +30,17 @@ def _point_cols(store, type_name):
     return st, col
 
 
+def _resident_xy(st):
+    """The type's device-resident f32 coordinate columns (built by
+    ensure_index), so processes scan without re-uploading the table."""
+    try:
+        st.ensure_index()
+    except Exception:
+        return None
+    sd = getattr(st, "scan_data", None)
+    return None if sd is None else (sd.xhi, sd.yhi)
+
+
 def knn_process(store, type_name: str, qx: float, qy: float, k: int,
                 ecql=None):
     """KNearestNeighborSearchProcess (knn/KNearestNeighborSearchProcess.scala:30):
@@ -45,7 +56,8 @@ def knn_process(store, type_name: str, qx: float, qy: float, k: int,
         scol = sub.col(st.sft.geom_field)
         d, idx = knn(scol.x, scol.y, qx, qy, min(k, sub.n))
         return sub.ids[idx], d
-    d, idx = knn(col.x, col.y, qx, qy, min(k, st.n))
+    d, idx = knn(col.x, col.y, qx, qy, min(k, st.n),
+                 device_xy=_resident_xy(st))
     return st.batch.ids[idx], d
 
 
@@ -93,7 +105,8 @@ def proximity_process(store, type_name: str, qx, qy,
         return (np.zeros(len(np.atleast_1d(qx)), np.int64), None)
     counts, pairs = dwithin_join(col.x, col.y, np.atleast_1d(qx),
                                  np.atleast_1d(qy), radius_deg,
-                                 counts_only=counts_only)
+                                 counts_only=counts_only,
+                                 device_xy=_resident_xy(st))
     if counts_only:
         return counts, None
     ids = st.batch.ids[np.unique(pairs[:, 0])] if len(pairs) else \

@@ -43,9 +43,18 @@ inline bool pair_less(const Pair& a, const Pair& b) {
 }
 
 int nthreads(int64_t n) {
-    if (n < (1 << 18)) return 1;  // not worth the thread spawn
     const char* e = std::getenv("GEOMESA_TPU_THREADS");
-    int t = e ? std::atoi(e) : (int)std::thread::hardware_concurrency();
+    if (e) {
+        // explicit override: honored even for small n, so tests can
+        // exercise the parallel scatter without 1M+-row fixtures
+        int t = std::atoi(e);
+        if (t < 1) t = 1;
+        if (t > 64) t = 64;
+        if ((int64_t)t > n && n > 0) t = (int)n;
+        return t < 1 ? 1 : t;
+    }
+    if (n < (1 << 18)) return 1;  // not worth the thread spawn
+    int t = (int)std::thread::hardware_concurrency();
     if (t < 1) t = 1;
     if (t > 64) t = 64;
     const int64_t per = (int64_t)1 << 20;  // >=1M rows per thread
@@ -65,46 +74,107 @@ void run_parallel(int t, void (*fn)(void*, int), void* ctx) {
     for (auto& th : pool) th.join();
 }
 
-// MSD threshold: segments below this go straight to std::sort; above
-// it, one bucket pass on the top z bits first. The bucket count
-// adapts to the segment (target ~128 pairs per bucket, at most 2^16
-// buckets) so the cursor array stays cache-resident for mid-size
-// segments instead of thrashing on a fixed 64k-entry table.
+// Segments at or below this go straight to std::sort; larger ones use
+// the LSD radix below (comparison sorts of millions of 12-byte pairs
+// with a branchy comparator were ~2-4x slower than counting passes on
+// the single-core builders this runs on).
 constexpr int64_t KSMALL = 1 << 15;
+// NBUCKETS is kept as the `hist` scratch contract with callers
 constexpr int MAX_BUCKET_BITS = 16;
 constexpr int64_t NBUCKETS = 1 << MAX_BUCKET_BITS;
 
-inline int bucket_bits(int64_t len) {
-    int bits = 8;
-    while (bits < MAX_BUCKET_BITS && (len >> bits) > 128) ++bits;
-    return bits;
+// LSD digit width: 2^11 write streams keep the scatter's active cache
+// lines (~128KB) inside L2; 16-bit digits halve the passes but thrash
+// (64k streams x 64B lines = 4MB of hot write lines).
+constexpr int RADIX_BITS = 11;
+constexpr int64_t RADIX_B = (int64_t)1 << RADIX_BITS;
+constexpr int RADIX_PASSES = (63 + RADIX_BITS - 1) / RADIX_BITS;
+
+// Sort one contiguous segment of pairs by (z, idx): stable LSD radix.
+// Input pairs arrive with idx ascending (the bin scatter is stable),
+// and LSD stability preserves that on z ties — identical order to
+// std::sort with pair_less. Constant digits (all rows share one
+// bucket) skip their pass: z3 keys are 63 bits but a time-binned
+// segment's top bits rarely span the full range. `scratch` must hold
+// at least the segment; `hist` at least NBUCKETS+1 entries (only
+// RADIX_PASSES * RADIX_B of it is used).
+// segments above this get one MSD split (top RADIX_BITS) first so the
+// work below runs over (near-)cache-resident sub-runs. Mid-size
+// segments (z3's per-time-bin runs, a few M pairs) measure FASTER
+// under direct LSD than under MSD + per-bucket sorts, so the split
+// only engages for huge single segments (the z2 whole-table sort).
+constexpr int64_t CACHE_PAIRS = 1 << 23;
+
+void sort_segment(Pair* seg, int64_t len, Pair* scratch, int64_t* hist,
+                  int depth = 0);
+
+// One stable MSD pass on an 11-bit window (window lowers with depth so
+// skewed data cannot recurse forever), then recurse into each bucket
+// (whose LSD skips its now-constant upper digits).
+void msd_split(Pair* seg, int64_t len, Pair* scratch, int64_t* hist,
+               int depth) {
+    const int shift = 63 - RADIX_BITS * (depth + 1);
+    for (int64_t b = 0; b <= RADIX_B; ++b) hist[b] = 0;
+    for (int64_t i = 0; i < len; ++i)
+        ++hist[(((uint64_t)seg[i].z >> shift) & (RADIX_B - 1)) + 1];
+    for (int64_t b = 1; b <= RADIX_B; ++b) hist[b] += hist[b - 1];
+    std::vector<int64_t> bounds(hist, hist + RADIX_B + 1);
+    {
+        std::vector<int64_t> cursor(hist, hist + RADIX_B);
+        for (int64_t i = 0; i < len; ++i)
+            scratch[cursor[((uint64_t)seg[i].z >> shift)
+                           & (RADIX_B - 1)]++] = seg[i];
+    }
+    for (int64_t b = 0; b < RADIX_B; ++b) {
+        const int64_t s = bounds[b], e = bounds[b + 1];
+        if (e - s > 1)
+            sort_segment(scratch + s, e - s, seg + s, hist, depth + 1);
+    }
+    std::copy(scratch, scratch + len, seg);
 }
 
-// Sort one contiguous segment of pairs by (z, idx). `scratch` must
-// hold at least the segment; `hist` at least NBUCKETS+1 entries.
-void sort_segment(Pair* seg, int64_t len, Pair* scratch, int64_t* hist) {
+void sort_segment(Pair* seg, int64_t len, Pair* scratch, int64_t* hist,
+                  int depth) {
     if (len <= 1) return;
     if (len <= KSMALL) {
         std::sort(seg, seg + len, pair_less);
         return;
     }
-    const int bits = bucket_bits(len);
-    const int shift = 63 - bits;  // z3 keys are 63 bits, z2 62
-    const int64_t nb = (int64_t)1 << bits;
-    for (int64_t b = 0; b <= nb; ++b) hist[b] = 0;
-    for (int64_t i = 0; i < len; ++i)
-        ++hist[((uint64_t)seg[i].z >> shift) + 1];
-    for (int64_t b = 1; b <= nb; ++b) hist[b] += hist[b - 1];
-    {
-        std::vector<int64_t> cursor(hist, hist + nb);
+    if (len > CACHE_PAIRS && 63 - RADIX_BITS * (depth + 1) >= 0) {
+        msd_split(seg, len, scratch, hist, depth);
+        return;
+    }
+    // one read pass builds every digit's histogram
+    int64_t* h = hist;  // RADIX_PASSES x RADIX_B, zeroed below
+    for (int64_t i = 0; i < RADIX_PASSES * RADIX_B; ++i) h[i] = 0;
+    for (int64_t i = 0; i < len; ++i) {
+        const uint64_t v = (uint64_t)seg[i].z;
+        for (int p = 0; p < RADIX_PASSES; ++p)
+            ++h[p * RADIX_B + ((v >> (p * RADIX_BITS)) & (RADIX_B - 1))];
+    }
+    Pair* src = seg;
+    Pair* dst = scratch;
+    for (int p = 0; p < RADIX_PASSES; ++p) {
+        int64_t* hp = h + p * RADIX_B;
+        // skip constant digits
+        bool constant = false;
+        for (int64_t b = 0; b < RADIX_B; ++b)
+            if (hp[b] == len) { constant = true; break; }
+        if (constant) continue;
+        // exclusive prefix sums -> write cursors
+        int64_t run = 0;
+        for (int64_t b = 0; b < RADIX_B; ++b) {
+            const int64_t cnt = hp[b];
+            hp[b] = run;
+            run += cnt;
+        }
+        const int shift = p * RADIX_BITS;
         for (int64_t i = 0; i < len; ++i)
-            scratch[cursor[(uint64_t)seg[i].z >> shift]++] = seg[i];
+            dst[hp[((uint64_t)src[i].z >> shift) & (RADIX_B - 1)]++] =
+                src[i];
+        std::swap(src, dst);
     }
-    for (int64_t b = 0; b < nb; ++b) {
-        const int64_t s = hist[b], e = hist[b + 1];
-        if (e - s > 1) std::sort(scratch + s, scratch + e, pair_less);
-    }
-    std::copy(scratch, scratch + len, seg);
+    if (src != seg) std::copy(src, src + len, seg);
 }
 
 struct SortCtx {
@@ -260,15 +330,16 @@ extern "C" int64_t geomesa_sort_z(const int64_t* z, int64_t n,
         pairs[(size_t)i].z = z[i];
         pairs[(size_t)i].idx = (int32_t)i;
     }
-    // one segment spanning everything: the MSD bucket pass splits it,
-    // then sub-runs drain in parallel
-    if (n <= KSMALL || t <= 1) {
-        std::vector<Pair> scratch((size_t)n);
-        std::vector<int64_t> hist(NBUCKETS + 1);
-        sort_segment(pairs.data(), n, scratch.data(), hist.data());
+    if (n <= KSMALL) {
+        std::sort(pairs.data(), pairs.data() + n, pair_less);
     } else {
-        // bucket once on thread 0, then parallel-sort the sub-runs
-        const int bits = bucket_bits(n);
+        // one MSD pass on the top RADIX_BITS splits the array into
+        // segments that fit the cache; each segment then LSD-radixes
+        // its remaining bits touching (near-)resident lines only. The
+        // MSD scatter is stable, so segment order == input order and
+        // ties stay lexsort-compatible. Sub-runs drain in parallel
+        // when the host has cores.
+        const int bits = RADIX_BITS;
         const int shift = 63 - bits;
         const int64_t nb = (int64_t)1 << bits;
         std::vector<int64_t> hist((size_t)nb + 1, 0);

@@ -389,17 +389,38 @@ class SqlEngine:
                 else ast.Include()
             results[a] = self.store.query(Query(tables[a], f))
 
+        # COUNT(*)-only inner join: reduce on device, no pair arrays.
+        # Only a well-formed ON (one side the joined alias, the other
+        # the FROM alias) takes the shortcut — anything irregular falls
+        # through to the pair path, which raises the proper errors.
+        if (len(sel.joins) == 1 and not sel.joins[0].outer
+                and not deferred and sel.group_by is None
+                and len(sel.items) == 1 and sel.items[0].agg == "count"
+                and sel.items[0].expr == "*"):
+            j = sel.joins[0]
+            a_alias, a_col = j.left_prop.split(".", 1)
+            b_alias, b_col = j.right_prop.split(".", 1)
+            if {a_alias, b_alias} == {sel.alias, j.alias} \
+                    and a_alias != b_alias:
+                total = self._join_count(
+                    j, results[a_alias], a_col, results[b_alias], b_col,
+                    a_table=tables.get(a_alias))
+                name = sel.items[0].name
+                return SqlResult([name], {name: np.array([total])})
+
         rows: dict[str, np.ndarray] = {
             sel.alias: np.arange(results[sel.alias].n, dtype=np.int64)}
         for j in sel.joins:
-            rows = self._apply_join(j, results, rows)
+            rows = self._apply_join(j, results, rows, tables)
         for a, f in deferred:
             keep = self._post_join_mask(f, results[a], rows[a])
             rows = {k: v[keep] for k, v in rows.items()}
         return self._project_join(sel, results, rows)
 
     def _apply_join(self, join: SqlJoin, results,
-                    rows: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+                    rows: dict[str, np.ndarray],
+                    tables: dict[str, str] | None = None
+                    ) -> dict[str, np.ndarray]:
         """Expand the current result rows by one join: match the new
         table against its anchor alias, repeat matched rows, and (for
         LEFT joins) keep unmatched anchor rows with a -1 (NULL) index."""
@@ -417,8 +438,9 @@ class SqlEngine:
                 f"ON must reference {new!r} and one preceding table")
         if a_alias not in results or b_alias not in results:
             raise ValueError("ON predicate must reference joined tables")
-        pairs = self._join_pairs(join, results[a_alias], a_col,
-                                 results[b_alias], b_col)
+        pairs = self._join_pairs(
+            join, results[a_alias], a_col, results[b_alias], b_col,
+            a_table=(tables or {}).get(a_alias))
         if flip and len(pairs):
             pairs = pairs[:, ::-1]
 
@@ -446,8 +468,62 @@ class SqlEngine:
         out[new] = new_idx
         return out
 
+    def _device_xy(self, table: str, res, a_col: str):
+        """The store's resident device coordinate columns for a query
+        result that covers the FULL table in row order — lets the join
+        kernels skip re-uploading coordinates (at 10M+ rows the
+        host->device transfer costs more than the scan). Returns None
+        when the result is a subset or the store has no resident point
+        scan data."""
+        from ..store.memory import InMemoryDataStore
+        ds = self.store
+        if not isinstance(ds, InMemoryDataStore):
+            return None
+        try:
+            st = ds._state(table)
+        except KeyError:
+            return None
+        if res.n != st.n or not st.sft.is_points:
+            return None
+        if a_col != st.sft.geom_field:
+            return None  # scan_data holds the DEFAULT geometry only
+        st.ensure_index()
+        sd = st.scan_data
+        if sd is None:
+            return None
+        return sd.xhi, sd.yhi
+
+    def _join_count(self, join: SqlJoin, a_res, a_col: str,
+                    b_res, b_col: str, a_table: str | None = None) -> int:
+        """Total match count for one inner join WITHOUT materializing
+        pairs: the count-reduce form of the device kernels, fed the
+        store's resident coordinates when the side covers a full table
+        (SELECT COUNT(*) FROM a JOIN b ON ... never pulls an (n, k)
+        matrix to the host)."""
+        if (a_res.n == 0 or b_res.n == 0
+                or a_res.batch is None or b_res.batch is None):
+            return 0
+        from ..analytics.join import contains_join, dwithin_join
+        if join.kind == "dwithin":
+            ax, ay = _centroids(a_res.batch, a_col)
+            bx, by = _centroids(b_res.batch, b_col)
+            dev = (self._device_xy(a_table, a_res, a_col)
+                   if a_table is not None else None)
+            counts, _ = dwithin_join(ax, ay, bx, by, join.distance,
+                                     counts_only=True, device_xy=dev)
+        else:
+            acol = a_res.batch.col(a_col)
+            if not isinstance(acol, GeometryColumn):
+                raise ValueError("contains join needs a polygon column "
+                                 "as the first ON argument")
+            bx, by = _centroids(b_res.batch, b_col)
+            counts, _ = contains_join(acol.geoms, bx, by,
+                                      counts_only=True)
+        return int(counts.sum())
+
     def _join_pairs(self, join: SqlJoin, a_res, a_col: str,
-                    b_res, b_col: str) -> np.ndarray:
+                    b_res, b_col: str, a_table: str | None = None
+                    ) -> np.ndarray:
         """(a_row, b_row) match pairs in ON-argument order, from the
         tiled device join kernels (analytics/join.py)."""
         if (a_res.n == 0 or b_res.n == 0
@@ -457,7 +533,10 @@ class SqlEngine:
         if join.kind == "dwithin":
             ax, ay = _centroids(a_res.batch, a_col)
             bx, by = _centroids(b_res.batch, b_col)
-            _, pairs = dwithin_join(ax, ay, bx, by, join.distance)
+            dev = (self._device_xy(a_table, a_res, a_col)
+                   if a_table is not None else None)
+            _, pairs = dwithin_join(ax, ay, bx, by, join.distance,
+                                    device_xy=dev)
             # dwithin_join pairs are (a_idx, b_idx)
         else:
             # ST_Contains(a, b): a (polygons) contains b (points)

@@ -31,10 +31,26 @@ class StatsEstimator:
                                   sft.z3_interval)
         self.attr_hist: dict[str, Histogram] = {}
 
+    # write-side stats sample cap: the z3 histogram only ever feeds
+    # RATIO estimates (mass / total_mass), so a strided subsample keeps
+    # selectivity unbiased while the write path stays O(sample) — a
+    # 100M-row ingest must not pay a full z3 re-encode for stats
+    # (the reference's stats are likewise approximate sketches)
+    _Z3_SAMPLE = 1_000_000
+
     def observe(self, batch) -> None:
         self.count.observe(batch)
         if self.z3 is not None:
-            self.z3.observe(batch)
+            if batch.n > self._Z3_SAMPLE:
+                # weight = stride, so masses from batches sampled at
+                # different rates stay comparable (a small unsampled
+                # batch must not outweigh a large strided one)
+                step = batch.n // self._Z3_SAMPLE + 1
+                self.z3.observe(batch.take(
+                    np.arange(0, batch.n, step, dtype=np.int64)),
+                    weight=step)
+            else:
+                self.z3.observe(batch)
 
     def estimate_count(self, f: ast.Filter) -> int | None:
         """Estimated matching features, or None if not estimable."""

@@ -543,7 +543,11 @@ class Z3Histogram(Stat):
         # z bits kept: log2(length) of the leading z3 bits
         self._shift = 63 - int(np.log2(length))
 
-    def observe(self, batch: FeatureBatch) -> None:
+    def observe(self, batch: FeatureBatch, weight: int = 1) -> None:
+        """``weight`` scales this batch's counts — the write path
+        observes a strided subsample of huge batches and passes the
+        stride so masses stay comparable across differently-sampled
+        batches."""
         gcol = batch.col(self.geom)
         if not isinstance(gcol, PointColumn):
             raise TypeError("Z3Histogram requires a point geometry")
@@ -556,16 +560,22 @@ class Z3Histogram(Stat):
         sfc = z3sfc(self.period)
         z = sfc.index(x, y, np.minimum(offs, int(sfc.time.max)), lenient=True)
         cell = (z >> np.uint64(self._shift)).astype(np.int64)
-        # one fused bincount over (time bin, cell) composite keys; the
-        # dense count grid is (max_bin+1) x length ints — a few MB —
-        # and replaces a per-bin mask + bincount pass over the column
-        key = tbins.astype(np.int64) * self.length + cell
+        # one fused bincount over (time bin, cell) composite keys. The
+        # grid is sized by the DISTINCT bins present (np.unique remap),
+        # not by the max absolute bin — keying by tbins.max() made a
+        # single clamped far-future timestamp (bin 32767) allocate a
+        # ~270MB transient regardless of batch size
+        ubins, inv = np.unique(tbins, return_inverse=True)
+        key = inv.astype(np.int64) * self.length + cell
         grid = np.bincount(
-            key, minlength=(int(tbins.max()) + 1) * self.length
-        ).reshape(-1, self.length)
-        for b in np.flatnonzero(grid.any(axis=1)).tolist():
-            arr = self.bins.setdefault(b, np.zeros(self.length, dtype=np.int64))
-            arr += grid[b]
+            key, minlength=len(ubins) * self.length
+        ).reshape(len(ubins), self.length)
+        if weight != 1:
+            grid = grid * int(weight)
+        for j, b in enumerate(ubins.tolist()):
+            arr = self.bins.setdefault(int(b),
+                                       np.zeros(self.length, dtype=np.int64))
+            arr += grid[j]
 
     def count(self, time_bin: int, cell: int) -> int:
         arr = self.bins.get(time_bin)

@@ -61,6 +61,11 @@ HOST_SCAN_ROWS = SystemProperty("geomesa.scan.host.rows", "2000000")
 EXTENT_HOST_SCAN_ROWS = SystemProperty("geomesa.scan.extent.host.rows",
                                        "50000")
 
+# point-in-polygon residuals below this row count stay on the host
+# (vectorized crossing-number, ~tens of M rows/s): a device dispatch
+# pays a round trip that only amortizes over large candidate sets
+_DEVICE_PIP_ROWS = 2_000_000
+
 __all__ = ["InMemoryDataStore", "QueryResult"]
 
 
@@ -79,6 +84,12 @@ class _LazyBatch:
         self.properties = properties
 
     def materialize(self) -> FeatureBatch:
+        if (self.properties is None and len(self.idx) == self.source.n
+                and self.idx[0] == 0 and self.idx[-1] == self.source.n - 1):
+            # full-table result in row order (idx is always sorted):
+            # the immutable source snapshot IS the result — an INCLUDE
+            # scan over 100M rows must not copy every column
+            return self.source
         batch = self.source.take(self.idx)
         if self.properties is not None:
             cols = {p: batch.columns[p] for p in self.properties}
@@ -97,12 +108,22 @@ class QueryResult:
     yields an (empty) batch.
     """
 
-    def __init__(self, ids: np.ndarray, batch, explain: Explainer,
-                 plan: FilterStrategy):
-        self.ids = ids
+    def __init__(self, ids, batch, explain: Explainer,
+                 plan: FilterStrategy, n: int | None = None):
+        # ids may be a thunk: the object-array id gather at 10M+ rows
+        # costs more than many whole queries, and join/count consumers
+        # never read it
+        self._ids = ids
+        self._n = n if n is not None else len(ids)
         self._batch = batch          # FeatureBatch | None | _LazyBatch
         self.explain = explain
         self.plan = plan
+
+    @property
+    def ids(self) -> np.ndarray:
+        if callable(self._ids):
+            self._ids = self._ids()
+        return self._ids
 
     @property
     def batch(self) -> FeatureBatch | None:
@@ -116,7 +137,7 @@ class QueryResult:
 
     @property
     def n(self) -> int:
-        return len(self.ids)
+        return self._n
 
     def features(self) -> Iterator[dict[str, Any]]:
         if self.batch is None:
@@ -144,11 +165,12 @@ class _TypeState:
         self._batch: FeatureBatch | None = None
         self._pending: list[tuple[FeatureBatch, np.ndarray]] = []
         self._pending_n = 0
-        self.scan_data: zscan.DeviceScanData | None = None
+        self._scan_data: zscan.DeviceScanData | None = None
+        self._scan_thunk = None  # deferred device build (see scan_data)
         self.extent_data = None  # gscan.ExtentScanData for non-points
         self.zindex = None       # index.zkeys.ZKeyIndex for points
-        self.host_xhi: np.ndarray | None = None
-        self.host_yhi: np.ndarray | None = None
+        self._host_xhi: np.ndarray | None = None
+        self._host_yhi: np.ndarray | None = None
         # lazily-built sorted attribute indexes (AttributeIndex analog)
         self.attr_idx: dict[str, Any] = {}
         # lazy device uploads of attribute columns for residual kernels
@@ -163,6 +185,55 @@ class _TypeState:
         # persisted sort orders to install into the next-built zindex
         # (fs-store index sidecars); consumed by ensure_index
         self.zindex_warm: dict | None = None
+
+    @property
+    def scan_data(self):
+        """The device point-scan arrays, uploaded ON FIRST DEVICE USE:
+        ensure_index defers the host->device column transfer (the
+        dominant cold-start cost at 100M rows) so selective queries
+        answered by the host z-index fast path never pay it. Reading
+        this property materializes the upload."""
+        if self._scan_data is None and self._scan_thunk is not None:
+            self._scan_data = self._scan_thunk()
+            self._scan_thunk = None
+        return self._scan_data
+
+    @scan_data.setter
+    def scan_data(self, value):
+        self._scan_data = value
+        self._scan_thunk = None
+
+    def _deferred_scan_build(self):
+        """Thunk over the CURRENT batch: reads state at materialize
+        time, so successive deferred extends just re-defer."""
+        def build():
+            geom = self.sft.geom_field
+            dtg = self.sft.dtg_field
+            col = self._batch.col(geom)
+            millis = (self._batch.col(dtg).millis if dtg is not None
+                      else np.zeros(self._batch.n, dtype=np.int64))
+            return zscan.build_scan_data(col.x, col.y, millis)
+        return build
+
+    @property
+    def host_xhi(self) -> np.ndarray | None:
+        self._ensure_host_split()
+        return self._host_xhi
+
+    @property
+    def host_yhi(self) -> np.ndarray | None:
+        self._ensure_host_split()
+        return self._host_yhi
+
+    def _ensure_host_split(self):
+        """Two-float hi parts of the coordinates, built on first use by
+        the boundary-patch/device tiers (deferred like scan_data)."""
+        if (self._host_xhi is None and self._batch is not None
+                and self.sft.geom_field is not None):
+            col = self._batch.col(self.sft.geom_field)
+            if isinstance(col, PointColumn):
+                self._host_xhi, _ = zscan.split_two_float(col.x)
+                self._host_yhi, _ = zscan.split_two_float(col.y)
 
     @property
     def n(self) -> int:
@@ -195,9 +266,11 @@ class _TypeState:
         self._pending_n += batch.n
 
     def has_point_scan(self) -> bool:
-        """Whether a device point-scan structure is built (subclasses
-        redefine what that structure is — e.g. mesh-sharded segments)."""
-        return self.scan_data is not None
+        """Whether a device point-scan structure is built or deferred
+        (subclasses redefine what that structure is — e.g. mesh-sharded
+        segments). Checking must NOT force the deferred upload."""
+        return (self._scan_data is not None
+                or self._scan_thunk is not None)
 
     def has_extent_scan(self) -> bool:
         return self.extent_data is not None
@@ -254,6 +327,14 @@ class _TypeState:
         the state dirty so the next read rebuilds from scratch."""
         dxhi, dxlo = zscan.split_two_float(col.x)
         dyhi, dylo = zscan.split_two_float(col.y)
+        if self._scan_data is None and self._scan_thunk is not None:
+            # device build still deferred: extend the host split (when
+            # materialized) and re-defer over the merged batch
+            if self._host_xhi is not None:
+                self._host_xhi = np.concatenate([self._host_xhi, dxhi])
+                self._host_yhi = np.concatenate([self._host_yhi, dyhi])
+            self._scan_thunk = self._deferred_scan_build()
+            return True
         scan_data = zscan.extend_scan_data(
             self.scan_data, col.x, col.y, dmillis,
             xy_split=(dxhi, dxlo, dyhi, dylo))
@@ -269,8 +350,9 @@ class _TypeState:
                 cap=zscan.next_pow2(self._batch.n + 1))
         # all structures built: publish atomically
         self.scan_data = scan_data
-        self.host_xhi = np.concatenate([self.host_xhi, dxhi])
-        self.host_yhi = np.concatenate([self.host_yhi, dyhi])
+        if self._host_xhi is not None:
+            self._host_xhi = np.concatenate([self._host_xhi, dxhi])
+            self._host_yhi = np.concatenate([self._host_yhi, dyhi])
         return True
 
     def delete(self, ids: set[str]):
@@ -337,15 +419,13 @@ class _TypeState:
         self.extent_data = None
 
     def _build_point_index(self, x, y, millis):
-        # split on host ONCE and hand the pairs to the device build:
-        # fetching xhi/yhi back off the device would round-trip two
-        # full columns through the interconnect at 100M rows
-        xhi, xlo = zscan.split_two_float(x)
-        yhi, ylo = zscan.split_two_float(y)
-        self.scan_data = zscan.build_scan_data(
-            x, y, millis, xy_split=(xhi, xlo, yhi, ylo))
-        self.host_xhi = xhi
-        self.host_yhi = yhi
+        # DEFER both the host two-float split (only the boundary-patch
+        # pass reads the hi parts) and the device upload: a selective
+        # first query resolves on the host z-index and pays neither
+        self._host_xhi = None
+        self._host_yhi = None
+        self._scan_data = None
+        self._scan_thunk = self._deferred_scan_build()
 
     def _build_extent_index(self, bounds, millis):
         self.extent_data = gscan.build_extent_data(bounds, millis)
@@ -642,7 +722,14 @@ class InMemoryDataStore(DataStore):
         if q.max_features is not None:
             idx = idx[:q.max_features]
 
-        ids = st.batch.ids[idx]
+        if len(idx) <= 10_000:
+            ids = st.batch.ids[idx]
+        else:
+            # deferred gather against the immutable batch snapshot:
+            # large results are often consumed via batch columns (or
+            # only counted) and never read ids at all
+            src = st.batch
+            ids = (lambda: src.ids[idx])
         if q.properties is not None:
             # validate projection names NOW: errors belong to query(),
             # not to whenever (or whether) .batch is first read
@@ -657,13 +744,13 @@ class InMemoryDataStore(DataStore):
             # small results materialize eagerly: the copy is trivial and
             # an unread result must not pin the multi-GB table snapshot
             batch = batch.materialize()
-        explain(f"Hits: {len(ids)}").pop()
+        explain(f"Hits: {len(idx)}").pop()
         if self.audit is not None:
             self.audit.record(q.type_name, str(q.filter), q.hints,
                               round(t_plan * 1000, 3),
                               round((_time.perf_counter() - t_scan0) * 1000, 3),
-                              len(ids))
-        return QueryResult(ids, batch, explain, strategy)
+                              len(idx))
+        return QueryResult(ids, batch, explain, strategy, n=len(idx))
 
     def query_count(self, q: Query | str,
                     type_name: str | None = None) -> int:
@@ -997,6 +1084,12 @@ class InMemoryDataStore(DataStore):
             return None
         g = spatial_f.geom
         if not isinstance(g, (Polygon, MultiPolygon)):
+            return None
+        if len(candidates) < _DEVICE_PIP_ROWS:
+            # a device dispatch costs a round trip (~100ms through a
+            # tunnel); the vectorized host crossing-number test clears
+            # small candidate sets orders of magnitude sooner — the
+            # selective ST_Contains hot loop must stay host-side
             return None
         px = col.x[candidates]
         py = col.y[candidates]

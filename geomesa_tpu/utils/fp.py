@@ -21,6 +21,22 @@ def f32_band(r: float, coord_span: float) -> tuple[float, float]:
     coordinate magnitudes (360 for degrees).
     """
     r2 = r * r
-    # relative error of the f32 computation ~ 4 ulp on terms of size span^2
-    err = 8.0 * float(np.finfo(np.float32).eps) * max(coord_span * coord_span, r2)
+    eps = float(np.finfo(np.float32).eps)
+    # The band only has to be valid for pairs whose f32 d2 lands NEAR
+    # r^2 — and for those, |dx| and |dy| are bounded by ~r, not by the
+    # coordinate span. Per-coordinate f64->f32 rounding plus the f32
+    # subtraction give |dx_f32 - dx| <= E with E ~ eps*span/2 (two
+    # half-ulp roundings of span/2-sized values + one ulp on the
+    # difference); we take E = eps*span for slack. Then
+    #   |d2_f32 - d2| <= 2(|dx|+|dy|)E + 2E^2 + 4 eps B^2
+    # with |dx|,|dy| <= B = sqrt(r2 + err). Solve by one fixed-point
+    # iteration from B = r (handles r ~ 0, where B ~ sqrt(2)*E).
+    #
+    # The old bound used max(span^2, r^2), which for r << span made the
+    # band wider than r^2 itself (r2_lo = 0): every true hit became a
+    # "maybe" and the entire join count fell to the host recheck path.
+    E = eps * coord_span
+    err = 4.0 * r * E + 2.0 * E * E + 4.0 * eps * r2
+    B = float(np.sqrt(r2 + err))
+    err = 4.0 * B * E + 2.0 * E * E + 4.0 * eps * B * B
     return r2 + err, max(r2 - err, 0.0)

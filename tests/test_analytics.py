@@ -117,6 +117,49 @@ class TestJoins:
         assert np.allclose(np.sort(d) ** 2, want, rtol=1e-12)
         assert len(set(idx.tolist())) == 100
 
+    def test_dwithin_join_device_xy_padded(self):
+        """Resident device columns may be capacity-padded past n; the
+        padded rows (garbage coordinates) must never match."""
+        import jax.numpy as jnp
+        rng = np.random.default_rng(23)
+        px = rng.uniform(-10, 10, 5_000)
+        py = rng.uniform(-10, 10, 5_000)
+        qx = rng.uniform(-10, 10, 64)
+        qy = rng.uniform(-10, 10, 64)
+        r = 0.5
+        # pad with values INSIDE the query area to catch missing masks
+        pad = 1000
+        dev = (jnp.asarray(np.concatenate(
+                   [px, np.zeros(pad)]).astype(np.float32)),
+               jnp.asarray(np.concatenate(
+                   [py, np.zeros(pad)]).astype(np.float32)))
+        d2 = ((px[:, None] - qx[None, :]) ** 2
+              + (py[:, None] - qy[None, :]) ** 2)
+        expect = (d2 <= r * r)
+        counts, _ = dwithin_join(px, py, qx, qy, r, counts_only=True,
+                                 device_xy=dev)
+        assert np.array_equal(counts, expect.sum(axis=0))
+        counts2, pairs = dwithin_join(px, py, qx, qy, r, device_xy=dev)
+        assert np.array_equal(counts2, expect.sum(axis=0))
+        assert set(map(tuple, pairs.tolist())) == \
+            set(zip(*np.nonzero(expect)))
+
+    def test_knn_device_xy_padded(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(29)
+        px = rng.uniform(-10, 10, 3_000)
+        py = rng.uniform(-10, 10, 3_000)
+        # padded rows sit exactly at the query point: would win every
+        # neighbour slot if not masked
+        dev = (jnp.asarray(np.concatenate(
+                   [px, np.full(500, 1.0)]).astype(np.float32)),
+               jnp.asarray(np.concatenate(
+                   [py, np.full(500, 2.0)]).astype(np.float32)))
+        d, idx = knn(px, py, 1.0, 2.0, 10, device_xy=dev)
+        d2 = (px - 1.0) ** 2 + (py - 2.0) ** 2
+        assert np.allclose(np.sort(d) ** 2, np.sort(d2)[:10], rtol=1e-12)
+        assert (idx < 3_000).all()
+
 
 class TestProcesses:
     @pytest.fixture(scope="class")

@@ -160,6 +160,18 @@ class TestSpatialJoin:
         want = int((d2 <= 0.04).sum())
         assert int(res.column("count(*)")[0]) == want
 
+    def test_join_count_fast_path_matches_pairs(self, engine):
+        """COUNT(*) (device count-reduce, no pair arrays) must agree
+        with COUNT(qualified) (pair materialization) on an inner join
+        with no NULLs."""
+        fast = engine.query(
+            "SELECT COUNT(*) FROM gdelt a JOIN gdelt b "
+            "ON ST_DWithin(a.geom, b.geom, 0.2)")
+        slow = engine.query(
+            "SELECT COUNT(b.__fid__) AS c FROM gdelt a JOIN gdelt b "
+            "ON ST_DWithin(a.geom, b.geom, 0.2)")
+        assert int(fast.column("count(*)")[0]) == int(slow.column("c")[0])
+
 
 class TestSemantics:
     def test_st_equals_is_exact(self):

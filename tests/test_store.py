@@ -163,6 +163,42 @@ class TestStoreLifecycle:
         res = ds.query("BBOX(geom, 0, 0, 1, 1)", "t")
         assert res.n == 0
 
+    def test_large_result_ids_survive_later_writes(self):
+        """ids materialize lazily for large results; the deferred
+        gather must read the snapshot taken at query time, not state
+        mutated afterwards."""
+        ds = InMemoryDataStore()
+        ds.create_schema("t", "dtg:Date,*geom:Point")
+        n = 20_000  # > the eager-ids threshold
+        rng = np.random.default_rng(5)
+        ds.write_dict("t", [f"r{i}" for i in range(n)], {
+            "dtg": np.full(n, MS("2017-01-01")),
+            "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n)),
+        })
+        res = ds.query("BBOX(geom, -10, -10, 10, 10)", "t")
+        assert res.n == n
+        ds.write_dict("t", ["extra"], {
+            "dtg": [MS("2017-01-02")], "geom": ([0.0], [0.0])})
+        # first .ids read happens after the write
+        assert len(res.ids) == n
+        assert set(res.ids.astype(str)) == {f"r{i}" for i in range(n)}
+
+    def test_full_table_result_shares_source_batch(self):
+        """An INCLUDE query's batch is the immutable source snapshot,
+        not a copy (join/aggregation inputs at 100M rows must not pay
+        per-column duplication)."""
+        ds = InMemoryDataStore()
+        ds.create_schema("t", "v:Integer,*geom:Point")
+        n = 20_000
+        rng = np.random.default_rng(6)
+        ds.write_dict("t", [f"r{i}" for i in range(n)], {
+            "v": rng.integers(0, 9, n),
+            "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n)),
+        })
+        src = ds._state("t").batch
+        res = ds.query(Query("t", "INCLUDE"))
+        assert res.batch is src
+
 
 class TestReviewRegressions:
     def test_quoted_date_string_on_z3_path(self):
