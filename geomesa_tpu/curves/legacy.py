@@ -97,6 +97,38 @@ class LegacyZ3SFC:
         return (self.lon.denormalize(xi), self.lat.denormalize(yi),
                 self.time.denormalize(ti).astype(np.int64))
 
+    # bit width per dimension for range decomposition (time uses only
+    # 20 bits but the interleave reserves 21; covering ranges over the
+    # 21-bit cube remain correct since legacy time cells never exceed
+    # 2^20-1)
+    precision = zorder.Z3_BITS
+
+    def ranges(self, xy, t, precision: int = 64,
+               max_ranges: int | None = None) -> np.ndarray:
+        """Covering z ranges under the LEGACY ceil normalization, so a
+        versioned (v1) index prunes with the same cells its writer
+        used. Monotonicity of ceil makes [normalize(lo), normalize(hi)]
+        a valid cell cover of [lo, hi]."""
+        from .zranges import merge_ranges as _merge_ranges
+        from .zranges import zranges as _zranges
+
+        def norm(dim, v):
+            return int(np.clip(dim.normalize(v), 0, dim.precision))
+
+        out = []
+        for (xmin, ymin, xmax, ymax) in xy:
+            for (tmin, tmax) in t:
+                lo = (norm(self.lon, xmin), norm(self.lat, ymin),
+                      norm(self.time, tmin))
+                hi = (norm(self.lon, xmax), norm(self.lat, ymax),
+                      norm(self.time, tmax))
+                out.append(_zranges(lo, hi, self.precision,
+                                    precision=precision,
+                                    max_ranges=max_ranges))
+        if not out:
+            return np.empty((0, 2), dtype=np.int64)
+        return _merge_ranges(np.concatenate(out, axis=0))
+
 
 _CACHE: dict[TimePeriod, LegacyZ3SFC] = {}
 

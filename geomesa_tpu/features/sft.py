@@ -45,7 +45,13 @@ class Configs:
     ATTR_SPLITS = "geomesa.attr.splits"
     LOGICAL_TIME = "geomesa.logical.time"
     KEYWORDS = "geomesa.keywords"
+    INDEX_VERSION = "geomesa.index.version"
 
+
+# current z-index layout version (see SimpleFeatureType.index_version)
+CURRENT_INDEX_VERSION = 2
+# versions a store can read or migrate to (1 = legacy curve)
+KNOWN_INDEX_VERSIONS = frozenset({1, CURRENT_INDEX_VERSION})
 
 GEOMETRY_TYPES = {
     "Point", "LineString", "Polygon", "MultiPoint", "MultiLineString",
@@ -170,6 +176,17 @@ class SimpleFeatureType:
     @property
     def z3_interval(self) -> TimePeriod:
         return TimePeriod.parse(self.user_data.get(Configs.Z3_INTERVAL, "week"))
+
+    @property
+    def index_version(self) -> int:
+        """Z-index layout version (GeoMesaFeatureIndex keys table names
+        by version, GeoMesaFeatureIndex.scala:33-35): v1 = legacy
+        semi-normalized z3 curve (curves/legacy.py), v2 = current
+        floor-normalized curves. Stores persist it in metadata so a
+        reopened table keeps reading with its writer's layout until a
+        reindex migrates it."""
+        return int(self.user_data.get(Configs.INDEX_VERSION,
+                                      CURRENT_INDEX_VERSION))
 
     @property
     def xz_precision(self) -> int:

@@ -295,12 +295,17 @@ class ZKeyIndex:
 
     def __init__(self, x: np.ndarray, y: np.ndarray,
                  millis: np.ndarray | None,
-                 period: TimePeriod | str = TimePeriod.WEEK):
+                 period: TimePeriod | str = TimePeriod.WEEK,
+                 version: int = 2):
         self._x = np.asarray(x, dtype=np.float64)
         self._y = np.asarray(y, dtype=np.float64)
         self._millis = (None if millis is None
                         else np.asarray(millis, dtype=np.int64))
         self.period = TimePeriod.parse(period)
+        # index layout version: 1 = legacy semi-normalized z3 curve
+        # (curves/legacy.py), 2 = current. Sort orders and query ranges
+        # must use the SAME curve or pruning silently drops rows.
+        self.version = int(version)
         self.n = len(self._x)
         self._z3 = None  # (ubins, seg_offsets, z_sorted, perm)
         self._z2 = None  # (z_sorted, perm)
@@ -325,15 +330,24 @@ class ZKeyIndex:
                 "the mesh-distributed store instead")
         return np.int32
 
+    def _sfc3(self):
+        """The z3 curve for this index's layout version."""
+        if self.version == 1:
+            from ..curves.legacy import legacy_z3sfc
+            return legacy_z3sfc(self.period)
+        return z3sfc(self.period)
+
     def _build_z3(self):
         if self._z3 is not None or self._millis is None:
             return self._z3
-        fused = _native_encode_binned_z3(self._x, self._y, self._millis,
-                                         self.period)
+        # the fused native encode implements only the CURRENT curve
+        fused = (_native_encode_binned_z3(self._x, self._y, self._millis,
+                                          self.period)
+                 if self.version != 1 else None)
         if fused is not None:
             bins, z = fused
         else:
-            sfc = z3sfc(self.period)
+            sfc = self._sfc3()
             bins, offs = timebin.to_binned(self._millis, self.period,
                                            lenient=True)
             z = sfc.index(self._x, self._y, offs.astype(np.float64),
@@ -382,12 +396,21 @@ class ZKeyIndex:
         if self._z2 is not None:
             z_sorted, perm = self._z2
             out.update(z2_zsorted=z_sorted, z2_perm=perm)
+        if out:
+            out["index_version"] = np.array([self.version],
+                                            dtype=np.int64)
         return out
 
     def load_state(self, state: dict) -> bool:
         """Install persisted sort orders (possibly memory-mapped).
         Returns False — installing nothing — when the arrays don't
-        cover this table's rows (stale sidecar after writes)."""
+        cover this table's rows (stale sidecar after writes) or were
+        built under a different index layout version (a reindexed
+        table must not adopt its pre-migration sort orders)."""
+        persisted_v = int(np.asarray(
+            state.get("index_version", [2]))[0])
+        if persisted_v != self.version:
+            return False
         ok = False
         if "z3_zsorted" in state and self._millis is not None:
             z_sorted, perm = state["z3_zsorted"], state["z3_perm"]
@@ -420,6 +443,7 @@ class ZKeyIndex:
         out._millis = (None if millis is None else np.concatenate(
             [self._millis, np.asarray(millis, dtype=np.int64)]))
         out.period = self.period
+        out.version = self.version
         out.n = len(out._x)
         out._perm_dtype()  # enforce the row cap before any merge work
         # built coord copies merge via the same inserts (delta-sized
@@ -454,7 +478,7 @@ class ZKeyIndex:
     def _merged_z3(self, x, y, millis):
         """Returns ((ubins, seg_offsets, z_sorted, perm), coords)."""
         ubins, seg_offsets, z_sorted, perm = self._z3
-        sfc = z3sfc(self.period)
+        sfc = self._sfc3()
         x = np.asarray(x, dtype=np.float64)
         y = np.asarray(y, dtype=np.float64)
         millis = np.asarray(millis, dtype=np.int64)
@@ -537,7 +561,7 @@ class ZKeyIndex:
             if built is None:
                 return None, None
             ubins, seg_offsets, z_sorted, perm = built
-            sfc = z3sfc(self.period)
+            sfc = self._sfc3()
             pos = binned_candidate_positions(
                 ubins, seg_offsets, z_sorted, intervals_ms, self.period,
                 lambda key: sfc.ranges(boxes, [key],
@@ -593,7 +617,7 @@ class ZKeyIndex:
         if built is None:
             return None
         ubins, seg_offsets, z_sorted, perm = built
-        sfc = z3sfc(self.period)
+        sfc = self._sfc3()
         pos = binned_candidate_positions(
             ubins, seg_offsets, z_sorted, intervals_ms, self.period,
             lambda key: sfc.ranges(boxes, [key], max_ranges=max_ranges),

@@ -12,7 +12,8 @@ from .mesh import (DistributedExtentData, DistributedScanData, data_mesh,
                    distributed_count, distributed_density,
                    distributed_histogram, distributed_minmax,
                    distributed_scan_mask, distributed_tristate,
-                   exact_host_mask, shard_extent_data, shard_scan_data)
+                   exact_hit_rows, exact_host_mask, shard_extent_data,
+                   shard_scan_data)
 from .ring import (distributed_knn, ring_dwithin_counts, shard_points,
                    shard_points_split)
 
@@ -20,6 +21,7 @@ __all__ = ["DistributedExtentData", "DistributedScanData", "data_mesh",
            "distributed_count", "distributed_density",
            "distributed_histogram", "distributed_minmax",
            "distributed_scan_mask", "distributed_tristate",
-           "exact_host_mask", "shard_extent_data", "shard_scan_data",
+           "exact_hit_rows", "exact_host_mask", "shard_extent_data",
+           "shard_scan_data",
            "distributed_knn", "ring_dwithin_counts", "shard_points",
            "shard_points_split"]

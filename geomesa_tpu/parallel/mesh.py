@@ -142,14 +142,51 @@ def exact_host_mask(data: DistributedScanData, q: zscan.ScanQuery) -> np.ndarray
                              data.host_millis, q)
 
 
-def _exact_count_adjustment(data: DistributedScanData,
-                            q: zscan.ScanQuery) -> int:
-    """Difference between exact-f64 and two-float verdicts over the
-    boundary candidates (time is exact in both, so only spatial flips)."""
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _mask_hit_rows(mask, cap):
+    """Device-side compaction of a (possibly sharded) scan mask: only
+    the hit row ids come back. fill = len(mask), filtered by the
+    caller's n bound (padding rows are also >= n)."""
+    return jnp.nonzero(mask, size=cap, fill_value=mask.shape[0])[0]
+
+
+def exact_hit_rows(data: DistributedScanData,
+                   q: zscan.ScanQuery) -> np.ndarray:
+    """Sorted matching row ids with the exact f64 boundary patch —
+    count-then-compact on device, so host work and transfers are
+    O(hits + boundary candidates), never a full-length mask (the
+    materializing analog of distributed_count's psum shape)."""
+    mask = distributed_scan_mask(data, q)
+    # int32 is the real contract: single-table row counts are capped
+    # below 2^31 (ZKeyIndex._perm_dtype)
+    total = int(jnp.sum(mask, dtype=jnp.int32))
+    if total:
+        cap = 1 << (total - 1).bit_length()
+        rows = np.asarray(_mask_hit_rows(mask, cap)).astype(np.int64)
+        rows = rows[rows < data.n]
+    else:
+        rows = np.empty(0, dtype=np.int64)
+    # boundary patch in ROW-SET space: recompute the two-float verdict
+    # on host for just the boundary candidates, compare with exact f64,
+    # and add/remove the flipped rows
     cand = zscan.boundary_candidates(data.host_xhi, data.host_yhi, q)
-    if len(cand) == 0:
-        return 0
-    # two-float verdict, recomputed on host with identical arithmetic
+    if len(cand):
+        dev, exact = _boundary_verdicts(data, q, cand)
+        add = cand[exact & ~dev]
+        remove = cand[dev & ~exact]
+        if len(remove):
+            rows = np.setdiff1d(rows, remove, assume_unique=True)
+        if len(add):
+            rows = np.union1d(rows, add)
+    # already sorted: nonzero indices ascend, setdiff1d preserves the
+    # (sorted) input order, union1d sorts
+    return rows
+
+
+def _boundary_verdicts(data: DistributedScanData, q: zscan.ScanQuery,
+                       cand: np.ndarray):
+    """(two_float, exact_f64) bool verdicts for the candidate rows,
+    with identical arithmetic to the device kernel for the former."""
     dev = np.zeros(len(cand), dtype=bool)
     xhi, xlo = zscan.split_two_float(data.host_x[cand])
     yhi, ylo = zscan.split_two_float(data.host_y[cand])
@@ -172,6 +209,17 @@ def _exact_count_adjustment(data: DistributedScanData,
             t_ok |= (cm >= lo) & (cm <= hi)
         dev &= t_ok
         exact &= t_ok
+    return dev, exact
+
+
+def _exact_count_adjustment(data: DistributedScanData,
+                            q: zscan.ScanQuery) -> int:
+    """Difference between exact-f64 and two-float verdicts over the
+    boundary candidates (time is exact in both, so only spatial flips)."""
+    cand = zscan.boundary_candidates(data.host_xhi, data.host_yhi, q)
+    if len(cand) == 0:
+        return 0
+    dev, exact = _boundary_verdicts(data, q, cand)
     return int(exact.sum()) - int(dev.sum())
 
 

@@ -493,6 +493,44 @@ class FileSystemDataStore(DataStore):
         mem = self._load(st, self._files_for(st, None))
         return mem.count(type_name)
 
+    def reindex(self, type_name: str, to_version: int | None = None):
+        """Migrate the type's z-index layout: record the new version in
+        the durable metadata, drop the old version's sidecars (their
+        sort orders are meaningless under the new curve — load_state
+        also rejects them by version), and rebuild loaded stores."""
+        import shutil
+        from ..features.sft import (CURRENT_INDEX_VERSION,
+                                    KNOWN_INDEX_VERSIONS, Configs)
+        if to_version is None:
+            to_version = CURRENT_INDEX_VERSION
+        if int(to_version) not in KNOWN_INDEX_VERSIONS:
+            raise ValueError(f"unknown index version {to_version}; "
+                             f"known: {sorted(KNOWN_INDEX_VERSIONS)}")
+        st = self._state(type_name)
+        if st.sft.index_version == int(to_version):
+            return
+        st.sft.user_data[Configs.INDEX_VERSION] = int(to_version)
+        meta_path = os.path.join(st.root, "metadata.json")
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+        meta["spec"] = st.sft.to_spec()
+        tmp = meta_path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(meta, fh, indent=2)
+        os.replace(tmp, meta_path)
+        shutil.rmtree(st.index_dir, ignore_errors=True)
+        # loaded stores may share the sft object (full loads) or hold a
+        # projected copy; set the version on each and mark dirty so the
+        # next read rebuilds under the new curve
+        for mem in st.cache.values():
+            try:
+                ms = mem._state(type_name)
+            except KeyError:
+                continue
+            ms.sft.user_data[Configs.INDEX_VERSION] = int(to_version)
+            ms.dirty = True
+        st.pending_sidecar.clear()
+
     def compact(self, type_name: str):
         """Merge each partition's files into one (fs/tools/compact analog)."""
         import pyarrow as pa

@@ -408,7 +408,8 @@ class _TypeState:
         from ..index.zkeys import ZKeyIndex
         self.zindex = ZKeyIndex(x, y,
                                 millis if dtg is not None else None,
-                                self.sft.z3_interval)
+                                self.sft.z3_interval,
+                                version=self.sft.index_version)
         if self.zindex_warm is not None:
             self.zindex.load_state(self.zindex_warm)  # no-op when stale
             self.zindex_warm = None
@@ -540,6 +541,29 @@ class InMemoryDataStore(DataStore):
 
     def count(self, type_name: str) -> int:
         return self._state(type_name).n
+
+    def reindex(self, type_name: str, to_version: int | None = None):
+        """Migrate the type's z-index layout to ``to_version`` (the
+        WriteIndexJob / AttributeIndexJob reindex analog,
+        jobs/accumulo/AttributeIndexJob; GeoMesaFeatureIndex.scala:33-35
+        versioned tables): rebuild the sort orders under the new
+        curve and swap them in atomically — the old index serves every
+        query until the swap."""
+        from ..features.sft import (CURRENT_INDEX_VERSION,
+                                    KNOWN_INDEX_VERSIONS, Configs)
+        if to_version is None:
+            to_version = CURRENT_INDEX_VERSION
+        if int(to_version) not in KNOWN_INDEX_VERSIONS:
+            raise ValueError(f"unknown index version {to_version}; "
+                             f"known: {sorted(KNOWN_INDEX_VERSIONS)}")
+        st = self._state(type_name)
+        if st.sft.index_version == int(to_version):
+            return
+        st.sft.user_data[Configs.INDEX_VERSION] = int(to_version)
+        if st.batch is None or st.n == 0:
+            return
+        st.dirty = True
+        st.ensure_index()  # rebuild + atomic swap
 
     def analyze(self, type_name: str):
         """Recompute stats from scratch (stats are additive on write and

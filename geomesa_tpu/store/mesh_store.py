@@ -43,7 +43,7 @@ from ..index.api import Explainer, Query, QueryHints
 from ..parallel import (DistributedScanData, data_mesh, distributed_count,
                         distributed_density, distributed_histogram,
                         distributed_knn, distributed_tristate,
-                        exact_host_mask, shard_extent_data,
+                        exact_hit_rows, shard_extent_data,
                         shard_points_split, shard_scan_data)
 from ..scan import zscan
 from .memory import (HOST_SCAN_ROWS, InMemoryDataStore, _TypeState,
@@ -149,12 +149,17 @@ class DistributedDataStore(InMemoryDataStore):
     def _scan_dense(self, st: _MeshTypeState, sq: zscan.ScanQuery,
                     explain: Explainer, nb: int, ni: int) -> np.ndarray:
         """Dense tier: the fused kernel shard-locally on every device,
-        per segment, with the exact f64 boundary patch."""
+        per segment, compacted ON DEVICE to hit row ids (count-then-
+        allocate; O(hits) host work, never a full-length mask) with the
+        exact f64 boundary patch applied in row-set space."""
         explain(f"Distributed scan over {self.mesh.devices.size} "
                 f"device(s), {len(st.segments)} segment(s), n={st.n}, "
                 f"{nb} box(es), {ni} interval(s)")
-        masks = [exact_host_mask(seg, sq) for seg in st.segments]
-        return np.flatnonzero(np.concatenate(masks))
+        offs = st.segment_offsets()[:-1]
+        parts = [exact_hit_rows(seg, sq) + off
+                 for seg, off in zip(st.segments, offs)]
+        return (np.concatenate(parts) if parts
+                else np.empty(0, dtype=np.int64))
 
     def _extent_states(self, st: _MeshTypeState, eq) -> np.ndarray:
         return np.concatenate([distributed_tristate(seg, eq)

@@ -227,6 +227,19 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_reindex(args) -> int:
+    """Migrate a type's z-index layout version (the reference's
+    reindex/WriteIndexJob: rebuild index tables at the current layout
+    while the old ones keep serving)."""
+    from ..features.sft import CURRENT_INDEX_VERSION
+    ds = _store(args)
+    before = ds.get_schema(args.name).index_version
+    to = args.index_version or CURRENT_INDEX_VERSION
+    ds.reindex(args.name, to)
+    print(f"reindexed {args.name}: v{before} -> v{to}")
+    return 0
+
+
 def cmd_version(args) -> int:
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -274,6 +287,10 @@ def main(argv=None) -> int:
         (["--max-features"], {"type": int, "default": None,
                               "dest": "max_features"}))
     add("count", cmd_count, name_arg, cql_arg)
+    add("reindex", cmd_reindex, name_arg,
+        (["--index-version"], {"type": int, "default": None,
+                               "help": "target layout version "
+                                       "(default: current)"}))
     add("explain", cmd_explain, name_arg,
         (["--cql"], {"required": True}))
     add("stats", cmd_stats, name_arg, cql_arg,

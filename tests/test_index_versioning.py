@@ -1,0 +1,141 @@
+"""Index layout versioning + migration (GeoMesaFeatureIndex versioned
+tables, GeoMesaFeatureIndex.scala:33-35; legacy curve retention,
+accumulo/index/legacy/): a v1 (legacy semi-normalized z3 curve) table
+must answer queries correctly, keep its layout across reopen, and
+migrate in place via reindex while staying correct throughout."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.features.sft import CURRENT_INDEX_VERSION, Configs
+from geomesa_tpu.index.zkeys import ZKeyIndex
+from geomesa_tpu.store import InMemoryDataStore
+from geomesa_tpu.store.fs import FileSystemDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+SPEC_V1 = ("kind:String,dtg:Date,*geom:Point:srid=4326;"
+           "geomesa.index.version='1'")
+ECQL = ("BBOX(geom, -10, -10, 10, 10) AND "
+        "dtg DURING 2017-01-02T00:00:00Z/2017-01-05T00:00:00Z")
+
+
+def _sample(n=20_000, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    ms = rng.integers(MS("2017-01-01"), MS("2017-01-20"), n)
+    return x, y, ms
+
+
+def _expect(x, y, ms):
+    hit = ((x >= -10) & (x <= 10) & (y >= -10) & (y <= 10)
+           & (ms > MS("2017-01-02")) & (ms < MS("2017-01-05")))
+    return set(np.flatnonzero(hit).tolist())
+
+
+class TestVersionedZKeyIndex:
+    def test_v1_ranges_prune_with_legacy_curve(self):
+        """A v1 index sorts by the LEGACY curve; query_rows must return
+        exactly the brute-force rows (ranges and keys share the
+        curve)."""
+        x, y, ms = _sample()
+        zi = ZKeyIndex(x, y, ms, "week", version=1)
+        kind, rows = zi.query_rows(
+            "z3", [(-10.0, -10.0, 10.0, 10.0)],
+            [(MS("2017-01-02") + 1, MS("2017-01-05") - 1)],
+            len(x), len(x))
+        assert kind == "exact"
+        got = set(np.asarray(rows).tolist())
+        assert got == _expect(x, y, ms)
+
+    def test_v1_and_v2_sort_orders_differ(self):
+        x, y, ms = _sample(5_000)
+        z1 = ZKeyIndex(x, y, ms, "week", version=1)
+        z2 = ZKeyIndex(x, y, ms, "week", version=2)
+        z1._build_z3()
+        z2._build_z3()
+        assert not np.array_equal(z1._z3[2], z2._z3[2])
+
+    def test_state_dict_version_rejected_across_layouts(self):
+        x, y, ms = _sample(3_000)
+        z1 = ZKeyIndex(x, y, ms, "week", version=1)
+        z1._build_z3()
+        state = z1.state_dict()
+        assert int(state["index_version"][0]) == 1
+        z2 = ZKeyIndex(x, y, ms, "week", version=2)
+        assert z2.load_state(state) is False
+        assert z2._z3 is None
+        z1b = ZKeyIndex(x, y, ms, "week", version=1)
+        assert z1b.load_state(state) is True
+
+
+class TestStoreMigration:
+    def test_memory_store_reindex(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("events", SPEC_V1))
+        assert ds.get_schema("events").index_version == 1
+        x, y, ms = _sample()
+        ds.write_dict("events", [f"e{i}" for i in range(len(x))],
+                      {"kind": ["k"] * len(x), "dtg": ms,
+                       "geom": (x, y)})
+        want = {f"e{i}" for i in _expect(x, y, ms)}
+        r1 = ds.query(ECQL, "events")
+        assert r1.plan.index == "z3"
+        assert set(r1.ids.astype(str)) == want
+        assert ds._state("events").zindex.version == 1
+
+        ds.reindex("events")
+        assert ds.get_schema("events").index_version == \
+            CURRENT_INDEX_VERSION
+        r2 = ds.query(ECQL, "events")
+        assert set(r2.ids.astype(str)) == want
+        assert ds._state("events").zindex.version == CURRENT_INDEX_VERSION
+
+    def test_fs_store_version_persists_and_migrates(self, tmp_path):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema(parse_spec("events", SPEC_V1))
+        x, y, ms = _sample(8_000)
+        ds.write_dict("events", [f"e{i}" for i in range(len(x))],
+                      {"kind": ["k"] * len(x), "dtg": ms,
+                       "geom": (x, y)})
+        want = {f"e{i}" for i in _expect(x, y, ms)}
+        assert set(ds.query(ECQL, "events").ids.astype(str)) == want
+
+        # reopen: version must come back from the durable metadata
+        ds2 = FileSystemDataStore(str(tmp_path))
+        assert ds2.get_schema("events").index_version == 1
+        assert set(ds2.query(ECQL, "events").ids.astype(str)) == want
+
+        ds2.reindex("events")
+        assert ds2.get_schema("events").index_version == \
+            CURRENT_INDEX_VERSION
+        # queries keep answering correctly post-migration...
+        assert set(ds2.query(ECQL, "events").ids.astype(str)) == want
+        # ...and the new version is durable
+        meta = json.loads(
+            (tmp_path / "events" / "metadata.json").read_text())
+        assert "geomesa.index.version='2'" in meta["spec"]
+        ds3 = FileSystemDataStore(str(tmp_path))
+        assert ds3.get_schema("events").index_version == \
+            CURRENT_INDEX_VERSION
+        assert set(ds3.query(ECQL, "events").ids.astype(str)) == want
+
+    def test_cli_reindex(self, tmp_path, capsys):
+        from geomesa_tpu.tools.cli import main
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema(parse_spec("events", SPEC_V1))
+        x, y, ms = _sample(2_000)
+        ds.write_dict("events", [f"e{i}" for i in range(len(x))],
+                      {"kind": ["k"] * len(x), "dtg": ms,
+                       "geom": (x, y)})
+        rc = main(["reindex", "--path", str(tmp_path), "--name",
+                   "events"])
+        assert rc == 0
+        assert "v1 -> v2" in capsys.readouterr().out
+        ds2 = FileSystemDataStore(str(tmp_path))
+        assert ds2.get_schema("events").index_version == \
+            CURRENT_INDEX_VERSION

