@@ -25,6 +25,7 @@ __all__ = [
     "st_intersects", "st_overlaps", "st_touches", "st_within", "st_dwithin",
     "st_distance", "st_distance_sphere", "st_area", "st_length",
     "st_centroid", "st_envelope", "st_buffer_envelope", "st_convex_hull",
+    "convex_hull_points",
     "st_closest_point", "st_translate", "st_point", "st_make_bbox",
     "st_geom_from_wkt", "st_as_text", "st_x", "st_y",
     "contains_points", "distance_points",
@@ -152,8 +153,13 @@ def st_buffer_envelope(g: Geometry, d: float) -> Polygon:
 
 def st_convex_hull(g: Geometry) -> Geometry:
     """Monotone-chain convex hull of all vertices."""
-    pts = np.vstack(g.coords_list())
-    pts = np.unique(pts, axis=0)
+    return convex_hull_points(np.vstack(g.coords_list()))
+
+
+def convex_hull_points(pts: np.ndarray) -> Geometry:
+    """Monotone-chain convex hull of an (n, 2) coordinate array — the
+    raw form the SQL ConvexHull aggregate pools group members into."""
+    pts = np.unique(np.asarray(pts, np.float64), axis=0)
     if len(pts) == 1:
         return Point(*pts[0])
     if len(pts) == 2:

@@ -169,6 +169,156 @@ def _centroids(batch: FeatureBatch, geom_field: str):
     return (b[:, 0] + b[:, 2]) / 2, (b[:, 1] + b[:, 3]) / 2
 
 
+def _col_floats(col):
+    """Numeric view of a column (values or millis), or None."""
+    vals = getattr(col, "values", None)
+    if vals is None:
+        vals = getattr(col, "millis", None)
+    if vals is not None and vals.dtype.kind == "b":
+        vals = vals.astype(np.float64)
+    return None if vals is None else np.asarray(vals, np.float64)
+
+
+def _gather(col, idx):
+    """(valid, floats|None, col, idx) for rows `idx` of `col`; idx may
+    hold -1 for a LEFT join's NULL-extended rows (never valid). With
+    idx None the view covers the column directly."""
+    if idx is None:
+        idx = np.arange(col.n, dtype=np.int64)
+    safe = np.where(idx < 0, 0, idx)
+    valid = np.asarray(col.valid)[safe] & (idx >= 0)
+    floats = _col_floats(col)
+    return valid, (None if floats is None else floats[safe]), col, idx
+
+
+def _group_hull(col, idx, ginv, ng):
+    """Per-group convex hull (the reference's ConvexHull UDAF,
+    geomesa-spark-sql/.../udaf/ConvexHull.scala): pool every group
+    member's vertices, monotone-chain hull per group. NULL for empty
+    groups."""
+    from ..analytics.st_functions import convex_hull_points
+    if idx is None:
+        idx = np.arange(col.n, dtype=np.int64)
+    safe = np.where(idx < 0, 0, idx)
+    valid = np.asarray(col.valid)[safe] & (idx >= 0)
+    out = np.empty(ng, dtype=object)
+    out[:] = None
+    # one argsort gives every group's member rows as a contiguous
+    # segment — O(n log n) total, not an O(n) mask per group
+    vrows = np.flatnonzero(valid)
+    order = vrows[np.argsort(ginv[vrows], kind="stable")]
+    gsorted = ginv[order]
+    grid = np.arange(ng)
+    starts = np.searchsorted(gsorted, grid)
+    ends = np.searchsorted(gsorted, grid, side="right")
+    if isinstance(col, PointColumn):
+        xs, ys = col.x[safe], col.y[safe]
+        for g in range(ng):
+            rows = order[starts[g]:ends[g]]
+            if len(rows):
+                out[g] = convex_hull_points(
+                    np.stack([xs[rows], ys[rows]], axis=1))
+        return out
+    for g in range(ng):
+        rows = order[starts[g]:ends[g]]
+        if not len(rows):
+            continue
+        coords = [np.vstack(col.value(int(safe[i])).coords_list())
+                  for i in rows]
+        out[g] = convex_hull_points(np.vstack(coords))
+    return out
+
+
+def _equi_pairs(acol, bcol) -> np.ndarray:
+    """(a_row, b_row) match pairs of an equi-join ON a.col = b.col:
+    unify both sides' value domains (dictionary codes for strings),
+    then a sorted-merge emits each code's cross product — no hash
+    table, no per-row Python. NULL never equals NULL (SQL)."""
+    from ..features.batch import StringColumn
+    from ..index.zkeys import multi_arange
+    a_is_str = isinstance(acol, StringColumn)
+    b_is_str = isinstance(bcol, StringColumn)
+    if a_is_str != b_is_str:
+        raise ValueError("equi-join column types do not match")
+    if a_is_str:
+        # the columns are already dictionary-encoded with sorted
+        # vocabs: intersect the vocabs (tiny) and remap codes — no
+        # per-row string materialization
+        common, ca, cb = np.intersect1d(acol.vocab.astype(str),
+                                        bcol.vocab.astype(str),
+                                        assume_unique=True,
+                                        return_indices=True)
+        if not len(common):
+            return np.empty((0, 2), dtype=np.int64)
+        amap = np.full(len(acol.vocab), -1, dtype=np.int64)
+        amap[ca] = np.arange(len(common))
+        bmap = np.full(len(bcol.vocab), -1, dtype=np.int64)
+        bmap[cb] = np.arange(len(common))
+        ac_all = np.where(acol.codes >= 0, amap[acol.codes], -1)
+        bc_all = np.where(bcol.codes >= 0, bmap[bcol.codes], -1)
+        a_rows = np.flatnonzero(ac_all >= 0)
+        b_rows = np.flatnonzero(bc_all >= 0)
+        if not len(a_rows) or not len(b_rows):
+            return np.empty((0, 2), dtype=np.int64)
+        ac, bc = ac_all[a_rows], bc_all[b_rows]
+        a_keep = np.ones(len(a_rows), dtype=bool)
+        b_keep = np.ones(len(b_rows), dtype=bool)
+    else:
+        af, bf = _col_floats(acol), _col_floats(bcol)
+        if af is None or bf is None:
+            raise ValueError("equi-join needs comparable column types")
+        a_rows = np.flatnonzero(np.asarray(acol.valid))
+        b_rows = np.flatnonzero(np.asarray(bcol.valid))
+        if not len(a_rows) or not len(b_rows):
+            return np.empty((0, 2), dtype=np.int64)
+        ua, ainv = np.unique(af[a_rows], return_inverse=True)
+        ub, binv = np.unique(bf[b_rows], return_inverse=True)
+        common, ca, cb = np.intersect1d(ua, ub, assume_unique=True,
+                                        return_indices=True)
+        if not len(common):
+            return np.empty((0, 2), dtype=np.int64)
+        amap = np.full(len(ua), -1, dtype=np.int64)
+        amap[ca] = np.arange(len(common))
+        bmap = np.full(len(ub), -1, dtype=np.int64)
+        bmap[cb] = np.arange(len(common))
+        ac, bc = amap[ainv], bmap[binv]
+        a_keep, b_keep = ac >= 0, bc >= 0
+    ao = np.argsort(ac[a_keep], kind="stable")
+    a_sorted, acodes = a_rows[a_keep][ao], ac[a_keep][ao]
+    bo = np.argsort(bc[b_keep], kind="stable")
+    b_sorted, bcodes = b_rows[b_keep][bo], bc[b_keep][bo]
+    grid = np.arange(len(common))
+    bstart = np.searchsorted(bcodes, grid)
+    bend = np.searchsorted(bcodes, grid, side="right")
+    s, e = bstart[acodes], bend[acodes]
+    a_side = np.repeat(a_sorted, e - s)
+    b_side = b_sorted[multi_arange(s, e)]
+    return np.stack([a_side, b_side], axis=1).astype(np.int64)
+
+
+def _factorize_gathered(col, idx):
+    """_factorize over an index-gathered view (NULL-extended rows join
+    the null group 0)."""
+    from ..features.batch import StringColumn
+    if idx is None:
+        idx = np.arange(col.n, dtype=np.int64)
+    safe = np.where(idx < 0, 0, idx)
+    if isinstance(col, StringColumn):
+        codes = col.codes[safe].astype(np.int64) + 1
+        codes[idx < 0] = 0
+        return codes
+    valid = np.asarray(col.valid)[safe] & (idx >= 0)
+    floats = _col_floats(col)
+    if floats is None:
+        raise ValueError(f"cannot GROUP BY column {col.name!r}")
+    vals = floats[safe]
+    codes = np.zeros(len(idx), dtype=np.int64)
+    if valid.any():
+        _, inv = np.unique(vals[valid], return_inverse=True)
+        codes[valid] = inv.astype(np.int64) + 1
+    return codes
+
+
 class SqlEngine:
     """Executes SELECTs against one datastore's feature types."""
 
@@ -178,14 +328,14 @@ class SqlEngine:
     def query(self, text: str) -> SqlResult:
         sel = parse_sql(text)
         if sel.joins:
-            if sel.group_by is not None:
-                raise ValueError("GROUP BY over joins is not supported")
             return self._join_query(sel)
         return self._single_table(sel)
 
     # -- single table ------------------------------------------------------
 
     def _single_table(self, sel: SqlSelect) -> SqlResult:
+        if sel.having and sel.group_by is None:
+            raise ValueError("HAVING requires GROUP BY")
         where = (_strip_qualifier(sel.where, sel.alias)
                  if sel.where is not None else ast.Include())
         aggs = [i for i in sel.items if i.agg]
@@ -202,7 +352,8 @@ class SqlEngine:
                     raise ValueError(f"column {it.expr!r} must appear in "
                                      f"GROUP BY or an aggregate")
             res = self.store.query(Query(sel.table, where))
-            out = self._grouped(sel.items, keys, res.batch)
+            out = self._grouped(sel.items, keys, res.batch,
+                                having=sel.having)
             # output names may keep the qualifier ('g.name'): accept
             # the raw ORDER BY target when the stripped one is absent
             if sel.order_by is not None and order not in out.columns \
@@ -221,13 +372,76 @@ class SqlEngine:
             return self._aggregate(aggs, res.batch, res.n)
         return self._project(plain, res.batch, res.ids, sel.alias)
 
+    @staticmethod
+    def _reduce_item(it: SelectItem, ginv, ng: int, col, idx):
+        """One aggregate over grouped rows (vectorized segment reduces:
+        bincount / min.at / max.at; hull pooling for convex_hull). idx
+        indirects into col (None = direct); -1 rows are NULL."""
+        if it.agg not in ("count", "sum", "avg", "min", "max",
+                          "convex_hull"):
+            raise ValueError(f"not an aggregate: {it.name} (HAVING "
+                             f"terms must aggregate or be group keys)")
+        if it.agg == "count" and it.expr == "*":
+            return np.bincount(ginv, minlength=ng).astype(np.int64)
+        if it.agg == "convex_hull":
+            return _group_hull(col, idx, ginv, ng)
+        valid, vals, _, _ = _gather(col, idx)
+        if it.agg == "count":
+            return np.bincount(ginv, weights=valid.astype(np.float64),
+                               minlength=ng).astype(np.int64)
+        if vals is None:
+            raise ValueError(f"cannot aggregate column {it.expr}")
+        nvalid = np.bincount(ginv, weights=valid.astype(np.float64),
+                             minlength=ng)
+        if it.agg in ("sum", "avg"):
+            s = np.bincount(ginv, weights=np.where(valid, vals, 0.0),
+                            minlength=ng)
+            out = s if it.agg == "sum" else \
+                np.divide(s, nvalid, out=np.zeros(ng), where=nvalid > 0)
+        else:
+            fill = np.inf if it.agg == "min" else -np.inf
+            out = np.full(ng, fill)
+            op = np.minimum if it.agg == "min" else np.maximum
+            op.at(out, ginv[valid], vals[valid])
+        # SQL semantics: a group with no non-null values yields NULL
+        res = np.empty(ng, dtype=object)
+        for g in range(ng):
+            res[g] = None if nvalid[g] == 0 else out[g]
+        return res
+
+    @staticmethod
+    def _apply_having(out: SqlResult, having, compute) -> SqlResult:
+        """Filter grouped output rows by the HAVING conjuncts. Each
+        condition's aggregate reuses a select-list column when present,
+        else `compute(item)` evaluates it over the same groups."""
+        if not having:
+            return out
+        keep = np.ones(out.n, dtype=bool)
+        for cond in having:
+            if cond.item.name in out.columns:
+                vals = out.columns[cond.item.name]
+            else:
+                vals = compute(cond.item)
+            v = np.asarray(vals, dtype=object)
+            ok = np.zeros(len(v), dtype=bool)
+            for i, x in enumerate(v):
+                if x is None:
+                    continue
+                ok[i] = {"=": x == cond.value,
+                         "<>": x != cond.value,
+                         "<": x < cond.value, ">": x > cond.value,
+                         "<=": x <= cond.value,
+                         ">=": x >= cond.value}[cond.op]
+            keep &= ok
+        return SqlResult(out.names,
+                         {k: c[keep] for k, c in out.columns.items()})
+
     def _grouped(self, items: list[SelectItem], keys: list[str],
-                 batch) -> SqlResult:
+                 batch, having=None) -> SqlResult:
         """Grouped aggregation (GeoMesaSparkSQL.scala:212 grouped
         relations): factorize the key columns into dictionary codes,
         combine into one group id, and run vectorized segment reduces
-        (bincount / min.at / max.at) per aggregate — the columnar
-        analog of a per-group shuffle."""
+        per aggregate — the columnar analog of a per-group shuffle."""
         names = [it.name for it in items]
         if batch is None or batch.n == 0:
             return SqlResult(names, {n: np.empty(0, object)
@@ -242,50 +456,24 @@ class SqlEngine:
         uniq, rep, ginv = np.unique(gid, return_index=True,
                                     return_inverse=True)
         ng = len(uniq)
+
+        def col_of(it):
+            return batch.col(it.expr.split(".")[-1]) \
+                if it.expr != "*" else None
+
         cols: dict[str, np.ndarray] = {}
         for it in items:
             if not it.agg:
-                key = it.expr.split(".")[-1]
-                col = batch.col(key)
+                col = col_of(it)
                 cols[it.name] = np.array([col.value(int(i)) for i in rep],
                                          dtype=object)
                 continue
-            if it.agg == "count" and it.expr == "*":
-                cols[it.name] = np.bincount(ginv, minlength=ng) \
-                    .astype(np.int64)
-                continue
-            col = batch.col(it.expr.split(".")[-1])
-            valid = np.asarray(col.valid)
-            if it.agg == "count":
-                cols[it.name] = np.bincount(
-                    ginv, weights=valid.astype(np.float64),
-                    minlength=ng).astype(np.int64)
-                continue
-            vals = getattr(col, "values", None)
-            if vals is None:
-                vals = getattr(col, "millis", None)
-            if vals is None:
-                raise ValueError(f"cannot aggregate column {it.expr}")
-            vals = np.asarray(vals, np.float64)
-            nvalid = np.bincount(ginv, weights=valid.astype(np.float64),
-                                 minlength=ng)
-            if it.agg in ("sum", "avg"):
-                s = np.bincount(ginv, weights=np.where(valid, vals, 0.0),
-                                minlength=ng)
-                out = s if it.agg == "sum" else \
-                    np.divide(s, nvalid, out=np.zeros(ng),
-                              where=nvalid > 0)
-            else:
-                fill = np.inf if it.agg == "min" else -np.inf
-                out = np.full(ng, fill)
-                op = np.minimum if it.agg == "min" else np.maximum
-                op.at(out, ginv[valid], vals[valid])
-            # SQL semantics: a group with no non-null values yields NULL
-            res = np.empty(ng, dtype=object)
-            for g in range(ng):
-                res[g] = None if nvalid[g] == 0 else out[g]
-            cols[it.name] = res
-        return SqlResult(names, cols)
+            cols[it.name] = self._reduce_item(it, ginv, ng,
+                                              col_of(it), None)
+        out = SqlResult(names, cols)
+        return self._apply_having(
+            out, having,
+            lambda it: self._reduce_item(it, ginv, ng, col_of(it), None))
 
     def _aggregate(self, items: list[SelectItem], batch, n: int) -> SqlResult:
         names, cols = [], {}
@@ -294,6 +482,14 @@ class SqlEngine:
             names.append(name)
             if it.agg == "count" and it.expr == "*":
                 cols[name] = np.array([n], dtype=np.int64)
+                continue
+            if it.agg == "convex_hull":
+                if batch is None or n == 0:
+                    cols[name] = np.array([None], dtype=object)
+                else:
+                    cols[name] = _group_hull(
+                        batch.col(it.expr.split(".")[-1]), None,
+                        np.zeros(n, dtype=np.int64), 1)
                 continue
             col = batch.col(it.expr.split(".")[-1]) if batch else None
             if it.agg == "count":
@@ -348,6 +544,11 @@ class SqlEngine:
     # -- joins -------------------------------------------------------------
 
     def _join_query(self, sel: SqlSelect) -> SqlResult:
+        if sel.having and sel.group_by is None:
+            raise ValueError("HAVING requires GROUP BY")
+        return self._join_query_inner(sel)
+
+    def _join_query_inner(self, sel: SqlSelect) -> SqlResult:
         """Chained spatial joins (GeoMesaJoinRelation.buildScan analog,
         SQLRules.scala:270-360): each JOIN anchors to one preceding
         alias, runs a device join kernel, and expands the result rows;
@@ -395,6 +596,7 @@ class SqlEngine:
         # through to the pair path, which raises the proper errors.
         if (len(sel.joins) == 1 and not sel.joins[0].outer
                 and not deferred and sel.group_by is None
+                and sel.having is None and sel.joins[0].kind != "eq"
                 and len(sel.items) == 1 and sel.items[0].agg == "count"
                 and sel.items[0].expr == "*"):
             j = sel.joins[0]
@@ -415,7 +617,90 @@ class SqlEngine:
         for a, f in deferred:
             keep = self._post_join_mask(f, results[a], rows[a])
             rows = {k: v[keep] for k, v in rows.items()}
+        if sel.group_by is not None:
+            out = self._grouped_join(sel, results, rows)
+            order = sel.order_by
+            if order is not None and order not in out.columns \
+                    and order.split(".")[-1] in out.columns:
+                order = order.split(".")[-1]
+            return _order_limit(out, order, sel.order_desc, sel.limit)
         return self._project_join(sel, results, rows)
+
+    def _grouped_join(self, sel: SqlSelect, results,
+                      rows: dict[str, np.ndarray]) -> SqlResult:
+        """GROUP BY over joined rows: factorize the (gathered) key
+        columns, one composite group id per joined row, then the same
+        vectorized segment reduces the single-table path uses. LEFT
+        joins' NULL-extended rows land in the null group for keys on
+        the outer side and contribute nothing to column aggregates."""
+        names = [it.name for it in sel.items]
+        nrows = len(next(iter(rows.values()))) if rows else 0
+        keys = list(sel.group_by)
+        for it in sel.items:
+            # QUALIFIED comparison: a bare-name match would let the
+            # same-named column of a different table through (its
+            # per-group value is not constant)
+            if not it.agg and it.expr not in keys:
+                raise ValueError(f"column {it.expr!r} must appear in "
+                                 f"GROUP BY or an aggregate")
+        if nrows == 0:
+            return SqlResult(names, {n: np.empty(0, object)
+                                     for n in names})
+
+        def split(q: str):
+            if "." not in q:
+                raise ValueError(f"join columns must be qualified: {q}")
+            a, c = q.split(".", 1)
+            if a not in rows:
+                raise ValueError(f"unknown table qualifier {a!r} "
+                                 f"(tables: {list(rows)})")
+            return a, c
+
+        def col_idx(it: SelectItem):
+            if it.expr == "*":
+                return None, None
+            a, c = split(it.expr)
+            if c in ("__fid__", "id"):
+                if it.agg not in (None, "count"):
+                    raise ValueError(f"cannot {it.agg} feature ids")
+                from ..features.batch import NumericColumn
+                nb = results[a].n
+                return NumericColumn("__fid__", np.zeros(nb),
+                                     np.ones(nb, dtype=bool)), rows[a]
+            return results[a].batch.col(c), rows[a]
+
+        gid = np.zeros(nrows, dtype=np.int64)
+        for k in keys:
+            a, c = split(k)
+            if c in ("__fid__", "id"):
+                codes = rows[a] + 1     # one group per feature; NULL=0
+            else:
+                codes = _factorize_gathered(results[a].batch.col(c),
+                                            rows[a])
+            gid = gid * (int(codes.max()) + 1) + codes
+            _, gid = np.unique(gid, return_inverse=True)
+        uniq, rep, ginv = np.unique(gid, return_index=True,
+                                    return_inverse=True)
+        ng = len(uniq)
+        cols: dict[str, np.ndarray] = {}
+        for it in sel.items:
+            if not it.agg:
+                a, c = split(it.expr)
+                rep_idx = rows[a][rep]
+                if c in ("__fid__", "id"):
+                    vals = [None if i < 0 else results[a].ids[int(i)]
+                            for i in rep_idx]
+                else:
+                    col = results[a].batch.col(c)
+                    vals = [None if i < 0 else col.value(int(i))
+                            for i in rep_idx]
+                cols[it.name] = np.array(vals, dtype=object)
+                continue
+            cols[it.name] = self._reduce_item(it, ginv, ng, *col_idx(it))
+        out = SqlResult(names, cols)
+        return self._apply_having(
+            out, sel.having,
+            lambda it: self._reduce_item(it, ginv, ng, *col_idx(it)))
 
     def _apply_join(self, join: SqlJoin, results,
                     rows: dict[str, np.ndarray],
@@ -530,7 +815,10 @@ class SqlEngine:
                 or a_res.batch is None or b_res.batch is None):
             return np.empty((0, 2), dtype=np.int64)
         from ..analytics.join import contains_join, dwithin_join
-        if join.kind == "dwithin":
+        if join.kind == "eq":
+            pairs = _equi_pairs(a_res.batch.col(a_col),
+                                b_res.batch.col(b_col))
+        elif join.kind == "dwithin":
             ax, ay = _centroids(a_res.batch, a_col)
             bx, by = _centroids(b_res.batch, b_col)
             dev = (self._device_xy(a_table, a_res, a_col)
@@ -573,15 +861,18 @@ class SqlEngine:
         aggs = [i for i in sel.items if i.agg]
         nrows = len(next(iter(rows.values()))) if rows else 0
         if aggs:
-            if any(i.agg != "count" for i in aggs):
-                raise ValueError("join aggregates support COUNT only")
+            if any(not i.agg for i in sel.items):
+                raise ValueError("cannot mix aggregates and plain "
+                                 "columns without GROUP BY")
+            # one implicit group over every joined row: the same
+            # segment reduces the grouped path uses (COUNT/SUM/MIN/
+            # MAX/AVG/convex_hull, NULL-extended rows skipped)
             cols = {}
+            ginv = np.zeros(nrows, dtype=np.int64)
             for it in aggs:
                 if it.expr == "*":
                     cols[it.name] = np.array([nrows])
                     continue
-                # COUNT(col) skips NULLs — including LEFT-join
-                # NULL-extended rows
                 if "." not in it.expr:
                     raise ValueError(
                         f"join columns must be qualified: {it.expr}")
@@ -589,13 +880,13 @@ class SqlEngine:
                 if q not in rows:
                     raise ValueError(f"unknown table qualifier {q!r}")
                 idx = rows[q]
-                m = idx >= 0
                 if col in ("__fid__", "id"):
-                    cols[it.name] = np.array([int(m.sum())])
-                else:
-                    valid = np.asarray(results[q].batch.col(col).valid)
-                    cols[it.name] = np.array(
-                        [int(valid[idx[m]].sum())])
+                    if it.agg != "count":
+                        raise ValueError(f"cannot {it.agg} feature ids")
+                    cols[it.name] = np.array([int((idx >= 0).sum())])
+                    continue
+                c = results[q].batch.col(col)
+                cols[it.name] = self._reduce_item(it, ginv, 1, c, idx)
             return SqlResult([it.name for it in aggs], cols)
         names, cols = [], {}
 

@@ -60,11 +60,20 @@ class SelectItem:
 class SqlJoin:
     table: str
     alias: str
-    kind: str                 # 'dwithin' | 'contains' | 'intersects'
+    kind: str                 # 'dwithin' | 'contains' | 'intersects' | 'eq'
     distance: float | None    # for dwithin (degrees)
     left_prop: str            # qualified 'alias.col' (first ON arg)
     right_prop: str           # qualified 'alias.col' (second ON arg)
     outer: bool = False       # LEFT [OUTER] JOIN
+
+
+@dataclasses.dataclass
+class HavingCond:
+    """One HAVING conjunct: an aggregate (or group key) compared to a
+    literal. Conjuncts AND together."""
+    item: SelectItem          # the aggregated (or plain) expression
+    op: str                   # =, <>, <, >, <=, >=
+    value: Any
 
 
 @dataclasses.dataclass
@@ -78,6 +87,7 @@ class SqlSelect:
     order_desc: bool
     limit: int | None
     group_by: list[str] | None = None
+    having: list[HavingCond] | None = None
 
 
 _TOKEN_RE = re.compile(r"""
@@ -164,7 +174,12 @@ def _num(v: str) -> float:
 _RESERVED = {"FROM", "JOIN", "ON", "WHERE", "ORDER", "GROUP", "LIMIT",
              "AND", "OR", "NOT", "AS", "BY", "ASC", "DESC", "BETWEEN",
              "IN", "LIKE", "ILIKE", "IS", "NULL", "TRUE", "FALSE",
-             "INNER", "LEFT", "OUTER"}
+             "INNER", "LEFT", "OUTER", "HAVING"}
+
+# geometry aggregates (the reference's ConvexHull UDAF,
+# geomesa-spark-sql/.../udaf/ConvexHull.scala)
+_GEOM_AGGS = {"ST_CONVEXHULL": "convex_hull", "CONVEXHULL": "convex_hull",
+              "CONVEX_HULL": "convex_hull"}
 
 
 class _Parser:
@@ -201,6 +216,11 @@ class _Parser:
             while self.t.peek()[0] == "comma":
                 self.t.next()
                 group_by.append(self._name())
+        having = None
+        if self.t.take_word("HAVING"):
+            having = [self._having_cond()]
+            while self.t.take_word("AND"):
+                having.append(self._having_cond())
         order_by, desc = None, False
         if self.t.take_word("ORDER"):
             self.t.expect("word", "BY")
@@ -216,7 +236,23 @@ class _Parser:
         if k is not None:
             raise SqlError(f"unexpected trailing input: {v!r}")
         return SqlSelect(items, table, alias, joins, where,
-                         order_by, desc, limit, group_by)
+                         order_by, desc, limit, group_by, having)
+
+    def _having_cond(self) -> HavingCond:
+        """agg(col|*) op literal, or group-key op literal."""
+        k, v = self.t.peek()
+        if k == "word" and (v.upper() in _AGGS
+                            or v.upper() in _GEOM_AGGS) \
+                and self.t.peek(1)[0] == "lparen":
+            item = self._item()
+        else:
+            item = SelectItem(self._name())
+        k, op = self.t.next()
+        if k != "op":
+            raise SqlError(f"expected operator in HAVING, got {op!r}")
+        if op == "!=":
+            op = "<>"
+        return HavingCond(item, op, self._literal())
 
     def _table_ref(self) -> tuple[str, str]:
         name = self._name()
@@ -231,6 +267,17 @@ class _Parser:
     def _join(self, outer: bool = False) -> SqlJoin:
         table, alias = self._table_ref()
         self.t.expect("word", "ON")
+        # equi-join: ON a.col = b.col (no function-call parenthesis)
+        if self.t.peek(1)[0] != "lparen":
+            a = self._name()
+            k, op = self.t.next()
+            if k != "op" or op != "=":
+                raise SqlError(f"expected '=' in equi-join ON, got {op!r}")
+            b = self._name()
+            if "." not in a or "." not in b:
+                raise SqlError("join ON columns must be alias-qualified "
+                               f"(got {a!r}, {b!r})")
+            return SqlJoin(table, alias, "eq", None, a, b, outer)
         fn = self._name().upper()
         self.t.expect("lparen")
         a = self._name()
@@ -265,6 +312,13 @@ class _Parser:
         if k == "star":
             self.t.next()
             return SelectItem("*")
+        if k == "word" and v.upper() in _GEOM_AGGS \
+                and self.t.peek(1)[0] == "lparen":
+            self.t.next()
+            self.t.expect("lparen")
+            col = self._name()
+            self.t.expect("rparen")
+            return SelectItem(col, "convex_hull", self._opt_alias())
         if k == "word" and v.upper() in _AGGS \
                 and self.t.peek(1)[0] == "lparen":
             agg = self.t.next()[1].lower()

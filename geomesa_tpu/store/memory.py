@@ -78,17 +78,23 @@ class _LazyBatch:
     readers are lazy in the same way (KryoBufferSimpleFeature)."""
 
     def __init__(self, source: FeatureBatch, idx: np.ndarray,
-                 properties):
+                 properties, row_order: bool = True):
         self.source = source
         self.idx = idx
         self.properties = properties
+        # False when the caller reordered idx (sort_by): the endpoint
+        # identity check below would misread a permutation as identity
+        self.row_order = row_order
 
     def materialize(self) -> FeatureBatch:
-        if (self.properties is None and len(self.idx) == self.source.n
+        if (self.row_order and self.properties is None
+                and len(self.idx) == self.source.n
                 and self.idx[0] == 0 and self.idx[-1] == self.source.n - 1):
-            # full-table result in row order (idx is always sorted):
-            # the immutable source snapshot IS the result — an INCLUDE
-            # scan over 100M rows must not copy every column
+            # full-table result in ASCENDING row order (the scan
+            # strategies all return sorted indices), so endpoint +
+            # length checks imply identity: the immutable source
+            # snapshot IS the result — an INCLUDE scan over 100M rows
+            # must not copy every column
             return self.source
         batch = self.source.take(self.idx)
         if self.properties is not None:
@@ -763,7 +769,8 @@ class InMemoryDataStore(DataStore):
                 raise KeyError(f"unknown propert"
                                f"{'ies' if len(missing) > 1 else 'y'}: "
                                f"{', '.join(missing)}")
-        batch: Any = _LazyBatch(st.batch, idx, q.properties)
+        batch: Any = _LazyBatch(st.batch, idx, q.properties,
+                                row_order=q.sort_by is None)
         if len(idx) <= 10_000:
             # small results materialize eagerly: the copy is trivial and
             # an unread result must not pin the multi-GB table snapshot

@@ -470,11 +470,107 @@ class TestJoinAggregates:
         assert int(matched.column("n")[0]) == int(inner.column("n")[0])
         assert int(total.column("n")[0]) > int(matched.column("n")[0])
 
-    def test_group_by_over_join_raises(self, engine):
+    def _join_pairs_oracle(self, store):
+        """Brute-force (gdelt_row, zone_row) contains-join pairs."""
+        gb = store._state("gdelt").batch
+        zb = store._state("zones").batch
+        gx, gy = gb.col("geom").x, gb.col("geom").y
+        pairs = []
+        for zi, poly in enumerate(zb.col("area").geoms):
+            hit = poly.contains_points(gx, gy)
+            pairs.extend((gi, zi) for gi in np.flatnonzero(hit))
+        return pairs
+
+    def test_group_by_over_join_matches_oracle(self, store, engine):
+        res = engine.query(
+            "SELECT z.zid, COUNT(*) AS n, AVG(g.val) AS av, "
+            "MIN(g.val) AS mn, MAX(g.val) AS mx, SUM(g.val) AS sm "
+            "FROM gdelt g JOIN zones z ON ST_Contains(z.area, g.geom) "
+            "GROUP BY z.zid ORDER BY z.zid")
+        gvals = np.array([store._state("gdelt").batch.col("val")
+                          .value(i) for i in range(N)])
+        by_zone: dict = {}
+        for gi, zi in self._join_pairs_oracle(store):
+            by_zone.setdefault(zi, []).append(gvals[gi])
+        got = {int(z): (int(n), float(a), int(mn), int(mx), int(sm))
+               for z, n, a, mn, mx, sm in res.rows()}
+        want = {zi: (len(v), float(np.mean(v)), int(np.min(v)),
+                     int(np.max(v)), int(np.sum(v)))
+                for zi, v in by_zone.items()}
+        assert set(got) == set(want)
+        for z in want:
+            assert got[z][0] == want[z][0]
+            assert abs(got[z][1] - want[z][1]) < 1e-9
+            assert got[z][2:] == want[z][2:]
+
+    def test_having_over_join(self, store, engine):
+        res = engine.query(
+            "SELECT z.zid, COUNT(*) AS n FROM gdelt g "
+            "JOIN zones z ON ST_Contains(z.area, g.geom) "
+            "GROUP BY z.zid HAVING COUNT(*) > 80")
+        by_zone: dict = {}
+        for _, zi in self._join_pairs_oracle(store):
+            by_zone[zi] = by_zone.get(zi, 0) + 1
+        want = {zi: c for zi, c in by_zone.items() if c > 80}
+        got = {int(z): int(n) for z, n in res.rows()}
+        assert got == want and len(want) > 0
+
+    def test_convex_hull_aggregate_over_join(self, store, engine):
+        res = engine.query(
+            "SELECT z.zid, COUNT(*) AS n, ST_ConvexHull(g.geom) AS h "
+            "FROM gdelt g JOIN zones z ON ST_Contains(z.area, g.geom) "
+            "GROUP BY z.zid HAVING COUNT(*) > 5")
+        gb = store._state("gdelt").batch
+        gx, gy = gb.col("geom").x, gb.col("geom").y
+        by_zone: dict = {}
+        for gi, zi in self._join_pairs_oracle(store):
+            by_zone.setdefault(zi, []).append(gi)
+        assert res.n > 0
+        for z, n, hull in res.rows():
+            rows = by_zone[int(z)]
+            pts = np.stack([gx[rows], gy[rows]], axis=1)
+            env = hull.envelope
+            # hull bounds == point-set bounds, and all points inside
+            assert np.isclose(env.xmin, pts[:, 0].min())
+            assert np.isclose(env.xmax, pts[:, 0].max())
+            assert np.isclose(env.ymin, pts[:, 1].min())
+            assert np.isclose(env.ymax, pts[:, 1].max())
+            assert hull.contains_points(pts[:, 0], pts[:, 1]).all()
+
+    def test_equi_join_matches_pandas_style_oracle(self, store, engine):
+        # self equi-join on the dictionary column
+        res = engine.query(
+            "SELECT a.name, COUNT(*) AS n FROM gdelt a "
+            "JOIN gdelt b ON a.name = b.name "
+            "WHERE a.val < 20 AND b.val < 20 "
+            "GROUP BY a.name ORDER BY a.name")
+        gb = store._state("gdelt").batch
+        names = np.array([gb.col("name").value(i) for i in range(N)])
+        vals = np.array([gb.col("val").value(i) for i in range(N)])
+        sub = names[vals < 20]
+        import collections
+        cnt = collections.Counter(sub)
+        want = {k: c * c for k, c in cnt.items()}  # cross product
+        got = {str(k): int(n) for k, n in res.rows()}
+        assert got == want
+
+    def test_single_table_having_and_hull(self, store, engine):
+        res = engine.query(
+            "SELECT name, COUNT(*) AS n, ST_ConvexHull(geom) AS h "
+            "FROM gdelt GROUP BY name HAVING COUNT(*) >= 600")
+        gb = store._state("gdelt").batch
+        names = np.array([gb.col("name").value(i) for i in range(N)])
+        import collections
+        cnt = collections.Counter(names)
+        want = {k: c for k, c in cnt.items() if c >= 600}
+        got = {str(k): int(n) for k, n, _h in res.rows()}
+        assert got == want
+        for _k, _n, h in res.rows():
+            assert h is not None
+
+    def test_having_without_group_by_raises(self, engine):
         with pytest.raises(ValueError):
-            engine.query("SELECT g.name, COUNT(*) FROM gdelt g "
-                         "JOIN zones z ON ST_Contains(z.area, g.geom) "
-                         "GROUP BY g.name")
+            engine.query("SELECT COUNT(*) FROM gdelt HAVING COUNT(*) > 1")
 
     def test_grouped_order_by_qualified_key(self, engine):
         res = engine.query("SELECT g.name, COUNT(*) AS n FROM gdelt g "

@@ -198,6 +198,16 @@ class TestStoreLifecycle:
         src = ds._state("t").batch
         res = ds.query(Query("t", "INCLUDE"))
         assert res.batch is src
+        # ...but a SORTED full-table result is a permutation, and must
+        # materialize so batch rows still align with ids
+        res2 = ds.query(Query("t", "INCLUDE", sort_by="v"))
+        assert res2.batch is not src
+        vs = [res2.batch.col("v").value(i) for i in range(res2.batch.n)]
+        assert vs == sorted(vs)
+        v_by_id = {f"r{i}": ds._state("t").batch.col("v").value(i)
+                   for i in range(n)}
+        assert all(v_by_id[str(fid)] == vs[i]
+                   for i, fid in enumerate(res2.ids[:100]))
 
 
 class TestReviewRegressions:
