@@ -178,6 +178,15 @@ class SimpleFeatureType:
         return TimePeriod.parse(self.user_data.get(Configs.Z3_INTERVAL, "week"))
 
     @property
+    def visibility_level(self) -> str:
+        """'feature' (default) or 'attribute': attribute-level stores
+        one visibility label PER ATTRIBUTE per feature (comma-joined on
+        write), and queries null out unauthorized attributes instead of
+        dropping whole rows (KryoVisibilityRowEncoder semantics,
+        accumulo/iterators/KryoVisibilityRowEncoder.scala:26)."""
+        return str(self.user_data.get(Configs.VIS_LEVEL, "feature"))
+
+    @property
     def index_version(self) -> int:
         """Z-index layout version (GeoMesaFeatureIndex keys table names
         by version, GeoMesaFeatureIndex.scala:33-35): v1 = legacy

@@ -26,7 +26,8 @@ import numpy as np
 
 from ..geohash import covering, decode_bbox, encode
 
-__all__ = ["RasterStore", "RasterTile"]
+__all__ = ["RasterStore", "RasterTile", "RasterQueryPlanner",
+           "RasterQueryPlan", "CoverageReader"]
 
 
 @dataclasses.dataclass
@@ -211,3 +212,19 @@ class RasterStore:
     @property
     def num_tiles(self) -> int:
         return len(self._tiles)
+
+    # -- planned coverage reads ---------------------------------------------
+
+    def planner(self) -> "RasterQueryPlanner":
+        return RasterQueryPlanner(self)
+
+    def read(self, bbox, width: int, height: int) -> np.ndarray:
+        """WCS-shaped coverage read (GeoMesaCoverageReader.read
+        analog): the query planner selects the overview level for the
+        requested output resolution and decomposes the extent into
+        tile key ranges; the device mosaic assembles the grid."""
+        return CoverageReader(self).read(bbox, width, height)
+
+
+from .planner import (CoverageReader, RasterQueryPlan,  # noqa: E402
+                      RasterQueryPlanner)

@@ -1,0 +1,145 @@
+"""Raster query planner + coverage reader.
+
+The analogs of AccumuloRasterQueryPlanner
+(geomesa-accumulo-raster/.../data/AccumuloRasterQueryPlanner.scala:
+pick the best stored resolution for a requested one, then turn the
+query extent into covering key ranges) and GeoMesaCoverageReader
+(.../raster/wcs/GeoMesaCoverageReader.scala: the WCS read(width,
+height, envelope) surface that mosaics the chunks).
+
+TPU-native shape: level selection is a resolution comparison over the
+pyramid's per-level pixel pitches; the extent decomposes into geohash
+cells grouped into LEXICOGRAPHIC RUNS (the key-range form the
+reference hands its scanner); the mosaic itself is the store's jitted
+gather kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..geohash import _BASE32, covering
+
+__all__ = ["RasterQueryPlan", "RasterQueryPlanner", "CoverageReader"]
+
+
+def _geohash_succ(gh: str) -> str | None:
+    """Lexicographic successor at the same precision (base-32 with
+    carry); None past the last cell."""
+    chars = list(gh)
+    for i in range(len(chars) - 1, -1, -1):
+        j = _BASE32.index(chars[i])
+        if j + 1 < len(_BASE32):
+            chars[i] = _BASE32[j + 1]
+            return "".join(chars)
+        chars[i] = _BASE32[0]
+    return None
+
+
+def _ranges_of(geohashes: list[str]) -> list[tuple[str, str]]:
+    """Sorted geohashes -> [lo, hi] lexicographic runs (inclusive)."""
+    out: list[tuple[str, str]] = []
+    for gh in sorted(geohashes):
+        if out and _geohash_succ(out[-1][1]) == gh:
+            out[-1] = (out[-1][0], gh)
+        else:
+            out.append((gh, gh))
+    return out
+
+
+@dataclasses.dataclass
+class RasterQueryPlan:
+    level: int                       # chosen pyramid level
+    precision: int                   # geohash precision of that level
+    resolution: float                # degrees/pixel at that level
+    target_resolution: float         # what the request asked for
+    geohashes: list[str]             # covering cells of the extent
+
+    @property
+    def n_tiles(self) -> int:
+        return len(self.geohashes)
+
+    @property
+    def ranges(self) -> list[tuple[str, str]]:
+        """Covering cells as inclusive lexicographic key runs, built
+        on demand (the mosaic read path never needs them)."""
+        if not hasattr(self, "_ranges"):
+            self._ranges = _ranges_of(self.geohashes)
+        return self._ranges
+
+
+class RasterQueryPlanner:
+    """Chooses the overview level and decomposes the extent."""
+
+    def __init__(self, store):
+        self.store = store
+        self._res_cache: dict[int, float | None] = {}
+
+    def resolution_of(self, level: int) -> float | None:
+        """Degrees/pixel of a stored level (cell width over tile
+        pixels), or None when the level holds no tiles. Cached — the
+        pitch is a per-level constant."""
+        if level not in self._res_cache:
+            res = None
+            for (lv, gh), tile in self.store._tiles.items():
+                if lv == level:
+                    from ..geohash import decode_bbox
+                    x0, _, x1, _ = decode_bbox(gh)
+                    res = (x1 - x0) / tile.shape[1]
+                    break
+            self._res_cache[level] = res
+        return self._res_cache[level]
+
+    def select_level(self, target_resolution: float) -> int | None:
+        """The reference's closest-resolution policy
+        (AccumuloRasterQueryPlanner: serve the stored resolution best
+        matching the request): the COARSEST level still at least as
+        fine as the request (no detail lost, least data touched);
+        when nothing is fine enough, the finest available."""
+        best = None
+        best_res = None
+        finest = None
+        finest_res = np.inf
+        for lv in self.store.levels:
+            res = self.resolution_of(lv)
+            if res is None:
+                continue
+            if res < finest_res:
+                finest, finest_res = lv, res
+            if res <= target_resolution and (best_res is None
+                                             or res > best_res):
+                best, best_res = lv, res
+        return best if best is not None else finest
+
+    def plan(self, bbox, width: int, height: int) -> RasterQueryPlan | None:
+        xmin, ymin, xmax, ymax = (float(v) for v in bbox)
+        # the tighter of the two axes' pixel pitches: a tall skinny
+        # output must still get vertical detail
+        target = min((xmax - xmin) / max(width, 1),
+                     (ymax - ymin) / max(height, 1))
+        level = self.select_level(target)
+        if level is None:
+            return None
+        from . import _level_precision
+        prec = _level_precision(level)
+        ghs = sorted(covering(xmin, ymin, xmax, ymax, prec))
+        return RasterQueryPlan(level, prec,
+                               float(self.resolution_of(level)),
+                               target, ghs)
+
+
+class CoverageReader:
+    """WCS-shaped read surface (GeoMesaCoverageReader.read analog):
+    plan -> gather the planned tiles -> device mosaic."""
+
+    def __init__(self, store):
+        self.store = store
+        self.planner = RasterQueryPlanner(store)
+
+    def read(self, bbox, width: int, height: int) -> np.ndarray:
+        plan = self.planner.plan(bbox, width, height)
+        if plan is None or plan.n_tiles == 0:
+            return np.full((height, width), np.nan, dtype=np.float32)
+        return self.store.mosaic(bbox, width, height, level=plan.level)

@@ -461,19 +461,26 @@ class SqlEngine:
             return batch.col(it.expr.split(".")[-1]) \
                 if it.expr != "*" else None
 
+        def key_values(it):
+            col = col_of(it)
+            return np.array([col.value(int(i)) for i in rep],
+                            dtype=object)
+
         cols: dict[str, np.ndarray] = {}
         for it in items:
             if not it.agg:
-                col = col_of(it)
-                cols[it.name] = np.array([col.value(int(i)) for i in rep],
-                                         dtype=object)
+                cols[it.name] = key_values(it)
                 continue
             cols[it.name] = self._reduce_item(it, ginv, ng,
                                               col_of(it), None)
-        out = SqlResult(names, cols)
-        return self._apply_having(
-            out, having,
-            lambda it: self._reduce_item(it, ginv, ng, col_of(it), None))
+
+        def compute(it):
+            if not it.agg and it.expr.split(".")[-1] in keys:
+                return key_values(it)  # HAVING on a group key
+            return self._reduce_item(it, ginv, ng, col_of(it), None)
+
+        return self._apply_having(SqlResult(names, cols), having,
+                                  compute)
 
     def _aggregate(self, items: list[SelectItem], batch, n: int) -> SqlResult:
         names, cols = [], {}
@@ -682,25 +689,33 @@ class SqlEngine:
         uniq, rep, ginv = np.unique(gid, return_index=True,
                                     return_inverse=True)
         ng = len(uniq)
+
+        def key_values(it):
+            a, c = split(it.expr)
+            rep_idx = rows[a][rep]
+            if c in ("__fid__", "id"):
+                vals = [None if i < 0 else results[a].ids[int(i)]
+                        for i in rep_idx]
+            else:
+                col = results[a].batch.col(c)
+                vals = [None if i < 0 else col.value(int(i))
+                        for i in rep_idx]
+            return np.array(vals, dtype=object)
+
         cols: dict[str, np.ndarray] = {}
         for it in sel.items:
             if not it.agg:
-                a, c = split(it.expr)
-                rep_idx = rows[a][rep]
-                if c in ("__fid__", "id"):
-                    vals = [None if i < 0 else results[a].ids[int(i)]
-                            for i in rep_idx]
-                else:
-                    col = results[a].batch.col(c)
-                    vals = [None if i < 0 else col.value(int(i))
-                            for i in rep_idx]
-                cols[it.name] = np.array(vals, dtype=object)
+                cols[it.name] = key_values(it)
                 continue
             cols[it.name] = self._reduce_item(it, ginv, ng, *col_idx(it))
-        out = SqlResult(names, cols)
-        return self._apply_having(
-            out, sel.having,
-            lambda it: self._reduce_item(it, ginv, ng, *col_idx(it)))
+
+        def compute(it):
+            if not it.agg and it.expr in keys:
+                return key_values(it)  # HAVING on a group key
+            return self._reduce_item(it, ginv, ng, *col_idx(it))
+
+        return self._apply_having(SqlResult(names, cols), sel.having,
+                                  compute)
 
     def _apply_join(self, join: SqlJoin, results,
                     rows: dict[str, np.ndarray],

@@ -155,6 +155,47 @@ class QueryResult:
                 f"plan={self.plan.index if self.plan else None})")
 
 
+def _attr_vis_masks(vis_rows, n_attr: int, auths) -> np.ndarray:
+    """(len(rows), n_attr) bool authorization matrix for
+    attribute-level visibility labels (comma-joined per attribute;
+    empty part = world-readable). Distinct label combos are parsed
+    once."""
+    from ..security import parse_visibility
+    out = np.ones((len(vis_rows), n_attr), dtype=bool)
+    cache: dict[str, np.ndarray] = {}
+    auth_set = set(auths)
+    for i, v in enumerate(vis_rows):
+        if not v:
+            continue
+        row = cache.get(v)
+        if row is None:
+            parts = (str(v).split(",") + [""] * n_attr)[:n_attr]
+            row = np.array(
+                [not p or parse_visibility(p).evaluate(auth_set)
+                 for p in parts], dtype=bool)
+            cache[v] = row
+        out[i] = row
+    return out
+
+
+def _null_cells(col, bad: np.ndarray):
+    """Copy of a column with `bad` rows nulled (unauthorized
+    attribute values at query time)."""
+    import dataclasses as _dc
+
+    from ..features.batch import GeometryColumn, StringColumn
+    if isinstance(col, StringColumn):
+        codes = col.codes.copy()
+        codes[bad] = -1
+        return StringColumn(col.name, codes, col.vocab)
+    if isinstance(col, GeometryColumn):
+        geoms = [None if b else g for g, b in zip(col.geoms, bad)]
+        bounds = col.bounds.copy()
+        bounds[bad] = np.nan
+        return GeometryColumn(col.name, geoms, bounds)
+    return _dc.replace(col, valid=np.asarray(col.valid) & ~bad)
+
+
 class _TypeState:
     """Per-feature-type storage: host batch + lazily-built device index.
 
@@ -185,7 +226,9 @@ class _TypeState:
         self.pallas_data = None
         self.dirty = False
         # per-feature visibility expressions (None = world-readable);
-        # has_vis avoids an O(n) object-array scan on every query
+        # has_vis avoids an O(n) object-array scan on every query.
+        # Attribute-level schemas store comma-joined per-attribute
+        # labels in the same array (split lazily at query time).
         self.vis: np.ndarray = np.empty(0, dtype=object)
         self.has_vis = False
         # persisted sort orders to install into the next-built zindex
@@ -264,8 +307,22 @@ class _TypeState:
         if len(vis) != batch.n:
             raise ValueError("visibilities length mismatch")
         from ..security import parse_visibility
-        for e in distinct:
-            parse_visibility(str(e))  # raises on malformed expressions
+        if self.sft.visibility_level == "attribute":
+            # comma-joined per-attribute labels (empty = world-readable
+            # for that attribute), KryoVisibilityRowEncoder's layout
+            n_attr = len(self.sft.attributes)
+            for e in distinct:
+                parts = str(e).split(",")
+                if len(parts) != n_attr:
+                    raise ValueError(
+                        f"attribute-level visibility needs {n_attr} "
+                        f"comma-separated labels, got {e!r}")
+                for p in parts:
+                    if p:
+                        parse_visibility(p)
+        else:
+            for e in distinct:
+                parse_visibility(str(e))  # raises on malformed exprs
         if distinct:
             self.has_vis = True
         self._pending.append((batch, vis))
@@ -707,13 +764,26 @@ class InMemoryDataStore(DataStore):
             if managed is not None:
                 _REAPER.complete(managed)
 
+        attr_mask = None
         if q.auths is not None or st.has_vis:
             from ..security import evaluate_visibilities
             auths = q.auths or []
-            # evaluate only the rows that survived the scan
-            vis_ok = evaluate_visibilities(st.vis[idx], auths)
-            idx = idx[vis_ok]
-            explain(f"Visibility filter applied ({len(auths)} auths)")
+            if st.sft.visibility_level == "attribute" and st.has_vis:
+                # a row survives when ANY of its attributes is
+                # authorized; the mask rides along (aligned with idx)
+                # so materialization nulls cells without re-parsing
+                m = _attr_vis_masks(st.vis[idx],
+                                    len(st.sft.attributes), auths)
+                keep = m.any(axis=1)
+                idx = idx[keep]
+                attr_mask = m[keep]
+                explain(f"Attribute-level visibility filter applied "
+                        f"({len(auths)} auths)")
+            else:
+                # evaluate only the rows that survived the scan
+                vis_ok = evaluate_visibilities(st.vis[idx], auths)
+                idx = idx[vis_ok]
+                explain(f"Visibility filter applied ({len(auths)} auths)")
 
         rate = q.hints.get(QueryHints.SAMPLING)
         if rate is not None and len(idx):
@@ -725,9 +795,12 @@ class InMemoryDataStore(DataStore):
                 # nulls sort as empty string (argsort needs a total order)
                 by = np.array([col.value(int(i)) or "" for i in idx],
                               dtype=object).astype(str)
-            idx = idx[sample_mask(len(idx), float(rate), by)]
+            smask = sample_mask(len(idx), float(rate), by)
+            idx = idx[smask]
+            if attr_mask is not None:
+                attr_mask = attr_mask[smask]
             explain(f"Sampling applied: rate={rate}")
-        return idx, strategy, t_plan, t_scan0
+        return idx, strategy, t_plan, t_scan0, attr_mask
 
     def query(self, q: Query | str, type_name: str | None = None,
               explain_out=None) -> QueryResult:
@@ -744,13 +817,18 @@ class InMemoryDataStore(DataStore):
             return QueryResult(np.empty(0, dtype=object), None, explain,
                                FilterStrategy("empty", None, None))
         import time as _time
-        idx, strategy, t_plan, t_scan0 = self._matching_rows(q, st,
-                                                             explain)
+        idx, strategy, t_plan, t_scan0, attr_mask = \
+            self._matching_rows(q, st, explain)
         if q.sort_by is not None:
             from .common import sort_order
-            idx = idx[sort_order(st.batch, q.sort_by, q.sort_desc, idx)]
+            order = sort_order(st.batch, q.sort_by, q.sort_desc, idx)
+            idx = idx[order]
+            if attr_mask is not None:
+                attr_mask = attr_mask[order]
         if q.max_features is not None:
             idx = idx[:q.max_features]
+            if attr_mask is not None:
+                attr_mask = attr_mask[:q.max_features]
 
         if len(idx) <= 10_000:
             ids = st.batch.ids[idx]
@@ -771,7 +849,24 @@ class InMemoryDataStore(DataStore):
                                f"{', '.join(missing)}")
         batch: Any = _LazyBatch(st.batch, idx, q.properties,
                                 row_order=q.sort_by is None)
-        if len(idx) <= 10_000:
+        if attr_mask is not None:
+            # null unauthorized attribute values in the result rows
+            # (KryoVisibilityRowEncoder: the row is assembled from the
+            # cells the scanner's auths can see)
+            m = attr_mask
+            if not m.all():
+                mb = batch.materialize() if isinstance(batch, _LazyBatch) \
+                    else batch
+                by_name = {a.name: j
+                           for j, a in enumerate(st.sft.attributes)}
+                cols = {}
+                for a in mb.sft.attributes:
+                    col = mb.col(a.name)
+                    bad = ~m[:, by_name[a.name]]
+                    cols[a.name] = (_null_cells(col, bad) if bad.any()
+                                    else col)
+                batch = FeatureBatch(mb.sft, mb.ids, cols)
+        if isinstance(batch, _LazyBatch) and len(idx) <= 10_000:
             # small results materialize eagerly: the copy is trivial and
             # an unread result must not pin the multi-GB table snapshot
             batch = batch.materialize()
@@ -799,7 +894,7 @@ class InMemoryDataStore(DataStore):
         import time as _time
         explain = Explainer()
         explain.push(f"Counting '{q.type_name}' filter={q.filter}")
-        idx, _, t_plan, t_scan0 = self._matching_rows(q, st, explain)
+        idx, _, t_plan, t_scan0, _m = self._matching_rows(q, st, explain)
         n = len(idx)
         if q.max_features is not None:
             n = min(n, q.max_features)

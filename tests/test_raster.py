@@ -78,3 +78,64 @@ class TestRaster:
         a = rs.mosaic(bbox, 20, 20, level=3)
         b = rs2.mosaic(bbox, 20, 20, level=3)
         assert np.array_equal(a, b, equal_nan=True)
+
+
+class TestQueryPlanner:
+    """AccumuloRasterQueryPlanner / GeoMesaCoverageReader analogs:
+    overview-level selection by requested resolution, extent -> tile
+    key ranges, and the read(extent, w, h) surface."""
+
+    @pytest.fixture()
+    def pyramid(self):
+        rs = RasterStore()
+        bbox = (-5.0, 35.0, 5.0, 40.0)
+        # three overview levels: coarser levels from downsampled grids
+        rs.put_raster(gradient(64, 128, bbox), bbox, level=2)
+        rs.put_raster(gradient(256, 512, bbox), bbox, level=3)
+        rs.put_raster(gradient(1024, 2048, bbox), bbox, level=4)
+        return rs, bbox
+
+    def test_level_selection_policy(self, pyramid):
+        rs, bbox = pyramid
+        pl = rs.planner()
+        res = {lv: pl.resolution_of(lv) for lv in rs.levels}
+        assert res[2] > res[3] > res[4]  # finer levels, finer pitch
+        # a coarse output picks the coarsest sufficient level; a fine
+        # output falls through to finer levels
+        coarse = pl.plan(bbox, 16, 8)
+        fine = pl.plan(bbox, 4096, 2048)
+        assert coarse.level <= fine.level
+        assert fine.level == 4  # finest available for a too-fine ask
+        # exact policy: coarsest level with resolution <= target
+        # (floor keeps the implied target >= the level's own pitch)
+        for lv in rs.levels:
+            w = int((bbox[2] - bbox[0]) / res[lv])
+            assert pl.plan(bbox, w, 1).level == lv
+
+    def test_plan_ranges_cover_extent(self, pyramid):
+        rs, bbox = pyramid
+        plan = rs.planner().plan((-3, 36, 3, 39), 128, 64)
+        assert plan.n_tiles > 0
+        assert plan.ranges and len(plan.ranges) <= plan.n_tiles
+        # every covering geohash falls inside exactly one run
+        from geomesa_tpu.raster.planner import _ranges_of
+        for gh in plan.geohashes:
+            assert any(lo <= gh <= hi for lo, hi in plan.ranges)
+        # runs are disjoint + sorted
+        flat = [b for r in plan.ranges for b in r]
+        assert flat == sorted(flat)
+
+    def test_read_matches_function(self, pyramid):
+        rs, bbox = pyramid
+        sub = (-4, 35.5, 4, 39.5)
+        out = rs.read(sub, 100, 50)
+        assert out.shape == (50, 100)
+        truth = gradient(50, 100, sub)
+        ok = ~np.isnan(out)
+        assert ok.mean() > 0.99
+        assert np.nanmax(np.abs(out - truth)) < 0.6
+
+    def test_read_empty_store(self):
+        rs = RasterStore()
+        out = rs.read((-10, -10, 10, 10), 8, 8)
+        assert out.shape == (8, 8) and np.isnan(out).all()

@@ -577,3 +577,14 @@ class TestJoinAggregates:
                            "GROUP BY g.name ORDER BY g.name LIMIT 4")
         names = list(res.column("g.name"))
         assert names == sorted(names) and len(names) == 4
+
+
+class TestHavingOnGroupKey:
+    def test_having_on_key_not_in_select(self, engine, store):
+        res = engine.query(
+            "SELECT COUNT(*) AS n FROM gdelt GROUP BY name "
+            "HAVING name = 'actor7'")
+        gb = store._state("gdelt").batch
+        names = np.array([gb.col("name").value(i) for i in range(N)])
+        assert res.n == 1
+        assert int(res.column("n")[0]) == int((names == "actor7").sum())

@@ -237,3 +237,70 @@ class TestReviewRegressions:
             "v": [1, 2, 3], "geom": ([0.0, 1.0, 2.0], [0.0, 1.0, 2.0])})
         res = ds.query("IN ('f1','f2') AND IN ('f2','f3')", "t")
         assert set(res.ids.astype(str)) == {"f2"}
+
+
+class TestAttributeLevelVisibility:
+    """geomesa.visibility.level=attribute: one label per attribute per
+    feature (comma-joined); queries null unauthorized attribute values
+    instead of dropping rows, and a row with no visible attribute
+    disappears (KryoVisibilityRowEncoder semantics)."""
+
+    SPEC = ("name:String,age:Integer,dtg:Date,*geom:Point;"
+            "geomesa.visibility.level='attribute'")
+
+    def _store(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("t", self.SPEC))
+        ds.write_dict(
+            "t", ["a", "b", "c"],
+            {"name": ["alice", "bob", "carol"],
+             "age": [30, 40, 50],
+             "dtg": [MS("2017-01-01")] * 3,
+             "geom": ([1.0, 2.0, 3.0], [1.0, 2.0, 3.0])},
+            visibilities=[
+                "admin,,,",              # name admin-only, rest open
+                ",admin,,",              # age admin-only
+                "admin,admin,admin,admin",  # everything admin-only
+            ])
+        return ds
+
+    def test_partial_auths_null_unauthorized_attributes(self):
+        ds = self._store()
+        res = ds.query(Query("t", "INCLUDE", auths=[]))
+        got = {str(i): f for i, f in zip(res.ids, res.features())}
+        # c has no visible attribute: the row disappears
+        assert set(got) == {"a", "b"}
+        assert got["a"]["name"] is None and got["a"]["age"] == 30
+        assert got["b"]["name"] == "bob" and got["b"]["age"] is None
+        assert got["a"]["geom"] is not None
+
+    def test_full_auths_see_everything(self):
+        ds = self._store()
+        res = ds.query(Query("t", "INCLUDE", auths=["admin"]))
+        got = {str(i): f for i, f in zip(res.ids, res.features())}
+        assert set(got) == {"a", "b", "c"}
+        assert got["a"]["name"] == "alice"
+        assert got["b"]["age"] == 40
+        assert got["c"]["name"] == "carol"
+
+    def test_count_matches_any_visible(self):
+        ds = self._store()
+        assert ds.query_count(Query("t", "INCLUDE", auths=[])) == 2
+        assert ds.query_count(Query("t", "INCLUDE", auths=["admin"])) == 3
+
+    def test_label_count_validated(self):
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("t", self.SPEC))
+        with pytest.raises(ValueError):
+            ds.write_dict("t", ["x"], {
+                "name": ["n"], "age": [1],
+                "dtg": [MS("2017-01-01")], "geom": ([0.0], [0.0])},
+                visibilities=["admin,user"])  # 2 labels, 4 attrs
+
+    def test_selective_query_with_attribute_vis(self):
+        ds = self._store()
+        res = ds.query(Query(
+            "t", "BBOX(geom, 0, 0, 2.5, 2.5)", auths=[]))
+        got = {str(i): f for i, f in zip(res.ids, res.features())}
+        assert set(got) == {"a", "b"}
+        assert got["a"]["name"] is None
