@@ -311,6 +311,22 @@ def _knn_kernel(px, py, qx, qy, k: int, nrows=None):
     if nrows is not None:
         # capacity-padded resident columns: padded rows never win
         d2 = jnp.where(jnp.arange(px.shape[0]) < nrows, d2, jnp.inf)
+    n = d2.shape[0]
+    bs = 16384
+    if n > 4 * bs:
+        # two-stage exact top-k: per-block top-k batched over blocks
+        # (the vectorized shape the TPU sorts fast), then a final
+        # top-k over nb*k candidates — the single flat top_k over
+        # 50M+ elements lowers to a full-array sort and dominates the
+        # whole query
+        nb = (n + bs - 1) // bs
+        pad = nb * bs - n
+        d2p = jnp.pad(d2, (0, pad), constant_values=jnp.inf)
+        kb = min(k, bs)
+        neg, loc = jax.lax.top_k(-d2p.reshape(nb, bs), kb)
+        cand_idx = (jnp.arange(nb)[:, None] * bs + loc).ravel()
+        neg2, loc2 = jax.lax.top_k(neg.ravel(), k)
+        return -neg2, cand_idx[loc2]
     neg, idx = jax.lax.top_k(-d2, k)
     return -neg, idx
 

@@ -13,6 +13,8 @@ usage in the reference).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 __all__ = ["Envelope", "Geometry", "Point", "LineString", "Polygon",
@@ -266,8 +268,9 @@ class LineString(Geometry):
     def __init__(self, coords):
         self.coords = np.asarray(coords, dtype=np.float64).reshape(-1, 2)
 
-    @property
+    @functools.cached_property
     def envelope(self) -> Envelope:
+        # cached: coordinates are treated as immutable
         if len(self.coords) == 0:
             return Envelope.empty()
         return Envelope(self.coords[:, 0].min(), self.coords[:, 1].min(),
@@ -313,8 +316,9 @@ class Polygon(Geometry):
                       and not np.array_equal(h[0], h[-1]) else h
                       for h in self.holes]
 
-    @property
+    @functools.cached_property
     def envelope(self) -> Envelope:
+        # cached: the shell is treated as immutable
         if len(self.shell) == 0:
             return Envelope.empty()
         return Envelope(self.shell[:, 0].min(), self.shell[:, 1].min(),
@@ -376,8 +380,9 @@ class _Multi(Geometry):
     def __init__(self, parts):
         self.parts = list(parts)
 
-    @property
+    @functools.cached_property
     def envelope(self) -> Envelope:
+        # cached: parts are treated as immutable
         env = Envelope.empty()
         for p in self.parts:
             env = env.expand(p.envelope)

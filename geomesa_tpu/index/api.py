@@ -94,17 +94,25 @@ class Explainer:
 
     def __init__(self, out: Callable[[str], None] | None = None):
         self._depth = 0
-        self._lines: list[str] = []
+        self._lines: list = []
         self._out = out
 
-    def __call__(self, msg: str) -> "Explainer":
-        line = "  " * self._depth + msg
+    def __call__(self, msg) -> "Explainer":
+        """``msg`` may be a zero-arg callable: hot query paths pass
+        lambdas so plan traces that nobody reads never pay the string
+        formatting (filters stringify recursively — WKT and all)."""
+        if self._out is None and callable(msg):
+            self._lines.append(("  " * self._depth, msg))
+            return self
+        if callable(msg):
+            msg = msg()
+        line = "  " * self._depth + str(msg)
         self._lines.append(line)
         if self._out:
             self._out(line)
         return self
 
-    def push(self, msg: str | None = None) -> "Explainer":
+    def push(self, msg=None) -> "Explainer":
         if msg is not None:
             self(msg)
         self._depth += 1
@@ -116,7 +124,15 @@ class Explainer:
 
     @property
     def text(self) -> str:
-        return "\n".join(self._lines)
+        # resolve any deferred messages on first read
+        out = []
+        for ln in self._lines:
+            if isinstance(ln, tuple):
+                indent, fn = ln
+                out.append(indent + str(fn()))
+            else:
+                out.append(ln)
+        return "\n".join(out)
 
 
 class Timing:

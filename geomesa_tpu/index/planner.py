@@ -58,7 +58,7 @@ def decide_strategy(sft: SimpleFeatureType, query: Query,
     """Pick the best strategy (StrategyDecider.getFilterPlan analog)."""
     explain = explain or Explainer()
     options = split_filter(sft, query.filter, indices)
-    explain.push(f"Strategy options for '{query.filter}':")
+    explain.push(lambda: f"Strategy options for '{query.filter}':")
 
     forced = query.hints.get(QueryHints.QUERY_INDEX)
     if forced:
@@ -75,10 +75,10 @@ def decide_strategy(sft: SimpleFeatureType, query: Query,
             s.cost = _stats_cost(sft, s, stats, n_features)
         else:
             s.cost = heuristic_cost(sft, s, n_features)
-        explain(f"option: {s}")
+        explain(lambda s=s: f"option: {s}")
         if best is None or s.cost < best.cost:
             best = s
-    explain(f"Selected: {best}")
+    explain(lambda: f"Selected: {best}")
     explain.pop()
     return best
 

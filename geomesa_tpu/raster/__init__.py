@@ -84,6 +84,7 @@ class RasterStore:
     def __init__(self, directory: str | None = None):
         self.directory = directory
         self._tiles: dict[tuple[int, str], np.ndarray] = {}
+        self._planner = None  # memoized; invalidated by put_raster
         if directory and os.path.isdir(directory):
             self._load_catalog()
 
@@ -128,6 +129,7 @@ class RasterStore:
                 sub = np.where(np.isnan(sub), old, sub)
             self._tiles[key] = sub
             self._persist(key, sub)
+        self._planner = None  # level set / resolutions may have changed
 
     # -- query -------------------------------------------------------------
 
@@ -216,14 +218,21 @@ class RasterStore:
     # -- planned coverage reads ---------------------------------------------
 
     def planner(self) -> "RasterQueryPlanner":
-        return RasterQueryPlanner(self)
+        """Memoized — the planner's per-level resolution cache must
+        survive across reads (a WCS client issues many)."""
+        if self._planner is None:
+            self._planner = RasterQueryPlanner(self)
+        return self._planner
 
     def read(self, bbox, width: int, height: int) -> np.ndarray:
         """WCS-shaped coverage read (GeoMesaCoverageReader.read
         analog): the query planner selects the overview level for the
         requested output resolution and decomposes the extent into
         tile key ranges; the device mosaic assembles the grid."""
-        return CoverageReader(self).read(bbox, width, height)
+        plan = self.planner().plan(bbox, width, height)
+        if plan is None or plan.n_tiles == 0:
+            return np.full((height, width), np.nan, dtype=np.float32)
+        return self.mosaic(bbox, width, height, level=plan.level)
 
 
 from .planner import (CoverageReader, RasterQueryPlan,  # noqa: E402

@@ -132,14 +132,16 @@ class RasterQueryPlanner:
 
 class CoverageReader:
     """WCS-shaped read surface (GeoMesaCoverageReader.read analog):
-    plan -> gather the planned tiles -> device mosaic."""
+    plan -> gather the planned tiles -> device mosaic. Uses the
+    store's memoized planner so per-level resolutions stay cached
+    across reads."""
 
     def __init__(self, store):
         self.store = store
-        self.planner = RasterQueryPlanner(store)
+
+    @property
+    def planner(self) -> RasterQueryPlanner:
+        return self.store.planner()
 
     def read(self, bbox, width: int, height: int) -> np.ndarray:
-        plan = self.planner.plan(bbox, width, height)
-        if plan is None or plan.n_tiles == 0:
-            return np.full((height, width), np.nan, dtype=np.float32)
-        return self.store.mosaic(bbox, width, height, level=plan.level)
+        return self.store.read(bbox, width, height)

@@ -30,6 +30,8 @@ class StatsEstimator:
             self.z3 = Z3Histogram(sft.geom_field, sft.dtg_field,
                                   sft.z3_interval)
         self.attr_hist: dict[str, Histogram] = {}
+        # box-tuple -> coarse-cell indices (see _cells_for_boxes)
+        self._cells_cache: dict[tuple, np.ndarray] = {}
 
     # write-side stats sample cap: the z3 histogram only ever feeds
     # RATIO estimates (mass / total_mass), so a strided subsample keeps
@@ -155,7 +157,13 @@ class StatsEstimator:
 
     def _cells_for_boxes(self, sfc, hist: Z3Histogram, boxes) -> np.ndarray:
         """Indices of coarse z cells whose z-range intersects the boxes'
-        z-ranges over the whole period (cells are leading z bits)."""
+        z-ranges over the whole period (cells are leading z bits).
+        Cached by box tuple: a repeated query's cost estimate must not
+        re-run the range decomposition every time."""
+        key = tuple(b.as_tuple() for b in boxes)
+        cached = self._cells_cache.get(key)
+        if cached is not None:
+            return cached
         shift = hist._shift
         ranges = sfc.ranges([b.as_tuple() for b in boxes],
                             [(0, int(sfc.time.max))], max_ranges=256)
@@ -164,7 +172,11 @@ class StatsEstimator:
         mask = np.zeros(hist.length, dtype=bool)
         for lo, hi in zip(lo_cells.tolist(), hi_cells.tolist()):
             mask[lo:hi + 1] = True
-        return np.flatnonzero(mask)
+        out = np.flatnonzero(mask)
+        if len(self._cells_cache) >= 64:
+            self._cells_cache.pop(next(iter(self._cells_cache)))
+        self._cells_cache[key] = out
+        return out
 
 
 class DataStoreStats:

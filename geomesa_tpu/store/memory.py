@@ -734,8 +734,10 @@ class InMemoryDataStore(DataStore):
                        explain: Explainer):
         """The shared row-selection pipeline: plan (under the timeout
         reaper), scan, visibility, sampling. Returns (idx, strategy,
-        t_plan, t_scan0); query() materializes from it, query_count()
-        just counts — one pipeline, no drift between the two."""
+        t_plan, t_scan0, attr_mask) — attr_mask is the per-row
+        attribute authorization matrix for attribute-level visibility
+        schemas (None otherwise); query() materializes from it,
+        query_count() just counts — one pipeline, no drift."""
         # query timeout enforcement at stage boundaries
         # (ThreadManagement analog; geomesa.query.timeout property)
         from ..utils.properties import QUERY_TIMEOUT
@@ -777,6 +779,31 @@ class InMemoryDataStore(DataStore):
                 keep = m.any(axis=1)
                 idx = idx[keep]
                 attr_mask = m[keep]
+                # leak guard: the scan matched on RAW values, but the
+                # caller must not learn hidden cells through the
+                # predicate (reference semantics put the visibility
+                # filter BELOW the query filter). Re-evaluate on the
+                # NULLED view; hidden cells compare as NULL (UNKNOWN
+                # -> excluded). Deviation: IS NULL on a hidden cell
+                # under-matches here (the raw scan already dropped it).
+                if not attr_mask.all() \
+                        and not isinstance(q.filter, ast.Include):
+                    refd = ast.props_of(q.filter)
+                    by_name = {a.name: j for j, a
+                               in enumerate(st.sft.attributes)}
+                    hidden_refd = [a for a in refd if a in by_name
+                                   and not attr_mask[:, by_name[a]].all()]
+                    if hidden_refd:
+                        sub = st.batch.take(idx)
+                        cols = dict(sub.columns)
+                        for a in hidden_refd:
+                            cols[a] = _null_cells(
+                                sub.col(a), ~attr_mask[:, by_name[a]])
+                        nulled = FeatureBatch(sub.sft, sub.ids, cols)
+                        ok = np.asarray(evaluate(q.filter, nulled),
+                                        dtype=bool)
+                        idx = idx[ok]
+                        attr_mask = attr_mask[ok]
                 explain(f"Attribute-level visibility filter applied "
                         f"({len(auths)} auths)")
             else:
@@ -810,8 +837,8 @@ class InMemoryDataStore(DataStore):
             q = Query(type_name, q)
         st = self._state(q.type_name)
         explain = Explainer(explain_out)
-        explain.push(f"Planning '{q.type_name}' "
-                     f"filter={q.filter}")
+        explain.push(lambda: f"Planning '{q.type_name}' "
+                             f"filter={q.filter}")
         if st.batch is None or st.n == 0:
             explain("Store is empty").pop()
             return QueryResult(np.empty(0, dtype=object), None, explain,
@@ -893,7 +920,8 @@ class InMemoryDataStore(DataStore):
             return 0
         import time as _time
         explain = Explainer()
-        explain.push(f"Counting '{q.type_name}' filter={q.filter}")
+        explain.push(lambda: f"Counting '{q.type_name}' "
+                             f"filter={q.filter}")
         idx, _, t_plan, t_scan0, _m = self._matching_rows(q, st, explain)
         n = len(idx)
         if q.max_features is not None:

@@ -304,3 +304,20 @@ class TestAttributeLevelVisibility:
         got = {str(i): f for i, f in zip(res.ids, res.features())}
         assert set(got) == {"a", "b"}
         assert got["a"]["name"] is None
+
+    def test_filter_cannot_probe_hidden_attributes(self):
+        """The query predicate must not act as an oracle on cells the
+        caller cannot see: filtering on an admin-only attribute with
+        no auths matches nothing (the hidden cell evaluates as NULL),
+        and sorting/materialization never reveal it."""
+        ds = self._store()
+        # 'a' really has name='alice', but name is admin-only on 'a'
+        res = ds.query(Query("t", "name = 'alice'", auths=[]))
+        assert res.n == 0
+        # with auths the same predicate matches
+        res2 = ds.query(Query("t", "name = 'alice'", auths=["admin"]))
+        assert set(res2.ids.astype(str)) == {"a"}
+        # a predicate on a visible attribute still works without auths
+        res3 = ds.query(Query("t", "age = 30", auths=[]))
+        assert set(res3.ids.astype(str)) == {"a"}
+        assert next(res3.features())["name"] is None
