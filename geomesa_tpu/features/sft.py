@@ -53,6 +53,18 @@ CURRENT_INDEX_VERSION = 2
 # versions a store can read or migrate to (1 = legacy curve)
 KNOWN_INDEX_VERSIONS = frozenset({1, CURRENT_INDEX_VERSION})
 
+
+def check_index_version(to_version) -> int:
+    """Shared reindex-target validation (every store's reindex calls
+    this, so version rules cannot drift between backends)."""
+    if to_version is None:
+        return CURRENT_INDEX_VERSION
+    v = int(to_version)
+    if v not in KNOWN_INDEX_VERSIONS:
+        raise ValueError(f"unknown index version {to_version}; "
+                         f"known: {sorted(KNOWN_INDEX_VERSIONS)}")
+    return v
+
 GEOMETRY_TYPES = {
     "Point", "LineString", "Polygon", "MultiPoint", "MultiLineString",
     "MultiPolygon", "GeometryCollection", "Geometry",

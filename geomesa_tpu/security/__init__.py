@@ -7,7 +7,7 @@ row-level security on stores without native cell visibility.
 """
 
 from .visibility import (VisibilityExpression, evaluate_visibilities,
-                         parse_visibility)
+                         parse_visibility, validate_labels)
 
 __all__ = ["VisibilityExpression", "evaluate_visibilities",
-           "parse_visibility"]
+           "parse_visibility", "validate_labels"]

@@ -14,7 +14,29 @@ import re
 import numpy as np
 
 __all__ = ["parse_visibility", "VisibilityExpression",
-           "evaluate_visibilities"]
+           "evaluate_visibilities", "validate_labels"]
+
+
+def validate_labels(sft, labels) -> None:
+    """Validate distinct visibility labels against a schema: ONE shared
+    check for every backend's write path (memory, fs, live, ...), so
+    arity and grammar rules cannot drift. Attribute-level schemas need
+    exactly one comma-separated part per attribute; every non-empty
+    part (or whole label) must parse."""
+    if sft.visibility_level == "attribute":
+        n_attr = len(sft.attributes)
+        for e in labels:
+            parts = str(e).split(",")
+            if len(parts) != n_attr:
+                raise ValueError(
+                    f"attribute-level visibility needs {n_attr} "
+                    f"comma-separated labels, got {e!r}")
+            for p in parts:
+                if p:
+                    parse_visibility(p)
+    else:
+        for e in labels:
+            parse_visibility(str(e))
 
 _TERM_RE = re.compile(r'[A-Za-z0-9_\-:./]+|"(?:[^"\\]|\\.)*"')
 

@@ -71,6 +71,8 @@ def write_json_atomic(path: str, obj):
 def _encode(msg: GeoMessage) -> bytes:
     header: dict = {"kind": msg.kind, "type_name": msg.type_name,
                     "ids": list(msg.ids), "timestamp_ms": msg.timestamp_ms}
+    if msg.visibilities is not None:
+        header["vis"] = list(msg.visibilities)
     payload = b""
     if msg.batch is not None:
         import pyarrow as pa
@@ -95,9 +97,11 @@ def _decode(raw: bytes) -> GeoMessage:
         with pa.ipc.open_stream(pa.BufferReader(payload)) as r:
             rb = r.read_next_batch()
         batch = FeatureBatch.from_arrow(sft, rb)
+    vis = header.get("vis")
     return GeoMessage(header["kind"], header["type_name"], batch,
                       tuple(header.get("ids") or ()),
-                      header.get("timestamp_ms", 0))
+                      header.get("timestamp_ms", 0),
+                      None if vis is None else tuple(vis))
 
 
 class FileBus:

@@ -34,6 +34,9 @@ from .partitions import (DateTimeScheme, PartitionScheme, Z2Scheme,
 
 __all__ = ["FileSystemDataStore"]
 
+# reserved parquet column carrying per-feature visibility labels
+_VIS_COL = "__vis__"
+
 
 def _safe_partition(name) -> str:
     """Sanitize a scheme-produced partition name into a relative path:
@@ -226,9 +229,19 @@ class FileSystemDataStore(DataStore):
 
     # -- writes ------------------------------------------------------------
 
-    def write(self, type_name: str, batch: FeatureBatch):
+    def write(self, type_name: str, batch: FeatureBatch,
+              visibilities=None):
+        import pyarrow as pa
         import pyarrow.parquet as pq
         st = self._state(type_name)
+        vis = None
+        if visibilities is not None:
+            vis = np.asarray(visibilities, dtype=object)
+            if len(vis) != batch.n:
+                raise ValueError("visibilities length mismatch")
+            from ..security import validate_labels
+            validate_labels(st.sft,
+                            set(v for v in vis.tolist() if v))
         names = st.scheme.partition_for_rows(st.sft, batch)
         for part in np.unique(names):
             sel = np.flatnonzero(names == part)
@@ -236,8 +249,14 @@ class FileSystemDataStore(DataStore):
             pdir = os.path.join(st.data_dir, _safe_partition(part))
             os.makedirs(pdir, exist_ok=True)
             path = os.path.join(pdir, f"{uuid.uuid4().hex[:12]}.parquet")
-            import pyarrow as pa
-            pq.write_table(pa.Table.from_batches([sub.to_arrow()]), path)
+            table = pa.Table.from_batches([sub.to_arrow()])
+            if vis is not None:
+                # labels persist in a reserved column next to the data
+                # (the Accumulo column-visibility model made durable)
+                table = table.append_column(
+                    _VIS_COL, pa.array([None if v is None else str(v)
+                                        for v in vis[sel]], pa.string()))
+            pq.write_table(table, path)
         st.cache.clear()
         st.pending_sidecar.clear()
 
@@ -391,13 +410,45 @@ class FileSystemDataStore(DataStore):
         ds.create_schema(sft)
         if files:
             dataset = pds.dataset(files)
+            has_vis = _VIS_COL in dataset.schema.names
+            if has_vis and columns is not None:
+                # labels must survive projection or vis filtering
+                # silently disappears on projected queries
+                columns = columns + [_VIS_COL]
+            # attribute-level labels are positional over the FULL
+            # schema; a projected load must remap each label to the
+            # kept attributes or the parts guard the wrong columns
+            remap = None
+            if (has_vis and props is not None
+                    and st.sft.visibility_level == "attribute"):
+                kept = {a.name for a in sft.attributes}
+                keep_j = [j for j, a in enumerate(st.sft.attributes)
+                          if a.name in kept]
+                n_full = len(st.sft.attributes)
+
+                def remap(v, _k=keep_j, _n=n_full):
+                    if not v:
+                        return v
+                    parts = (str(v).split(",") + [""] * _n)[:_n]
+                    return ",".join(parts[j] for j in _k)
             # row-group statistics pruning + row-level predicate and
             # column projection happen inside the parquet scan
             table = dataset.to_table(filter=expr, columns=columns)
             for rb in table.to_batches():
-                if rb.num_rows:
-                    ds.write(sft.type_name,
-                             FeatureBatch.from_arrow(sft, rb))
+                if not rb.num_rows:
+                    continue
+                vis = None
+                if has_vis:
+                    i = rb.schema.get_field_index(_VIS_COL)
+                    vis = np.asarray(rb.column(i).to_pylist(),
+                                     dtype=object)
+                    if remap is not None:
+                        vis = np.array([remap(v) for v in vis],
+                                       dtype=object)
+                    rb = rb.drop_columns([_VIS_COL])
+                ds.write(sft.type_name,
+                         FeatureBatch.from_arrow(sft, rb),
+                         visibilities=vis)
         # adopt a persisted index snapshot for this exact load, or mark
         # the store for persistence once a query builds its index
         if files:
@@ -499,17 +550,12 @@ class FileSystemDataStore(DataStore):
         sort orders are meaningless under the new curve — load_state
         also rejects them by version), and rebuild loaded stores."""
         import shutil
-        from ..features.sft import (CURRENT_INDEX_VERSION,
-                                    KNOWN_INDEX_VERSIONS, Configs)
-        if to_version is None:
-            to_version = CURRENT_INDEX_VERSION
-        if int(to_version) not in KNOWN_INDEX_VERSIONS:
-            raise ValueError(f"unknown index version {to_version}; "
-                             f"known: {sorted(KNOWN_INDEX_VERSIONS)}")
+        from ..features.sft import Configs, check_index_version
+        to_version = check_index_version(to_version)
         st = self._state(type_name)
-        if st.sft.index_version == int(to_version):
+        if st.sft.index_version == to_version:
             return
-        st.sft.user_data[Configs.INDEX_VERSION] = int(to_version)
+        st.sft.user_data[Configs.INDEX_VERSION] = to_version
         meta_path = os.path.join(st.root, "metadata.json")
         with open(meta_path) as fh:
             meta = json.load(fh)
@@ -543,7 +589,8 @@ class FileSystemDataStore(DataStore):
             if len(files) <= 1:
                 continue
             tables = [pq.read_table(f) for f in files]
-            merged = pa.concat_tables(tables)
+            # files may disagree on the optional __vis__ column
+            merged = pa.concat_tables(tables, promote_options="default")
             out = os.path.join(pdir, f"{uuid.uuid4().hex[:12]}.parquet")
             pq.write_table(merged, out)
             for f in files:

@@ -58,7 +58,8 @@ class LambdaDataStore(DataStore):
     def _transient_has(self, type_name: str) -> bool:
         return type_name in self.transient.get_type_names()
 
-    def write(self, type_name: str, batch, timestamp_ms=None):
+    def write(self, type_name: str, batch, timestamp_ms=None,
+              visibilities=None):
         if not self._transient_has(type_name):
             if type_name in self.persistent.get_type_names():
                 # persistent-only type: register it in the transient
@@ -68,7 +69,8 @@ class LambdaDataStore(DataStore):
                     self.persistent.get_schema(type_name))
             else:
                 raise KeyError(f"no such schema: {type_name}")
-        self.transient.write(type_name, batch, timestamp_ms)
+        self.transient.write(type_name, batch, timestamp_ms,
+                             visibilities=visibilities)
 
     def delete(self, type_name: str, ids):
         self.transient.delete(type_name, ids)
@@ -82,9 +84,18 @@ class LambdaDataStore(DataStore):
             type_name, now - self.persist_after)
         if batch is None or batch.n == 0:
             return 0
+        # visibility labels travel with the features to the durable
+        # tier (looked up by id BEFORE the transient delete)
+        st = self.transient._mem._state(type_name)
+        vis = None
+        if st.has_vis and st.batch is not None:
+            pos = {str(i): k for k, i
+                   in enumerate(st.batch.ids.astype(str))}
+            vis = [st.vis[pos[str(i)]] if str(i) in pos else None
+                   for i in ids]
         # upsert into the persistent store
         self.persistent.delete(type_name, ids)
-        self.persistent.write(type_name, batch)
+        self.persistent.write(type_name, batch, visibilities=vis)
         self.transient.delete(type_name, ids)
         return batch.n
 

@@ -40,6 +40,7 @@ class GeoMessage:
     batch: FeatureBatch | None = None   # for create
     ids: tuple = ()                 # for delete
     timestamp_ms: int = 0
+    visibilities: tuple | None = None   # per-feature labels (create)
 
 
 class MessageBus:
@@ -92,10 +93,14 @@ class LiveDataStore(DataStore):
     # -- producer side -----------------------------------------------------
 
     def write(self, type_name: str, batch: FeatureBatch,
-              timestamp_ms: int | None = None):
+              timestamp_ms: int | None = None, visibilities=None):
         ts = timestamp_ms if timestamp_ms is not None else int(time.time() * 1000)
+        vis = (None if visibilities is None
+               else tuple(None if v is None else str(v)
+                          for v in visibilities))
         self.bus.publish(type_name, GeoMessage("create", type_name, batch,
-                                               timestamp_ms=ts))
+                                               timestamp_ms=ts,
+                                               visibilities=vis))
 
     def delete(self, type_name: str, ids):
         self.bus.publish(type_name, GeoMessage(
@@ -138,7 +143,8 @@ class LiveDataStore(DataStore):
                 if dup.any():
                     self._mem.delete(t, existing.batch.ids[dup])
                     self._arrival_ms[t] = self._arrival_ms[t][~dup]
-            self._mem.write(t, msg.batch)
+            self._mem.write(t, msg.batch,
+                            visibilities=msg.visibilities)
             self._arrival_ms[t] = np.concatenate([
                 self._arrival_ms[t],
                 np.full(msg.batch.n, msg.timestamp_ms, dtype=np.int64)])

@@ -306,23 +306,8 @@ class _TypeState:
             distinct = set(v for v in vis.tolist() if v)
         if len(vis) != batch.n:
             raise ValueError("visibilities length mismatch")
-        from ..security import parse_visibility
-        if self.sft.visibility_level == "attribute":
-            # comma-joined per-attribute labels (empty = world-readable
-            # for that attribute), KryoVisibilityRowEncoder's layout
-            n_attr = len(self.sft.attributes)
-            for e in distinct:
-                parts = str(e).split(",")
-                if len(parts) != n_attr:
-                    raise ValueError(
-                        f"attribute-level visibility needs {n_attr} "
-                        f"comma-separated labels, got {e!r}")
-                for p in parts:
-                    if p:
-                        parse_visibility(p)
-        else:
-            for e in distinct:
-                parse_visibility(str(e))  # raises on malformed exprs
+        from ..security import validate_labels
+        validate_labels(self.sft, distinct)  # raises on malformed
         if distinct:
             self.has_vis = True
         self._pending.append((batch, vis))
@@ -612,17 +597,12 @@ class InMemoryDataStore(DataStore):
         versioned tables): rebuild the sort orders under the new
         curve and swap them in atomically — the old index serves every
         query until the swap."""
-        from ..features.sft import (CURRENT_INDEX_VERSION,
-                                    KNOWN_INDEX_VERSIONS, Configs)
-        if to_version is None:
-            to_version = CURRENT_INDEX_VERSION
-        if int(to_version) not in KNOWN_INDEX_VERSIONS:
-            raise ValueError(f"unknown index version {to_version}; "
-                             f"known: {sorted(KNOWN_INDEX_VERSIONS)}")
+        from ..features.sft import Configs, check_index_version
+        to_version = check_index_version(to_version)
         st = self._state(type_name)
-        if st.sft.index_version == int(to_version):
+        if st.sft.index_version == to_version:
             return
-        st.sft.user_data[Configs.INDEX_VERSION] = int(to_version)
+        st.sft.user_data[Configs.INDEX_VERSION] = to_version
         if st.batch is None or st.n == 0:
             return
         st.dirty = True
@@ -848,7 +828,16 @@ class InMemoryDataStore(DataStore):
             self._matching_rows(q, st, explain)
         if q.sort_by is not None:
             from .common import sort_order
-            order = sort_order(st.batch, q.sort_by, q.sort_desc, idx)
+            hidden = None
+            if attr_mask is not None:
+                # hidden sort values must not leak through the row
+                # ordering: they sort as NULL
+                aj = {a.name: j
+                      for j, a in enumerate(st.sft.attributes)}.get(q.sort_by)
+                if aj is not None:
+                    hidden = ~attr_mask[:, aj]
+            order = sort_order(st.batch, q.sort_by, q.sort_desc, idx,
+                               hidden=hidden)
             idx = idx[order]
             if attr_mask is not None:
                 attr_mask = attr_mask[order]

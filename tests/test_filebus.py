@@ -220,3 +220,24 @@ class TestCrossProcess:
         assert "w0-0" not in ids and "w2-4" in ids
         res = reader.query("BBOX(geom, -1, -1, 5, 5)", "live")
         assert res.n > 0
+
+
+def test_visibilities_roundtrip_through_codec(tmp_path):
+    """GeoMessage visibility labels must survive the wire format (the
+    same codec serves FileBus and the TCP SocketBus)."""
+    import numpy as np
+    from geomesa_tpu.features import FeatureBatch, parse_spec
+    from geomesa_tpu.store.filebus import _decode, _encode
+    from geomesa_tpu.store.live import GeoMessage
+    sft = parse_spec("t", "v:Integer,*geom:Point")
+    batch = FeatureBatch.from_dict(
+        sft, np.array(["a", "b"], dtype=object),
+        {"v": [1, 2], "geom": ([0.0, 1.0], [0.0, 1.0])})
+    msg = GeoMessage("create", "t", batch, timestamp_ms=5,
+                     visibilities=("admin", None))
+    out = _decode(_encode(msg))
+    assert out.visibilities == ("admin", None)
+    assert out.batch.n == 2
+    # absent labels stay absent (no spurious empty tuple)
+    out2 = _decode(_encode(GeoMessage("create", "t", batch)))
+    assert out2.visibilities is None

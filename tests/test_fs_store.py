@@ -390,3 +390,46 @@ class TestIndexSidecars:
             ds.query(f"BBOX(geom, {k}, 0, {k + 1}, 1)", "events")
         idx_dir = tmp_path / "events" / "index"
         assert len(list(idx_dir.iterdir())) <= FileSystemDataStore._SIDECAR_CAP
+
+
+class TestFsAttributeVisibility:
+    SPEC = ("name:String,age:Integer,dtg:Date,*geom:Point;"
+            "geomesa.visibility.level='attribute'")
+
+    def _store(self, tmp_path):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("t", self.SPEC)
+        ds.write_dict("t", ["a", "b"],
+                      {"name": ["alice", "bob"], "age": [30, 40],
+                       "dtg": [MS("2017-01-01")] * 2,
+                       "geom": ([1.0, 2.0], [1.0, 2.0])},
+                      visibilities=["admin,,,", ",,,"])
+        return ds
+
+    def test_labels_persist_and_null_cells(self, tmp_path):
+        self._store(tmp_path)
+        ds2 = FileSystemDataStore(str(tmp_path))  # reopen from parquet
+        res = ds2.query(Query("t", "INCLUDE", auths=[]))
+        got = {str(i): f for i, f in zip(res.ids, res.features())}
+        assert got["a"]["name"] is None and got["b"]["name"] == "bob"
+
+    def test_projected_query_remaps_labels(self, tmp_path):
+        """Projection drops columns; positional labels must remap to
+        the kept attributes (round-4 review finding: projected loads
+        raised on the full-schema label arity)."""
+        ds = self._store(tmp_path)
+        res = ds.query(Query("t", "INCLUDE", auths=[],
+                             properties=["name"]))
+        got = {str(i): f for i, f in zip(res.ids, res.features())}
+        assert got["a"]["name"] is None  # still admin-guarded
+        assert got["b"]["name"] == "bob"
+
+    def test_write_rejects_wrong_label_arity(self, tmp_path):
+        ds = FileSystemDataStore(str(tmp_path))
+        ds.create_schema("t", self.SPEC)
+        with pytest.raises(ValueError):
+            ds.write_dict("t", ["x"],
+                          {"name": ["n"], "age": [1],
+                           "dtg": [MS("2017-01-01")],
+                           "geom": ([0.0], [0.0])},
+                          visibilities=["admin,user"])

@@ -321,3 +321,24 @@ class TestAttributeLevelVisibility:
         res3 = ds.query(Query("t", "age = 30", auths=[]))
         assert set(res3.ids.astype(str)) == {"a"}
         assert next(res3.features())["name"] is None
+
+    def test_sort_cannot_leak_hidden_ordering(self):
+        """Sorting by a hidden attribute must not order rows by the
+        raw values (an ordering oracle): unauthorized sort keys rank
+        as NULL, so their relative order is scan order."""
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec(
+            "t", "age:Integer,*geom:Point;"
+            "geomesa.visibility.level='attribute'"))
+        ds.write_dict("t", ["a", "b", "c"],
+                      {"age": [50, 10, 30],
+                       "geom": ([0.0, 1.0, 2.0], [0.0, 0.0, 0.0])},
+                      visibilities=["admin,", "admin,", "admin,"])
+        res = ds.query(Query("t", "INCLUDE", auths=[], sort_by="age"))
+        # all ages hidden: sort keys equal -> stable scan order a,b,c
+        assert list(res.ids.astype(str)) == ["a", "b", "c"]
+        assert all(f["age"] is None for f in res.features())
+        # authorized callers get the real ordering
+        res2 = ds.query(Query("t", "INCLUDE", auths=["admin"],
+                              sort_by="age"))
+        assert list(res2.ids.astype(str)) == ["b", "c", "a"]
