@@ -141,15 +141,30 @@ def _native_sort_z(z: np.ndarray):
 
 _native_build = None  # None = unprobed, False = unavailable
 _PERIOD_CODE = {timebin.TimePeriod.DAY: 0, timebin.TimePeriod.WEEK: 1}
+_EDGE_CACHE: dict = {}  # period -> int64 bin-edge epoch millis
+
+
+def _bin_edges(period) -> np.ndarray:
+    """Epoch millis of every calendar bin boundary (MONTH/YEAR), one
+    past the last indexable bin included — computed once, 262KB."""
+    period = timebin.TimePeriod.parse(period)
+    if period not in _EDGE_CACHE:
+        unit = "M" if period is timebin.TimePeriod.MONTH else "Y"
+        grid = np.arange(0, 32769).astype(f"datetime64[{unit}]")
+        _EDGE_CACHE[period] = grid.astype("datetime64[ms]") \
+            .astype(np.int64)
+    return _EDGE_CACHE[period]
 
 
 def _native_encode_binned_z3(x, y, millis, period):
     """(bins:int32, z:int64) from the fused native clamp+bin+encode
     pass (native/src/zbuild.cpp), or None when the native library is
-    absent or the period needs calendar binning (MONTH/YEAR)."""
+    absent. DAY/WEEK use constant-divisor bin splits; MONTH/YEAR pass
+    a precomputed calendar bin-edge table and binary-search it fused
+    with the encode."""
     global _native_build
-    code = _PERIOD_CODE.get(timebin.TimePeriod.parse(period))
-    if code is None or _native_build is False or not len(x):
+    period = timebin.TimePeriod.parse(period)
+    if _native_build is False or not len(x):
         return None
     import ctypes
     if _native_build is None:
@@ -162,6 +177,10 @@ def _native_encode_binned_z3(x, y, millis, period):
                 ctypes.c_int64,
                 [dp, dp, i64p, ctypes.c_int64, ctypes.c_int32,
                  ctypes.c_double, i32p, i64p]),
+            "geomesa_encode_binned_z3_edges": (
+                ctypes.c_int64,
+                [dp, dp, i64p, ctypes.c_int64, i64p, ctypes.c_int64,
+                 ctypes.c_int64, ctypes.c_double, i32p, i64p]),
         })
         _native_build = lib if lib is not None else False
         if _native_build is False:
@@ -175,9 +194,19 @@ def _native_encode_binned_z3(x, y, millis, period):
     bins = np.empty(n, dtype=np.int32)
     z = np.empty(n, dtype=np.int64)
     dptr = ctypes.POINTER(ctypes.c_double)
-    rc = _native_build.geomesa_encode_binned_z3(
-        x.ctypes.data_as(dptr), y.ctypes.data_as(dptr), _i64p(millis),
-        n, code, float(z3sfc(period).time.max), _i32p(bins), _i64p(z))
+    t_max = float(z3sfc(period).time.max)
+    code = _PERIOD_CODE.get(period)
+    if code is not None:
+        rc = _native_build.geomesa_encode_binned_z3(
+            x.ctypes.data_as(dptr), y.ctypes.data_as(dptr),
+            _i64p(millis), n, code, t_max, _i32p(bins), _i64p(z))
+    else:
+        edges = _bin_edges(period)
+        off_div = 1000 if period is timebin.TimePeriod.MONTH else 60_000
+        rc = _native_build.geomesa_encode_binned_z3_edges(
+            x.ctypes.data_as(dptr), y.ctypes.data_as(dptr),
+            _i64p(millis), n, _i64p(edges), len(edges) - 1, off_div,
+            t_max, _i32p(bins), _i64p(z))
     return None if rc != 0 else (bins, z)
 
 

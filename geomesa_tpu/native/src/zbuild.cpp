@@ -122,3 +122,50 @@ extern "C" int64_t geomesa_encode_binned_z3(
         return -1;
     return 0;
 }
+
+// Calendar-binned variant (MONTH/YEAR): bin boundaries are irregular,
+// so the caller passes the precomputed bin-edge epoch millis (edges
+// has nbins+1 entries, edges[b] = first instant of bin b) and the
+// offset divisor (1000 for month-seconds, 60000 for year-minutes).
+// Rows clamp leniently into [edges[0], edges[nbins]-1] and binary
+// search their bin — fused with the z3 encode in the same pass.
+extern "C" int64_t geomesa_encode_binned_z3_edges(
+    const double* x, const double* y, const int64_t* millis, int64_t n,
+    const int64_t* edges, int64_t nbins, int64_t off_div, double t_max,
+    int32_t* bins_out, int64_t* z_out) {
+    if (n < 0 || nbins <= 0 || off_div <= 0 || !(t_max > 0.0)) return -1;
+    const double bins_f = 2097152.0;  // 2^21
+    const double nx = bins_f / 360.0;
+    const double ny = bins_f / 180.0;
+    const double nt = bins_f / t_max;
+    const uint64_t mi = (1ULL << 21) - 1;
+    const int64_t lo = edges[0];
+    const int64_t hi = edges[nbins] - 1;
+    int32_t prev_bin = 0;  // locality: consecutive rows share bins
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t ms = millis[i];
+        if (ms < lo) ms = lo;
+        if (ms > hi) ms = hi;
+        int32_t b;
+        if (edges[prev_bin] <= ms && ms < edges[prev_bin + 1]) {
+            b = prev_bin;
+        } else {
+            // upper_bound(edges, ms) - 1
+            int64_t l = 0, r = nbins;
+            while (l < r) {
+                const int64_t m = (l + r) / 2;
+                if (edges[m] <= ms) l = m + 1; else r = m;
+            }
+            b = (int32_t)(l - 1);
+            prev_bin = b;
+        }
+        bins_out[i] = b;
+        const int64_t off = (ms - edges[b]) / off_div;
+        const uint64_t xi = norm(x[i], -180.0, 180.0, nx, mi);
+        const uint64_t yi = norm(y[i], -90.0, 90.0, ny, mi);
+        const uint64_t ti = norm((double)off, 0.0, t_max, nt, mi);
+        z_out[i] = (int64_t)(split3(xi) | (split3(yi) << 1)
+                             | (split3(ti) << 2));
+    }
+    return 0;
+}

@@ -81,12 +81,6 @@ class TestFusedEncode:
         assert np.array_equal(bins, eb)
         assert np.array_equal(z, ez)
 
-    def test_month_period_declines(self, period):
-        del period
-        out = zkeys._native_encode_binned_z3(
-            np.array([1.0]), np.array([2.0]),
-            np.array([1000], dtype=np.int64), TimePeriod.MONTH)
-        assert out is None
 
 
 class TestBucketedSort:
@@ -157,3 +151,56 @@ class TestBucketedSort:
         assert np.array_equal(perm, eperm)
         assert np.array_equal(ubins, [0, 5, 900])
         assert np.array_equal(seg_offsets, [0, 1, 3, 5])
+
+
+class TestCalendarEncode:
+    """MONTH/YEAR fused native encode (bin-edge table) must match the
+    numpy datetime64 calendar-binning path exactly."""
+
+    @pytest.mark.parametrize("period", ["month", "year"])
+    def test_parity_with_numpy_path(self, period):
+        from geomesa_tpu.curves import timebin
+        from geomesa_tpu.curves.sfc import z3sfc
+        rng = np.random.default_rng(13)
+        n = 50_000
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        lo = int(np.datetime64("1975-01-01", "ms").astype(np.int64))
+        hi = int(np.datetime64("2030-01-01", "ms").astype(np.int64))
+        ms = rng.integers(lo, hi, n)
+        # a few out-of-range rows probe the lenient clamp
+        ms[:3] = [-5, 0, 2**55]
+        out = zkeys._native_encode_binned_z3(x, y, ms, period)
+        if out is None:
+            pytest.skip("native library unavailable")
+        bins, z = out
+        sfc = z3sfc(period)
+        ebins, eoffs = timebin.to_binned(ms, period, lenient=True)
+        ez = sfc.index(x, y, np.minimum(eoffs.astype(np.float64),
+                                        sfc.time.max),
+                       lenient=True).astype(np.int64)
+        assert np.array_equal(bins, ebins)
+        assert np.array_equal(z, ez)
+
+    def test_build_z3_uses_native_for_month(self):
+        rng = np.random.default_rng(14)
+        n = 20_000
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+        lo = int(np.datetime64("2015-01-01", "ms").astype(np.int64))
+        hi = int(np.datetime64("2020-01-01", "ms").astype(np.int64))
+        ms = rng.integers(lo, hi, n)
+        zi = zkeys.ZKeyIndex(x, y, ms, "month")
+        rows = zi.query_rows(
+            "z3", [(-20.0, -20.0, 20.0, 20.0)],
+            [(int(np.datetime64("2016-03-01", "ms").astype(np.int64)),
+              int(np.datetime64("2016-09-01", "ms").astype(np.int64)))],
+            n, n)
+        kind, got = rows
+        assert kind == "exact"
+        t0 = int(np.datetime64("2016-03-01", "ms").astype(np.int64))
+        t1 = int(np.datetime64("2016-09-01", "ms").astype(np.int64))
+        hitm = ((x >= -20) & (x <= 20) & (y >= -20) & (y <= 20)
+                & (ms >= t0) & (ms <= t1))
+        assert set(np.asarray(got).tolist()) == \
+            set(np.flatnonzero(hitm).tolist())
