@@ -542,6 +542,11 @@ class Z3Histogram(Stat):
         self.bins: dict[int, np.ndarray] = {}
         # z bits kept: log2(length) of the leading z3 bits
         self._shift = 63 - int(np.log2(length))
+        # incrementally-maintained aggregates: the cost estimator reads
+        # these per query, so they must never require an O(bins) walk
+        self.total = 0
+        self.bin_mass: dict[int, int] = {}
+        self.cell_mass = np.zeros(length, dtype=np.int64)
 
     def observe(self, batch: FeatureBatch, weight: int = 1) -> None:
         """``weight`` scales this batch's counts — the write path
@@ -576,6 +581,10 @@ class Z3Histogram(Stat):
             arr = self.bins.setdefault(int(b),
                                        np.zeros(self.length, dtype=np.int64))
             arr += grid[j]
+            m = int(grid[j].sum())
+            self.bin_mass[int(b)] = self.bin_mass.get(int(b), 0) + m
+            self.total += m
+        self.cell_mass += grid.sum(axis=0)
 
     def count(self, time_bin: int, cell: int) -> int:
         arr = self.bins.get(time_bin)
@@ -587,6 +596,9 @@ class Z3Histogram(Stat):
                 self.bins[b] += arr
             else:
                 self.bins[b] = arr.copy()
+            self.bin_mass[b] = self.bin_mass.get(b, 0) + int(arr.sum())
+        self.total += other.total
+        self.cell_mass += other.cell_mass
         return self
 
     @property

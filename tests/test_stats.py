@@ -129,6 +129,24 @@ class TestSketches:
         total = sum(int(a.sum()) for a in s.bins.values())
         assert total == b.n
 
+    def test_z3_histogram_aggregates(self):
+        # total / bin_mass / cell_mass are maintained incrementally (the
+        # cost estimator reads them per query) and must stay consistent
+        # with the full per-bin arrays across observe and merge
+        a = Z3Histogram("geom", "dtg", "week", 1024)
+        a.observe(make_batch(3000))
+        a.observe(make_batch(2000))
+        other = Z3Histogram("geom", "dtg", "week", 1024)
+        other.observe(make_batch(1000))
+        a.merge(other)
+        want_total = sum(int(arr.sum()) for arr in a.bins.values())
+        assert a.total == want_total == 6000
+        assert a.bin_mass == {b: int(arr.sum()) for b, arr in a.bins.items()}
+        want_cells = np.zeros(1024, dtype=np.int64)
+        for arr in a.bins.values():
+            want_cells += arr
+        assert np.array_equal(a.cell_mass, want_cells)
+
 
 class TestEstimator:
     def test_selectivity_tracks_area(self):
