@@ -28,6 +28,7 @@ Dates parse to epoch millis; geometries parse via the WKT reader.
 
 from __future__ import annotations
 
+import functools
 import re
 
 import numpy as np
@@ -342,8 +343,15 @@ class _Parser:
         raise ECQLError(f"expected instant, got {t[1]!r}")
 
 
+@functools.lru_cache(maxsize=512)
 def parse_ecql(text: str) -> ast.Filter:
-    """Parse an ECQL filter string to a Filter AST."""
+    """Parse an ECQL filter string to a Filter AST.
+
+    Cached: AST nodes are frozen dataclasses, so one shared tree per
+    query string is safe — and it makes repeated queries hit the
+    stores' plan caches (keyed on filter object identity). The
+    reference caches parsed filters the same way on its servers
+    (IteratorCache, index/iterators/IteratorCache.scala)."""
     text = text.strip()
     if not text:
         return ast.Include()

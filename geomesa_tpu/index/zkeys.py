@@ -29,6 +29,8 @@ the two "tables" of the reference's Z3Index/Z2Index).
 
 from __future__ import annotations
 
+from collections import OrderedDict
+
 import numpy as np
 
 from ..curves import timebin
@@ -315,6 +317,11 @@ def prune_candidates(zindex, index_name: str, boxes, intervals,
     return None
 
 
+# cache-miss sentinel for ZKeyIndex._qcache (a stored None means "the
+# decomposition chose the dense path", which is itself worth caching)
+_QMISS = object()
+
+
 class ZKeyIndex:
     """Sorted (bin, z3) and z2 key orders over point columns.
 
@@ -345,6 +352,11 @@ class ZKeyIndex:
         # full columns into sequential slices
         self._z3_coords = None  # (xs, ys, ms) in z3 order
         self._z2_coords = None  # (xs, ys) in z2 order
+        # (boxes, intervals, caps) -> candidate positions: repeated
+        # queries skip the range decomposition + seek (extend() returns
+        # a NEW index, so entries never outlive the data they describe)
+        self._qcache: "OrderedDict" = OrderedDict()
+        self._qcache_n = 0  # total cached positions (byte bound)
 
     # -- build -------------------------------------------------------------
 
@@ -440,6 +452,8 @@ class ZKeyIndex:
             state.get("index_version", [2]))[0])
         if persisted_v != self.version:
             return False
+        self._qcache.clear()  # positions are per sort-order build
+        self._qcache_n = 0
         ok = False
         if "z3_zsorted" in state and self._millis is not None:
             z_sorted, perm = state["z3_zsorted"], state["z3_perm"]
@@ -474,6 +488,8 @@ class ZKeyIndex:
         out.period = self.period
         out.version = self.version
         out.n = len(out._x)
+        out._qcache = OrderedDict()
+        out._qcache_n = 0
         out._perm_dtype()  # enforce the row cap before any merge work
         # built coord copies merge via the same inserts (delta-sized
         # sort + O(N) memcpy); unbuilt ones stay lazy
@@ -585,7 +601,22 @@ class ZKeyIndex:
         # no z3 order in play, results may only be CANDIDATES (the
         # caller's scan re-checks time), never "exact"
         exact_ok = use_z3 or not intervals_ms
-        if use_z3:
+        # decomposition + seek cache: the candidate POSITIONS (not the
+        # final rows) are deterministic per sort-order snapshot, so a
+        # repeated query skips the z-range decomposition and the
+        # searchsorted seeks; the exact evaluation below still runs —
+        # the cache holds the plan's ranges, the scan stays a scan
+        qkey = (use_z3, tuple(boxes),
+                tuple(tuple(i) for i in intervals_ms),
+                block_cap, max_ranges)
+        hit = self._qcache.get(qkey, _QMISS)
+        if hit is not _QMISS:
+            pos = hit
+            if use_z3:
+                _, _, _, perm = self._build_z3()
+            else:
+                _, perm = self._build_z2()
+        elif use_z3:
             built = self._build_z3()
             if built is None:
                 return None, None
@@ -606,6 +637,16 @@ class ZKeyIndex:
                 pos = None
             else:
                 pos = multi_arange(los, his)
+        if hit is _QMISS and (pos is None or len(pos) <= 262_144):
+            # bounded in BYTES, not just entries: evict oldest until the
+            # retained position arrays fit ~16MB (2M int64 positions)
+            self._qcache_n += 0 if pos is None else len(pos)
+            while (len(self._qcache) >= 64
+                   or self._qcache_n > 2_097_152):
+                _, old = self._qcache.popitem(last=False)
+                if old is not None:
+                    self._qcache_n -= len(old)
+            self._qcache[qkey] = pos
         if pos is None:
             return None, None
         if not exact_ok:

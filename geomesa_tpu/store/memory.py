@@ -20,6 +20,7 @@ This single-device path is the building block the mesh-sharded store
 from __future__ import annotations
 
 import dataclasses
+import weakref
 from typing import Any, Iterator
 
 import numpy as np
@@ -69,6 +70,21 @@ _DEVICE_PIP_ROWS = 2_000_000
 __all__ = ["InMemoryDataStore", "QueryResult"]
 
 
+class _PlanArtifacts:
+    """Filter-derived plan state reused across identical queries
+    (cached next to the FilterStrategy in _TypeState.plan_cache):
+    query geometries/boxes/intervals and the device scan-query struct.
+    All fields derive from the immutable filter AST only, never from
+    the data, so they survive until the plan cache is invalidated."""
+
+    __slots__ = ("geoms", "boxes", "intervals", "needs_exact",
+                 "spatial_f", "sq", "filled")
+
+    def __init__(self):
+        self.filled = False
+        self.sq = None
+
+
 class _LazyBatch:
     """Deferred result materialization: the source batch snapshot (the
     columnar arrays are immutable — writes build new objects) plus the
@@ -85,8 +101,21 @@ class _LazyBatch:
         # False when the caller reordered idx (sort_by): the endpoint
         # identity check below would misread a permutation as identity
         self.row_order = row_order
+        self._mat: FeatureBatch | None = None
+
+    def detach(self):
+        """Break the pin on the source snapshot (the store calls this
+        when data mutates): small results materialize — the copy is
+        trivial, and an unread small result must not keep a superseded
+        multi-GB snapshot alive. Large results stay lazy (pre-existing
+        policy: their consumers read the columns soon, and the copy is
+        the expensive part)."""
+        if self._mat is None and len(self.idx) <= 10_000:
+            self.materialize()
 
     def materialize(self) -> FeatureBatch:
+        if self._mat is not None:
+            return self._mat
         if (self.row_order and self.properties is None
                 and len(self.idx) == self.source.n
                 and self.idx[0] == 0 and self.idx[-1] == self.source.n - 1):
@@ -95,13 +124,16 @@ class _LazyBatch:
             # length checks imply identity: the immutable source
             # snapshot IS the result — an INCLUDE scan over 100M rows
             # must not copy every column
-            return self.source
+            self._mat = self.source
+            return self._mat
         batch = self.source.take(self.idx)
         if self.properties is not None:
             cols = {p: batch.columns[p] for p in self.properties}
             batch = FeatureBatch(
                 _project_sft(self.source.sft, self.properties),
                 batch.ids, cols)
+        self._mat = batch
+        self.source = None  # release the snapshot pin
         return batch
 
 
@@ -234,6 +266,16 @@ class _TypeState:
         # persisted sort orders to install into the next-built zindex
         # (fs-store index sidecars); consumed by ensure_index
         self.zindex_warm: dict | None = None
+        # (filter, hints) -> (filter_ref, FilterStrategy, _PlanArtifacts):
+        # repeated queries skip the splitter/cost decision and the
+        # filter-side geometry/interval extraction (the reference keeps
+        # the same artifacts on its QueryPlan). Cleared on any data
+        # mutation — costs and n_features feed the decision.
+        self.plan_cache: dict = {}
+        # outstanding lazy results: on data mutation, small ones are
+        # detached (materialized) so they stop pinning the superseded
+        # column snapshot
+        self.live_lazy: "weakref.WeakSet" = weakref.WeakSet()
 
     @property
     def scan_data(self):
@@ -312,6 +354,15 @@ class _TypeState:
             self.has_vis = True
         self._pending.append((batch, vis))
         self._pending_n += batch.n
+        self.plan_cache.clear()
+        self._detach_live()
+
+    def _detach_live(self):
+        """Materialize outstanding small lazy results so they release
+        the about-to-be-superseded column snapshot."""
+        for lb in list(self.live_lazy):
+            lb.detach()
+        self.live_lazy.clear()
 
     def has_point_scan(self) -> bool:
         """Whether a device point-scan structure is built or deferred
@@ -407,6 +458,8 @@ class _TypeState:
         # dirty first: the flush skips merge work the delete is about to
         # invalidate anyway
         self.dirty = True
+        self.plan_cache.clear()
+        self._detach_live()
         self.flush()
         if self._batch is None:
             return
@@ -606,6 +659,7 @@ class InMemoryDataStore(DataStore):
         if st.batch is None or st.n == 0:
             return
         st.dirty = True
+        st.plan_cache.clear()
         st.ensure_index()  # rebuild + atomic swap
 
     def analyze(self, type_name: str):
@@ -613,6 +667,7 @@ class InMemoryDataStore(DataStore):
         go stale after deletes — the reference's `stats analyze` run)."""
         st = self._state(type_name)
         self.stats.clear(type_name)
+        st.plan_cache.clear()  # cached strategies used the stale stats
         if st.batch is not None and st.n:
             self.stats.observe(st.sft, st.batch)
         return self.stats.get(type_name)
@@ -731,15 +786,30 @@ class InMemoryDataStore(DataStore):
         import time as _time
         try:
             t_plan0 = _time.perf_counter()
-            strategy = decide_strategy(st.sft, q, self._indices(st.sft),
-                                       st.n,
-                                       stats=self.stats.get(q.type_name),
-                                       explain=explain)
+            # plan cache (keyed on the filter object + strategy-relevant
+            # hints): the ECQL parse cache returns one shared AST per
+            # query string, so repeated queries hit here and skip the
+            # splitter / cost estimation / geometry extraction. The `is`
+            # check makes id() reuse after GC harmless.
+            pkey = (id(q.filter), q.hints.get(QueryHints.QUERY_INDEX))
+            hit = st.plan_cache.get(pkey)
+            if hit is not None and hit[0] is q.filter:
+                strategy, art = hit[1], hit[2]
+                explain(lambda: f"Plan cache hit: {strategy.index}")
+            else:
+                strategy = decide_strategy(st.sft, q,
+                                           self._indices(st.sft), st.n,
+                                           stats=self.stats.get(q.type_name),
+                                           explain=explain)
+                art = _PlanArtifacts()
+                if len(st.plan_cache) >= 256:
+                    st.plan_cache.pop(next(iter(st.plan_cache)))
+                st.plan_cache[pkey] = (q.filter, strategy, art)
             t_plan = _time.perf_counter() - t_plan0
             if managed is not None:
                 managed.check()
             t_scan0 = _time.perf_counter()
-            idx = self._execute(st, q, strategy, explain)
+            idx = self._execute(st, q, strategy, explain, art)
             if managed is not None:
                 managed.check()
         finally:
@@ -846,14 +916,6 @@ class InMemoryDataStore(DataStore):
             if attr_mask is not None:
                 attr_mask = attr_mask[:q.max_features]
 
-        if len(idx) <= 10_000:
-            ids = st.batch.ids[idx]
-        else:
-            # deferred gather against the immutable batch snapshot:
-            # large results are often consumed via batch columns (or
-            # only counted) and never read ids at all
-            src = st.batch
-            ids = (lambda: src.ids[idx])
         if q.properties is not None:
             # validate projection names NOW: errors belong to query(),
             # not to whenever (or whether) .batch is first read
@@ -865,6 +927,7 @@ class InMemoryDataStore(DataStore):
                                f"{', '.join(missing)}")
         batch: Any = _LazyBatch(st.batch, idx, q.properties,
                                 row_order=q.sort_by is None)
+        st.live_lazy.add(batch)
         if attr_mask is not None:
             # null unauthorized attribute values in the result rows
             # (KryoVisibilityRowEncoder: the row is assembled from the
@@ -882,10 +945,24 @@ class InMemoryDataStore(DataStore):
                     cols[a.name] = (_null_cells(col, bad) if bad.any()
                                     else col)
                 batch = FeatureBatch(mb.sft, mb.ids, cols)
-        if isinstance(batch, _LazyBatch) and len(idx) <= 10_000:
-            # small results materialize eagerly: the copy is trivial and
-            # an unread result must not pin the multi-GB table snapshot
-            batch = batch.materialize()
+        if isinstance(batch, FeatureBatch):
+            # attr-visibility path materialized already; reuse its ids
+            ids = batch.ids
+        elif len(idx) <= 100_000:
+            # eager id gather (the result's identity), lazy columns:
+            # id-only consumers — count checks, bench loops, join sides
+            # — never pay the per-column copies, and .batch still
+            # materializes on first read (the reference's readers are
+            # lazy over their scan buffers the same way,
+            # KryoBufferSimpleFeature). The result pins the immutable
+            # column snapshot until dropped.
+            ids = st.batch.ids[idx]
+        else:
+            # deferred gather against the immutable batch snapshot:
+            # large results are often consumed via batch columns (or
+            # only counted) and never read ids at all
+            src = st.batch
+            ids = (lambda: src.ids[idx])
         explain(f"Hits: {len(idx)}").pop()
         if self.audit is not None:
             self.audit.record(q.type_name, str(q.filter), q.hints,
@@ -923,7 +1000,8 @@ class InMemoryDataStore(DataStore):
         return n
 
     def _execute(self, st: _TypeState, q: Query, strategy: FilterStrategy,
-                 explain: Explainer) -> np.ndarray:
+                 explain: Explainer,
+                 art: "_PlanArtifacts | None" = None) -> np.ndarray:
         """Run the chosen strategy; returns sorted matching row indices.
 
         Index-space (not mask-space) so an index-pruned scan never pays
@@ -938,7 +1016,7 @@ class InMemoryDataStore(DataStore):
             st.ensure_index()
 
         if strategy.index in ("z3", "z2") and st.has_point_scan():
-            idx = self._device_scan(st, q, strategy, explain)
+            idx = self._device_scan(st, q, strategy, explain, art)
         elif strategy.index in ("xz3", "xz2") and st.has_extent_scan():
             idx = self._device_extent_scan(st, q, strategy, explain)
         elif strategy.index == "id" and strategy.primary is not None:
@@ -1036,7 +1114,8 @@ class InMemoryDataStore(DataStore):
         return rows[keep]
 
     def _device_scan(self, st: _TypeState, q: Query,
-                     strategy: FilterStrategy, explain: Explainer) -> np.ndarray:
+                     strategy: FilterStrategy, explain: Explainer,
+                     art: "_PlanArtifacts | None" = None) -> np.ndarray:
         """The hot path: z-range index pruning -> fused device kernel
         (gathered candidates or dense) + exact boundary patch +
         non-envelope geometry residual. Returns sorted row indices."""
@@ -1046,14 +1125,24 @@ class InMemoryDataStore(DataStore):
         dtg = sft.dtg_field
         primary = strategy.primary if strategy.primary is not None else ast.Include()
 
-        geoms = extract_geometries(primary, geom)
-        boxes = [g.envelope.as_tuple() for g in geoms] or \
-            [(-180.0, -90.0, 180.0, 90.0)]
-
-        intervals = (_intervals_ms(primary, dtg)
-                     if dtg is not None and strategy.index == "z3" else [])
-
-        sq = zscan.make_query(boxes, intervals)
+        if art is not None and art.filled:
+            geoms, boxes, intervals = art.geoms, art.boxes, art.intervals
+            needs_exact, spatial_f = art.needs_exact, art.spatial_f
+        else:
+            geoms = extract_geometries(primary, geom)
+            boxes = [g.envelope.as_tuple() for g in geoms] or \
+                [(-180.0, -90.0, 180.0, 90.0)]
+            intervals = (_intervals_ms(primary, dtg)
+                         if dtg is not None and strategy.index == "z3"
+                         else [])
+            needs_exact = _needs_exact(geoms, primary)
+            spatial_f = (_spatial_only(primary, geom) if needs_exact
+                         else None)
+            if art is not None:
+                art.geoms, art.boxes = geoms, boxes
+                art.intervals = intervals
+                art.needs_exact, art.spatial_f = needs_exact, spatial_f
+                art.filled = True
 
         # z-range pruning (Z3IndexKeySpace.getRanges analog): the host
         # fast path resolves selective queries EXACTLY inside the index
@@ -1078,17 +1167,23 @@ class InMemoryDataStore(DataStore):
                     f"of {st.n}, {len(boxes)} box(es), "
                     f"{len(intervals)} interval(s)")
             idx = idx_exact
-        elif rows is not None:
-            idx = self._scan_gathered(st, sq, rows, explain,
-                                      len(boxes), len(intervals))
         else:
-            idx = self._scan_dense(st, sq, explain,
-                                   len(boxes), len(intervals))
+            # the two-float device query struct is only needed by the
+            # kernel tiers; the exact host tier above never builds it
+            sq = art.sq if art is not None and art.sq is not None \
+                else zscan.make_query(boxes, intervals)
+            if art is not None:
+                art.sq = sq
+            if rows is not None:
+                idx = self._scan_gathered(st, sq, rows, explain,
+                                          len(boxes), len(intervals))
+            else:
+                idx = self._scan_dense(st, sq, explain,
+                                       len(boxes), len(intervals))
 
         # non-envelope query geometries need the exact predicate too
-        if _needs_exact(geoms, primary):
+        if needs_exact:
             if len(idx):
-                spatial_f = _spatial_only(primary, geom)
                 if spatial_f is not None:
                     col = batch.col(geom)
                     keep = self._pip_residual(spatial_f, col, idx, explain)

@@ -157,6 +157,42 @@ class TestStoreLifecycle:
         res = ds.query("BBOX(geom, 1.2, 1.2, 3, 3)", "t")
         assert set(res.ids.astype(str)) == {"c", "d"}
 
+    def test_small_result_detaches_on_write(self):
+        """A retained small lazy result must not pin the superseded
+        column snapshot once the store mutates — it materializes on
+        the mutation and drops its source reference."""
+        ds = InMemoryDataStore()
+        ds.create_schema("t", "v:Integer,*geom:Point")
+        ds.write_dict("t", ["a", "b"], {
+            "v": [1, 2], "geom": ([0.0, 1.0], [0.0, 1.0])})
+        res = ds.query("BBOX(geom, -1, -1, 0.5, 0.5)", "t")
+        lazy = res._batch
+        ds.write_dict("t", ["c"], {"v": [3], "geom": ([2.0], [2.0])})
+        from geomesa_tpu.store.memory import _LazyBatch
+        assert isinstance(lazy, _LazyBatch)
+        assert lazy.source is None          # pin released
+        assert res.batch.n == 1             # rows from the old snapshot
+        assert list(res.ids.astype(str)) == ["a"]
+
+    def test_plan_cache_refreshes_after_analyze(self):
+        """analyze() recomputes stats; cached strategies decided under
+        the stale stats must not be served afterwards."""
+        from geomesa_tpu.index.api import Query
+        ds = InMemoryDataStore()
+        ds.create_schema("t", "v:Integer,dtg:Date,*geom:Point")
+        n = 1000
+        ds.write_dict("t", [str(i) for i in range(n)], {
+            "v": list(range(n)),
+            "dtg": [MS("2017-01-01")] * n,
+            "geom": (np.linspace(-170, 170, n), np.linspace(-80, 80, n)),
+        })
+        q = Query("t", "BBOX(geom, -10, -10, 10, 10)")
+        ds.query(q)
+        st = ds._state("t")
+        assert st.plan_cache            # populated by the query
+        ds.analyze("t")
+        assert not st.plan_cache        # invalidated with the stats
+
     def test_empty_store_query(self):
         ds = InMemoryDataStore()
         ds.create_schema("t", "v:Integer,*geom:Point")
