@@ -41,6 +41,64 @@ def _resident_xy(st):
     return None if sd is None else (sd.xhi, sd.yhi)
 
 
+def _knn_zring(st, col, qx: float, qy: float, k: int):
+    """Z-index ring-expansion KNN: the reference's iterative geohash
+    spiral (knn/KNNQuery.scala:27-81) with its distance-bounded cut
+    (knn/GeoHashSpiral.scala:53,80), re-keyed to the z2 sorted index —
+    grow a box around the query until it provably contains the k
+    nearest (the kth candidate distance fits inside the box radius),
+    then exact f64 top-k over just the in-box rows. Touches O(rows
+    near q), never the full table. Returns (distances, rows) ascending,
+    or None when the index is unavailable / the region is too dense for
+    the host tier (caller falls back to the fused device scan)."""
+    if k <= 0:
+        return np.empty(0), np.empty(0, dtype=np.int64)
+    try:
+        st.ensure_index()
+    except Exception:
+        return None
+    zi = st.zindex
+    if zi is None or st.n == 0:
+        return None
+    from ..index.zkeys import search_rows
+    # initial radius sized so the box holds ~2k points at the GLOBAL
+    # density (8k/pi in the box); local density deviations just mean an
+    # extra doubling or a one-round shrink via the dk bound
+    rho = max(st.n, 1) / (360.0 * 180.0)
+    r = float(np.sqrt(2.0 * k / (np.pi * rho)))
+    cap = 2_000_000  # host-tier ceiling; denser regions use the kernel
+    for _ in range(64):
+        if (qx - r <= -180.0 and qx + r >= 180.0
+                and qy - r <= -90.0 and qy + r >= 90.0):
+            # ring covers the world: the candidate set is the whole
+            # table, which is exactly what the fused kernel is for
+            return None
+        box = (max(qx - r, -180.0), max(qy - r, -90.0),
+               min(qx + r, 180.0), min(qy + r, 90.0))
+        # cache=False: these boxes never repeat — they must not flush
+        # the decomposition cache serving repeated store queries
+        kind, rows = search_rows(zi, "z2", [box], [], cap, cap,
+                                 cache=False)
+        if kind != "exact":
+            return None
+        if len(rows) >= k:
+            dx = col.x[rows] - qx
+            dy = col.y[rows] - qy
+            d2 = dx * dx + dy * dy
+            sel = np.argpartition(d2, k - 1)[:k]
+            dk = float(np.sqrt(d2[sel].max()))
+            if dk <= r:
+                order = np.argsort(d2[sel], kind="stable")
+                top = sel[order]
+                return np.sqrt(d2[top]), rows[top]
+            # candidates found but the kth may lie outside the box:
+            # one more round with the proven cover radius
+            r = dk * (1.0 + 1e-12)
+        else:
+            r *= 2.0
+    return None
+
+
 def knn_process(store, type_name: str, qx: float, qy: float, k: int,
                 ecql=None):
     """KNearestNeighborSearchProcess (knn/KNearestNeighborSearchProcess.scala:30):
@@ -56,6 +114,10 @@ def knn_process(store, type_name: str, qx: float, qy: float, k: int,
         scol = sub.col(st.sft.geom_field)
         d, idx = knn(scol.x, scol.y, qx, qy, min(k, sub.n))
         return sub.ids[idx], d
+    pruned = _knn_zring(st, col, qx, qy, min(k, st.n))
+    if pruned is not None:
+        d, rows = pruned
+        return st.batch.ids[rows], d
     d, idx = knn(col.x, col.y, qx, qy, min(k, st.n),
                  device_xy=_resident_xy(st))
     return st.batch.ids[idx], d

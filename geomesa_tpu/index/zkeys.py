@@ -275,14 +275,17 @@ def binned_candidate_positions(ubins, seg_offsets, keys_sorted,
 
 
 def search_rows(zindex, index_name: str, boxes, intervals,
-                host_cap: int | None, block_cap: int | None):
+                host_cap: int | None, block_cap: int | None,
+                cache: bool = True):
     """THE store-level fast-path policy (single copy for every store):
     whole-world gate, then one range decomposition via
     ``zindex.query_rows`` serving both tiers — ("exact", rows) under
     ``host_cap``, ("candidates", rows) under ``block_cap``,
     (None, None) for the dense path. Indexes without query_rows (the XZ
     extent family runs its own exact stage) fall back to
-    prune_candidates."""
+    prune_candidates. ``cache=False`` skips the decomposition cache —
+    probe loops with never-repeating boxes (KNN ring expansion) must
+    not flush entries that repeated store queries rely on."""
     whole_world = list(boxes) == [(-180.0, -90.0, 180.0, 90.0)]
     if zindex is None or (whole_world
                           and not (index_name == "z3" and intervals)):
@@ -292,7 +295,8 @@ def search_rows(zindex, index_name: str, boxes, intervals,
         rows = prune_candidates(zindex, index_name, boxes, intervals,
                                 block_cap)
         return ("candidates", rows) if rows is not None else (None, None)
-    return qr(index_name, boxes, intervals, host_cap, block_cap)
+    return qr(index_name, boxes, intervals, host_cap, block_cap,
+              cache=cache)
 
 
 def prune_candidates(zindex, index_name: str, boxes, intervals,
@@ -589,13 +593,14 @@ class ZKeyIndex:
 
     def query_rows(self, index_name: str, boxes, intervals_ms,
                    host_cap: int | None, block_cap: int | None,
-                   max_ranges: int | None = None):
+                   max_ranges: int | None = None, cache: bool = True):
         """ONE range decomposition serving both tiers: returns
         ("exact", rows) when the candidate positions fit ``host_cap``
         (exact evaluation over sorted-order coordinate copies —
         sequential access), ("candidates", rows) when they fit only
         ``block_cap`` (caller runs the gathered device scan), or
-        (None, None) for the dense path."""
+        (None, None) for the dense path. ``cache=False`` neither reads
+        nor writes the decomposition cache (one-shot probe boxes)."""
         use_z3 = index_name == "z3" and bool(intervals_ms)
         # the z2 order cannot evaluate time: with intervals present but
         # no z3 order in play, results may only be CANDIDATES (the
@@ -609,7 +614,7 @@ class ZKeyIndex:
         qkey = (use_z3, tuple(boxes),
                 tuple(tuple(i) for i in intervals_ms),
                 block_cap, max_ranges)
-        hit = self._qcache.get(qkey, _QMISS)
+        hit = self._qcache.get(qkey, _QMISS) if cache else _QMISS
         if hit is not _QMISS:
             pos = hit
             if use_z3:
@@ -637,7 +642,8 @@ class ZKeyIndex:
                 pos = None
             else:
                 pos = multi_arange(los, his)
-        if hit is _QMISS and (pos is None or len(pos) <= 262_144):
+        if cache and hit is _QMISS and (pos is None
+                                        or len(pos) <= 262_144):
             # bounded in BYTES, not just entries: evict oldest until the
             # retained position arrays fit ~16MB (2M int64 positions)
             self._qcache_n += 0 if pos is None else len(pos)

@@ -604,7 +604,11 @@ class InMemoryDataStore(DataStore):
         return sorted(self._types)
 
     def remove_schema(self, type_name: str):
-        self._types.pop(type_name, None)
+        st = self._types.pop(type_name, None)
+        if st is not None:
+            # outstanding small lazy results must not pin the dropped
+            # column snapshot
+            st._detach_live()
 
     def _state(self, type_name: str) -> _TypeState:
         if type_name not in self._types:

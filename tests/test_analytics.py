@@ -180,6 +180,34 @@ class TestProcesses:
         assert len(ids) == 50
         assert np.all(np.diff(d) >= 0)
 
+    def test_knn_process_exact_vs_brute(self, store):
+        """The z-ring pruned path must return the identical id set as
+        a brute-force scan (the bench's ids_exact contract), including
+        queries outside the data extent (forces ring doublings) and
+        k larger than the in-extent neighborhood."""
+        batch = store._state("pts").batch
+        x, y = batch.col("geom").x, batch.col("geom").y
+        for (qx, qy, k) in [(0.0, 0.0, 100), (9.9, -9.9, 17),
+                            (120.0, 40.0, 25), (0.0, 0.0, 1)]:
+            ids, d = knn_process(store, "pts", qx, qy, k)
+            d2 = (x - qx) ** 2 + (y - qy) ** 2
+            expect = set(np.argpartition(d2, k)[:k].tolist()) \
+                if k < len(x) else set(range(len(x)))
+            got = {int(str(i)[1:]) for i in ids}
+            assert got == expect
+            assert np.all(np.diff(d) >= 0)
+
+    def test_knn_process_k_zero(self, store):
+        ids, d = knn_process(store, "pts", 0.0, 0.0, 0)
+        assert len(ids) == 0 and len(d) == 0
+
+    def test_knn_process_fewer_than_k(self):
+        ds = InMemoryDataStore()
+        ds.create_schema("few", "*geom:Point")
+        ds.write_dict("few", ["a", "b"], {"geom": ([0.0, 5.0], [0.0, 5.0])})
+        ids, d = knn_process(ds, "few", 1.0, 1.0, 10)
+        assert list(ids.astype(str)) == ["a", "b"]
+
     def test_knn_process_filtered(self, store):
         ids, d = knn_process(store, "pts", 0.0, 0.0, 10, ecql="kind = 'k1'")
         assert len(ids) == 10
