@@ -23,6 +23,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jaxcache import ensure_compile_cache
+
+ensure_compile_cache()
+
 from ..utils.fp import f32_band as _f32_band
 
 __all__ = ["dwithin_join", "contains_join", "knn"]
@@ -95,6 +99,15 @@ def _sorted_by_x_cached(pxj, nrows, cacheable):
     return xs, order
 
 
+@jax.jit
+def _slab_bounds(xs, qb, w):
+    """Both slab edges in ONE program: a cold call pays one executable
+    load instead of two (each load costs seconds over the tunnel)."""
+    los = jnp.searchsorted(xs, qb - w, side="left")
+    his = jnp.searchsorted(xs, qb + w, side="right")
+    return jnp.stack([los, his])
+
+
 @functools.partial(jax.jit, static_argnames=("smax",))
 def _slab_rows(xs, order, los, smax):
     """Row ids of up to smax sorted positions starting at each lo —
@@ -126,10 +139,8 @@ def _resolve_band_counts(pxj, px64, py64, qx64, qy64, banded,
     eps = float(np.sqrt(max(r2_hi, 0.0))) - radius_deg + 1e-4
     w = radius_deg + eps
     qb = qx64[banded].astype(np.float32)
-    los = np.asarray(jnp.searchsorted(xs, jnp.asarray(qb - np.float32(w)),
-                                      side="left"))
-    his = np.asarray(jnp.searchsorted(xs, jnp.asarray(qb + np.float32(w)),
-                                      side="right"))
+    lohi = np.asarray(_slab_bounds(xs, jnp.asarray(qb), np.float32(w)))
+    los, his = lohi[0], lohi[1]
     widths = his - los
     if not len(widths) or widths.max() == 0:
         return

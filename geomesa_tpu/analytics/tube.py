@@ -17,6 +17,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jaxcache import ensure_compile_cache
+
+ensure_compile_cache()
+
 from ..scan.zscan import MILLIS_PER_DAY, next_pow2, split_two_float
 
 __all__ = ["TubeBuilder", "tube_select_mask"]

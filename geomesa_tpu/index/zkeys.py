@@ -82,6 +82,7 @@ def _native_sort_lib():
         from ..native import symbols
         i32p = ctypes.POINTER(ctypes.c_int32)
         i64p = ctypes.POINTER(ctypes.c_int64)
+        dp = ctypes.POINTER(ctypes.c_double)
         lib = symbols({
             "geomesa_sort_bin_z": (
                 ctypes.c_int64,
@@ -89,6 +90,9 @@ def _native_sort_lib():
                  i64p]),
             "geomesa_sort_z": (
                 ctypes.c_int64, [i64p, ctypes.c_int64, i32p, i64p]),
+            "geomesa_gather_xyz": (
+                ctypes.c_int64,
+                [dp, dp, i64p, i32p, ctypes.c_int64, dp, dp, i64p]),
         })
         _native_sort = lib if lib is not None else False
     return _native_sort or None
@@ -356,6 +360,8 @@ class ZKeyIndex:
         # full columns into sequential slices
         self._z3_coords = None  # (xs, ys, ms) in z3 order
         self._z2_coords = None  # (xs, ys) in z2 order
+        self._z3_uses = 0       # exact-tier queries served per curve;
+        self._z2_uses = 0       # gates the sorted-copy amortization
         # (boxes, intervals, caps) -> candidate positions: repeated
         # queries skip the range decomposition + seek (extend() returns
         # a NEW index, so entries never outlive the data they describe)
@@ -363,6 +369,12 @@ class ZKeyIndex:
         self._qcache_n = 0  # total cached positions (byte bound)
 
     # -- build -------------------------------------------------------------
+
+    # exact-tier queries per curve before the sorted-order coordinate
+    # copies are worth their full-table build cost: the FIRST query
+    # answers off the cheap per-candidate gather (cold start never pays
+    # the full-table copies), any repeat usage amortizes them at once
+    _COORDS_AFTER = 1
 
     def _perm_dtype(self):
         # XLA TPU gathers address with 32-bit indices, and a >=2^31-row
@@ -446,6 +458,15 @@ class ZKeyIndex:
                                             dtype=np.int64)
         return out
 
+    def warm(self) -> None:
+        """Build the curve sort orders now (ingest-time indexing — the
+        reference writes z-keys with every mutation, write path 3.2).
+        Queries that arrive later find a ready index. The sorted-order
+        coordinate copies stay deferred (see _COORDS_AFTER)."""
+        if self._millis is not None:
+            self._build_z3()
+        self._build_z2()
+
     def load_state(self, state: dict) -> bool:
         """Install persisted sort orders (possibly memory-mapped).
         Returns False — installing nothing — when the arrays don't
@@ -494,6 +515,8 @@ class ZKeyIndex:
         out.n = len(out._x)
         out._qcache = OrderedDict()
         out._qcache_n = 0
+        out._z3_uses = self._z3_uses
+        out._z2_uses = self._z2_uses
         out._perm_dtype()  # enforce the row cap before any merge work
         # built coord copies merge via the same inserts (delta-sized
         # sort + O(N) memcpy); unbuilt ones stay lazy
@@ -569,6 +592,47 @@ class ZKeyIndex:
                 None if ms is None else np.insert(ms, pos,
                                                   millis[dorder]))
         return (ubins2, seg_offsets2, new_z, new_perm), coords
+
+    def _gather_coords(self, perm: np.ndarray, with_ms: bool):
+        """Sorted-order coordinate copies — the native fused gather
+        reads ``perm`` once per row and fills every output with
+        sequential writes across threads; numpy fallback pays one
+        single-threaded random gather per column (the difference is
+        seconds of first-query latency at 100M rows)."""
+        ms = self._millis if with_ms else None
+        lib = _native_sort_lib()
+        import os
+        # single-core hosts: numpy's tuned per-array take beats the
+        # fused interleaved loop (3 random streams thrash one cache);
+        # the fused pass wins only when threads split the row range
+        if (os.cpu_count() or 1) > 1 and lib is not None and len(perm) \
+                and perm.dtype == np.int32 \
+                and hasattr(lib, "geomesa_gather_xyz"):
+            import ctypes
+            n = len(perm)
+            x = np.ascontiguousarray(self._x)
+            y = np.ascontiguousarray(self._y)
+            p = np.ascontiguousarray(perm)
+            xo = np.empty(n, dtype=np.float64)
+            yo = np.empty(n, dtype=np.float64)
+            dp = ctypes.POINTER(ctypes.c_double)
+            mo = None
+            msp = ctypes.cast(None, ctypes.POINTER(ctypes.c_int64))
+            mop = msp
+            if ms is not None:
+                mo = np.empty(n, dtype=np.int64)
+                msp = _i64p(np.ascontiguousarray(ms))
+                mop = _i64p(mo)
+            rc = lib.geomesa_gather_xyz(
+                x.ctypes.data_as(dp), y.ctypes.data_as(dp), msp,
+                _i32p(p), n, xo.ctypes.data_as(dp),
+                yo.ctypes.data_as(dp), mop)
+            if rc == 0:
+                return (xo, yo, mo) if with_ms else (xo, yo)
+        if with_ms:
+            return (self._x[perm], self._y[perm],
+                    None if ms is None else ms[perm])
+        return (self._x[perm], self._y[perm])
 
     # -- exact search (host fast path) -------------------------------------
 
@@ -661,20 +725,34 @@ class ZKeyIndex:
             return "exact", np.empty(0, dtype=np.int64)
         if host_cap is not None and len(pos) > host_cap:
             return "candidates", perm[pos].astype(np.int64)
+        # sorted-order coordinate copies turn the candidate pass into
+        # sequential slices, but building them costs full-table gathers
+        # (~10s at 100M rows) — far more than a first query needs. Early
+        # queries evaluate on a per-candidate gather (O(|pos|)); the
+        # copies build only once the curve has served enough queries to
+        # amortize them.
         if use_z3:
-            if self._z3_coords is None:
-                self._z3_coords = (self._x[perm], self._y[perm],
-                                   None if self._millis is None
-                                   else self._millis[perm])
-            xs, ys, ms = self._z3_coords
-            ivals = intervals_ms
+            coords, ivals = self._z3_coords, intervals_ms
+            self._z3_uses += 1 if cache else 0
+            if coords is None and self._z3_uses > self._COORDS_AFTER:
+                coords = self._z3_coords = self._gather_coords(perm, True)
         else:
-            if self._z2_coords is None:
-                self._z2_coords = (self._x[perm], self._y[perm])
-            xs, ys = self._z2_coords
-            ms, ivals = None, []
-        keep = self._eval_sorted(xs, ys, ms, pos, boxes, ivals)
-        return "exact", np.sort(perm[pos[keep]].astype(np.int64))
+            coords, ivals = self._z2_coords, []
+            # one-shot probe loops (cache=False, e.g. KNN rings) must
+            # not trip the amortization gate: their boxes never repeat
+            self._z2_uses += 1 if cache else 0
+            if coords is None and self._z2_uses > self._COORDS_AFTER:
+                coords = self._z2_coords = self._gather_coords(perm, False)
+        if coords is not None:
+            xs, ys = coords[0], coords[1]
+            ms = coords[2] if use_z3 else None
+            keep = self._eval_sorted(xs, ys, ms, pos, boxes, ivals)
+            return "exact", np.sort(perm[pos[keep]].astype(np.int64))
+        rows = perm[pos]
+        keep = self._eval_sorted(self._x, self._y,
+                                 self._millis if use_z3 else None,
+                                 rows, boxes, ivals)
+        return "exact", np.sort(rows[keep].astype(np.int64))
 
 
     # -- candidates --------------------------------------------------------

@@ -19,6 +19,10 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..utils.jaxcache import ensure_compile_cache
+
+ensure_compile_cache()
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..scan import zscan

@@ -15,6 +15,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jaxcache import ensure_compile_cache
+
+ensure_compile_cache()
+
 __all__ = ["density_grid", "encode_bin_records", "decode_bin_records",
            "merge_sorted_bin_chunks",
            "sample_mask"]

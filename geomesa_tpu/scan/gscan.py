@@ -35,6 +35,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jaxcache import ensure_compile_cache
+
+ensure_compile_cache()
+
 from .zscan import MILLIS_PER_DAY, next_pow2
 
 __all__ = ["ExtentScanData", "build_extent_data", "extent_query",

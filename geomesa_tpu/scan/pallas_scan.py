@@ -29,6 +29,10 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..utils.jaxcache import ensure_compile_cache
+
+ensure_compile_cache()
 from jax.experimental import pallas as pl
 
 from .zscan import MILLIS_PER_DAY, ScanQuery, split_two_float

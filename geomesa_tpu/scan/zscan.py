@@ -28,6 +28,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jaxcache import ensure_compile_cache
+
+ensure_compile_cache()
+
 __all__ = ["DeviceScanData", "ScanQuery", "build_scan_data",
            "extend_scan_data", "make_query", "next_pow2", "scan_mask", "scan_mask_at",
            "split_two_float", "MILLIS_PER_DAY"]

@@ -617,14 +617,36 @@ class InMemoryDataStore(DataStore):
 
     # -- writes ------------------------------------------------------------
 
+    # bulk writes at or above this build the z-key orders eagerly: the
+    # reference indexes at INGEST (every BatchWriter mutation carries
+    # its z-keys, write path 3.2), so a bulk load should hand the first
+    # query a ready index instead of a multi-second build
+    _EAGER_INDEX_ROWS = 5_000_000
+
     def write(self, type_name: str, batch: FeatureBatch, visibilities=None):
         st = self._state(type_name)
         if batch.sft != st.sft:
             raise ValueError("batch schema does not match store schema")
+        was_empty = st.n == 0
         st.append(batch, visibilities)
         # auto-maintained stats, the write-side StatsCombiner analog
         # (accumulo/data/stats/StatsCombiner.scala)
         self.stats.observe(st.sft, batch)
+        # initial bulk load only: chunked ingests must not re-merge the
+        # whole accumulated table per batch (later chunks stay lazy and
+        # fold into ONE incremental merge at the next read)
+        if was_empty and batch.n >= self._EAGER_INDEX_ROWS:
+            try:
+                st.ensure_index()
+                if st.zindex is not None and hasattr(st.zindex, "warm"):
+                    st.zindex.warm()
+            except MemoryError:
+                raise
+            except Exception:
+                import logging
+                logging.getLogger("geomesa_tpu").warning(
+                    "ingest-time index build failed; falling back to "
+                    "lazy build on first read", exc_info=True)
 
     def delete(self, type_name: str, ids):
         self._state(type_name).delete(set(map(str, ids)))

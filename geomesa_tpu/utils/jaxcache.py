@@ -1,0 +1,66 @@
+"""Persistent XLA compilation cache.
+
+The reference ships its server-side code as a pre-built jar to the
+tablet servers (geomesa-accumulo-distributed-runtime), so scan
+machinery never compiles at query time. The TPU analog: persist XLA
+executables across processes so only the FIRST process ever pays the
+20-40s trace+compile of the scan/join kernels — every later run (and
+every benchmark round) loads them from disk.
+
+Enabled the first time any kernel module imports; configuration:
+
+- ``GEOMESA_TPU_COMPILE_CACHE`` — cache directory (default:
+  ``<repo>/.jax_cache``)
+- ``GEOMESA_TPU_NO_COMPILE_CACHE=1`` — disable entirely
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+_done = False
+
+
+def ensure_compile_cache() -> None:
+    """Idempotent: point JAX at the persistent compilation cache."""
+    global _done
+    if _done or os.environ.get("GEOMESA_TPU_NO_COMPILE_CACHE"):
+        _done = True
+        return
+    _done = True
+    try:
+        import jax
+
+        d = os.environ.get("GEOMESA_TPU_COMPILE_CACHE")
+        candidates = ([d] if d else
+                      [str(pathlib.Path(__file__).resolve().parents[2]
+                           / ".jax_cache"),
+                       # read-only installs (site-packages): user cache
+                       os.path.join(os.path.expanduser("~"), ".cache",
+                                    "geomesa_tpu", "jax")])
+        d = None
+        for cand in candidates:
+            try:
+                pathlib.Path(cand).mkdir(parents=True, exist_ok=True)
+                probe = pathlib.Path(cand) / ".wtest"
+                probe.touch()
+                probe.unlink()
+                d = cand
+                break
+            except OSError:
+                continue
+        if d is None:
+            return
+        jax.config.update("jax_compilation_cache_dir", d)
+        # cache everything that took meaningful compile time; the
+        # default threshold skips exactly the 1-2s kernels that add up
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:
+            pass  # knob absent on older jax
+    except Exception:
+        pass  # cache is an optimization, never a failure mode

@@ -88,6 +88,7 @@ class TestZKeyMerge:
         y = rng.uniform(-90, 90, n + d)
         ms = rng.integers(MS("2019-01-01"), MS("2019-03-01"), n + d)
         base = ZKeyIndex(x[:n], y[:n], ms[:n])
+        base._z3_uses = ZKeyIndex._COORDS_AFTER  # skip the deferral
         boxes = [(-20.0, -20.0, 20.0, 20.0)]
         iv = [(MS("2019-01-10"), MS("2019-02-10"))]
         base.query_rows("z3", boxes, iv, n, n)   # builds z3 + coords
