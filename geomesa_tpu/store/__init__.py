@@ -10,6 +10,7 @@ from .filebus import FileBus
 from .socketbus import SocketBroker, SocketBus
 from .lambda_store import LambdaDataStore
 from .mesh_store import DistributedDataStore
+from .fs_mesh import FsBackedDistributedDataStore
 from .stream import (FileTailSource, IterableSource, StreamDataStore,
                      StreamSource)
 from .partitions import (AttributeScheme, CompositeScheme, DateTimeScheme,
@@ -17,7 +18,7 @@ from .partitions import (AttributeScheme, CompositeScheme, DateTimeScheme,
 
 __all__ = ["DataStore", "InMemoryDataStore", "QueryResult",
            "FileSystemDataStore",
-           "DistributedDataStore",
+           "DistributedDataStore", "FsBackedDistributedDataStore",
            "GeoMessage", "LiveDataStore", "MessageBus", "LambdaDataStore",
            "FileBus", "SocketBroker", "SocketBus",
            "StreamSource", "StreamDataStore", "FileTailSource",

@@ -259,6 +259,10 @@ class FileSystemDataStore(DataStore):
             pq.write_table(table, path)
         st.cache.clear()
         st.pending_sidecar.clear()
+        # per-row RAW partition names (callers quote via partitions()
+        # semantics when keying on-disk names) — the sharded tier reuses
+        # this instead of recomputing the assignment
+        return names
 
     def delete(self, type_name: str, ids):
         """Remove features by id: rewrite every parquet file that holds
@@ -388,6 +392,45 @@ class FileSystemDataStore(DataStore):
             snaps.sort(key=lambda p: os.path.getmtime(p))
             for p in snaps[:-self._SIDECAR_CAP]:
                 shutil.rmtree(p, ignore_errors=True)
+
+    def read_partition(self, type_name: str, partition: str):
+        """Raw rows of one partition: (FeatureBatch | None, vis | None).
+        ``partition`` is a name as returned by ``partitions()`` (the
+        on-disk quoted form) — it is NOT re-quoted here. The loader the
+        sharded mesh tier maps over partitions (partition -> device
+        placement; FsQueryPlanning reads the same files per-partition
+        in the reference)."""
+        import pyarrow.dataset as pds
+        st = self._state(type_name)
+        pdir = os.path.join(st.data_dir,
+                            partition.replace("/", os.sep))
+        if not os.path.isdir(pdir) \
+                or os.path.commonpath(
+                    [os.path.abspath(pdir),
+                     os.path.abspath(st.data_dir)]) \
+                != os.path.abspath(st.data_dir):
+            return None, None
+        files = [os.path.join(pdir, f) for f in sorted(os.listdir(pdir))
+                 if f.endswith(".parquet")]
+        if not files:
+            return None, None
+        dataset = pds.dataset(files)
+        table = dataset.to_table()
+        has_vis = _VIS_COL in dataset.schema.names
+        batches, vises = [], []
+        for rb in table.to_batches():
+            if not rb.num_rows:
+                continue
+            if has_vis:
+                i = rb.schema.get_field_index(_VIS_COL)
+                vises.append(np.asarray(rb.column(i).to_pylist(),
+                                        dtype=object))
+                rb = rb.drop_columns([_VIS_COL])
+            batches.append(FeatureBatch.from_arrow(st.sft, rb))
+        if not batches:
+            return None, None
+        batch = FeatureBatch.concat_all(batches)
+        return batch, (np.concatenate(vises) if has_vis else None)
 
     def _load(self, st: _FsTypeState, files: list[str],
               expr=None, props: list[str] | None = None

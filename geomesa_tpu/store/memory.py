@@ -756,6 +756,15 @@ class InMemoryDataStore(DataStore):
             return None
         return res.batch.to_arrow()
 
+    def arrow_ipc(self, type_name: str, ecql="INCLUDE",
+                  sort_by: str | None = None) -> bytes:
+        """Arrow IPC stream of matching features, readable by
+        FeatureArrowFileReader (the ARROW_ENCODE hint surface). The
+        distributed store overrides this with the shard-local
+        dictionary-delta merge."""
+        from ..arrow.scan import ArrowScan
+        return ArrowScan(self).execute(type_name, ecql, sort_by=sort_by)
+
     def stats_query(self, type_name: str, stat_spec: str,
                     ecql: str | ast.Filter = None):
         """Run a stat sketch over query results (StatsScan analog,

@@ -277,6 +277,55 @@ class DistributedDataStore(InMemoryDataStore):
                                      jax.device_put(jnp.asarray(m), sh),
                                      self.mesh, nbins, lo, hi)
 
+    def arrow_ipc(self, type_name: str, ecql="INCLUDE",
+                  sort_by: str | None = None) -> bytes:
+        """Distributed Arrow output (DeltaWriter.scala:47,203 shape):
+        the row-selection pipeline runs once, matched rows split along
+        the mesh's shard boundaries, every shard encodes ITS rows as an
+        IPC payload with shard-local dictionaries, and the payloads
+        merge into one stream with global dictionaries
+        (arrow/scan.merge_deltas). On hardware the per-shard encode is
+        host work against that device's row range — the client-side
+        reduce of the reference's server-side ArrowScan."""
+        from ..arrow.io import write_ipc
+        from ..arrow.scan import merge_deltas
+        from ..features.batch import FeatureBatch
+        from ..index.api import Query as _Q
+        from .memory import _null_cells
+        st = self._state(type_name)
+        sft = st.sft
+        if st.batch is None or st.n == 0:
+            return merge_deltas([], sft=sft, sort_by=sort_by)
+        q = ecql if isinstance(ecql, _Q) else _Q(type_name, ecql)
+        idx, _strategy, _tp, _ts, attr_mask = self._matching_rows(
+            q, st, Explainer())
+        if not len(idx):
+            return merge_deltas([], sft=sft, sort_by=sort_by)
+        # matched ORIGINAL row ids split at the mesh's shard
+        # boundaries (rows shard evenly in row order): each shard
+        # encodes its own rows with shard-local dictionaries
+        k = self.mesh.devices.size
+        per = (st.n + k - 1) // k
+        shard_of = np.minimum(idx // max(per, 1), k - 1)
+        payloads = []
+        for s in np.unique(shard_of):
+            sel = shard_of == s
+            sub = st.batch.take(idx[sel])
+            if attr_mask is not None and not attr_mask[sel].all():
+                # same cell-level redaction as query(): unauthorized
+                # attribute values must not leak through the Arrow
+                # surface (KryoVisibilityRowEncoder semantics)
+                m = attr_mask[sel]
+                cols = {}
+                for j, a in enumerate(sft.attributes):
+                    col = sub.col(a.name)
+                    bad = ~m[:, j]
+                    cols[a.name] = (_null_cells(col, bad) if bad.any()
+                                    else col)
+                sub = FeatureBatch(sft, sub.ids, cols)
+            payloads.append(write_ipc(sft, sub))
+        return merge_deltas(payloads, sft=sft, sort_by=sort_by)
+
     def knn(self, type_name: str, qx: float, qy: float, k: int) -> np.ndarray:
         """k nearest feature ids: shard-local top-k prune per segment
         (candidates travel with their two-float coords), exact f64

@@ -30,7 +30,8 @@ def _populate(ds, type_name="t"):
     return ds
 
 
-@pytest.fixture(params=["memory", "fs", "live", "lambda", "mesh"])
+@pytest.fixture(params=["memory", "fs", "live", "lambda", "mesh",
+                        "fs_mesh"])
 def store(request, tmp_path):
     kind = request.param
     if kind == "memory":
@@ -41,6 +42,11 @@ def store(request, tmp_path):
         yield _populate(LiveDataStore())
     elif kind == "lambda":
         yield _populate(LambdaDataStore())
+    elif kind == "fs_mesh":
+        from geomesa_tpu.parallel import data_mesh
+        from geomesa_tpu.store import FsBackedDistributedDataStore
+        yield _populate(FsBackedDistributedDataStore(str(tmp_path),
+                                                     data_mesh()))
     else:
         from geomesa_tpu.parallel import data_mesh
         yield _populate(DistributedDataStore(data_mesh()))
@@ -130,6 +136,69 @@ class TestContract:
             "ext")
         assert set(res.ids.astype(str)) == {"g0", "g1", "g2"}
         assert store.query("INCLUDE", "ext").n == len(wkts)
+
+    def test_sort_and_limit(self, store):
+        from geomesa_tpu.index.api import Query
+        res = store.query(Query("t", "BBOX(geom, -60, -30, 60, 30)",
+                                sort_by="val", max_features=25))
+        assert res.n == 25
+        vals = [f["val"] for f in res.features()]
+        assert vals == sorted(vals)
+        desc = store.query(Query("t", "BBOX(geom, -60, -30, 60, 30)",
+                                 sort_by="val", sort_desc=True,
+                                 max_features=25))
+        dvals = [f["val"] for f in desc.features()]
+        assert dvals == sorted(dvals, reverse=True)
+
+    def test_projection(self, store):
+        from geomesa_tpu.index.api import Query
+        res = store.query(Query("t", "name = 'n3'",
+                                properties=["name", "geom"]))
+        f = next(res.features())
+        assert set(f) == {"id", "name", "geom"}
+
+    def test_bin_output(self, store):
+        if not hasattr(store, "bin_query"):
+            pytest.skip("backend has no bin surface")
+        from geomesa_tpu.scan.aggregations import decode_bin_records
+        payload = store.bin_query("t", "BBOX(geom, -60, -30, 60, 30)")
+        recs = decode_bin_records(payload)
+        want = store.query_count("BBOX(geom, -60, -30, 60, 30)", "t")
+        assert len(recs["lon"]) == want
+
+    def test_arrow_ipc_roundtrip(self, store):
+        if not hasattr(store, "arrow_ipc"):
+            pytest.skip("backend has no arrow surface")
+        from geomesa_tpu.arrow.io import FeatureArrowFileReader
+        payload = store.arrow_ipc("t", "BBOX(geom, -60, -30, 60, 30)",
+                                  sort_by="dtg")
+        rd = FeatureArrowFileReader(payload, store.get_schema("t"))
+        batch = rd.read_all()
+        res = store.query("BBOX(geom, -60, -30, 60, 30)", "t")
+        assert set(np.asarray(batch.ids).astype(str)) \
+            == set(res.ids.astype(str))
+        ms = batch.col("dtg").millis
+        assert np.all(np.diff(ms) >= 0)  # sorted merge
+
+    def test_differential_vs_memory(self, store):
+        """Black-box differential: every backend must return the same
+        id sets as the single-chip memory store for a mixed battery
+        (InMemoryQueryRunner.scala:57-103 is the reference's shared
+        oracle)."""
+        oracle = _populate(InMemoryDataStore())
+        battery = [
+            "BBOX(geom, -10, -10, 10, 10)",
+            "name = 'n7' AND val >= 50",
+            "BBOX(geom, 0, -80, 170, 80) AND "
+            "dtg DURING 2019-01-05T00:00:00Z/2019-02-20T00:00:00Z",
+            "val < 10 OR name = 'n1'",
+            "INTERSECTS(geom, POLYGON ((0 0, 40 0, 40 40, 0 40, 0 0)))",
+            "NOT (val < 90)",
+        ]
+        for ecql in battery:
+            got = set(store.query(ecql, "t").ids.astype(str))
+            want = set(oracle.query(ecql, "t").ids.astype(str))
+            assert got == want, ecql
 
     def test_visibilities(self, store):
         # visibility labels enforce row-level access on backends whose

@@ -4,6 +4,7 @@ geomesa-lambda test intent)."""
 import numpy as np
 import pytest
 
+from geomesa_tpu.features import parse_spec
 from geomesa_tpu.index.api import Query
 from geomesa_tpu.store import (CompositeScheme, DateTimeScheme,
                                FileSystemDataStore, LambdaDataStore,
@@ -433,3 +434,105 @@ class TestFsAttributeVisibility:
                            "dtg": [MS("2017-01-01")],
                            "geom": ([0.0], [0.0])},
                           visibilities=["admin,user"])
+
+
+class TestFsBackedMesh:
+    """Durable sharded tier: fs partitions -> mesh shards, reopen
+    recovery, sidecar adoption (VERDICT r4 item 4)."""
+
+    def _write(self, root):
+        from geomesa_tpu.parallel import data_mesh
+        from geomesa_tpu.store import FsBackedDistributedDataStore
+        rng = np.random.default_rng(31)
+        n = 5_000
+        ds = FsBackedDistributedDataStore(root, data_mesh())
+        ds.create_schema(parse_spec(
+            "ais", "name:String,dtg:Date,*geom:Point:srid=4326"))
+        ds.write_dict("ais", [f"f{i}" for i in range(n)], {
+            "name": [f"n{i % 7}" for i in range(n)],
+            "dtg": rng.integers(MS("2021-03-01"), MS("2021-03-20"), n),
+            "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+        })
+        return ds, n
+
+    def test_roundtrip_reopen_identical_ids(self, tmp_path):
+        from geomesa_tpu.parallel import data_mesh
+        from geomesa_tpu.store import FsBackedDistributedDataStore
+        root = str(tmp_path)
+        ds, n = self._write(root)
+        ecql = ("BBOX(geom, -90, -45, 90, 45) AND "
+                "dtg DURING 2021-03-05T00:00:00Z/2021-03-10T00:00:00Z")
+        want = set(ds.query(ecql, "ais").ids.astype(str))
+        assert want and len(want) < n
+        ds.persist_index("ais")
+        # recovery: a NEW instance on the same root serves identically
+        re = FsBackedDistributedDataStore(root, data_mesh())
+        assert re.count("ais") == n
+        got = set(re.query(ecql, "ais").ids.astype(str))
+        assert got == want
+        # the reopened serving tier adopted the persisted sort orders
+        st = re._state("ais")
+        assert st.zindex_warm is not None or st.zindex is not None
+
+    def test_partition_shard_placement(self, tmp_path):
+        ds, n = self._write(str(tmp_path))
+        parts = ds.partitions("ais")
+        assert len(parts) > 1            # daily scheme -> many partitions
+        shards = ds.partition_shards("ais")
+        assert set(shards) <= set(parts)
+        k = ds.mesh.devices.size
+        for devs in shards.values():
+            assert devs and all(0 <= d < k for d in devs)
+        # every device serves some partition (balanced placement)
+        assert set().union(*shards.values()) == set(range(k))
+
+    def test_write_through_durability(self, tmp_path):
+        from geomesa_tpu.store import FileSystemDataStore
+        ds, n = self._write(str(tmp_path))
+        # the durable tier alone (plain fs store) sees every row
+        fs = FileSystemDataStore(str(tmp_path))
+        assert fs.count("ais") == n
+        res = fs.query("name = 'n3'", "ais")
+        assert set(res.ids.astype(str)) \
+            == set(ds.query("name = 'n3'", "ais").ids.astype(str))
+
+    def test_delete_propagates(self, tmp_path):
+        from geomesa_tpu.parallel import data_mesh
+        from geomesa_tpu.store import FsBackedDistributedDataStore
+        root = str(tmp_path)
+        ds, n = self._write(root)
+        ds.delete("ais", [f"f{i}" for i in range(50)])
+        assert ds.count("ais") == n - 50
+        re = FsBackedDistributedDataStore(root, data_mesh())
+        assert re.count("ais") == n - 50
+        assert not (set(f"f{i}" for i in range(50))
+                    & set(re.query("INCLUDE", "ais").ids.astype(str)))
+
+    def test_reopen_with_quoted_partition_names(self, tmp_path):
+        """Partition names needing URL-quoting (spaces, colons) must
+        survive the write -> reopen round trip (review regression:
+        double-quoting dropped every such partition on recovery)."""
+        from geomesa_tpu.parallel import data_mesh
+        from geomesa_tpu.store import (AttributeScheme,
+                                       FsBackedDistributedDataStore)
+        root = str(tmp_path)
+        ds = FsBackedDistributedDataStore(root, data_mesh())
+        ds.create_schema(parse_spec("t", "name:String,*geom:Point"),
+                         scheme=AttributeScheme("name"))
+        ds.write_dict("t", [f"f{i}" for i in range(10)], {
+            "name": ["a b" if i % 2 else "x:y" for i in range(10)],
+            "geom": (np.linspace(0, 9, 10), np.linspace(0, 9, 10)),
+        })
+        assert ds.count("t") == 10
+        re = FsBackedDistributedDataStore(root, data_mesh())
+        assert re.count("t") == 10
+        assert set(re.query("INCLUDE", "t").ids.astype(str)) \
+            == {f"f{i}" for i in range(10)}
+        # live and reopened partition metadata agree on quoted keys
+        assert set(ds.partition_shards("t")) == set(ds.partitions("t"))
+
+    def test_partition_shards_after_delete(self, tmp_path):
+        ds, n = self._write(str(tmp_path))
+        ds.delete("ais", [f"f{i}" for i in range(10)])
+        shards = ds.partition_shards("ais")
+        assert shards  # recomputed, not permanently empty

@@ -267,3 +267,29 @@ class TestDistributedStore:
         ds.create_schema(parse_spec("e", SPEC))
         assert ds.query("INCLUDE", "e").n == 0
         assert ds.query_count("INCLUDE", "e") == 0
+
+
+class TestMeshArrowVisibility:
+    def test_arrow_ipc_redacts_hidden_cells(self):
+        """The distributed Arrow surface must apply the same cell-level
+        redaction as query() (review regression: raw values leaked)."""
+        from geomesa_tpu.arrow.io import FeatureArrowFileReader
+        from geomesa_tpu.features import parse_spec
+        from geomesa_tpu.parallel import data_mesh
+        from geomesa_tpu.store import DistributedDataStore
+        ds = DistributedDataStore(data_mesh())
+        ds.create_schema(parse_spec(
+            "t", "name:String,age:Integer,*geom:Point;"
+            "geomesa.visibility.level='attribute'"))
+        ds.write_dict("t", ["a", "b", "c", "d"], {
+            "name": [f"secret{i}" for i in range(4)],
+            "age": [10, 20, 30, 40],
+            "geom": ([0.0, 1.0, 2.0, 3.0], [0.0, 1.0, 2.0, 3.0]),
+        }, visibilities=["admin,,"] * 4)
+        payload = ds.arrow_ipc("t", "INCLUDE")
+        assert b"secret" not in payload
+        batch = FeatureArrowFileReader(
+            payload, ds.get_schema("t")).read_all()
+        assert all(batch.col("name").value(i) is None
+                   for i in range(batch.n))
+        assert batch.col("age").value(0) == 10  # unlabeled col visible
