@@ -28,6 +28,10 @@ __all__ = [
     "convex_hull_points",
     "st_closest_point", "st_translate", "st_point", "st_make_bbox",
     "st_geom_from_wkt", "st_as_text", "st_x", "st_y",
+    "st_relate", "st_relate_bool", "st_buffer", "st_buffer_point",
+    "st_distance_spheroid", "st_cast_to_point", "st_cast_to_linestring",
+    "st_cast_to_polygon", "st_cast_to_geometry", "st_as_binary",
+    "st_geom_from_wkb", "st_as_geojson", "SQL_SCALARS",
     "contains_points", "distance_points",
 ]
 
@@ -91,19 +95,30 @@ def st_equals(a: Geometry, b: Geometry) -> bool:
 
 
 def st_crosses(a: Geometry, b: Geometry) -> bool:
-    return (a.intersects(b) and not a.contains(b) and not b.contains(a))
+    from ..geometry.relate import crosses
+    return crosses(a, b)
 
 
 def st_overlaps(a: Geometry, b: Geometry) -> bool:
-    return (a.geom_type == b.geom_type and a.intersects(b)
-            and not a.contains(b) and not b.contains(a))
+    from ..geometry.relate import overlaps
+    return overlaps(a, b)
 
 
 def st_touches(a: Geometry, b: Geometry) -> bool:
-    if not a.intersects(b):
-        return False
-    ca, cb = a.centroid, b.centroid
-    return not (a.contains(cb) or b.contains(ca))
+    from ..geometry.relate import touches
+    return touches(a, b)
+
+
+def st_relate(a: Geometry, b: Geometry) -> str:
+    """The DE-9IM matrix string (SQLSpatialFunctions ST_Relate)."""
+    from ..geometry.relate import relate
+    return relate(a, b)
+
+
+def st_relate_bool(a: Geometry, b: Geometry, pattern: str) -> bool:
+    """DE-9IM pattern match (ST_RelateBool)."""
+    from ..geometry.relate import relate, relate_matches
+    return relate_matches(relate(a, b), pattern)
 
 
 def st_dwithin(a: Geometry, b: Geometry, distance_deg: float) -> bool:
@@ -149,6 +164,171 @@ def st_buffer_envelope(g: Geometry, d: float) -> Polygon:
     """Envelope-expansion buffer (planning-grade; exact round buffers are
     not needed by any reference hot path)."""
     return g.envelope.buffer(d).to_polygon()
+
+
+def st_buffer(g: Geometry, d: float, segments: int = 64) -> Polygon:
+    """Planar buffer in degrees (JTS ST_Buffer semantics). Points get a
+    true round buffer (n-gon circle in coordinate space); other
+    geometries use the envelope expansion — a documented
+    over-approximation (planning-grade; exact offset curves for
+    lines/polygons are not on any reference hot path)."""
+    if isinstance(g, Point):
+        ang = np.linspace(0.0, 2.0 * math.pi, segments, endpoint=False)
+        ring = np.column_stack([g.x + d * np.cos(ang),
+                                g.y + d * np.sin(ang)])
+        return Polygon(ring)
+    return st_buffer_envelope(g, d)
+
+
+def st_buffer_point(p: Point, meters: float, segments: int = 64) -> Polygon:
+    """True round buffer of a point by a distance in METERS: a ring of
+    geodesic destination points on the mean sphere (the reference's
+    ST_BufferPoint uses GeoHashUtils' geodesic point buffer;
+    SQLGeometryProcessingFunctions.scala). Accurate to the spherical
+    approximation; exact circle in the metric, polygonal in degrees."""
+    lat1 = math.radians(p.y)
+    lon1 = math.radians(p.x)
+    ang = meters / EARTH_RADIUS_M
+    bearings = np.linspace(0.0, 2.0 * math.pi, segments, endpoint=False)
+    lat2 = np.arcsin(np.sin(lat1) * np.cos(ang)
+                     + np.cos(lat1) * np.sin(ang) * np.cos(bearings))
+    lon2 = lon1 + np.arctan2(
+        np.sin(bearings) * np.sin(ang) * np.cos(lat1),
+        np.cos(ang) - np.sin(lat1) * np.sin(lat2))
+    ring = np.column_stack([np.degrees(lon2), np.degrees(lat2)])
+    return Polygon(ring)
+
+
+# WGS84 spheroid
+_WGS84_A = 6_378_137.0
+_WGS84_F = 1.0 / 298.257223563
+_WGS84_B = _WGS84_A * (1.0 - _WGS84_F)
+
+
+def st_distance_spheroid(a: Point, b: Point) -> float:
+    """Vincenty inverse distance on the WGS84 ellipsoid in meters
+    (SQLGeometryProcessingFunctions ST_DistanceSpheroid). Falls back to
+    haversine for near-antipodal pairs where the iteration diverges."""
+    if a.x == b.x and a.y == b.y:
+        return 0.0
+    L = math.radians(b.x - a.x)
+    u1 = math.atan((1 - _WGS84_F) * math.tan(math.radians(a.y)))
+    u2 = math.atan((1 - _WGS84_F) * math.tan(math.radians(b.y)))
+    su1, cu1 = math.sin(u1), math.cos(u1)
+    su2, cu2 = math.sin(u2), math.cos(u2)
+    lam = L
+    for _ in range(200):
+        sl, cl = math.sin(lam), math.cos(lam)
+        s_sig = math.sqrt((cu2 * sl) ** 2
+                          + (cu1 * su2 - su1 * cu2 * cl) ** 2)
+        if s_sig == 0:
+            return 0.0
+        c_sig = su1 * su2 + cu1 * cu2 * cl
+        sig = math.atan2(s_sig, c_sig)
+        sin_alpha = cu1 * cu2 * sl / s_sig
+        cos2_alpha = 1.0 - sin_alpha * sin_alpha
+        cos_2sigm = (c_sig - 2 * su1 * su2 / cos2_alpha
+                     if cos2_alpha != 0 else 0.0)
+        C = _WGS84_F / 16 * cos2_alpha * (4 + _WGS84_F
+                                          * (4 - 3 * cos2_alpha))
+        lam_prev = lam
+        lam = L + (1 - C) * _WGS84_F * sin_alpha * (
+            sig + C * s_sig * (cos_2sigm
+                               + C * c_sig * (-1 + 2 * cos_2sigm ** 2)))
+        if abs(lam - lam_prev) < 1e-12:
+            break
+    else:
+        return float(haversine_m(a.x, a.y, b.x, b.y))
+    u_sq = cos2_alpha * (_WGS84_A ** 2 - _WGS84_B ** 2) / _WGS84_B ** 2
+    A = 1 + u_sq / 16384 * (4096 + u_sq * (-768 + u_sq
+                                           * (320 - 175 * u_sq)))
+    B = u_sq / 1024 * (256 + u_sq * (-128 + u_sq * (74 - 47 * u_sq)))
+    d_sig = B * s_sig * (cos_2sigm + B / 4 * (
+        c_sig * (-1 + 2 * cos_2sigm ** 2)
+        - B / 6 * cos_2sigm * (-3 + 4 * s_sig ** 2)
+        * (-3 + 4 * cos_2sigm ** 2)))
+    return float(_WGS84_B * A * (sig - d_sig))
+
+
+# -- casts / outputs (SQLGeometricCastFunctions / OutputFunctions) ---------
+
+def st_cast_to_point(g: Geometry) -> Point:
+    if isinstance(g, Point):
+        return g
+    if isinstance(g, MultiPoint) and len(g.parts) == 1:
+        return g.parts[0]
+    raise TypeError(f"cannot cast {g.geom_type} to Point")
+
+
+def st_cast_to_linestring(g: Geometry) -> LineString:
+    if isinstance(g, LineString):
+        return g
+    from ..geometry import MultiLineString
+    if isinstance(g, MultiLineString) and len(g.parts) == 1:
+        return g.parts[0]
+    raise TypeError(f"cannot cast {g.geom_type} to LineString")
+
+
+def st_cast_to_polygon(g: Geometry) -> Polygon:
+    if isinstance(g, Polygon):
+        return g
+    from ..geometry import MultiPolygon
+    if isinstance(g, MultiPolygon) and len(g.parts) == 1:
+        return g.parts[0]
+    raise TypeError(f"cannot cast {g.geom_type} to Polygon")
+
+
+def st_cast_to_geometry(g: Geometry) -> Geometry:
+    return g
+
+
+def st_as_binary(g: Geometry) -> bytes:
+    from ..geometry.wkb import to_wkb
+    return to_wkb(g)
+
+
+def st_geom_from_wkb(data: bytes) -> Geometry:
+    from ..geometry.wkb import from_wkb
+    return from_wkb(data)
+
+
+def st_as_geojson(g: Geometry) -> str:
+    import json
+    from ..geometry.geojson import to_geojson
+    return json.dumps(to_geojson(g))
+
+
+# SQL scalar registry: SELECT-list ST_* calls resolve here (uppercased
+# SQL name -> python fn taking (geometry_value, *literal_args)); the
+# SQLSpatialAccessorFunctions / CastFunctions / OutputFunctions /
+# GeometryProcessingFunctions surface of the reference
+SQL_SCALARS = {
+    "ST_X": lambda g: float(g.x),
+    "ST_Y": lambda g: float(g.y),
+    "ST_AREA": lambda g: g.area,
+    "ST_LENGTH": lambda g: g.length,
+    "ST_CENTROID": lambda g: g.centroid,
+    "ST_ENVELOPE": lambda g: st_envelope(g),
+    "ST_GEOMETRYTYPE": lambda g: g.geom_type,
+    "ST_ASTEXT": lambda g: st_as_text(g),
+    "ST_ASBINARY": st_as_binary,
+    "ST_ASGEOJSON": st_as_geojson,
+    "ST_CASTTOPOINT": st_cast_to_point,
+    "ST_CASTTOLINESTRING": st_cast_to_linestring,
+    "ST_CASTTOPOLYGON": st_cast_to_polygon,
+    "ST_CASTTOGEOMETRY": st_cast_to_geometry,
+    "ST_BUFFER": lambda g, d: st_buffer(g, float(d)),
+    "ST_BUFFERPOINT": lambda g, m: st_buffer_point(g, float(m)),
+    "ST_CONVEXHULL": lambda g: st_convex_hull(g),
+    "ST_TRANSLATE": lambda g, dx, dy: st_translate(g, float(dx),
+                                                   float(dy)),
+    "ST_DISTANCE": lambda g, other: st_distance(g, other),
+    "ST_DISTANCESPHERE": lambda g, o: st_distance_sphere(g, o),
+    "ST_DISTANCESPHEROID": lambda g, o: st_distance_spheroid(g, o),
+    "ST_CLOSESTPOINT": lambda g, o: st_closest_point(g, o),
+    "ST_RELATE": lambda g, o: st_relate(g, o),
+    "ST_RELATEBOOL": lambda g, o, p: st_relate_bool(g, o, str(p)),
+}
 
 
 def st_convex_hull(g: Geometry) -> Geometry:

@@ -208,23 +208,18 @@ def _apply_pred(f: ast.SpatialPredicate, feature_geom, query_geom) -> bool:
         return feature_geom.contains(query_geom)
     if isinstance(f, ast.Within):
         return query_geom.contains(feature_geom)
+    # DE-9IM-derived predicates (full JTS semantics; the envelope
+    # prefilter above already rejected the cheap negatives)
+    from ..geometry import relate as _rel
     if isinstance(f, ast.Touches):
-        return (feature_geom.intersects(query_geom)
-                and not _interiors_intersect(feature_geom, query_geom))
+        return _rel.touches(feature_geom, query_geom)
     if isinstance(f, ast.GeomEquals):
-        return feature_geom == query_geom
-    if isinstance(f, ast.Crosses) or isinstance(f, ast.Overlaps):
-        # pragmatic: interiors intersect but neither contains the other
-        return (feature_geom.intersects(query_geom)
-                and not feature_geom.contains(query_geom)
-                and not query_geom.contains(feature_geom))
+        return _rel.topo_equals(feature_geom, query_geom)
+    if isinstance(f, ast.Crosses):
+        return _rel.crosses(feature_geom, query_geom)
+    if isinstance(f, ast.Overlaps):
+        return _rel.overlaps(feature_geom, query_geom)
     raise TypeError(type(f).__name__)
-
-
-def _interiors_intersect(a, b) -> bool:
-    # approximation: centroid-in-other or mutual containment
-    ca, cb = a.centroid, b.centroid
-    return (b.contains(ca) and a.contains(ca)) or (a.contains(cb) and b.contains(cb))
 
 
 def _dwithin(f: ast.DWithin, b: FeatureBatch) -> np.ndarray:

@@ -338,8 +338,9 @@ class SqlEngine:
             raise ValueError("HAVING requires GROUP BY")
         where = (_strip_qualifier(sel.where, sel.alias)
                  if sel.where is not None else ast.Include())
-        aggs = [i for i in sel.items if i.agg]
-        plain = [i for i in sel.items if not i.agg]
+        # scalar ST_* calls are per-row projections, not aggregates
+        aggs = [i for i in sel.items if i.agg and i.agg != "st"]
+        plain = [i for i in sel.items if not i.agg or i.agg == "st"]
         order = sel.order_by
         if order and "." in order:
             order = order.split(".", 1)[1]
@@ -544,8 +545,14 @@ class SqlEngine:
                 add(it.name, ids)
                 continue
             c = batch.col(col_name)
-            add(it.name, np.array([c.value(i) for i in range(c.n)],
-                                  dtype=object))
+            vals = np.array([c.value(i) for i in range(c.n)],
+                            dtype=object)
+            if it.agg == "st":
+                from ..analytics.st_functions import SQL_SCALARS
+                fn = SQL_SCALARS[it.fn]
+                vals = np.array([None if v is None else fn(v, *it.args)
+                                 for v in vals], dtype=object)
+            add(it.name, vals)
         return SqlResult(names, cols)
 
     # -- joins -------------------------------------------------------------
@@ -873,10 +880,11 @@ class SqlEngine:
 
     def _project_join(self, sel: SqlSelect, results,
                       rows: dict[str, np.ndarray]) -> SqlResult:
-        aggs = [i for i in sel.items if i.agg]
+        # scalar ST_* calls project per-row, like plain columns
+        aggs = [i for i in sel.items if i.agg and i.agg != "st"]
         nrows = len(next(iter(rows.values()))) if rows else 0
         if aggs:
-            if any(not i.agg for i in sel.items):
+            if any(not i.agg or i.agg == "st" for i in sel.items):
                 raise ValueError("cannot mix aggregates and plain "
                                  "columns without GROUP BY")
             # one implicit group over every joined row: the same
@@ -928,6 +936,11 @@ class SqlEngine:
             else:
                 c = res.batch.col(col)
                 out[m] = [c.value(int(i)) for i in idx[m]]
+            if it.agg == "st":
+                from ..analytics.st_functions import SQL_SCALARS
+                fn = SQL_SCALARS[it.fn]
+                out = np.array([None if v is None else fn(v, *it.args)
+                                for v in out], dtype=object)
             add(it.name if it.alias else it.expr, out)
         result = SqlResult(names, cols)
         order = sel.order_by

@@ -42,15 +42,20 @@ class SqlError(ValueError):
 
 @dataclasses.dataclass
 class SelectItem:
-    """One projected output: a column, * or an aggregate over one."""
+    """One projected output: a column, *, an aggregate over one, or a
+    scalar ST_* call over one (fn + literal args)."""
     expr: str                 # column name ('a.geom' qualified ok) or '*'
-    agg: str | None = None    # count/min/max/sum/avg
+    agg: str | None = None    # count/min/max/sum/avg | 'st' (scalar)
     alias: str | None = None
+    fn: str | None = None     # uppercased ST_* name when agg == 'st'
+    args: tuple = ()          # literal args after the column
 
     @property
     def name(self) -> str:
         if self.alias:
             return self.alias
+        if self.agg == "st":
+            return f"{(self.fn or 'st').lower()}({self.expr})"
         if self.agg:
             return f"{self.agg}({self.expr})"
         return self.expr
@@ -103,6 +108,8 @@ _TOKEN_RE = re.compile(r"""
     )""", re.VERBOSE)
 
 _AGGS = {"COUNT", "MIN", "MAX", "SUM", "AVG"}
+
+from ..analytics.st_functions import SQL_SCALARS as _SQL_SCALARS  # noqa: E402
 
 # ST predicate -> (column-first AST node, literal-first AST node): the
 # literal-first rewrite is STContainsRule's argument flip
@@ -319,6 +326,29 @@ class _Parser:
             col = self._name()
             self.t.expect("rparen")
             return SelectItem(col, "convex_hull", self._opt_alias())
+        if k == "word" and v.upper() in _SQL_SCALARS \
+                and self.t.peek(1)[0] == "lparen":
+            fn = self.t.next()[1].upper()
+            self.t.expect("lparen")
+            col = self._name()
+            args: list = []
+            while self.t.peek()[0] == "comma":
+                self.t.next()
+                kk, vv = self.t.peek()
+                if kk == "number":
+                    args.append(_num(self.t.next()[1]))
+                elif kk == "string":
+                    args.append(_unquote(self.t.next()[1]))
+                else:
+                    g = self._geom_or_col()
+                    if not isinstance(g, Geometry):
+                        raise SqlError(
+                            f"{fn}: literal argument expected, got "
+                            f"column {g!r}")
+                    args.append(g)
+            self.t.expect("rparen")
+            return SelectItem(col, "st", self._opt_alias(), fn=fn,
+                              args=tuple(args))
         if k == "word" and v.upper() in _AGGS \
                 and self.t.peek(1)[0] == "lparen":
             agg = self.t.next()[1].lower()

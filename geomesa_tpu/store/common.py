@@ -23,6 +23,12 @@ def sort_order(batch, sort_by: str, sort_desc: bool = False,
     if keys is None:
         keys = getattr(col, "millis", None)
     if keys is None:
+        codes = getattr(col, "codes", None)
+        if codes is not None:
+            # dictionary-encoded strings: the vocab is sorted, so code
+            # order IS lexicographic order; nulls (-1) sort last
+            keys = np.where(codes < 0, np.iinfo(codes.dtype).max, codes)
+    if keys is None:
         raise ValueError(f"cannot sort by {sort_by}")
     if idx is not None:
         keys = keys[idx]

@@ -588,3 +588,75 @@ class TestHavingOnGroupKey:
         names = np.array([gb.col("name").value(i) for i in range(N)])
         assert res.n == 1
         assert int(res.column("n")[0]) == int((names == "actor7").sum())
+
+
+class TestScalarSTFunctions:
+    """SELECT-list ST_* scalars (accessors / casts / outputs /
+    processing: SQLSpatialAccessorFunctions & friends)."""
+
+    @pytest.fixture()
+    def eng(self):
+        from geomesa_tpu.features import parse_spec
+        from geomesa_tpu.sql import SqlEngine
+        from geomesa_tpu.store import InMemoryDataStore
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("pts", "name:String,*geom:Point:srid=4326"))
+        ds.write_dict("pts", ["a", "b"], {
+            "name": ["x", "y"], "geom": ([10.0, 20.0], [5.0, -5.0])})
+        return SqlEngine(ds)
+
+    def test_accessors_and_outputs(self, eng):
+        r = eng.query("SELECT ST_X(geom) AS x, ST_Y(geom) AS y, "
+                      "ST_AsText(geom) AS wkt, ST_GeometryType(geom) AS t "
+                      "FROM pts")
+        assert list(r.column("x")) == [10.0, 20.0]
+        assert list(r.column("y")) == [5.0, -5.0]
+        assert r.column("wkt")[0].startswith("POINT")
+        assert r.column("t")[0] == "Point"
+
+    def test_wkb_geojson_roundtrip(self, eng):
+        from geomesa_tpu.geometry.wkb import from_wkb
+        r = eng.query("SELECT ST_AsBinary(geom) AS b, "
+                      "ST_AsGeoJSON(geom) AS j FROM pts")
+        g = from_wkb(r.column("b")[0])
+        assert (g.x, g.y) == (10.0, 5.0)
+        import json
+        assert json.loads(r.column("j")[0])["type"] == "Point"
+
+    def test_distance_spheroid_and_relate(self, eng):
+        r = eng.query("SELECT ST_DistanceSpheroid(geom, ST_Point(10, 6)) "
+                      "AS d, ST_Relate(geom, ST_Point(10, 5)) AS m "
+                      "FROM pts")
+        # one degree of latitude ~ 110.6km on WGS84 at lat 5-6
+        assert 110_000 < r.column("d")[0] < 111_500
+        assert r.column("m")[0] == "0FFFFFFF2"  # equal points
+
+    def test_buffer_point(self, eng):
+        r = eng.query("SELECT ST_BufferPoint(geom, 10000) AS buf "
+                      "FROM pts")
+        poly = r.column("buf")[0]
+        # ~10km radius circle: area ~ pi * (10km in deg)^2; just check
+        # the centre is inside and a 20km-away point is not
+        from geomesa_tpu.geometry import Point
+        assert poly.contains(Point(10.0, 5.0))
+        assert not poly.contains(Point(10.0, 5.5))
+        assert poly.contains(Point(10.0, 5.08))  # ~8.9km north
+
+    def test_scalar_in_join(self, eng):
+        from geomesa_tpu.features import parse_spec
+        ds = eng.store
+        ds.create_schema(parse_spec("zones", "*pgeom:Geometry:srid=4326"))
+        ds.write_dict("zones", ["z"], {
+            "pgeom": ["POLYGON ((0 0, 30 0, 30 30, 0 30, 0 0))"]})
+        r = eng.query("SELECT a.name, ST_X(a.geom) AS x FROM pts a "
+                      "JOIN zones b ON ST_Contains(b.pgeom, a.geom)")
+        assert list(r.column("x")) == [10.0]
+        assert list(r.column("a.name")) == ["x"]
+
+    def test_st_buffer_point_round(self, eng):
+        from geomesa_tpu.geometry import Point
+        r = eng.query("SELECT ST_Buffer(geom, 0.5) AS b FROM pts")
+        poly = r.column("b")[0]
+        # round, not rectangular: the corner of the bbox is NOT inside
+        assert poly.contains(Point(10.0 + 0.49, 5.0))
+        assert not poly.contains(Point(10.0 + 0.4, 5.0 + 0.4))

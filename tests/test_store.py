@@ -378,3 +378,18 @@ class TestAttributeLevelVisibility:
         res2 = ds.query(Query("t", "INCLUDE", auths=["admin"],
                               sort_by="age"))
         assert list(res2.ids.astype(str)) == ["b", "c", "a"]
+
+
+class TestStringSort:
+    def test_sort_by_string_column(self):
+        from geomesa_tpu.index.api import Query
+        ds = InMemoryDataStore()
+        ds.create_schema("t", "name:String,*geom:Point")
+        ds.write_dict("t", ["a", "b", "c", "d"], {
+            "name": ["zed", "ann", None, "mid"],
+            "geom": ([0.0, 1.0, 2.0, 3.0], [0.0] * 4)})
+        res = ds.query(Query("t", "INCLUDE", sort_by="name"))
+        assert list(res.ids.astype(str)) == ["b", "d", "a", "c"]  # null last
+        desc = ds.query(Query("t", "INCLUDE", sort_by="name",
+                              sort_desc=True))
+        assert list(desc.ids.astype(str))[:3] == ["c", "a", "d"]
