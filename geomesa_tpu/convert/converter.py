@@ -39,16 +39,22 @@ class SimpleFeatureConverter:
         self.sft = sft
         self.config = config
         self.id_expr = compile_expression(config.get("id-field", "uuid()"))
-        self.field_exprs: dict[str, Any] = {}
-        # nameless entries are column bindings only (e.g. a bare JSON
-        # path that later transforms reference by column number)
-        declared = {f["name"]: f.get("transform") for f in
-                    config.get("fields", []) if "name" in f}
+        # every named field compiles IN DECLARATION ORDER — later
+        # transforms (and the id expression) may reference earlier ones
+        # as $fieldName (Transformers' fieldLookup). Intermediate fields
+        # not in the SFT are building blocks only. Nameless entries are
+        # column bindings (e.g. a bare JSON path referenced by number).
+        self.ordered_exprs: list[tuple[str, Any]] = []
+        declared = {}
+        for f in config.get("fields", []):
+            if "name" not in f or f.get("transform") is None:
+                continue
+            declared[f["name"]] = True
+            self.ordered_exprs.append(
+                (f["name"], compile_expression(f["transform"])))
         for attr in sft.attributes:
-            t = declared.get(attr.name)
-            if t is None:
+            if attr.name not in declared:
                 raise ValueError(f"no transform for attribute {attr.name!r}")
-            self.field_exprs[attr.name] = compile_expression(t)
         from .validators import build_validators
         self.validators = build_validators(
             config.get("options", {}).get("validators", []), sft)
@@ -68,9 +74,12 @@ class SimpleFeatureConverter:
                 ctx.failure += 1
                 continue
             try:
-                fid = str(self.id_expr(cols))
-                values = {name: expr(cols)
-                          for name, expr in self.field_exprs.items()}
+                fields: dict[str, Any] = {}
+                for name, expr in self.ordered_exprs:
+                    fields[name] = expr(cols, fields)
+                fid = str(self.id_expr(cols, fields))
+                values = {a.name: fields[a.name]
+                          for a in self.sft.attributes}
             except Exception:
                 ctx.failure += 1
                 continue

@@ -95,6 +95,16 @@ def _stats_cost(sft: SimpleFeatureType, s: FilterStrategy, stats,
         return float(max(n_features, 1))
     if s.index.startswith("attr:"):
         cost = heuristic_cost(sft, s, n_features)
+        # histogram/sketch-backed equality selectivity: a predicate on
+        # a value covering most of the table must LOSE to a selective
+        # z strategy (the skewed-data failure the flat heuristic had;
+        # StatsBasedEstimator.scala:27)
+        if (isinstance(s.primary, ast.Compare)
+                and s.primary.op == ast.CompareOp.EQ):
+            est = stats.attr_equality_estimate(
+                s.index.split(":", 1)[1], s.primary.value)
+            if est is not None:
+                cost = float(est)
         # secondary (value, date) tiering: an equality scan narrowed by
         # the residual's date bounds touches only the matching time
         # bins, so its cost scales with the temporal selectivity

@@ -14,7 +14,8 @@ from ..curves import timebin, z3sfc
 from ..features.sft import SimpleFeatureType
 from ..filters import ast
 from ..filters.helper import extract_geometries, extract_intervals
-from .sketches import CountStat, Histogram, SeqStat, Stat, Z3Histogram
+from .sketches import (CountStat, Frequency, Histogram, SeqStat, Stat,
+                       Z3Histogram)
 
 __all__ = ["StatsEstimator", "DataStoreStats"]
 
@@ -30,6 +31,11 @@ class StatsEstimator:
             self.z3 = Z3Histogram(sft.geom_field, sft.dtg_field,
                                   sft.z3_interval)
         self.attr_hist: dict[str, Histogram] = {}
+        # per-INDEXED-attribute count-min sketches, auto-maintained on
+        # write: equality selectivity feeds attr-vs-z strategy costs
+        # (StatsBasedEstimator.scala:27 composes per-attribute
+        # estimates the same way)
+        self.attr_freq: dict[str, Frequency] = {}
         # box-tuple -> coarse-cell indices (see _cells_for_boxes)
         self._cells_cache: dict[tuple, np.ndarray] = {}
         # lazily-built per-cell spatial bounds (see _cell_bounds)
@@ -44,17 +50,21 @@ class StatsEstimator:
 
     def observe(self, batch) -> None:
         self.count.observe(batch)
+        # ONE strided sub-batch shared by every sketch; weight = stride
+        # keeps masses comparable across differently-sampled batches (a
+        # small unsampled batch must not outweigh a large strided one)
+        step = 1
+        sub = batch
+        if batch.n > self._Z3_SAMPLE:
+            step = batch.n // self._Z3_SAMPLE + 1
+            sub = batch.take(np.arange(0, batch.n, step, dtype=np.int64))
+        for a in self.sft.attributes:
+            if not a.indexed or a.name not in batch.columns:
+                continue
+            fr = self.attr_freq.setdefault(a.name, Frequency(a.name))
+            fr.observe(sub, weight=step)
         if self.z3 is not None:
-            if batch.n > self._Z3_SAMPLE:
-                # weight = stride, so masses from batches sampled at
-                # different rates stay comparable (a small unsampled
-                # batch must not outweigh a large strided one)
-                step = batch.n // self._Z3_SAMPLE + 1
-                self.z3.observe(batch.take(
-                    np.arange(0, batch.n, step, dtype=np.int64)),
-                    weight=step)
-            else:
-                self.z3.observe(batch)
+            self.z3.observe(sub, weight=step)
 
     def estimate_count(self, f: ast.Filter) -> int | None:
         """Estimated matching features, or None if not estimable."""
@@ -131,6 +141,16 @@ class StatsEstimator:
             mass = sum(int(arr[cells].sum()) for b in sel_bins
                        if (arr := hist.bins.get(b)) is not None)
         return mass / total_mass
+
+    def attr_equality_estimate(self, attr: str, value) -> int | None:
+        """Estimated rows matching ``attr = value`` from the maintained
+        count-min sketch, scaled for write-side subsampling; None when
+        no sketch exists (unindexed attribute / nothing observed)."""
+        fr = self.attr_freq.get(attr)
+        if fr is None or fr.total == 0:
+            return None
+        scale = max(self.count.count, 1) / fr.total
+        return int(round(fr.count(value) * scale))
 
     def temporal_fraction(self, intervals) -> float | None:
         """Fraction of observed mass inside the date intervals (time-bin

@@ -271,19 +271,22 @@ class Frequency(Stat):
             out[d] = (mixed % np.uint64(self.width)).astype(np.int64)
         return out
 
-    def observe(self, batch: FeatureBatch) -> None:
+    def observe(self, batch: FeatureBatch, weight: int = 1) -> None:
         vals, valid = _col_values(batch, self.attribute)
-        self.observe_values(np.asarray(vals)[valid])
+        self.observe_values(np.asarray(vals)[valid], weight)
 
-    def observe_values(self, vals: np.ndarray) -> None:
+    def observe_values(self, vals: np.ndarray, weight: int = 1) -> None:
         """Value-level update (also the hook for key-derived sketches
-        like Z3Frequency)."""
+        like Z3Frequency). ``weight`` scales each observation — the
+        write path observes strided subsamples of huge batches and
+        passes the stride so differently-sampled batches stay
+        comparable (same contract as Z3Histogram.observe)."""
         if len(vals) == 0:
             return
         idx = self._hash(vals)
         for d in range(self.D):
-            np.add.at(self.table[d], idx[d], 1)
-        self.total += len(vals)
+            np.add.at(self.table[d], idx[d], int(weight))
+        self.total += len(vals) * int(weight)
 
     @staticmethod
     def _scalar_bits(v) -> int:

@@ -262,3 +262,151 @@ class TestCliExportFormats:
         _schema, recs = read_avro(data)
         assert len(recs) == 2
         assert {r["__fid__"] for r in recs} == {"a", "b"}
+
+
+class TestTransformersParity:
+    """The reference Transformers test corpus shape
+    (geomesa-convert/.../TransformersTest.scala): regex literals and
+    extraction, the date zoo, hashes, math, list/map helpers, and
+    $field cross-references composed inside arbitrary expressions."""
+
+    def _ev(self, text, cols=None, fields=None):
+        from geomesa_tpu.convert.dsl import compile_expression
+        return compile_expression(text)(cols or [None], fields)
+
+    def test_regex_literal_and_replace(self):
+        assert self._ev("regexReplace('foo'::r, 'bar', 'foobaz')") == "barbaz"
+        assert self._ev("regexReplace('\\d+'::r, 'N', 'a1b22c')") == "aNbNc"
+
+    def test_regex_extract(self):
+        assert self._ev("regexExtract('id=(\\d+)'::r, 'x id=42 y')") == "42"
+        assert self._ev("regexExtract('(a+)(b+)', 'caabbd', 2)") == "bb"
+        assert self._ev("regexExtract('zz', 'abc')") is None
+
+    def test_composed_column_expressions(self):
+        cols = [None, "  7 ", "points", "3"]
+        got = self._ev("add(trim($1)::int, $3::int)", cols)
+        assert got == 10.0
+        assert self._ev("concat(uppercase($2), '-', trim($1))", cols) \
+            == "POINTS-7"
+
+    def test_field_references(self):
+        cols = [None, "world"]
+        fields = {"greeting": "hello"}
+        assert self._ev("concat($greeting, ' ', $1)", cols, fields) \
+            == "hello world"
+        with pytest.raises(ValueError):
+            self._ev("$missing", cols, {})
+
+    def test_date_zoo(self):
+        want = 1483228800000  # 2017-01-01T00:00:00Z
+        assert self._ev("isodate('20170101')") == want
+        assert self._ev("basicDateTimeNoMillis('20170101T000000Z')") == want
+        assert self._ev(
+            "dateHourMinuteSecondMillis('2017-01-01T00:00:00.000')") == want
+        assert self._ev("datetime('2017-01-01T00:00:00Z')") == want
+        assert self._ev("dateToString('yyyy-MM-dd', 1483228800000)") \
+            == "2017-01-01"
+        assert self._ev("secsToDate(1483228800)") == want
+
+    def test_hashes(self):
+        # murmur3 reference vectors (x86_32 seed 0)
+        from geomesa_tpu.convert.dsl import murmur3_32, murmur3_128
+        assert murmur3_32(b"") == 0
+        assert murmur3_32(b"hello") == 0x248BFA47
+        assert murmur3_32(b"The quick brown fox jumps over the lazy dog") \
+            == 0x2E4FF723
+        # x64_128 reference vector
+        h1, h2 = murmur3_128(b"hello")
+        assert h1 == 0xCBD8A7B341BD9B02 and h2 == 0x5B1E906A48AE1D19
+        assert self._ev("md5(stringToBytes('row'))") \
+            == "f1965a857bc285d26fe22023aa5ab50d"
+        assert self._ev("base64('abc')") == "YWJj"
+        assert isinstance(self._ev("murmur3_64('abc')"), int)
+
+    def test_math_and_lists(self):
+        assert self._ev("mean(1, 2, 3, 6)") == 3.0
+        assert self._ev("subtract(10, 3, 2)") == 5.0
+        assert self._ev("divide(100, 5, 2)") == 10.0
+        assert self._ev("parseList('int', '1,2,3')") == [1, 2, 3]
+        assert self._ev("parseMap('int', 'a->1,b->2')") == {"a": 1, "b": 2}
+        assert self._ev("listItem(list('x', 'y'), 1)") == "y"
+
+    def test_string_additions(self):
+        assert self._ev("stripQuotes('''quoted''')") == "quoted"
+        assert self._ev("capitalize('hello')") == "Hello"
+        assert self._ev("emptyToNull('  ')") is None
+        assert self._ev("mkstring('-', 'a', 'b', 'c')") == "a-b-c"
+        assert self._ev("stringToInt('42')") == 42
+        assert self._ev("stringToInt('x', 7)") == 7
+
+    def test_geometry_constructors(self):
+        g = self._ev("linestring('0 0, 1 1, 2 0')")
+        assert g.geom_type == "LineString" and g.length > 2.8
+        p = self._ev("polygon('0 0, 4 0, 4 4, 0 4, 0 0')")
+        assert p.geom_type == "Polygon" and p.area == 16.0
+
+    def test_converter_field_chain(self):
+        """End-to-end: intermediate fields + $field refs + id-field
+        hashing a computed field (the reference's md5($0) idiom)."""
+        from geomesa_tpu.convert.converter import DelimitedTextConverter
+        from geomesa_tpu.features import parse_spec
+        sft = parse_spec("t", "name:String,*geom:Point:srid=4326")
+        conv = DelimitedTextConverter(sft, {
+            "id-field": "md5($fullname)",
+            "fields": [
+                {"name": "first", "transform": "trim($1)"},
+                {"name": "fullname",
+                 "transform": "concat($first, '_', lowercase($2))"},
+                {"name": "name", "transform": "uppercase($fullname)"},
+                {"name": "geom",
+                 "transform": "point($3::double, $4::double)"},
+            ]})
+        batch, ctx = conv.process([" Ann ,SMITH,10,20"])
+        assert ctx.success == 1 and ctx.failure == 0
+        assert batch.col("name").value(0) == "ANN_SMITH"
+        import hashlib
+        assert batch.ids[0] == hashlib.md5(b"Ann_smith").hexdigest()
+
+    def test_review_regressions(self):
+        # regexExtract without a capture group: whole match, no crash
+        assert self._ev("regexExtract('abc', 'xabcy')") == "abc"
+        with pytest.raises(ValueError):
+            self._ev("regexExtract('abc', 'xabcy', 2)")
+        # stringToBoolean falls back to the default on garbage
+        assert self._ev("stringToBoolean('garbage', 'true'::boolean)") \
+            is True
+        assert self._ev("stringToBoolean('no')") is False
+        # dateToString emits 3-digit millis for SSS
+        assert self._ev(
+            "dateToString('HH:mm:ss.SSS', 1483228800123)") \
+            == "00:00:00.123"
+        # bare multilinestring body parses
+        g = self._ev("multilinestring('0 0, 1 1')")
+        assert g.geom_type == "MultiLineString"
+        with pytest.raises(ValueError):
+            self._ev("geometrycollection('0 0')")
+
+    def test_subsample_weighting_unbiased(self):
+        """Frequency estimates must stay unbiased when batches observe
+        at different subsample rates (review regression: unweighted
+        strided observes skewed attr cost estimates)."""
+        from geomesa_tpu.stats import StatsEstimator
+        from geomesa_tpu.features import FeatureBatch, parse_spec
+        sft = parse_spec("t", "k:String:index=true,*geom:Point:srid=4326")
+        est = StatsEstimator(sft)
+        est._Z3_SAMPLE = 1000  # force subsampling on the big batch
+        big = FeatureBatch.from_dict(
+            sft, [f"b{i}" for i in range(50_000)],
+            {"k": np.array(["big"] * 50_000, dtype=object),
+             "geom": (np.zeros(50_000), np.zeros(50_000))})
+        small = FeatureBatch.from_dict(
+            sft, [f"s{i}" for i in range(500)],
+            {"k": np.array(["small"] * 500, dtype=object),
+             "geom": (np.zeros(500), np.zeros(500))})
+        est.observe(big)
+        est.observe(small)
+        assert est.attr_equality_estimate("k", "big") == \
+            pytest.approx(50_000, rel=0.1)
+        assert est.attr_equality_estimate("k", "small") == \
+            pytest.approx(500, rel=0.1)

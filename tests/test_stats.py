@@ -232,3 +232,55 @@ class TestBinMerge:
         assert len(rec) == sum(len(s) for s in all_secs)
         assert np.all(np.diff(rec["secs"]) >= 0)
         assert merge_sorted_bin_chunks([]) == b""
+
+
+class TestAttrCostEstimation:
+    def test_skewed_data_flips_attr_vs_z(self):
+        """Histogram/sketch-backed cost estimation (StatsBasedEstimator
+        analog): an equality on a DOMINANT value must lose to a
+        selective spatial strategy, while an equality on a RARE value
+        must win — the flat attr heuristic could not flip."""
+        from geomesa_tpu.store import InMemoryDataStore
+        rng = np.random.default_rng(4)
+        n = 60_000
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec(
+            "t", "name:String:index=true,*geom:Point:srid=4326"))
+        names = np.array(["common"] * n, dtype=object)
+        names[:25] = "rare"
+        # points spread wide; the bbox below covers ~0.01% of them
+        ds.write_dict("t", np.arange(n).astype(str).astype(object), {
+            "name": names,
+            "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+        })
+        res = ds.query("BBOX(geom, 10, 10, 11, 11) AND name = 'common'",
+                       "t")
+        assert res.plan.index == "z2", res.plan
+        res2 = ds.query("BBOX(geom, -180, -90, 90, 90) AND name = 'rare'",
+                        "t")
+        assert res2.plan.index == "attr:name", res2.plan
+        # both paths stay exact
+        batch = ds._state("t").batch
+        x, y = batch.col("geom").x, batch.col("geom").y
+        m = (x >= 10) & (x <= 11) & (y >= 10) & (y <= 11) \
+            & (names == "common")
+        assert set(res.ids.astype(str)) == \
+            set(np.flatnonzero(m).astype(str))
+
+    def test_attr_equality_estimate(self):
+        est = StatsEstimator(parse_spec(
+            "t", "kind:String:index=true,*geom:Point:srid=4326"))
+        rng = np.random.default_rng(1)
+        n = 10_000
+        kinds = np.where(rng.random(n) < 0.9, "big", "small").astype(object)
+        b = FeatureBatch.from_dict(
+            parse_spec("t", "kind:String:index=true,*geom:Point:srid=4326"),
+            np.arange(n).astype(str).astype(object),
+            {"kind": kinds, "geom": (rng.uniform(-10, 10, n),
+                                     rng.uniform(-10, 10, n))})
+        est.observe(b)
+        big = est.attr_equality_estimate("kind", "big")
+        small = est.attr_equality_estimate("kind", "small")
+        assert big == pytest.approx((kinds == "big").sum(), rel=0.05)
+        assert small == pytest.approx((kinds == "small").sum(), rel=0.05)
+        assert est.attr_equality_estimate("kind", "absent") < n * 0.01
