@@ -115,6 +115,25 @@ def partitioned_dwithin_join(xa, ya, xb, yb, radius_deg: float,
     pa = assign_partitions(xa, ya, envelopes)
     pairs = []
     e = np.asarray(envelopes, dtype=np.float64)
+
+    def pad_pow2(x, y, fill):
+        """Pad to the next power of two with far-away points: per-cell
+        sizes vary, and every distinct size is a fresh XLA compile —
+        pow2 buckets make the shapes repeat so the kernel compiles
+        O(log n) times total instead of once per cell. The two sides
+        pad to OPPOSITE far corners — same-corner pads would x-slab
+        match each other and blow the kernel's slab width up to the
+        pad count."""
+        n = len(x)
+        cap = 1 << max(n - 1, 1).bit_length()
+        if cap == n:
+            return x, y, n
+        xp = np.full(cap, fill)
+        yp = np.full(cap, fill)
+        xp[:n] = x
+        yp[:n] = y
+        return xp, yp, n
+
     for c in range(len(e)):
         ia = np.flatnonzero(pa == c)
         if not len(ia):
@@ -124,7 +143,12 @@ def partitioned_dwithin_join(xa, ya, xb, yb, radius_deg: float,
                             & (yb >= y0 - radius_deg) & (yb < y1 + radius_deg))
         if not len(ib):
             continue
-        _, local = dwithin_join(xa[ia], ya[ia], xb[ib], yb[ib], radius_deg)
+        axp, ayp, na = pad_pow2(xa[ia], ya[ia], 1e9)
+        bxp, byp, nb = pad_pow2(xb[ib], yb[ib], -1e9)
+        _, local = dwithin_join(axp, ayp, bxp, byp, radius_deg)
+        if len(local):
+            keep = (local[:, 0] < na) & (local[:, 1] < nb)
+            local = local[keep]
         if len(local):
             pairs.append(np.stack([ia[local[:, 0]], ib[local[:, 1]]], axis=1))
     if not pairs:

@@ -31,6 +31,13 @@ from ..filters import ast
 from ..index.api import Query
 from .parser import SelectItem, SqlJoin, SqlSelect, parse_sql
 
+# |a| x |b| above which a spatial join with two large sides routes
+# through grid partitioning instead of the direct tiled kernel, and
+# the minimum small-side size for the route (module globals so tests
+# can exercise the branch at test scale)
+_PARTITION_PAIR_BUDGET = 2e11
+_PARTITION_MIN_SIDE = 50_000
+
 __all__ = ["SqlEngine", "SqlResult"]
 
 
@@ -843,10 +850,23 @@ class SqlEngine:
         elif join.kind == "dwithin":
             ax, ay = _centroids(a_res.batch, a_col)
             bx, by = _centroids(b_res.batch, b_col)
-            dev = (self._device_xy(a_table, a_res, a_col)
-                   if a_table is not None else None)
-            _, pairs = dwithin_join(ax, ay, bx, by, join.distance,
-                                    device_xy=dev)
+            # two LARGE sides: route through grid/quadtree spatial
+            # partitioning (SpatialJoinStrategy -> zipPartitions,
+            # SQLRules.scala:270, GeoMesaSparkSQL.scala:312-360) — the
+            # direct kernel's work is O(|a| x |b|) and stops scaling
+            # once both sides are big; per-cell joins bound it to
+            # near-matching pairs
+            if (len(ax) * len(bx) > _PARTITION_PAIR_BUDGET
+                    and min(len(ax), len(bx)) > _PARTITION_MIN_SIDE):
+                from ..analytics.partitioning import \
+                    partitioned_dwithin_join
+                pairs = partitioned_dwithin_join(ax, ay, bx, by,
+                                                 join.distance)
+            else:
+                dev = (self._device_xy(a_table, a_res, a_col)
+                       if a_table is not None else None)
+                _, pairs = dwithin_join(ax, ay, bx, by, join.distance,
+                                        device_xy=dev)
             # dwithin_join pairs are (a_idx, b_idx)
         else:
             # ST_Contains(a, b): a (polygons) contains b (points)

@@ -5,8 +5,9 @@ from .sketches import (CountStat, DescriptiveStats, EnumerationStat,
                        Frequency, GroupBy, Histogram, MinMax, SeqStat,
                        Stat, TopK, Z3Frequency, Z3Histogram, parse_stat)
 from .estimator import DataStoreStats, StatsEstimator
+from .serialize import deserialize_stat, serialize_stat
 
 __all__ = ["CountStat", "DescriptiveStats", "EnumerationStat", "Frequency",
            "GroupBy", "Histogram", "MinMax", "SeqStat", "Stat", "TopK",
            "Z3Frequency", "Z3Histogram", "parse_stat", "DataStoreStats",
-           "StatsEstimator"]
+           "StatsEstimator", "serialize_stat", "deserialize_stat"]

@@ -284,3 +284,68 @@ class TestAttrCostEstimation:
         assert big == pytest.approx((kinds == "big").sum(), rel=0.05)
         assert small == pytest.approx((kinds == "small").sum(), rel=0.05)
         assert est.attr_equality_estimate("kind", "absent") < n * 0.01
+
+
+class TestBinarySerialization:
+    """Every sketch must survive the wire in binary form
+    (StatSerializer analog) — the payloads the bus/lambda tiers carry
+    between processes."""
+
+    SPECS = ["Count()", "MinMax(age)", "MinMax(name)",
+             "Enumeration(name)", "TopK(name)",
+             "Histogram(age,10,0,100)", "Frequency(name)",
+             "DescriptiveStats(score)", "GroupBy(name,Count())",
+             "Count();MinMax(age)",
+             "Z3Histogram(geom,dtg,week,1024)",
+             "Z3Frequency(geom,dtg,week,12)"]
+
+    @pytest.mark.parametrize("spec", SPECS)
+    def test_roundtrip(self, spec):
+        from geomesa_tpu.stats import (deserialize_stat, parse_stat,
+                                       serialize_stat)
+        s = parse_stat(spec)
+        b = make_batch(2_000, seed=3)
+        s.observe(b)
+        data = serialize_stat(s)
+        back = deserialize_stat(data)
+        assert type(back) is type(s)
+        assert json.dumps(back.to_json_object(), default=str) \
+            == json.dumps(s.to_json_object(), default=str)
+        # merged results must match local merges (the client-side
+        # reduce of server-side partials)
+        other = parse_stat(spec)
+        other.observe(make_batch(1_000, seed=4))
+        merged_wire = deserialize_stat(serialize_stat(s))
+        merged_wire.merge(deserialize_stat(serialize_stat(other)))
+        local = s + other
+        assert json.dumps(merged_wire.to_json_object(), default=str) \
+            == json.dumps(local.to_json_object(), default=str)
+
+    def test_rejects_garbage(self):
+        from geomesa_tpu.stats import deserialize_stat
+        with pytest.raises(ValueError):
+            deserialize_stat(b"\x00\x01\x02\x03\x04\x05\x06\x07rubbish")
+
+    def test_cross_process_roundtrip(self, tmp_path):
+        """A sketch serialized here deserializes in a SEPARATE python
+        process with identical results — the cross-process contract the
+        bus/lambda tiers rely on (no pickle, no shared memory)."""
+        import subprocess
+        import sys
+        from geomesa_tpu.stats import parse_stat, serialize_stat
+        s = parse_stat("GroupBy(name,Count());Histogram(age,10,0,100)")
+        s.observe(make_batch(500, seed=5))
+        path = tmp_path / "stat.bin"
+        path.write_bytes(serialize_stat(s))
+        code = (
+            "import sys, json; sys.path.insert(0, %r); "
+            "from geomesa_tpu.stats import deserialize_stat; "
+            "st = deserialize_stat(open(%r, 'rb').read()); "
+            "print(json.dumps(st.to_json_object(), default=str))"
+        ) % (str(__import__('pathlib').Path(__file__).parent.parent),
+             str(path))
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert json.loads(out.stdout.strip()) == json.loads(
+            json.dumps(s.to_json_object(), default=str))
