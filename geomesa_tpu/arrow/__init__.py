@@ -13,8 +13,12 @@ from .io import (DEFAULT_BATCH_SIZE, FeatureArrowFileReader,
 from .scan import ArrowScan, merge_deltas
 from .data import ArrowDataStore
 from .feature import ArrowFeature
+from .vector import (ArrowAttributeReader, ArrowAttributeWriter,
+                     ArrowDictionary, SimpleFeatureVector)
 
 __all__ = ["DEFAULT_BATCH_SIZE", "FeatureArrowFileWriter",
            "FeatureArrowFileReader", "write_ipc", "read_ipc_batches",
            "sort_batches", "merge_sorted_ipc", "ArrowScan", "merge_deltas",
-           "ArrowDataStore", "ArrowFeature"]
+           "ArrowDataStore", "ArrowFeature", "SimpleFeatureVector",
+           "ArrowDictionary", "ArrowAttributeReader",
+           "ArrowAttributeWriter"]

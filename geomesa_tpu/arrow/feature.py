@@ -22,21 +22,12 @@ class ArrowFeature:
         return self._rb.column("__fid__")[self._row].as_py()
 
     def get(self, name: str):
-        a = self._sft.attr(name)
-        col = self._rb.column(name)
-        v = col[self._row]
-        if not v.is_valid:
-            return None
-        if a.type.name == "Point":
-            d = v.as_py()
-            return Point(d["x"], d["y"])
-        if a.type.is_geometry:
-            from ..geometry.wkt import parse_wkt
-            return parse_wkt(v.as_py())
-        if a.type.name == "Date":
-            import numpy as np
-            return int(np.datetime64(v.as_py(), "ms").astype(np.int64))
-        return v.as_py()
+        # ONE decode implementation for every layout: the typed reader
+        # (arrow/vector.py); a second copy here would drift
+        from .vector import ArrowAttributeReader
+        return ArrowAttributeReader(
+            name, self._rb.column(name),
+            attr=self._sft.attr(name)).apply(self._row)
 
     def as_dict(self) -> dict:
         out = {"id": self.id}

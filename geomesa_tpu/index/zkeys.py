@@ -675,6 +675,15 @@ class ZKeyIndex:
         # repeated query skips the z-range decomposition and the
         # searchsorted seeks; the exact evaluation below still runs —
         # the cache holds the plan's ranges, the scan stays a scan
+        if max_ranges is None:
+            # the host tiers re-check every candidate exactly, so a
+            # coarse cover only grows the (small) candidate set while
+            # the range decomposition is a PER-QUERY cost — a deep
+            # 2000-range BFS spends more than the extra candidates save
+            # on selective query streams (the coarsening knob the
+            # reference turns with SCAN_RANGES_TARGET)
+            from ..utils.properties import HOST_RANGES_TARGET
+            max_ranges = int(HOST_RANGES_TARGET.get())
         qkey = (use_z3, tuple(boxes),
                 tuple(tuple(i) for i in intervals_ms),
                 block_cap, max_ranges)

@@ -1222,6 +1222,15 @@ class InMemoryDataStore(DataStore):
                 if spatial_f is not None:
                     col = batch.col(geom)
                     keep = self._pip_residual(spatial_f, col, idx, explain)
+                    if keep is None and isinstance(col, PointColumn) \
+                            and isinstance(spatial_f, (ast.Intersects,
+                                                       ast.Within)) \
+                            and hasattr(spatial_f.geom, "contains_points"):
+                        # host crossing-number on just the candidate
+                        # coords — a full batch.take gathers every
+                        # column for rows whose geometry alone decides
+                        keep = spatial_f.geom.contains_points(
+                            col.x[idx], col.y[idx]) & col.valid[idx]
                     if keep is None:
                         keep = evaluate(spatial_f, batch.take(idx))
                     idx = idx[keep]

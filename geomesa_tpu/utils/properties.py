@@ -88,5 +88,9 @@ class SystemProperty:
 
 # the reference's headline tuning flags (QueryProperties.scala:14-18)
 SCAN_RANGES_TARGET = SystemProperty("geomesa.scan.ranges.target", "2000")
+# coarser target for the host index tiers, which re-check every
+# candidate exactly: deep decompositions are a per-query cost that a
+# selective query stream never earns back
+HOST_RANGES_TARGET = SystemProperty("geomesa.scan.ranges.host", "256")
 QUERY_TIMEOUT = SystemProperty("geomesa.query.timeout", None)
 FORCE_COUNT = SystemProperty("geomesa.force.count", "false")

@@ -127,3 +127,125 @@ class TestArrowFeature:
         g = f.get("geom")
         assert g.x == pytest.approx(batch.col("geom").x[3])
         assert f.as_dict()["name"] == batch.feature(3)["name"]
+
+
+class TestSimpleFeatureVector:
+    """Typed per-attribute vector surface (SimpleFeatureVector.scala:35-93
+    + ArrowDictionary.scala:133)."""
+
+    def _sft(self):
+        from geomesa_tpu.features import parse_spec
+        return parse_spec(
+            "v", "name:String,age:Integer,score:Double,flag:Boolean,"
+                 "dtg:Date,*geom:Point:srid=4326")
+
+    def test_write_read_roundtrip(self):
+        from geomesa_tpu.arrow import SimpleFeatureVector
+        from geomesa_tpu.geometry import Point
+        sft = self._sft()
+        v = SimpleFeatureVector.create(sft, capacity=16)
+        v.set(0, "a", {"name": "x", "age": 7, "score": 1.5, "flag": True,
+                       "dtg": 1_500_000_000_000, "geom": Point(1.0, 2.0)})
+        v.set(1, "b", {"name": None, "age": None, "score": None,
+                       "flag": None, "dtg": None, "geom": None})
+        rb = v.unload()
+        assert rb.num_rows == 2
+        r = SimpleFeatureVector.wrap(sft, rb)
+        assert list(r.ids()) == ["a", "b"]
+        assert r.reader("name").apply(0) == "x"
+        assert r.reader("age").apply(0) == 7
+        assert r.reader("dtg").apply(0) == 1_500_000_000_000
+        p = r.reader("geom").apply(0)
+        assert (p.x, p.y) == (1.0, 2.0)
+        for col in ("name", "age", "score", "flag", "dtg", "geom"):
+            assert r.reader(col).apply(1) is None
+        # zero-copy row facade
+        f = r.feature(0)
+        assert f.id == "a" and f.get("name") == "x"
+        assert f.get("geom").x == 1.0
+
+    def test_point_precision_f32(self):
+        from geomesa_tpu.arrow import SimpleFeatureVector
+        from geomesa_tpu.geometry import Point
+        import pyarrow as pa
+        sft = self._sft()
+        v = SimpleFeatureVector.create(sft, capacity=4, precision="f32")
+        v.set(0, "a", {"geom": Point(1.25, -2.5)})
+        rb = v.unload()
+        assert rb.column("geom").type == pa.list_(pa.float32(), 2)
+        r = SimpleFeatureVector.wrap(sft, rb)
+        p = r.reader("geom").apply(0)
+        assert (p.x, p.y) == (1.25, -2.5)  # representable in f32
+
+    def test_shared_dictionary_and_delta(self):
+        from geomesa_tpu.arrow import ArrowDictionary, SimpleFeatureVector
+        sft = self._sft()
+        d = ArrowDictionary(["alpha"])
+        base = len(d)
+        v = SimpleFeatureVector.create(sft, capacity=8,
+                                       dictionaries={"name": d})
+        v.set(0, "a", {"name": "alpha"})
+        v.set(1, "b", {"name": "beta"})   # grows the dictionary
+        rb = v.unload()
+        assert d.delta_since(base) == ["beta"]       # wire delta
+        assert d.lookup("beta") == 1 and d.lookup("nope") == -1
+        # the batch's dictionary array carries the full vocab
+        assert rb.column("name").dictionary.to_pylist() == ["alpha",
+                                                            "beta"]
+
+    def test_geometry_wkb_column(self):
+        from geomesa_tpu.arrow import SimpleFeatureVector
+        from geomesa_tpu.features import parse_spec
+        from geomesa_tpu.geometry import parse_wkt
+        sft = parse_spec("g", "*geom:Geometry:srid=4326")
+        v = SimpleFeatureVector.create(sft, capacity=4)
+        poly = parse_wkt("POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))")
+        v.set(0, "p", {"geom": poly})
+        r = SimpleFeatureVector.wrap(sft, v.unload())
+        back = r.reader("geom").apply(0)
+        assert back.geom_type == "Polygon" and back.area == 16.0
+        assert r.feature(0).get("geom").area == 16.0
+
+    def test_capacity_guard(self):
+        from geomesa_tpu.arrow import SimpleFeatureVector
+        v = SimpleFeatureVector.create(self._sft(), capacity=1)
+        v.set(0, "a", {})
+        import pytest as _pt
+        with _pt.raises(IndexError):
+            v.set(1, "b", {})
+
+    def test_reset_clears_previous_batch(self):
+        """reset() must never re-emit the prior batch's rows on a
+        sparse refill (review regression)."""
+        from geomesa_tpu.arrow import SimpleFeatureVector
+        from geomesa_tpu.geometry import Point
+        sft = self._sft()
+        v = SimpleFeatureVector.create(sft, capacity=4)
+        v.set(0, "old0", {"name": "stale", "geom": Point(9, 9)})
+        v.set(1, "old1", {"name": "stale", "geom": Point(9, 9)})
+        v.unload()
+        v.reset()
+        v.set(1, "new1", {"name": "fresh", "geom": Point(1, 1)})
+        rb = v.unload()
+        assert rb.num_rows == 2
+        r = SimpleFeatureVector.wrap(sft, rb)
+        assert r.ids()[0] is None            # never written this round
+        assert r.reader("name").apply(0) is None
+        assert r.reader("geom").apply(0) is None
+        assert r.reader("name").apply(1) == "fresh"
+
+    def test_null_point_through_facade(self):
+        from geomesa_tpu.arrow import SimpleFeatureVector
+        sft = self._sft()
+        v = SimpleFeatureVector.create(sft, capacity=2)
+        v.set(0, "a", {"geom": None})
+        r = SimpleFeatureVector.wrap(sft, v.unload())
+        assert r.reader("geom").apply(0) is None
+        assert r.feature(0).get("geom") is None  # facade agrees
+
+    def test_unsupported_type_rejected(self):
+        from geomesa_tpu.arrow import SimpleFeatureVector
+        from geomesa_tpu.features import parse_spec
+        sft = parse_spec("u", "uid:UUID,*geom:Point")
+        with pytest.raises(ValueError):
+            SimpleFeatureVector.create(sft, capacity=2)
