@@ -15,6 +15,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Sequence
 
+import numpy as _np
+
 from ..geometry import Geometry
 
 __all__ = [
@@ -37,6 +39,13 @@ class Filter:
 
     def __invert__(self) -> "Filter":
         return Not(self)
+
+
+def _iso(millis: int) -> str:
+    """Epoch millis -> ISO-8601 instant (temporal predicates stringify
+    to re-parseable ECQL for the wire); numpy always emits the 'T'
+    separator."""
+    return str(_np.datetime64(int(millis), "ms")) + "Z"
 
 
 def walk(f: Filter):
@@ -266,7 +275,10 @@ class During(Filter):
     end: int
 
     def __str__(self) -> str:
-        return f"{self.prop} DURING {self.start}/{self.end}"
+        # ISO instants: str(filter) must be re-parseable ECQL (the
+        # remote client ships filters over the wire as text)
+        return (f"{self.prop} DURING "
+                f"{_iso(self.start)}/{_iso(self.end)}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -275,7 +287,7 @@ class Before(Filter):
     time: int
 
     def __str__(self) -> str:
-        return f"{self.prop} BEFORE {self.time}"
+        return f"{self.prop} BEFORE {_iso(self.time)}"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -284,7 +296,7 @@ class After(Filter):
     time: int
 
     def __str__(self) -> str:
-        return f"{self.prop} AFTER {self.time}"
+        return f"{self.prop} AFTER {_iso(self.time)}"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -293,4 +305,4 @@ class TEquals(Filter):
     time: int
 
     def __str__(self) -> str:
-        return f"{self.prop} TEQUALS {self.time}"
+        return f"{self.prop} TEQUALS {_iso(self.time)}"

@@ -11,6 +11,7 @@ from .socketbus import SocketBroker, SocketBus
 from .lambda_store import LambdaDataStore
 from .mesh_store import DistributedDataStore
 from .fs_mesh import FsBackedDistributedDataStore
+from .remote import RemoteDataStore
 from .stream import (FileTailSource, IterableSource, StreamDataStore,
                      StreamSource)
 from .partitions import (AttributeScheme, CompositeScheme, DateTimeScheme,
@@ -19,6 +20,7 @@ from .partitions import (AttributeScheme, CompositeScheme, DateTimeScheme,
 __all__ = ["DataStore", "InMemoryDataStore", "QueryResult",
            "FileSystemDataStore",
            "DistributedDataStore", "FsBackedDistributedDataStore",
+           "RemoteDataStore",
            "GeoMessage", "LiveDataStore", "MessageBus", "LambdaDataStore",
            "FileBus", "SocketBroker", "SocketBus",
            "StreamSource", "StreamDataStore", "FileTailSource",

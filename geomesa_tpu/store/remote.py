@@ -1,0 +1,215 @@
+"""Networked datastore client: the DataStore SPI over HTTP.
+
+The reference's remote backends are client stacks speaking a wire
+protocol to data-holding servers (Accumulo Thrift scanners/batch
+writers, HBase protobuf RPC — SURVEY.md 2.6); queries execute where
+the data lives and results stream back. The TPU analog: a
+``GeoMesaWebServer`` (web/server.py) fronts any local store — the
+fs-backed mesh store for a durable, device-served deployment — and
+``RemoteDataStore`` is the client plumbing: schema management, Arrow
+batch writes (visibility labels ride a reserved ``__vis__`` column,
+the parquet tier's convention), server-side query/count/stats/density
+execution, Arrow results decoded back into columnar batches.
+
+    server = GeoMesaWebServer(FsBackedDistributedDataStore(root)).start()
+    ds = RemoteDataStore("127.0.0.1", server.port)
+    ds.create_schema("pts", "*geom:Point:srid=4326")
+    ds.write_dict("pts", ids, {"geom": (x, y)})
+    ds.query("BBOX(geom, 0, 0, 10, 10)", "pts").ids
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any
+from urllib.parse import quote, urlencode
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.sft import SimpleFeatureType, parse_spec
+from ..index.api import FilterStrategy, Query, QueryHints
+from .api import DataStore
+
+__all__ = ["RemoteDataStore"]
+
+
+class RemoteError(RuntimeError):
+    pass
+
+
+class RemoteDataStore(DataStore):
+    """DataStore client over the GeoMesaWebServer wire surface."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 60.0):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self._schemas: dict[str, SimpleFeatureType] = {}
+
+    # -- transport ---------------------------------------------------------
+
+    def _request(self, method: str, path: str, params: dict | None = None,
+                 body: bytes | None = None):
+        import http.client
+        qs = ("?" + urlencode(params)) if params else ""
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout_s)
+        try:
+            conn.request(method, path + qs, body=body)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status == 404:
+                # the server maps KeyError -> 404; surface the SPI's
+                # unknown-type signal so the client stays a drop-in
+                try:
+                    msg = json.loads(data.decode()).get("error", path)
+                except Exception:
+                    msg = path
+                raise KeyError(msg)
+            if resp.status >= 400:
+                try:
+                    msg = json.loads(data.decode()).get("error", "")
+                except Exception:
+                    msg = data[:200].decode(errors="replace")
+                raise RemoteError(f"{resp.status} {path}: {msg}")
+            return resp.getheader("Content-Type", ""), data
+        finally:
+            conn.close()
+
+    def _json(self, method: str, path: str, params=None, body=None):
+        _, data = self._request(method, path, params, body)
+        return json.loads(data.decode())
+
+    # -- schema management -------------------------------------------------
+
+    def create_schema(self, sft: SimpleFeatureType | str,
+                      spec: str | None = None):
+        if isinstance(sft, str):
+            sft = parse_spec(sft, spec or "")
+        self._json("POST", f"/rest/schemas/{quote(sft.type_name)}",
+                   body=sft.to_spec().encode())
+        self._schemas[sft.type_name] = sft
+
+    def get_schema(self, type_name: str) -> SimpleFeatureType:
+        if type_name not in self._schemas:
+            meta = self._json("GET", f"/rest/schemas/{quote(type_name)}")
+            self._schemas[type_name] = parse_spec(type_name,
+                                                  meta["spec"])
+        return self._schemas[type_name]
+
+    def get_type_names(self) -> list[str]:
+        return list(self._json("GET", "/rest/schemas"))
+
+    def remove_schema(self, type_name: str):
+        self._json("DELETE", f"/rest/schemas/{quote(type_name)}")
+        self._schemas.pop(type_name, None)
+
+    # -- writes ------------------------------------------------------------
+
+    def write(self, type_name: str, batch: FeatureBatch,
+              visibilities=None, **kwargs):
+        import pyarrow as pa
+        table = pa.Table.from_batches([batch.to_arrow()])
+        if visibilities is not None:
+            vis = np.asarray(visibilities, dtype=object)
+            if len(vis) != batch.n:
+                raise ValueError("visibilities length mismatch")
+            table = table.append_column(
+                "__vis__", pa.array([None if v is None else str(v)
+                                     for v in vis], pa.string()))
+        sink = io.BytesIO()
+        with pa.ipc.new_file(sink, table.schema) as w:
+            w.write_table(table)
+        self._json("POST", f"/rest/write/{quote(type_name)}",
+                   body=sink.getvalue())
+
+    def delete(self, type_name: str, ids):
+        self._json("POST", f"/rest/delete/{quote(type_name)}",
+                   body=json.dumps([str(i) for i in ids]).encode())
+
+    # -- queries -----------------------------------------------------------
+
+    def query(self, q: Query | str, type_name: str | None = None,
+              explain_out=None):
+        if isinstance(q, str):
+            if type_name is None:
+                raise ValueError("type_name required with a filter string")
+            q = Query(type_name, q)
+        params: dict[str, Any] = {"cql": str(q.filter), "format": "arrow"}
+        if q.max_features is not None:
+            params["maxFeatures"] = q.max_features
+        if q.properties is not None:
+            params["properties"] = ",".join(q.properties)
+        if q.sort_by is not None:
+            params["sortBy"] = q.sort_by
+            params["sortOrder"] = "desc" if q.sort_desc else "asc"
+        if q.auths is not None:
+            params["auths"] = ",".join(q.auths)
+        if QueryHints.SAMPLING in q.hints:
+            params["sampling"] = q.hints[QueryHints.SAMPLING]
+        if QueryHints.SAMPLE_BY in q.hints:
+            params["sampleBy"] = q.hints[QueryHints.SAMPLE_BY]
+        if QueryHints.QUERY_INDEX in q.hints:
+            params["index"] = q.hints[QueryHints.QUERY_INDEX]
+        _, data = self._request("GET", f"/rest/query/{quote(q.type_name)}",
+                                params)
+        sft = self.get_schema(q.type_name)
+        if q.properties is not None:
+            keep = set(q.properties)
+            sft = SimpleFeatureType(
+                sft.type_name,
+                [a for a in sft.attributes if a.name in keep],
+                sft.user_data)
+        import pyarrow as pa
+        with pa.ipc.open_file(io.BytesIO(data)) as rd:
+            table = rd.read_all()
+        batches = [FeatureBatch.from_arrow(sft, rb)
+                   for rb in table.to_batches() if rb.num_rows]
+        batch = (FeatureBatch.concat_all(batches) if batches
+                 else FeatureBatch.from_dict(
+                     sft, np.empty(0, dtype=object),
+                     {a.name: ((np.empty(0), np.empty(0))
+                               if a.type.name == "Point" else [])
+                      for a in sft.attributes}))
+        from .memory import QueryResult
+        from ..index.api import Explainer
+        return QueryResult(batch.ids, batch, Explainer(),
+                           FilterStrategy("remote", q.filter, None),
+                           n=batch.n)
+
+    def count(self, type_name: str) -> int:
+        return int(self._json("GET", f"/rest/count/{quote(type_name)}")
+                   ["count"])
+
+    def query_count(self, q: Query | str,
+                    type_name: str | None = None) -> int:
+        if isinstance(q, str):
+            if type_name is None:
+                raise ValueError("type_name required with a filter string")
+            q = Query(type_name, q)
+        if q.hints or q.auths is not None or q.max_features is not None:
+            # the count endpoint is filter-only; hints (sampling,
+            # forced index), auths, and limits count via the full
+            # query surface so semantics match the local stores
+            return self.query(q).n
+        return int(self._json(
+            "GET", f"/rest/count/{quote(q.type_name)}",
+            {"cql": str(q.filter)})["count"])
+
+    # -- server-side analytics ---------------------------------------------
+
+    def stats_query(self, type_name: str, stat_spec: str, ecql=None):
+        params = {"stat": stat_spec}
+        if ecql:
+            params["cql"] = str(ecql)
+        return self._json("GET", f"/rest/stats/{quote(type_name)}", params)
+
+    def density(self, type_name: str, ecql, bbox, width: int,
+                height: int):
+        out = self._json("GET", f"/rest/density/{quote(type_name)}",
+                         {"cql": str(ecql or "INCLUDE"),
+                          "bbox": ",".join(str(v) for v in bbox),
+                          "width": width, "height": height})
+        return np.asarray(out["grid"], dtype=np.float32)

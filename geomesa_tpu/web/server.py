@@ -102,6 +102,41 @@ class GeoMesaWebServer:
                                 for a in sft.attributes]})
         if len(parts) == 2 and parts[0] == "query":
             return self._query(parts[1], params)
+        if len(parts) == 2 and parts[0] == "count":
+            if "cql" in params:
+                n = self.store.query_count(params["cql"][0], parts[1])
+            else:
+                # total stored features — the SPI count() contract
+                # (NOT visibility-filtered, matching local stores)
+                n = self.store.count(parts[1])
+            return 200, "application/json", _j({"count": int(n)})
+        if len(parts) == 2 and parts[0] == "write" and method == "POST":
+            # body = Arrow IPC stream; a reserved __vis__ column (when
+            # present) carries per-row visibility labels — the same
+            # convention the parquet tier persists
+            sft = self.store.get_schema(parts[1])
+            vis = None
+            import pyarrow as pa
+            import io as _io
+            with pa.ipc.open_file(_io.BytesIO(body)) as rd:
+                table = rd.read_all()
+            if "__vis__" in table.schema.names:
+                vis = np.asarray(table.column("__vis__").to_pylist(),
+                                 dtype=object)
+                table = table.drop_columns(["__vis__"])
+            from ..features.batch import FeatureBatch
+            batches = [FeatureBatch.from_arrow(sft, rb)
+                       for rb in table.to_batches() if rb.num_rows]
+            if batches:
+                self.store.write(parts[1],
+                                 FeatureBatch.concat_all(batches),
+                                 visibilities=vis)
+            n = sum(b.n for b in batches)
+            return 200, "application/json", _j({"written": n})
+        if len(parts) == 2 and parts[0] == "delete" and method == "POST":
+            ids = json.loads(body.decode())
+            self.store.delete(parts[1], ids)
+            return 200, "application/json", _j({"deleted": len(ids)})
         if len(parts) == 2 and parts[0] == "stats":
             stat = self.store.stats_query(
                 parts[1], params.get("stat", ["Count()"])[0],
@@ -143,6 +178,9 @@ class GeoMesaWebServer:
                            .lower() == "desc")
         # ViewParams analog (index/geotools ViewParams:28): URL params
         # map onto per-query hints
+        if "properties" in params:
+            q.properties = [p for p in params["properties"][0].split(",")
+                            if p]
         if "sampling" in params:
             q.hints[QueryHints.SAMPLING] = float(params["sampling"][0])
         if "sampleBy" in params:
@@ -163,8 +201,9 @@ class GeoMesaWebServer:
                     {a.name: ((np.empty(0), np.empty(0))
                               if a.type.name == "Point" else [])
                      for a in sft.attributes})
+            # projected results carry a projected schema
             return (200, "application/vnd.apache.arrow.file",
-                    write_ipc(sft, batch))
+                    write_ipc(batch.sft, batch))
         res = self.store.query(q)
         sft = self.store.get_schema(name)
         if fmt == "geojson":

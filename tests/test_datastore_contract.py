@@ -31,9 +31,22 @@ def _populate(ds, type_name="t"):
 
 
 @pytest.fixture(params=["memory", "fs", "live", "lambda", "mesh",
-                        "fs_mesh"])
+                        "fs_mesh", "remote"])
 def store(request, tmp_path):
     kind = request.param
+    if kind == "remote":
+        # the networked backend: a web server fronting a local store,
+        # exercised through the HTTP client plumbing (the remote-KV
+        # client-stack analog)
+        from geomesa_tpu.store import RemoteDataStore
+        from geomesa_tpu.web.server import GeoMesaWebServer
+        backing = InMemoryDataStore()
+        server = GeoMesaWebServer(backing).start()
+        try:
+            yield _populate(RemoteDataStore("127.0.0.1", server.port))
+        finally:
+            server.stop()
+        return
     if kind == "memory":
         yield _populate(InMemoryDataStore())
     elif kind == "fs":

@@ -243,3 +243,43 @@ class TestLeaflet:
         from geomesa_tpu.geometry import parse_wkt
         html = L.render([L.GeoJsonLayer([parse_wkt("POINT (1 2)")])])
         assert "geoJSON" in html
+
+
+class TestRemoteStoreSemantics:
+    """Review regressions on the networked client: SPI count() is the
+    TOTAL (not visibility-filtered), unknown types raise KeyError."""
+
+    def _pair(self):
+        from geomesa_tpu.store import InMemoryDataStore, RemoteDataStore
+        from geomesa_tpu.web.server import GeoMesaWebServer
+        backing = InMemoryDataStore()
+        server = GeoMesaWebServer(backing).start()
+        return backing, server, RemoteDataStore("127.0.0.1", server.port)
+
+    def test_count_is_total_not_filtered(self):
+        from geomesa_tpu.features import parse_spec
+        backing, server, ds = self._pair()
+        try:
+            ds.create_schema(parse_spec("t", "name:String,*geom:Point"))
+            ds.write_dict("t", ["a", "b", "c"],
+                          {"name": ["x", "y", "z"],
+                           "geom": ([0.0, 1.0, 2.0], [0.0, 1.0, 2.0])},
+                          visibilities=[None, "admin", None])
+            assert ds.count("t") == backing.count("t") == 3
+            # the filtered surface still enforces visibility
+            assert ds.query("INCLUDE", "t").n == 2
+        finally:
+            server.stop()
+
+    def test_unknown_type_keyerror(self):
+        import pytest
+        backing, server, ds = self._pair()
+        try:
+            with pytest.raises(KeyError):
+                ds.get_schema("nope")
+            with pytest.raises(KeyError):
+                ds.query("INCLUDE", "nope")
+            with pytest.raises(KeyError):
+                ds.count("nope")
+        finally:
+            server.stop()
