@@ -46,6 +46,13 @@ class DataStore(abc.ABC):
     def get_type_names(self) -> list[str]:
         """All registered type names."""
 
+    def remove_schema(self, type_name: str):
+        """Drop a feature type and its data. Part of the SPI (the CLI
+        and web server call it polymorphically); backends without a
+        removal story must say so explicitly rather than AttributeError."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support schema removal")
+
     # -- writes ----------------------------------------------------------------
 
     @abc.abstractmethod

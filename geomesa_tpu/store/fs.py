@@ -222,6 +222,16 @@ class FileSystemDataStore(DataStore):
     def get_type_names(self) -> list[str]:
         return sorted(self._types)
 
+    def remove_schema(self, type_name: str):
+        """Drop the type and its on-disk data/index directories. The
+        directory removal runs FIRST and raises on failure — the
+        catalog entry must not disappear while data survives on disk
+        (a reopen would silently resurrect the schema)."""
+        import shutil
+        st = self._state(type_name)
+        shutil.rmtree(st.root)
+        self._types.pop(type_name, None)
+
     def _state(self, type_name: str) -> _FsTypeState:
         if type_name not in self._types:
             raise KeyError(f"no such schema: {type_name}")

@@ -133,6 +133,11 @@ class FsBackedDistributedDataStore(DistributedDataStore):
         # row ranges shift after a delete; recompute lazily on demand
         self._partition_rows[type_name] = []
 
+    def remove_schema(self, type_name: str):
+        self.fs.remove_schema(type_name)
+        super().remove_schema(type_name)
+        self._partition_rows.pop(type_name, None)
+
     # -- partition / shard metadata ----------------------------------------
 
     def partitions(self, type_name: str) -> list[str]:

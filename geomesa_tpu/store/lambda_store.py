@@ -55,6 +55,12 @@ class LambdaDataStore(DataStore):
         return sorted(set(self.transient.get_type_names())
                       | set(self.persistent.get_type_names()))
 
+    def remove_schema(self, type_name: str):
+        if self._transient_has(type_name):
+            self.transient.remove_schema(type_name)
+        if type_name in self.persistent.get_type_names():
+            self.persistent.remove_schema(type_name)
+
     def _transient_has(self, type_name: str) -> bool:
         return type_name in self.transient.get_type_names()
 

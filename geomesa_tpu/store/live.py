@@ -90,6 +90,11 @@ class LiveDataStore(DataStore):
     def get_type_names(self) -> list[str]:
         return self._mem.get_type_names()
 
+    def remove_schema(self, type_name: str):
+        self._mem.remove_schema(type_name)
+        self._arrival_ms.pop(type_name, None)
+        self._listeners.pop(type_name, None)
+
     # -- producer side -----------------------------------------------------
 
     def write(self, type_name: str, batch: FeatureBatch,

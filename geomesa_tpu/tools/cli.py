@@ -29,8 +29,23 @@ __all__ = ["main"]
 
 
 def _store(args):
+    """Datastore from the --path argument (the per-backend runner
+    dispatch of the reference's CLI): a plain directory opens the
+    parquet fs store; ``fs-mesh://<dir>`` serves the same durable root
+    through the device mesh; ``remote://host:port`` speaks to a
+    GeoMesaWebServer over the network."""
+    path = args.path
+    if path.startswith("remote://"):
+        from ..store import RemoteDataStore
+        host, _, port = path[len("remote://"):].partition(":")
+        # no explicit port -> the serve command's default
+        return RemoteDataStore(host or "127.0.0.1",
+                               int(port) if port else 8080)
+    if path.startswith("fs-mesh://"):
+        from ..store import FsBackedDistributedDataStore
+        return FsBackedDistributedDataStore(path[len("fs-mesh://"):])
     from ..store import FileSystemDataStore
-    return FileSystemDataStore(args.path)
+    return FileSystemDataStore(path)
 
 
 def cmd_create_schema(args) -> int:
@@ -60,11 +75,9 @@ def cmd_describe_schema(args) -> int:
 
 
 def cmd_delete_schema(args) -> int:
-    import shutil
-    import os
     ds = _store(args)
-    ds._state(args.name)  # validate
-    shutil.rmtree(os.path.join(args.path, args.name))
+    ds.get_schema(args.name)  # validate (KeyError on absence)
+    ds.remove_schema(args.name)
     print(f"deleted schema {args.name!r}")
     return 0
 
@@ -306,4 +319,16 @@ def main(argv=None) -> int:
     add("env", cmd_env, needs_store=False)
 
     args = p.parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # downstream closed early (e.g. `... | head`): exit quietly,
+        # the unix convention for pipeline producers
+        import os
+        import sys
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        os.dup2(os.open(os.devnull, os.O_WRONLY), 1)
+        return 0
