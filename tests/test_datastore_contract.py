@@ -150,6 +150,15 @@ class TestContract:
         assert set(res.ids.astype(str)) == {"g0", "g1", "g2"}
         assert store.query("INCLUDE", "ext").n == len(wkts)
 
+    def test_remove_schema(self, store):
+        store.create_schema(parse_spec("gone", "v:Integer,*geom:Point"))
+        store.write_dict("gone", ["a"], {"v": [1], "geom": ([0.0], [0.0])})
+        assert "gone" in store.get_type_names()
+        store.remove_schema("gone")
+        assert "gone" not in store.get_type_names()
+        with pytest.raises(KeyError):
+            store.get_schema("gone")
+
     def test_sort_and_limit(self, store):
         from geomesa_tpu.index.api import Query
         res = store.query(Query("t", "BBOX(geom, -60, -30, 60, 30)",
