@@ -12,6 +12,11 @@ Additional configs (BASELINE.md table):
   #3  ST_DWithin radius join, 10M points x 1k query points
   #4  KNN, 50M points, k=100
   #5  ST_Contains, 100M points vs 10k polygons (z2-index pruned path)
+  #6  concurrent BBOX micro-batching, 10M points: aggregate queries/sec
+      at concurrency {1, 8, 32, 128}, sequential per-query dispatch vs
+      the coalesced `query_batched` path (one fused vmapped scan per
+      admission batch; scan/batcher.py), plus the single-query p50
+      through the QueryBatcher passthrough vs direct `query()`
   north star: p50 latency of a 100M-point BBOX+time query through the
   in-memory store (index-pruned gather scan), reported as p50_ms_100m.
 
@@ -29,7 +34,18 @@ Prints ONE JSON line:
 
 Env knobs: GEOMESA_TPU_BENCH_N (10M), GEOMESA_TPU_BENCH_REPS (512),
 GEOMESA_TPU_BENCH_TRIALS (3), GEOMESA_TPU_BENCH_CONFIGS
-("1,2,3,4,5,northstar" — comma list to run a subset).
+("1,2,3,4,5,6,northstar" — comma list to run a subset).
+
+Config #6 also honors the batcher's own knobs (utils/properties
+resolution: thread-local override -> env var -> default):
+  geomesa.batch.max.size      / GEOMESA_BATCH_MAX_SIZE      (32) —
+      max queries per fused dispatch; <= 1 disables coalescing
+  geomesa.batch.linger.micros / GEOMESA_BATCH_LINGER_MICROS (2000) —
+      how long an admission-queue leader waits for followers
+The web tier's write gate (not benched, documented for completeness):
+  geomesa.web.auth.token      / GEOMESA_WEB_AUTH_TOKEN      (unset) —
+      opt-in shared bearer token for POST /rest/write, POST
+      /rest/delete, DELETE /rest/schemas.
 """
 
 import functools
@@ -46,7 +62,7 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,northstar").split(","))
+                             "1,2,3,4,5,6,northstar").split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
 T0_DAY, T1_DAY = 17_000, 17_100
@@ -386,6 +402,108 @@ def bench_config5(rng, ds, x, y):
             "total_matches": total, "counts_exact": bool(ok)}
 
 
+# -- config 6: concurrent BBOX micro-batching at 10M ----------------------
+
+def bench_config6(rng, x, y, ms):
+    """Aggregate throughput of coalesced multi-query execution. Wide
+    BBOX windows land in the dense device tier, where the sequential
+    path pays per-query launch + O(n) mask transfer + host boundary
+    scan; `query_batched` evaluates the whole admission batch in ONE
+    vmapped kernel (device-side candidate detection, O(hits) transfer),
+    so throughput scales with batch size instead of request count."""
+    import threading
+
+    from geomesa_tpu.features import parse_spec
+    from geomesa_tpu.index.api import Query
+    from geomesa_tpu.scan.batcher import QueryBatcher
+    from geomesa_tpu.store import InMemoryDataStore
+
+    n = len(x)
+    ds = InMemoryDataStore()
+    ds.create_schema(parse_spec("ais6", "dtg:Date,*geom:Point:srid=4326"))
+    ds.write_dict("ais6", np.arange(n).astype(str).astype(object),
+                  {"dtg": ms, "geom": (x, y)})
+
+    def mk_queries(m, seed):
+        q_rng = np.random.default_rng(seed)
+        out = []
+        for _ in range(m):
+            x0 = float(q_rng.uniform(-150, 110))
+            y0 = float(q_rng.uniform(-70, 45))
+            out.append(Query("ais6",
+                             f"BBOX(geom, {x0:.4f}, {y0:.4f}, "
+                             f"{x0 + 40:.4f}, {y0 + 25:.4f})"))
+        return out
+
+    # exactness gate: coalesced ids equal per-query ids, query for query
+    probe = mk_queries(8, seed=7)
+    seq_ids = [set(ds.query(q).ids.astype(str)) for q in probe]
+    bat_ids = [set(r.ids.astype(str)) for r in ds.query_batched(probe)]
+    ok = seq_ids == bat_ids
+
+    levels = {}
+    for c in (1, 8, 32, 128):
+        rounds = 12 if c == 1 else 3
+        qs = mk_queries(c * rounds, seed=100 + c)
+        # sequential per-query dispatch (today's path)
+        for q in qs[:min(2, len(qs))]:
+            ds.query(q)  # warm the scalar shape class
+        t0 = time.perf_counter()
+        for q in qs:
+            ds.query(q)
+        seq_s = time.perf_counter() - t0
+        # coalesced: one fused scan per c-sized admission batch. Warm
+        # with an un-timed pass over the SAME chunks so every hit-count
+        # compaction size class is compiled — the timed pass measures
+        # steady-state serving, matching the other configs' convention
+        for j in range(rounds):
+            ds.query_batched(qs[j * c:(j + 1) * c])
+        t0 = time.perf_counter()
+        for j in range(rounds):
+            ds.query_batched(qs[j * c:(j + 1) * c])
+        bat_s = time.perf_counter() - t0
+        levels[str(c)] = {
+            "queries": len(qs),
+            "seq_qps": round(len(qs) / seq_s, 1),
+            "batched_qps": round(len(qs) / bat_s, 1),
+            "speedup": round(seq_s / bat_s, 2),
+        }
+
+    # single-query latency through the batcher passthrough (the <= 10%
+    # regression budget) vs direct store.query
+    q1 = mk_queries(1, seed=999)[0]
+    solo = QueryBatcher(ds)
+    solo.query(q1)
+    direct_p50 = _p50([_timed(lambda: ds.query(q1)) for _ in range(15)])
+    via_p50 = _p50([_timed(lambda: solo.query(q1)) for _ in range(15)])
+
+    # a threaded burst through the real admission queue: occupancy,
+    # coalesce ratio and plan-cache behavior as a server would see them
+    burst = QueryBatcher(ds, max_batch=32, linger_us=20_000)
+    bqs = mk_queries(32, seed=13)
+    threads = [threading.Thread(target=burst.query, args=(q,))
+               for q in bqs]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    burst_s = time.perf_counter() - t0
+    st = burst.stats()
+    return {
+        "concurrency": levels,
+        "speedup_at_32": levels["32"]["speedup"],
+        "p50_direct_ms": round(direct_p50 * 1e3, 3),
+        "p50_via_batcher_ms": round(via_p50 * 1e3, 3),
+        "single_query_overhead_pct": round(
+            (via_p50 / direct_p50 - 1.0) * 100, 1),
+        "threaded_burst_qps": round(len(bqs) / burst_s, 1),
+        "coalesce_ratio": round(st["coalesce_ratio"], 3),
+        "plan_cache_hit_rate": round(st["plan_cache_hit_rate"], 3),
+        "n": n, "ids_exact": bool(ok),
+    }
+
+
 # -- north star: store-level 100M BBOX+time p50 ---------------------------
 
 def _build_big_store(x, y, ms):
@@ -447,7 +565,7 @@ def main():
     rng = np.random.default_rng(1234)
     out: dict = {"configs": {}}
 
-    need_big = CONFIGS & {"3", "4", "5", "northstar"}
+    need_big = CONFIGS & {"3", "4", "5", "6", "northstar"}
     bx = by = bms = None
     if need_big:
         bx, by, bms = _big_points(rng)
@@ -473,6 +591,11 @@ def main():
 
     if "4" in CONFIGS:
         out["configs"]["4_knn_50m_k100"] = bench_config4(rng, bx, by)
+
+    if "6" in CONFIGS:
+        m = min(N, len(bx))
+        out["configs"]["6_concurrent_bbox"] = bench_config6(
+            rng, bx[:m], by[:m], bms[:m])
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

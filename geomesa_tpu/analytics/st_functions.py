@@ -166,6 +166,9 @@ def st_buffer_envelope(g: Geometry, d: float) -> Polygon:
     return g.envelope.buffer(d).to_polygon()
 
 
+_buffer_envelope_warned = False
+
+
 def st_buffer(g: Geometry, d: float, segments: int = 64) -> Polygon:
     """Planar buffer in degrees (JTS ST_Buffer semantics). Points get a
     true round buffer (n-gon circle in coordinate space); other
@@ -177,6 +180,14 @@ def st_buffer(g: Geometry, d: float, segments: int = 64) -> Polygon:
         ring = np.column_stack([g.x + d * np.cos(ang),
                                 g.y + d * np.sin(ang)])
         return Polygon(ring)
+    global _buffer_envelope_warned
+    if not _buffer_envelope_warned:
+        _buffer_envelope_warned = True
+        import warnings
+        warnings.warn(
+            "st_buffer of a non-point geometry returns an envelope"
+            " expansion (bbox over-approximation), not an exact offset"
+            " curve", stacklevel=2)
     return st_buffer_envelope(g, d)
 
 

@@ -340,7 +340,9 @@ def relate(a: Geometry, b: Geometry) -> str:
                 ip = interior_point(a)
                 if ip is not None:
                     loc = _locate(b, *ip)
-                    mat.up("I", "I" if loc == "I" else loc, 2)
+                    # Int(A)∩Bnd(B) is a subset of a boundary — at most
+                    # 1-dimensional; only the I/E columns can carry 2
+                    mat.up("I", loc, 2 if loc != "B" else 1)
                 ipb = interior_point(b)
                 if ipb is not None:
                     loc = _locate(a, *ipb)

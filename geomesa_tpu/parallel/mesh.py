@@ -25,6 +25,13 @@ from ..utils.jaxcache import ensure_compile_cache
 ensure_compile_cache()
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map graduated from jax.experimental in newer releases;
+# resolve whichever this jax ships
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from ..scan import zscan
 
 __all__ = ["data_mesh", "DistributedScanData", "shard_scan_data",
@@ -109,7 +116,7 @@ _SPECS_IN = (P("data"), P("data"), P("data"), P("data"),
 
 @functools.lru_cache(maxsize=32)
 def _mask_fn(mesh: Mesh, time_any: bool):
-    return jax.jit(jax.shard_map(_shard_mask_fn(time_any), mesh=mesh,
+    return jax.jit(_shard_map(_shard_mask_fn(time_any), mesh=mesh,
                                  in_specs=_SPECS_IN, out_specs=P("data")))
 
 
@@ -121,7 +128,7 @@ def _count_fn(mesh: Mesh, time_any: bool):
         mask = body(*args)
         return jax.lax.psum(jnp.sum(mask, dtype=jnp.int32), "data")
 
-    return jax.jit(jax.shard_map(counted, mesh=mesh,
+    return jax.jit(_shard_map(counted, mesh=mesh,
                                  in_specs=_SPECS_IN, out_specs=P()))
 
 
@@ -216,6 +223,57 @@ def _boundary_verdicts(data: DistributedScanData, q: zscan.ScanQuery,
     return dev, exact
 
 
+def _shard_batch_mask_fn():
+    """Shard-local BATCHED scan body: the scalar kernel vmapped over a
+    stacked query batch, plus the per-query boundary-candidate mask
+    (two-float hi-cell collisions) computed in the same launch. Pad
+    rows carry out-of-domain coords (1e9) so neither output can flag
+    them; per-query time_any is absorbed into catch-all intervals by
+    zscan.stack_queries, so the temporal compare always runs."""
+    def body(xhi, xlo, yhi, ylo, tday, tms, boxes, box_valid, times, tvalid):
+        def one(bx, bv, tx, tv):
+            return (zscan._mask_body(xhi, xlo, yhi, ylo, tday, tms,
+                                     bx, bv, tx, tv, time_any=False,
+                                     n_valid=None),
+                    zscan._cand_body(xhi, yhi, bx, bv))
+        return jax.vmap(one)(boxes, box_valid, times, tvalid)
+    return body
+
+
+@functools.lru_cache(maxsize=32)
+def _batch_mask_fn(mesh: Mesh):
+    return jax.jit(_shard_map(
+        _shard_batch_mask_fn(), mesh=mesh, in_specs=_SPECS_IN,
+        out_specs=(P(None, "data"), P(None, "data"))))
+
+
+def batch_exact_hit_rows(data: DistributedScanData,
+                         bq: zscan.BatchedScanQuery) -> list[np.ndarray]:
+    """Micro-batched exact_hit_rows: ONE shard-mapped launch evaluates
+    every query in the batch on every device, then per-query
+    count-then-compact keeps host work and transfers O(hits +
+    candidates) per query — the multi-query analog of exact_hit_rows."""
+    mask, cand = _batch_mask_fn(data.mesh)(
+        data.xhi, data.xlo, data.yhi, data.ylo, data.tday, data.tms,
+        bq.boxes, bq.box_valid, bq.times, bq.time_valid)
+    counts = np.asarray(zscan._batch_count(mask))
+    ccounts = np.asarray(zscan._batch_count(cand))
+    size = 1 << max(int(counts.max()) - 1, 0).bit_length()
+    csize = 1 << max(int(ccounts.max()) - 1, 0).bit_length()
+    idx = np.asarray(zscan._batch_nonzero(mask, size))
+    cidx = np.asarray(zscan._batch_nonzero(cand, csize))
+    out = []
+    for i, sq in enumerate(bq.queries):
+        rows = idx[i, :counts[i]].astype(np.int64)
+        rows = rows[rows < data.n]
+        crows = cidx[i, :ccounts[i]].astype(np.int64)
+        crows = crows[crows < data.n]
+        out.append(zscan.patch_hit_rows(rows, sq, data.host_x,
+                                        data.host_y, data.host_millis,
+                                        crows))
+    return out
+
+
 def _exact_count_adjustment(data: DistributedScanData,
                             q: zscan.ScanQuery) -> int:
     """Difference between exact-f64 and two-float verdicts over the
@@ -256,7 +314,7 @@ def _density_fn(mesh: Mesh, time_any: bool,
         grid = grid.at[flat].add(mask.astype(jnp.float32))
         return jax.lax.psum(grid, "data")
 
-    return jax.jit(jax.shard_map(density, mesh=mesh,
+    return jax.jit(_shard_map(density, mesh=mesh,
                                  in_specs=_SPECS_IN, out_specs=P()))
 
 
@@ -273,7 +331,7 @@ def _hist_fn(mesh: Mesh, nbins: int, lo: float, hi: float):
         h = h.at[b].add(mask.astype(jnp.int32))
         return jax.lax.psum(h, "data")
 
-    return jax.jit(jax.shard_map(body, mesh=mesh,
+    return jax.jit(_shard_map(body, mesh=mesh,
                                  in_specs=(P("data"), P("data")),
                                  out_specs=P()))
 
@@ -299,7 +357,7 @@ def _minmax_fn(mesh: Mesh):
         vmax = jnp.max(jnp.where(mask, values, jnp.float32(-np.inf)))
         return (jax.lax.pmin(vmin, "data"), jax.lax.pmax(vmax, "data"))
 
-    return jax.jit(jax.shard_map(body, mesh=mesh,
+    return jax.jit(_shard_map(body, mesh=mesh,
                                  in_specs=(P("data"), P("data")),
                                  out_specs=(P(), P())))
 
@@ -381,7 +439,7 @@ def _tristate_fn(mesh: Mesh, time_any: bool, has_time: bool):
                                     tday, tms, outer, inner, bvalid,
                                     times, tvalid, time_any, has_time)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(_shard_map(
         body, mesh=mesh, in_specs=(P("data"),) * 7 + (P(),) * 5,
         out_specs=P("data")))
 

@@ -1,0 +1,271 @@
+"""Query micro-batching: coalesce concurrent ``query()`` calls into one
+fused device scan.
+
+The reference amortizes per-request overhead by running filters inside
+the scan machinery itself (Accumulo iterators / HBase coprocessors
+serving many concurrent scans per tablet server). The TPU rebuild's
+analog bottleneck is DISPATCH COUNT: a 10M-point fused scan costs
+~0.33 ms on device, so at production concurrency the store spends its
+time launching kernels, not filtering points. This module turns N
+in-flight queries into ONE vmapped launch (scan/zscan.py
+``stack_queries`` + ``batch_hit_rows``) and demultiplexes per-caller
+results.
+
+Admission control is leader/follower with per-schema queues: the first
+caller for a schema becomes the leader, lingers up to
+``linger_us`` microseconds (or until ``max_batch`` callers are queued),
+then drains the queue and dispatches ``store.query_batched``.
+Followers block until the leader hands them their ``QueryResult``.
+Queues are keyed by type name, so queries never coalesce across
+schemas.
+
+Lingering is load-gated: an idle singleton dispatches immediately (a
+lone query must not pay the linger window as latency), and the wait
+only applies when another dispatch is already in flight or followers
+are already queued — exactly the situations where arrivals inside the
+window can coalesce.
+
+Knobs (system properties / environment):
+
+- ``geomesa.batch.max.size``  (``GEOMESA_BATCH_MAX_SIZE``)   — max
+  queries per fused dispatch, default 32; <= 1 disables batching.
+- ``geomesa.batch.linger.micros`` (``GEOMESA_BATCH_LINGER_MICROS``) —
+  how long a leader waits for followers, default 2000 µs.
+
+Metrics (global registry): ``batcher.queries``, ``batcher.batches``,
+``batcher.coalesced``, ``batcher.occupancy``, ``batcher.coalesce_ratio``,
+``batcher.linger`` (timer), ``batcher.plan_cache.hit`` / ``.miss``,
+``batcher.plan_cache.hit_rate``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..metrics import metrics
+from ..utils.properties import SystemProperty
+from .zscan import next_pow2
+
+__all__ = ["QueryBatcher", "BATCH_MAX_SIZE", "BATCH_LINGER_MICROS"]
+
+BATCH_MAX_SIZE = SystemProperty("geomesa.batch.max.size", "32")
+BATCH_LINGER_MICROS = SystemProperty("geomesa.batch.linger.micros", "2000")
+
+
+class _Pending:
+    __slots__ = ("q", "ev", "result", "error")
+
+    def __init__(self, q):
+        self.q = q
+        self.ev = threading.Event()
+        self.result = None
+        self.error = None
+
+    def resolve(self, result=None, error=None):
+        self.result, self.error = result, error
+        self.ev.set()
+
+    def get(self):
+        self.ev.wait()
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class _TypeQueue:
+    __slots__ = ("items", "has_leader")
+
+    def __init__(self):
+        self.items: list[_Pending] = []
+        self.has_leader = False
+
+
+class QueryBatcher:
+    """Admission-queue executor over a DataStore's ``query_batched``.
+
+    Thread-safe; one instance fronts one store. Callers on the same
+    schema arriving within a linger window share a single fused device
+    scan; results are exactly what per-query ``store.query()`` would
+    return (the store falls back per query for non-fusible plans).
+    """
+
+    def __init__(self, store, max_batch: int | None = None,
+                 linger_us: float | None = None, registry=metrics):
+        self.store = store
+        self.max_batch = int(max_batch if max_batch is not None
+                             else BATCH_MAX_SIZE.get())
+        self.linger_us = float(linger_us if linger_us is not None
+                               else BATCH_LINGER_MICROS.get())
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: dict[str, _TypeQueue] = {}
+        # jit/plan shape-class cache: keyed (type_name, index_version,
+        # padded data cap, padded batch size). A miss predicts an XLA
+        # retrace of the fused kernel for that shape class; hits mean
+        # the trace is reused. Tracking it here (not in jax) gives the
+        # serving layer observable recompile behavior.
+        self._plan_keys: set[tuple] = set()
+        self._in_flight = 0
+        self.total_queries = 0
+        self.coalesced_queries = 0
+        self.batches = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    # -- public surface ----------------------------------------------------
+
+    def query(self, q, type_name: str | None = None):
+        """Submit one query; blocks until its result is ready. Mirrors
+        ``store.query(q, type_name)`` ergonomics (ECQL string + type
+        name, or a Query object)."""
+        if isinstance(q, str):
+            from ..index.api import Query
+            if type_name is None:
+                raise ValueError("type_name required with a filter string")
+            q = Query(type_name, q)
+        if self.max_batch <= 1:
+            self._note(1)
+            return self.store.query(q)
+        p = _Pending(q)
+        with self._cond:
+            tq = self._queues.setdefault(q.type_name, _TypeQueue())
+            tq.items.append(p)
+            if not tq.has_leader:
+                tq.has_leader = True
+                leader = True
+            else:
+                leader = False
+                if len(tq.items) >= self.max_batch:
+                    self._cond.notify_all()
+        if not leader:
+            return p.get()
+        self._lead(q.type_name, tq)
+        return p.get()
+
+    def stats(self) -> dict:
+        """Batching counters (also mirrored into the metrics registry)."""
+        total = self.total_queries
+        probes = self.cache_hits + self.cache_misses
+        return {
+            "total_queries": total,
+            "batches": self.batches,
+            "coalesced_queries": self.coalesced_queries,
+            "coalesce_ratio": (self.coalesced_queries / total
+                               if total else 0.0),
+            "plan_cache_hits": self.cache_hits,
+            "plan_cache_misses": self.cache_misses,
+            "plan_cache_hit_rate": (self.cache_hits / probes
+                                    if probes else 0.0),
+        }
+
+    # -- leader path -------------------------------------------------------
+
+    def _lead(self, type_name: str, tq: _TypeQueue):
+        """Linger for followers (only under load), then drain the queue
+        in max_batch chunks and dispatch each as one fused scan."""
+        t0 = time.perf_counter()
+        chunks: list[list[_Pending]] = []
+        with self._cond:
+            # linger pays only when arrivals inside the window can
+            # actually coalesce: another dispatch in flight, or
+            # followers already queued behind this leader. An idle
+            # singleton dispatches immediately — a lone query must not
+            # see the linger window as added latency.
+            if self.linger_us > 0 and (self._in_flight > 0
+                                       or len(tq.items) > 1):
+                deadline = time.monotonic() + self.linger_us / 1e6
+                while len(tq.items) < self.max_batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            while tq.items:
+                chunks.append(tq.items[:self.max_batch])
+                del tq.items[:self.max_batch]
+            tq.has_leader = False
+            self._in_flight += 1
+        self._observe_linger(time.perf_counter() - t0)
+        try:
+            for chunk in chunks:
+                self._dispatch(type_name, chunk)
+        finally:
+            with self._cond:
+                self._in_flight -= 1
+
+    def _observe_linger(self, seconds: float):
+        ctx = self.registry.time("batcher.linger")
+        ctx.__enter__()
+        ctx.t0 -= seconds  # backdate so the timer records the real wait
+        ctx.__exit__(None, None, None)
+
+    def _dispatch(self, type_name: str, chunk: list[_Pending]):
+        occupancy = len(chunk)
+        self._note(occupancy)
+        try:
+            if occupancy == 1:
+                results = [self.store.query(chunk[0].q)]
+            else:
+                self._probe_plan_cache(type_name, occupancy)
+                results = self.store.query_batched(
+                    [p.q for p in chunk])
+            for p, r in zip(chunk, results):
+                p.resolve(result=r)
+        except Exception:
+            # semantics fallback: a batch-level failure must not take
+            # down every caller — replay each query individually so
+            # errors land on exactly the caller that owns them
+            for p in chunk:
+                try:
+                    p.resolve(result=self.store.query(p.q))
+                except Exception as e:  # noqa: BLE001
+                    p.resolve(error=e)
+
+    # -- accounting --------------------------------------------------------
+
+    def _note(self, occupancy: int):
+        with self._lock:
+            self.total_queries += occupancy
+            self.batches += 1
+            if occupancy > 1:
+                self.coalesced_queries += occupancy
+            total, co = self.total_queries, self.coalesced_queries
+        reg = self.registry
+        reg.counter("batcher.queries", occupancy)
+        reg.counter("batcher.batches")
+        if occupancy > 1:
+            reg.counter("batcher.coalesced", occupancy)
+        reg.gauge("batcher.occupancy", occupancy)
+        reg.gauge("batcher.coalesce_ratio", co / total if total else 0.0)
+
+    def _probe_plan_cache(self, type_name: str, occupancy: int):
+        key = self._shape_key(type_name, occupancy)
+        with self._lock:
+            hit = key in self._plan_keys
+            if hit:
+                self.cache_hits += 1
+            else:
+                self._plan_keys.add(key)
+                self.cache_misses += 1
+            hits, misses = self.cache_hits, self.cache_misses
+        reg = self.registry
+        reg.counter("batcher.plan_cache.hit" if hit
+                    else "batcher.plan_cache.miss")
+        reg.gauge("batcher.plan_cache.hit_rate",
+                  hits / (hits + misses) if hits + misses else 0.0)
+
+    def _shape_key(self, type_name: str, occupancy: int) -> tuple:
+        """(type_name, index_version, padded data cap, padded batch
+        size) — the shape class that decides whether the fused kernel's
+        jit trace is reused. An index version bump or a capacity-class
+        change invalidates every cached trace for the type."""
+        try:
+            version = self.store.get_schema(type_name).index_version
+        except Exception:  # noqa: BLE001
+            version = -1
+        try:
+            cap = next_pow2(max(int(self.store.count(type_name)), 1))
+        except Exception:  # noqa: BLE001
+            cap = 0
+        return (type_name, version, cap, next_pow2(occupancy))

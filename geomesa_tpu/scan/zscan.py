@@ -32,9 +32,11 @@ from ..utils.jaxcache import ensure_compile_cache
 
 ensure_compile_cache()
 
-__all__ = ["DeviceScanData", "ScanQuery", "build_scan_data",
-           "extend_scan_data", "make_query", "next_pow2", "scan_mask", "scan_mask_at",
-           "split_two_float", "MILLIS_PER_DAY"]
+__all__ = ["BatchedScanQuery", "DeviceScanData", "ScanQuery",
+           "batch_hit_rows", "build_scan_data", "extend_scan_data",
+           "make_query", "next_pow2", "patch_hit_rows", "scan_mask",
+           "scan_mask_at", "scan_mask_batch", "scan_mask_batch_at",
+           "split_two_float", "stack_queries", "MILLIS_PER_DAY"]
 
 MILLIS_PER_DAY = 86_400_000
 
@@ -354,13 +356,9 @@ def boundary_candidates(data_xhi: np.ndarray, data_yhi: np.ndarray,
     return np.flatnonzero(mask)
 
 
-def exact_patch(mask: np.ndarray, cand_idx: np.ndarray,
-                x: np.ndarray, y: np.ndarray, millis: np.ndarray,
-                q: ScanQuery) -> np.ndarray:
-    """Fully re-evaluate boundary candidates in exact f64/i64 semantics
-    and patch their mask bits, making the overall result exact."""
-    if len(cand_idx) == 0:
-        return mask
+def _exact_hits(cand_idx: np.ndarray, x: np.ndarray, y: np.ndarray,
+                millis: np.ndarray, q: ScanQuery) -> np.ndarray:
+    """Exact f64/i64 verdict for each candidate row index."""
     cx, cy = x[cand_idx], y[cand_idx]
     ok = np.zeros(len(cand_idx), dtype=bool)
     for i in range(q.n_boxes):
@@ -372,6 +370,244 @@ def exact_patch(mask: np.ndarray, cand_idx: np.ndarray,
         for lo, hi in q.host_intervals:
             t_ok |= (cm >= lo) & (cm <= hi)
         ok &= t_ok
+    return ok
+
+
+def exact_patch(mask: np.ndarray, cand_idx: np.ndarray,
+                x: np.ndarray, y: np.ndarray, millis: np.ndarray,
+                q: ScanQuery) -> np.ndarray:
+    """Fully re-evaluate boundary candidates in exact f64/i64 semantics
+    and patch their mask bits, making the overall result exact."""
+    if len(cand_idx) == 0:
+        return mask
+    ok = _exact_hits(cand_idx, x, y, millis, q)
     mask = mask.copy()
     mask[cand_idx] = ok
     return mask
+
+
+# -- micro-batched multi-query scan ---------------------------------------
+#
+# N concurrent queries become ONE device launch: each query's padded
+# boxes/intervals are stacked along a leading pow2 batch dim and the
+# scalar-query kernel is vmapped over it. Per-query `time_any` is a
+# static argument and may differ within a batch, so time-unconstrained
+# queries get a CATCH-ALL interval (all representable days) and the
+# batched kernel always runs the temporal compare.
+
+_CATCH_ALL_INTERVAL = (-(2 ** 30), 0, 2 ** 30, MILLIS_PER_DAY)
+
+
+class BatchedScanQuery:
+    """Qp stacked queries padded to common box/interval counts.
+
+    boxes: (Qp, K, 8) f32; box_valid: (Qp, K) bool
+    times: (Qp, B, 4) i32; time_valid: (Qp, B) bool
+
+    ``queries`` keeps the original ScanQuery objects (exact f64 bounds
+    for per-query boundary patches); Qp - n_queries tail rows are pure
+    padding with box_valid all False (they match nothing).
+    """
+
+    def __init__(self, boxes: np.ndarray, box_valid: np.ndarray,
+                 times: np.ndarray, time_valid: np.ndarray,
+                 queries: list[ScanQuery]):
+        self._np = (np.asarray(boxes), np.asarray(box_valid),
+                    np.asarray(times), np.asarray(time_valid))
+        self._dev = None
+        self.queries = queries
+
+    @property
+    def n_queries(self) -> int:
+        return len(self.queries)
+
+    @property
+    def padded_queries(self) -> int:
+        return int(self._np[0].shape[0])
+
+    @property
+    def shape_key(self) -> tuple[int, int, int]:
+        """(Qp, K, B) — the jit shape class of this batch."""
+        return (int(self._np[0].shape[0]), int(self._np[0].shape[1]),
+                int(self._np[2].shape[1]))
+
+    def _device(self):
+        if self._dev is None:
+            self._dev = tuple(jnp.asarray(a) for a in self._np)
+        return self._dev
+
+    @property
+    def boxes(self) -> jax.Array:
+        return self._device()[0]
+
+    @property
+    def box_valid(self) -> jax.Array:
+        return self._device()[1]
+
+    @property
+    def times(self) -> jax.Array:
+        return self._device()[2]
+
+    @property
+    def time_valid(self) -> jax.Array:
+        return self._device()[3]
+
+
+def stack_queries(queries: list[ScanQuery],
+                  min_batch: int = 1) -> BatchedScanQuery:
+    """Stack padded ScanQueries into one BatchedScanQuery.
+
+    Box/interval dims are padded to the max across the batch (already
+    pow2 per query, so the max is pow2 too); the batch dim is padded to
+    a power of two (at least ``min_batch``) so jit traces are reused
+    across occupancy levels."""
+    if not queries:
+        raise ValueError("stack_queries needs at least one query")
+    k = max(q.boxes_np.shape[0] for q in queries)
+    b = max(q.times_np.shape[0] for q in queries)
+    qp = max(next_pow2(len(queries)), min_batch)
+    boxes = np.zeros((qp, k, 8), dtype=np.float32)
+    box_valid = np.zeros((qp, k), dtype=bool)
+    times = np.zeros((qp, b, 4), dtype=np.int32)
+    time_valid = np.zeros((qp, b), dtype=bool)
+    for i, q in enumerate(queries):
+        bk = q.boxes_np.shape[0]
+        boxes[i, :bk] = q.boxes_np
+        box_valid[i, :bk] = q.box_valid_np
+        if q.time_any:
+            times[i, 0] = _CATCH_ALL_INTERVAL
+            time_valid[i, 0] = True
+        else:
+            tb = q.times_np.shape[0]
+            times[i, :tb] = q.times_np
+            time_valid[i, :tb] = q.time_valid_np
+    return BatchedScanQuery(boxes, box_valid, times, time_valid,
+                            list(queries))
+
+
+def _cand_body(xhi, yhi, boxes, box_valid, n_valid=None):
+    """Boundary-candidate mask: rows whose hi-cell equals any valid
+    box bound's hi-cell (the only rows where the two-float compare can
+    disagree with exact f64). Device analog of boundary_candidates."""
+    bx = boxes[None, :, :]
+    c = ((xhi[:, None] == bx[..., 0]) | (xhi[:, None] == bx[..., 2])
+         | (yhi[:, None] == bx[..., 4]) | (yhi[:, None] == bx[..., 6]))
+    cand = jnp.any(c & box_valid[None, :], axis=1)
+    if n_valid is not None:
+        cand = cand & (jnp.arange(xhi.shape[0]) < n_valid)
+    return cand
+
+
+@jax.jit
+def _batch_mask(xhi, xlo, yhi, ylo, tday, tms,
+                boxes, box_valid, times, time_valid, n_valid):
+    def one(bx, bv, tx, tv):
+        return _mask_body(xhi, xlo, yhi, ylo, tday, tms,
+                          bx, bv, tx, tv, time_any=False, n_valid=n_valid)
+    return jax.vmap(one)(boxes, box_valid, times, time_valid)
+
+
+@jax.jit
+def _batch_mask_cand(xhi, xlo, yhi, ylo, tday, tms,
+                     boxes, box_valid, times, time_valid, n_valid):
+    def one(bx, bv, tx, tv):
+        return (_mask_body(xhi, xlo, yhi, ylo, tday, tms,
+                           bx, bv, tx, tv, time_any=False, n_valid=n_valid),
+                _cand_body(xhi, yhi, bx, bv, n_valid))
+    return jax.vmap(one)(boxes, box_valid, times, time_valid)
+
+
+@jax.jit
+def _batch_gather_mask(xhi, xlo, yhi, ylo, tday, tms, idx,
+                       boxes, box_valid, times, time_valid):
+    def g(a):
+        return jnp.take(a, idx, mode="clip")
+
+    def one(bx, bv, tx, tv):
+        return _mask_body(g(xhi), g(xlo), g(yhi), g(ylo), g(tday), g(tms),
+                          bx, bv, tx, tv, time_any=False, n_valid=None)
+    return jax.vmap(one)(boxes, box_valid, times, time_valid)
+
+
+@jax.jit
+def _batch_count(mask):
+    return jnp.sum(mask, axis=1, dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def _batch_nonzero(mask, size: int):
+    def one(row):
+        return jnp.nonzero(row, size=size, fill_value=row.shape[0])[0]
+    return jax.vmap(one)(mask)
+
+
+def scan_mask_batch(data: DeviceScanData,
+                    bq: BatchedScanQuery) -> jax.Array:
+    """One fused launch over all queries: device bool[Qp, cap] mask.
+    ``n_valid`` is traced (not static) so appends within a capacity
+    class never recompile."""
+    return _batch_mask(data.xhi, data.xlo, data.yhi, data.ylo,
+                       data.tday, data.tms,
+                       bq.boxes, bq.box_valid, bq.times, bq.time_valid,
+                       jnp.int32(data.n))
+
+
+def scan_mask_batch_at(data: DeviceScanData, bq: BatchedScanQuery,
+                       rows: np.ndarray) -> np.ndarray:
+    """Fused batch scan over one SHARED candidate row set (the union of
+    the batch's index candidates); host bool[Qp, len(rows)]."""
+    m = len(rows)
+    if m == 0:
+        return np.zeros((bq.padded_queries, 0), dtype=bool)
+    k = next_pow2(m)
+    idx = np.zeros(k, dtype=rows.dtype)
+    idx[:m] = rows
+    out = _batch_gather_mask(
+        data.xhi, data.xlo, data.yhi, data.ylo, data.tday, data.tms,
+        jnp.asarray(idx), bq.boxes, bq.box_valid, bq.times, bq.time_valid)
+    return np.asarray(out)[:, :m]
+
+
+def batch_hit_rows(data: DeviceScanData, bq: BatchedScanQuery
+                   ) -> tuple[list[np.ndarray], list[np.ndarray]]:
+    """Fused scan + on-device compaction: per-query (sorted hit rows,
+    boundary-candidate rows).
+
+    Transfers O(Qp * max_hits) instead of O(Qp * cap) — counts are
+    fetched first (a 2*Qp-int sync), then hits/candidates are compacted
+    to the next pow2 of the largest per-query count so the compaction
+    kernel's trace is reused across batches in the same hit-size class.
+    Boundary candidates are found ON DEVICE inside the same launch, so
+    the per-query O(n) host candidate scan of the scalar path is
+    amortized away entirely."""
+    mask, cand = _batch_mask_cand(
+        data.xhi, data.xlo, data.yhi, data.ylo, data.tday, data.tms,
+        bq.boxes, bq.box_valid, bq.times, bq.time_valid, jnp.int32(data.n))
+    counts = np.asarray(_batch_count(mask))
+    ccounts = np.asarray(_batch_count(cand))
+    size = next_pow2(max(int(counts.max()), 1))
+    csize = next_pow2(max(int(ccounts.max()), 1))
+    idx = np.asarray(_batch_nonzero(mask, size))
+    cidx = np.asarray(_batch_nonzero(cand, csize))
+    hits = [idx[i, :counts[i]] for i in range(bq.n_queries)]
+    cands = [cidx[i, :ccounts[i]] for i in range(bq.n_queries)]
+    return hits, cands
+
+
+def patch_hit_rows(rows: np.ndarray, q: ScanQuery,
+                   x: np.ndarray, y: np.ndarray, millis: np.ndarray,
+                   cand: np.ndarray) -> np.ndarray:
+    """Boundary patch in row-index space: re-evaluate the (vanishing)
+    set of hi-cell boundary candidates ``cand`` in exact f64/i64 and
+    add/remove them from ``rows``, making the hit set exactly the f64
+    result."""
+    if len(cand) == 0:
+        return rows
+    ok = _exact_hits(cand, x, y, millis, q)
+    add = cand[ok]
+    drop = cand[~ok]
+    if len(drop):
+        rows = np.setdiff1d(rows, drop, assume_unique=False)
+    if len(add):
+        rows = np.union1d(rows, add)
+    return rows

@@ -151,7 +151,11 @@ class FsBackedDistributedDataStore(DistributedDataStore):
         (deletes shift row positions)."""
         st = self._state(type_name)
         ranges = self._partition_rows.get(type_name)
-        if not ranges and st.n:
+        # ranges are stale whenever they don't cover every serving row —
+        # not just when empty: a write after a delete appends ranges for
+        # the NEW rows only, leaving the surviving rows untracked
+        covered = sum(hi - lo for _, lo, hi in ranges or [])
+        if st.n and covered != st.n:
             ranges = self._recompute_partition_rows(type_name)
         k = self.mesh.devices.size
         n = max(st.n, 1)

@@ -146,6 +146,50 @@ class LambdaDataStore(DataStore):
                 batch = batch.take(np.arange(min(q.max_features, batch.n)))
         return QueryResult(ids, batch, rt.explain, rt.plan)
 
+    def query_batched(self, queries: list[Query],
+                      explain_out=None) -> list[QueryResult]:
+        """Coalesced execution across the lambda tiers. Queries that a
+        single tier can answer (persistent-only types, tier hints) fuse
+        within that tier's batched scan; queries needing the
+        transient+persistent union run the scalar merge path — its
+        dedup depends on BOTH tiers' results, so fusing it would not
+        change the number of dispatches it needs."""
+        queries = list(queries)
+        if len(queries) <= 1:
+            return [self.query(q, explain_out=explain_out)
+                    for q in queries]
+        results: list[QueryResult | None] = [None] * len(queries)
+        persistent_idx: list[int] = []
+        transient_idx: list[int] = []
+        union_idx: list[int] = []
+        for i, q in enumerate(queries):
+            if q.hints.get(LAMBDA_QUERY_TRANSIENT):
+                transient_idx.append(i)
+            elif q.hints.get(LAMBDA_QUERY_PERSISTENT) \
+                    or not self._transient_has(q.type_name):
+                persistent_idx.append(i)
+            else:
+                union_idx.append(i)
+
+        def run_tier(tier, members):
+            if not members:
+                return
+            if len(members) >= 2 and hasattr(tier, "query_batched"):
+                sub = tier.query_batched([queries[i] for i in members],
+                                         explain_out=explain_out)
+                for i, r in zip(members, sub):
+                    results[i] = r
+            else:
+                for i in members:
+                    results[i] = tier.query(queries[i],
+                                            explain_out=explain_out)
+
+        run_tier(self.persistent, persistent_idx)
+        run_tier(self.transient, transient_idx)
+        for i in union_idx:
+            results[i] = self.query(queries[i], explain_out=explain_out)
+        return results  # type: ignore[return-value]
+
     def count(self, type_name: str) -> int:
         q = Query(type_name)
         return self.query(q).n

@@ -206,5 +206,11 @@ class LiveDataStore(DataStore):
               explain_out=None) -> QueryResult:
         return self._mem.query(q, type_name, explain_out=explain_out)
 
+    def query_batched(self, queries: list[Query],
+                      explain_out=None) -> list[QueryResult]:
+        """Coalesced multi-query execution over the live view (one
+        fused device scan; see InMemoryDataStore.query_batched)."""
+        return self._mem.query_batched(queries, explain_out=explain_out)
+
     def count(self, type_name: str) -> int:
         return self._mem.count(type_name)

@@ -800,6 +800,30 @@ class InMemoryDataStore(DataStore):
                 out.append(f"attr:{a.name}")
         return out
 
+    def _plan_for(self, q: Query, st: _TypeState,
+                  explain: Explainer) -> tuple[FilterStrategy,
+                                               _PlanArtifacts]:
+        """Plan-cache lookup (keyed on the filter object +
+        strategy-relevant hints): the ECQL parse cache returns one
+        shared AST per query string, so repeated queries hit here and
+        skip the splitter / cost estimation / geometry extraction. The
+        `is` check makes id() reuse after GC harmless."""
+        pkey = (id(q.filter), q.hints.get(QueryHints.QUERY_INDEX))
+        hit = st.plan_cache.get(pkey)
+        if hit is not None and hit[0] is q.filter:
+            strategy, art = hit[1], hit[2]
+            explain(lambda: f"Plan cache hit: {strategy.index}")
+        else:
+            strategy = decide_strategy(st.sft, q,
+                                       self._indices(st.sft), st.n,
+                                       stats=self.stats.get(q.type_name),
+                                       explain=explain)
+            art = _PlanArtifacts()
+            if len(st.plan_cache) >= 256:
+                st.plan_cache.pop(next(iter(st.plan_cache)))
+            st.plan_cache[pkey] = (q.filter, strategy, art)
+        return strategy, art
+
     def _matching_rows(self, q: Query, st: _TypeState,
                        explain: Explainer):
         """The shared row-selection pipeline: plan (under the timeout
@@ -821,25 +845,7 @@ class InMemoryDataStore(DataStore):
         import time as _time
         try:
             t_plan0 = _time.perf_counter()
-            # plan cache (keyed on the filter object + strategy-relevant
-            # hints): the ECQL parse cache returns one shared AST per
-            # query string, so repeated queries hit here and skip the
-            # splitter / cost estimation / geometry extraction. The `is`
-            # check makes id() reuse after GC harmless.
-            pkey = (id(q.filter), q.hints.get(QueryHints.QUERY_INDEX))
-            hit = st.plan_cache.get(pkey)
-            if hit is not None and hit[0] is q.filter:
-                strategy, art = hit[1], hit[2]
-                explain(lambda: f"Plan cache hit: {strategy.index}")
-            else:
-                strategy = decide_strategy(st.sft, q,
-                                           self._indices(st.sft), st.n,
-                                           stats=self.stats.get(q.type_name),
-                                           explain=explain)
-                art = _PlanArtifacts()
-                if len(st.plan_cache) >= 256:
-                    st.plan_cache.pop(next(iter(st.plan_cache)))
-                st.plan_cache[pkey] = (q.filter, strategy, art)
+            strategy, art = self._plan_for(q, st, explain)
             t_plan = _time.perf_counter() - t_plan0
             if managed is not None:
                 managed.check()
@@ -851,6 +857,14 @@ class InMemoryDataStore(DataStore):
             if managed is not None:
                 _REAPER.complete(managed)
 
+        idx, attr_mask = self._post_scan(q, st, idx, explain)
+        return idx, strategy, t_plan, t_scan0, attr_mask
+
+    def _post_scan(self, q: Query, st: _TypeState, idx: np.ndarray,
+                   explain: Explainer):
+        """Post-scan row stages shared by the scalar and batched
+        pipelines: visibility filtering (row- or attribute-level) and
+        statistical sampling. Returns (idx, attr_mask)."""
         attr_mask = None
         if q.auths is not None or st.has_vis:
             from ..security import evaluate_visibilities
@@ -912,7 +926,7 @@ class InMemoryDataStore(DataStore):
             if attr_mask is not None:
                 attr_mask = attr_mask[smask]
             explain(f"Sampling applied: rate={rate}")
-        return idx, strategy, t_plan, t_scan0, attr_mask
+        return idx, attr_mask
 
     def query(self, q: Query | str, type_name: str | None = None,
               explain_out=None) -> QueryResult:
@@ -928,9 +942,19 @@ class InMemoryDataStore(DataStore):
             explain("Store is empty").pop()
             return QueryResult(np.empty(0, dtype=object), None, explain,
                                FilterStrategy("empty", None, None))
-        import time as _time
         idx, strategy, t_plan, t_scan0, attr_mask = \
             self._matching_rows(q, st, explain)
+        return self._finish_query(q, st, idx, attr_mask, strategy,
+                                  explain, t_plan, t_scan0)
+
+    def _finish_query(self, q: Query, st: _TypeState, idx: np.ndarray,
+                      attr_mask, strategy: FilterStrategy,
+                      explain: Explainer, t_plan: float,
+                      t_scan0: float) -> QueryResult:
+        """Result-assembly stages shared by the scalar and batched
+        pipelines: sort, max_features, projection validation, lazy
+        batch + attribute-cell redaction, id gather, audit."""
+        import time as _time
         if q.sort_by is not None:
             from .common import sort_order
             hidden = None
@@ -1033,6 +1057,98 @@ class InMemoryDataStore(DataStore):
                               round((_time.perf_counter() - t_scan0)
                                     * 1000, 3), n)
         return n
+
+    def query_batched(self, queries: list[Query],
+                      explain_out=None) -> list[QueryResult]:
+        """Micro-batched execution: evaluate several queries with ONE
+        fused device scan (the vmapped kernel in scan/zscan.py) and
+        demultiplex per-query results.
+
+        Queries whose plan cannot fuse — non-point schemas, id/attr
+        strategies, secondary residual filters, exact-geometry
+        predicates — fall back to the scalar pipeline individually, so
+        the result list is always exactly what per-query ``query()``
+        calls would return, id for id. Single-element batches pass
+        through to ``query()`` untouched."""
+        queries = list(queries)
+        if len(queries) <= 1:
+            return [self.query(q, explain_out=explain_out)
+                    for q in queries]
+        results: list[QueryResult | None] = [None] * len(queries)
+        groups: dict[str, list[int]] = {}
+        for i, q in enumerate(queries):
+            groups.setdefault(q.type_name, []).append(i)
+        import time as _time
+        for tn, members in groups.items():
+            st = self._types.get(tn)
+            fused: list[int] = []
+            plans: dict[int, tuple[FilterStrategy, _PlanArtifacts]] = {}
+            fallback: list[int] = []
+            for i in members:
+                q = queries[i]
+                if st is None or st.batch is None or st.n == 0:
+                    fallback.append(i)
+                    continue
+                explain = Explainer(explain_out)
+                strategy, art = self._plan_for(q, st, explain)
+                ok = (strategy.index in ("z3", "z2")
+                      and strategy.secondary is None)
+                if ok:
+                    st.ensure_index()
+                    ok = st.has_point_scan()
+                if ok:
+                    _g, _b, _i, needs_exact, _s = \
+                        self._fill_artifacts(st, strategy, art)
+                    ok = not needs_exact
+                if ok:
+                    fused.append(i)
+                    plans[i] = (strategy, art)
+                else:
+                    fallback.append(i)
+            if len(fused) < 2:
+                fallback = sorted(fallback + fused)
+                fused = []
+            for i in fallback:
+                results[i] = self.query(queries[i],
+                                        explain_out=explain_out)
+            if not fused:
+                continue
+            t_scan0 = _time.perf_counter()
+            rows_per_q = self._batched_scan_rows(
+                st, [(queries[i],) + plans[i] for i in fused])
+            for i, rows in zip(fused, rows_per_q):
+                q = queries[i]
+                explain = Explainer(explain_out)
+                explain.push(lambda q=q: f"Batched '{q.type_name}' "
+                                         f"filter={q.filter}")
+                idx, attr_mask = self._post_scan(q, st, rows, explain)
+                results[i] = self._finish_query(
+                    q, st, idx, attr_mask, plans[i][0], explain,
+                    0.0, t_scan0)
+        return results  # type: ignore[return-value]
+
+    def _batched_scan_rows(self, st: _TypeState, items) -> list[np.ndarray]:
+        """One fused vmapped launch over the stacked queries, then a
+        per-query exact boundary patch (candidates are compacted on
+        device inside the same launch, so there is NO per-query O(n)
+        host work). ``items`` is a list of (query, strategy, artifacts)
+        whose plans were checked fusible by query_batched."""
+        sqs = []
+        for _q, strategy, art in items:
+            if art.sq is None:
+                _g, boxes, intervals, _ne, _s = \
+                    self._fill_artifacts(st, strategy, art)
+                art.sq = zscan.make_query(boxes, intervals)
+            sqs.append(art.sq)
+        bq = zscan.stack_queries(sqs)
+        hits, cands = zscan.batch_hit_rows(st.scan_data, bq)
+        batch = st.batch
+        col = batch.col(st.sft.geom_field)
+        dtg = st.sft.dtg_field
+        millis = (batch.col(dtg).millis if dtg is not None
+                  else np.zeros(st.n, dtype=np.int64))
+        return [zscan.patch_hit_rows(rows, sq, col.x, col.y, millis, cand)
+                for rows, cand, sq in zip(hits, cands, sqs)]
 
     def _execute(self, st: _TypeState, q: Query, strategy: FilterStrategy,
                  explain: Explainer,
@@ -1148,6 +1264,36 @@ class InMemoryDataStore(DataStore):
         keep = evaluate(strategy.primary, st.batch.take(rows))
         return rows[keep]
 
+    def _fill_artifacts(self, st: _TypeState, strategy: FilterStrategy,
+                        art: "_PlanArtifacts | None"):
+        """Derive (and cache on the plan artifacts) the scan-shaped
+        view of a strategy's primary filter: query geometries, their
+        envelopes, time intervals, and whether an exact geometry
+        residual is needed."""
+        sft = st.sft
+        primary = (strategy.primary if strategy.primary is not None
+                   else ast.Include())
+        if art is not None and art.filled:
+            return (art.geoms, art.boxes, art.intervals,
+                    art.needs_exact, art.spatial_f)
+        geom = sft.geom_field
+        dtg = sft.dtg_field
+        geoms = extract_geometries(primary, geom)
+        boxes = [g.envelope.as_tuple() for g in geoms] or \
+            [(-180.0, -90.0, 180.0, 90.0)]
+        intervals = (_intervals_ms(primary, dtg)
+                     if dtg is not None and strategy.index == "z3"
+                     else [])
+        needs_exact = _needs_exact(geoms, primary)
+        spatial_f = (_spatial_only(primary, geom) if needs_exact
+                     else None)
+        if art is not None:
+            art.geoms, art.boxes = geoms, boxes
+            art.intervals = intervals
+            art.needs_exact, art.spatial_f = needs_exact, spatial_f
+            art.filled = True
+        return geoms, boxes, intervals, needs_exact, spatial_f
+
     def _device_scan(self, st: _TypeState, q: Query,
                      strategy: FilterStrategy, explain: Explainer,
                      art: "_PlanArtifacts | None" = None) -> np.ndarray:
@@ -1157,27 +1303,9 @@ class InMemoryDataStore(DataStore):
         sft = st.sft
         batch = st.batch
         geom = sft.geom_field
-        dtg = sft.dtg_field
         primary = strategy.primary if strategy.primary is not None else ast.Include()
-
-        if art is not None and art.filled:
-            geoms, boxes, intervals = art.geoms, art.boxes, art.intervals
-            needs_exact, spatial_f = art.needs_exact, art.spatial_f
-        else:
-            geoms = extract_geometries(primary, geom)
-            boxes = [g.envelope.as_tuple() for g in geoms] or \
-                [(-180.0, -90.0, 180.0, 90.0)]
-            intervals = (_intervals_ms(primary, dtg)
-                         if dtg is not None and strategy.index == "z3"
-                         else [])
-            needs_exact = _needs_exact(geoms, primary)
-            spatial_f = (_spatial_only(primary, geom) if needs_exact
-                         else None)
-            if art is not None:
-                art.geoms, art.boxes = geoms, boxes
-                art.intervals = intervals
-                art.needs_exact, art.spatial_f = needs_exact, spatial_f
-                art.filled = True
+        geoms, boxes, intervals, needs_exact, spatial_f = \
+            self._fill_artifacts(st, strategy, art)
 
         # z-range pruning (Z3IndexKeySpace.getRanges analog): the host
         # fast path resolves selective queries EXACTLY inside the index

@@ -161,6 +161,30 @@ class DistributedDataStore(InMemoryDataStore):
         return (np.concatenate(parts) if parts
                 else np.empty(0, dtype=np.int64))
 
+    def _batched_scan_rows(self, st: _MeshTypeState,
+                           items) -> list[np.ndarray]:
+        """Micro-batched dense tier over the sharded segments: ONE
+        shard-mapped launch per segment evaluates every query in the
+        batch (parallel/mesh.batch_exact_hit_rows), replacing the
+        per-query dispatch of the scalar path."""
+        from ..parallel.mesh import batch_exact_hit_rows
+        sqs = []
+        for _q, strategy, art in items:
+            if art.sq is None:
+                _g, boxes, intervals, _ne, _s = \
+                    self._fill_artifacts(st, strategy, art)
+                art.sq = zscan.make_query(boxes, intervals)
+            sqs.append(art.sq)
+        bq = zscan.stack_queries(sqs)
+        offs = st.segment_offsets()[:-1]
+        per_query: list[list[np.ndarray]] = [[] for _ in sqs]
+        for seg, off in zip(st.segments, offs):
+            for j, rows in enumerate(batch_exact_hit_rows(seg, bq)):
+                per_query[j].append(rows + off)
+        return [np.concatenate(parts) if parts
+                else np.empty(0, dtype=np.int64)
+                for parts in per_query]
+
     def _extent_states(self, st: _MeshTypeState, eq) -> np.ndarray:
         return np.concatenate([distributed_tristate(seg, eq)
                                for seg in st.ext_segments])

@@ -42,10 +42,12 @@ class RemoteError(RuntimeError):
 class RemoteDataStore(DataStore):
     """DataStore client over the GeoMesaWebServer wire surface."""
 
-    def __init__(self, host: str, port: int, timeout_s: float = 60.0):
+    def __init__(self, host: str, port: int, timeout_s: float = 60.0,
+                 auth_token: str | None = None):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
+        self.auth_token = auth_token  # bearer token for gated endpoints
         self._schemas: dict[str, SimpleFeatureType] = {}
 
     # -- transport ---------------------------------------------------------
@@ -56,8 +58,11 @@ class RemoteDataStore(DataStore):
         qs = ("?" + urlencode(params)) if params else ""
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout_s)
+        headers = {}
+        if self.auth_token:
+            headers["Authorization"] = f"Bearer {self.auth_token}"
         try:
-            conn.request(method, path + qs, body=body)
+            conn.request(method, path + qs, body=body, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
             if resp.status == 404:

@@ -33,19 +33,41 @@ import numpy as np
 
 from .. import __version__ as _version
 from ..index.api import Query, QueryHints
+from ..utils.properties import SystemProperty
 
 __all__ = ["GeoMesaWebServer"]
+
+# opt-in shared bearer token for the mutating endpoints (POST
+# /rest/write, POST /rest/delete, DELETE /rest/schemas). Unset -> those
+# endpoints stay open (embedded/test deployments); set -> requests
+# without `Authorization: Bearer <token>` get 403.
+WEB_AUTH_TOKEN = SystemProperty("geomesa.web.auth.token", None)
+
+# the endpoints the shared token gates: (method, first path segment)
+_GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas")}
 
 
 class GeoMesaWebServer:
     """Bind a datastore to an HTTP port. ``start()`` serves on a daemon
-    thread (tests/notebooks); ``serve_forever()`` blocks (CLI)."""
+    thread (tests/notebooks); ``serve_forever()`` blocks (CLI).
+
+    Concurrent ``/rest/query`` requests ride ThreadingHTTPServer's
+    thread-per-request model into a QueryBatcher: requests for the same
+    schema arriving within the linger window share ONE fused device
+    scan (scan/batcher.py)."""
 
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
-                 audit=None):
+                 audit=None, auth_token: str | None = None,
+                 batcher=None):
+        from ..scan.batcher import QueryBatcher
         self.store = store
         self.audit = audit if audit is not None \
             else getattr(store, "audit", None)
+        self.auth_token = (auth_token if auth_token is not None
+                           else WEB_AUTH_TOKEN.get())
+        if batcher is None and hasattr(store, "query_batched"):
+            batcher = QueryBatcher(store)
+        self.batcher = batcher
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: threading.Thread | None = None
@@ -69,18 +91,28 @@ class GeoMesaWebServer:
 
     # -- request handling (called from the handler) -----------------------
 
-    def handle(self, method: str, path: str, params: dict, body: bytes):
+    def handle(self, method: str, path: str, params: dict, body: bytes,
+               headers=None):
         """Route -> (status, content_type, payload bytes)."""
         parts = [unquote(p) for p in path.strip("/").split("/") if p]
         if not parts or parts[0] != "rest":
             return 404, "application/json", _j({"error": "not found"})
         parts = parts[1:]
+        if parts and (method, parts[0]) in _GATED \
+                and not self._authorized(headers):
+            return 403, "application/json", _j({"error": "forbidden"})
         try:
             return self._route(method, parts, params, body)
         except KeyError as e:
             return 404, "application/json", _j({"error": str(e)})
         except Exception as e:  # surface planner/parse errors as 400s
             return 400, "application/json", _j({"error": repr(e)})
+
+    def _authorized(self, headers) -> bool:
+        if not self.auth_token:
+            return True  # gate not opted in: endpoints stay open
+        got = (headers or {}).get("Authorization", "")
+        return got == f"Bearer {self.auth_token}"
 
     def _route(self, method, parts, params, body):
         if parts == ["version"]:
@@ -191,7 +223,7 @@ class GeoMesaWebServer:
             q.auths = [a for a in params["auths"][0].split(",") if a]
         if fmt == "arrow":
             from ..arrow.io import write_ipc
-            res = self.store.query(q)
+            res = self._run_query(q)
             sft = self.store.get_schema(name)
             batch = res.batch
             if batch is None:
@@ -204,7 +236,7 @@ class GeoMesaWebServer:
             # projected results carry a projected schema
             return (200, "application/vnd.apache.arrow.file",
                     write_ipc(batch.sft, batch))
-        res = self.store.query(q)
+        res = self._run_query(q)
         sft = self.store.get_schema(name)
         if fmt == "geojson":
             from ..geometry.geojson import to_geojson
@@ -223,6 +255,13 @@ class GeoMesaWebServer:
         rows = list(res.features()) if res.batch is not None else []
         return 200, "application/json", _j({"count": len(rows),
                                             "features": rows})
+
+    def _run_query(self, q: Query):
+        """Queries coalesce through the batcher (one fused scan per
+        linger window per schema); stores without batching run direct."""
+        if self.batcher is not None:
+            return self.batcher.query(q)
+        return self.store.query(q)
 
     def _density(self, name, params):
         bbox = tuple(float(v) for v in params["bbox"][0].split(","))
@@ -264,7 +303,7 @@ def _make_handler(server: GeoMesaWebServer):
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             status, ctype, payload = server.handle(
-                self.command, u.path, params, body)
+                self.command, u.path, params, body, headers=self.headers)
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))

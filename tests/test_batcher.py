@@ -1,0 +1,282 @@
+"""QueryBatcher admission-queue tests: passthrough exactness, schema
+isolation, load-gated lingering, and plan-cache shape accounting.
+
+Coalescing is driven deterministically rather than by racing threads:
+a sacrificial query is gated inside the store (``RecordingStore.hold``)
+so the batcher has a dispatch in flight, which is exactly the condition
+under which the load-gated leader lingers for followers. Filling the
+queue to ``max_batch`` then releases the leader without waiting out the
+linger window, so the fast tests never sleep."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.index.api import Query
+from geomesa_tpu.scan.batcher import QueryBatcher
+from geomesa_tpu.store import InMemoryDataStore
+
+MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
+
+
+class RecordingStore(InMemoryDataStore):
+    """InMemoryDataStore that records every dispatch the batcher makes
+    and can gate a marked scalar ``query()`` on an event (to hold a
+    dispatch in flight while the test stages followers)."""
+
+    def __init__(self):
+        super().__init__()
+        self.scalar_calls: list[str] = []
+        self.batched_calls: list[list[str]] = []
+        self.hold: threading.Event | None = None
+
+    def query(self, q, *args, **kwargs):
+        if getattr(q, "type_name", None) is not None:
+            self.scalar_calls.append(q.type_name)
+        if self.hold is not None and getattr(q, "hints", {}).get("_gate"):
+            assert self.hold.wait(10.0), "gated query never released"
+        return super().query(q, *args, **kwargs)
+
+    def query_batched(self, queries, *args, **kwargs):
+        self.batched_calls.append([q.type_name for q in queries])
+        return super().query_batched(queries, *args, **kwargs)
+
+
+def _fill(ds, type_name: str, n: int = 5000, seed: int = 7):
+    ds.create_schema(parse_spec(
+        type_name, "dtg:Date,*geom:Point:srid=4326"))
+    rng = np.random.default_rng(seed)
+    ds.write_dict(type_name, [f"{type_name}{i}" for i in range(n)], {
+        "dtg": rng.integers(MS("2020-01-01"), MS("2020-03-01"), n),
+        "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+    })
+
+
+def _bbox(tn: str, x0: float, y0: float, w: float = 60, h: float = 40):
+    return Query(tn, f"BBOX(geom, {x0}, {y0}, {x0 + w}, {y0 + h})")
+
+
+def _gated(tn: str):
+    """A sacrificial query the store will hold in flight (see
+    ``RecordingStore.hold``) so the next leader load-gates into its
+    linger window."""
+    q = _bbox(tn, -179.5, -89.5, 0.5, 0.5)
+    q.hints["_gate"] = True
+    return q
+
+
+def _wait(pred, timeout: float = 5.0):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError("timed out waiting for batcher state")
+        time.sleep(0.001)
+
+
+def _queued(batcher, tn: str, k: int):
+    return lambda: len(getattr(batcher._queues.get(tn), "items", ())) >= k
+
+
+def _stage_coalesced(batcher, store, queries):
+    """Run `queries` (one schema) through the batcher as ONE fused
+    dispatch. Gates a sacrificial scalar query so the next leader
+    lingers (load-gated), stages each query as it lands in the queue,
+    and lets the last arrival fill the batch. Returns results in
+    submission order."""
+    tn = queries[0].type_name
+    store.hold = threading.Event()
+    warm = threading.Thread(target=batcher.query, args=(_gated(tn),))
+    warm.start()
+    _wait(lambda: batcher._in_flight >= 1)
+    out: list = [None] * len(queries)
+    threads = []
+    for k, q in enumerate(queries):
+        t = threading.Thread(
+            target=lambda k=k, q=q: out.__setitem__(k, batcher.query(q)))
+        t.start()
+        threads.append(t)
+        if k < len(queries) - 1:
+            _wait(_queued(batcher, tn, k + 1))
+    for t in threads:
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "batched caller never resolved"
+    store.hold.set()
+    warm.join(timeout=10.0)
+    store.hold = None
+    return out
+
+
+class TestPassthrough:
+    def test_single_query_matches_store_id_for_id(self):
+        ds = RecordingStore()
+        _fill(ds, "ships")
+        b = QueryBatcher(ds, max_batch=8, linger_us=2000)
+        q = _bbox("ships", -30, -20)
+        got = b.query(q)
+        want = ds.query(_bbox("ships", -30, -20))
+        assert np.array_equal(got.ids, want.ids)
+        # an idle singleton must dispatch scalar, never via the fused
+        # batch path, and must not pay the linger window
+        assert ds.batched_calls == []
+        assert b.stats()["total_queries"] == 1
+        assert b.stats()["coalesced_queries"] == 0
+
+    def test_filter_string_form(self):
+        ds = RecordingStore()
+        _fill(ds, "ships")
+        b = QueryBatcher(ds, max_batch=8, linger_us=0)
+        got = b.query("BBOX(geom, 0, 0, 60, 40)", type_name="ships")
+        want = ds.query(_bbox("ships", 0, 0))
+        assert np.array_equal(got.ids, want.ids)
+        with pytest.raises(ValueError, match="type_name"):
+            b.query("BBOX(geom, 0, 0, 1, 1)")
+
+    def test_disabled_batching_passes_through(self):
+        ds = RecordingStore()
+        _fill(ds, "ships")
+        b = QueryBatcher(ds, max_batch=1, linger_us=2000)
+        got = b.query(_bbox("ships", 10, 5))
+        assert np.array_equal(got.ids, ds.query(_bbox("ships", 10, 5)).ids)
+        assert ds.batched_calls == []
+        assert b._queues == {}
+
+
+class TestCoalescing:
+    def test_batched_ids_exact(self):
+        ds = RecordingStore()
+        _fill(ds, "ships")
+        b = QueryBatcher(ds, max_batch=4, linger_us=1_000_000)
+        queries = [_bbox("ships", x0, y0) for x0, y0 in
+                   ((-150, -60), (-40, -10), (10, 20), (80, -35))]
+        results = _stage_coalesced(b, ds, queries)
+        assert ds.batched_calls == [["ships"] * 4]
+        for q, r in zip(queries, results):
+            want = ds.query(q)
+            assert np.array_equal(r.ids, want.ids)
+        st = b.stats()
+        assert st["coalesced_queries"] == 4
+        assert st["batches"] == 2  # sacrificial singleton + fused batch
+
+    def test_no_cross_schema_coalescing(self):
+        ds = RecordingStore()
+        _fill(ds, "ships", seed=1)
+        _fill(ds, "planes", seed=2)
+        b = QueryBatcher(ds, max_batch=2, linger_us=1_000_000)
+        ds.hold = threading.Event()
+        warm = threading.Thread(target=b.query, args=(_gated("ships"),))
+        warm.start()
+        _wait(lambda: b._in_flight >= 1)
+        out = {}
+        threads = []
+        # interleave the two schemas so a schema-oblivious queue would
+        # happily fuse ships with planes
+        for tag, q in (("s1", _bbox("ships", -60, -30)),
+                       ("p1", _bbox("planes", -60, -30)),
+                       ("s2", _bbox("ships", 40, 10)),
+                       ("p2", _bbox("planes", 40, 10))):
+            t = threading.Thread(
+                target=lambda tag=tag, q=q: out.__setitem__(
+                    tag, b.query(q)))
+            t.start()
+            threads.append(t)
+            if tag in ("s1", "p1"):
+                tn = "ships" if tag[0] == "s" else "planes"
+                _wait(_queued(b, tn, 1))
+        for t in threads:
+            t.join(timeout=10.0)
+            assert not t.is_alive()
+        ds.hold.set()
+        warm.join(timeout=10.0)
+        assert sorted(map(tuple, ds.batched_calls)) == [
+            ("planes", "planes"), ("ships", "ships")]
+        for tag, tn in (("s1", "ships"), ("p1", "planes")):
+            want = ds.query(_bbox(tn, -60, -30))
+            assert np.array_equal(out[tag].ids, want.ids)
+
+    def test_linger_fires_under_low_concurrency(self):
+        """Two concurrent queries — far below max_batch — must still
+        coalesce: with a dispatch in flight the leader waits out the
+        linger window instead of launching a singleton scan."""
+        ds = RecordingStore()
+        _fill(ds, "ships")
+        linger_s = 0.12
+        b = QueryBatcher(ds, max_batch=8, linger_us=linger_s * 1e6)
+        ds.hold = threading.Event()
+        warm = threading.Thread(target=b.query, args=(_gated("ships"),))
+        warm.start()
+        _wait(lambda: b._in_flight >= 1)
+        t0 = time.monotonic()
+        out = [None, None]
+        threads = [
+            threading.Thread(target=lambda k=k: out.__setitem__(
+                k, b.query(_bbox("ships", -20 + 30 * k, -10))))
+            for k in range(2)]
+        threads[0].start()
+        _wait(_queued(b, "ships", 1))
+        threads[1].start()
+        for t in threads:
+            t.join(timeout=10.0)
+            assert not t.is_alive()
+        elapsed = time.monotonic() - t0
+        ds.hold.set()
+        warm.join(timeout=10.0)
+        # one fused dispatch of both, and the leader really lingered
+        assert ds.batched_calls == [["ships", "ships"]]
+        assert elapsed >= linger_s * 0.8
+        for k in range(2):
+            want = ds.query(_bbox("ships", -20 + 30 * k, -10))
+            assert np.array_equal(out[k].ids, want.ids)
+
+
+class TestPlanCache:
+    def test_counters_across_index_version_bump(self):
+        ds = RecordingStore()
+        _fill(ds, "ships")
+        b = QueryBatcher(ds, max_batch=2, linger_us=1_000_000)
+        key0 = b._shape_key("ships", 2)
+
+        _stage_coalesced(b, ds, [_bbox("ships", -60, -30),
+                                 _bbox("ships", 20, 0)])
+        st = b.stats()
+        assert (st["plan_cache_misses"], st["plan_cache_hits"]) == (1, 0)
+
+        # same shape class -> the fused kernel's trace is reused
+        _stage_coalesced(b, ds, [_bbox("ships", -100, 10),
+                                 _bbox("ships", 60, -50)])
+        st = b.stats()
+        assert (st["plan_cache_misses"], st["plan_cache_hits"]) == (1, 1)
+
+        # an index version bump invalidates every cached trace for the
+        # type: the shape key changes, so the next batch is a miss
+        ds.reindex("ships", to_version=1)
+        assert b._shape_key("ships", 2) != key0
+        results = _stage_coalesced(b, ds, [_bbox("ships", -60, -30),
+                                           _bbox("ships", 20, 0)])
+        st = b.stats()
+        assert (st["plan_cache_misses"], st["plan_cache_hits"]) == (2, 1)
+        assert st["plan_cache_hit_rate"] == pytest.approx(1 / 3)
+        # and the migrated index still answers exactly
+        want = ds.query(_bbox("ships", -60, -30))
+        assert np.array_equal(results[0].ids, want.ids)
+
+
+class TestErrorIsolation:
+    def test_batch_failure_replays_per_caller(self):
+        class FlakyStore(RecordingStore):
+            def query_batched(self, queries, *args, **kwargs):
+                self.batched_calls.append(
+                    [q.type_name for q in queries])
+                raise RuntimeError("fused scan exploded")
+
+        ds = FlakyStore()
+        _fill(ds, "ships")
+        b = QueryBatcher(ds, max_batch=2, linger_us=1_000_000)
+        queries = [_bbox("ships", -60, -30), _bbox("ships", 20, 0)]
+        results = _stage_coalesced(b, ds, queries)
+        assert len(ds.batched_calls) == 1
+        for q, r in zip(queries, results):
+            want = ds.query(q)
+            assert np.array_equal(r.ids, want.ids)

@@ -293,3 +293,46 @@ class TestMeshArrowVisibility:
         assert all(batch.col("name").value(i) is None
                    for i in range(batch.n))
         assert batch.col("age").value(0) == 10  # unlabeled col visible
+
+
+class TestFsMeshPartitionPlacement:
+    """partition_shards staleness after delete-then-write (fs_mesh.py):
+    a write after a delete appends ranges for the NEW rows only, so the
+    old recompute guard (fires only on EMPTY ranges) served placement
+    that missed every surviving row."""
+
+    def _store(self, root):
+        from geomesa_tpu.parallel import data_mesh
+        from geomesa_tpu.store import FsBackedDistributedDataStore
+        rng = np.random.default_rng(23)
+        n = 4_000
+        ds = FsBackedDistributedDataStore(root, data_mesh())
+        ds.create_schema(parse_spec(
+            "ais", "name:String,dtg:Date,*geom:Point:srid=4326"))
+        ds.write_dict("ais", [f"f{i}" for i in range(n)], {
+            "name": [f"n{i % 5}" for i in range(n)],
+            "dtg": rng.integers(MS("2021-03-01"), MS("2021-03-10"), n),
+            "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+        })
+        return ds, n
+
+    def test_partition_shards_after_delete_then_write(self, tmp_path):
+        ds, n = self._store(str(tmp_path))
+        ds.delete("ais", [f"f{i}" for i in range(100)])
+        rng = np.random.default_rng(29)
+        m = 40
+        ds.write_dict("ais", [f"g{i}" for i in range(m)], {
+            "name": [f"n{i % 5}" for i in range(m)],
+            "dtg": rng.integers(MS("2021-03-01"), MS("2021-03-10"), m),
+            "geom": (rng.uniform(-180, 180, m), rng.uniform(-90, 90, m)),
+        })
+        shards = ds.partition_shards("ais")
+        st = ds._state("ais")
+        # the tracked ranges behind the answer must cover EVERY serving
+        # row, not just the post-delete write's rows
+        covered = sum(hi - lo for _, lo, hi in ds._partition_rows["ais"])
+        assert covered == st.n == n - 100 + m
+        # complete coverage => every mesh device serves some partition
+        k = ds.mesh.devices.size
+        assert set().union(*shards.values()) == set(range(k))
+        assert set(shards) <= set(ds.partitions("ais"))

@@ -63,6 +63,22 @@ class TestMatrices:
         assert relate(donut, spanning)[0] == "2"
         assert overlaps(donut, spanning)
 
+    def test_shared_boundary_degenerate_sample(self):
+        """Area-vs-area shared-boundary fallback: when the sampled
+        interior point of A lands exactly ON B's boundary (here: B's
+        hole ring has a vertex at A's centroid), Int(A)∩Bnd(B) must
+        cap at dimension 1 — a boundary is never 2-dimensional."""
+        a = W(SQ)
+        b = W("POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0), "
+              "(1 1, 1.5 1, 1.5 1.5, 1 1.5, 1 1))")
+        # the degenerate sampling configuration: ip(A) on Bnd(B)
+        from geomesa_tpu.geometry.relate import _locate
+        assert _locate(b, *interior_point(a)) == "B"
+        got = relate(a, b)
+        assert got == "212F1FFF2"
+        assert got[1] != "2"  # the capped cell
+        assert relate(b, a) == "2FF11F2F2"
+
     def test_matches_wildcards(self):
         assert relate_matches("212101212", "T*T***T**")
         assert not relate_matches("FF2FF1212", "T********")

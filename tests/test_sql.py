@@ -661,6 +661,21 @@ class TestScalarSTFunctions:
         assert poly.contains(Point(10.0 + 0.49, 5.0))
         assert not poly.contains(Point(10.0 + 0.4, 5.0 + 0.4))
 
+    def test_st_buffer_non_point_warns_once(self):
+        import warnings
+        import geomesa_tpu.analytics.st_functions as stf
+        from geomesa_tpu.geometry import parse_wkt
+        line = parse_wkt("LINESTRING (0 0, 2 2)")
+        stf._buffer_envelope_warned = False
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            stf.st_buffer(line, 0.1)
+            stf.st_buffer(line, 0.2)  # second call stays silent
+            stf.st_buffer(parse_wkt("POINT (1 1)"), 0.1)  # never warns
+        msgs = [str(x.message) for x in w
+                if "envelope" in str(x.message)]
+        assert len(msgs) == 1
+
 
 class TestPartitionedSpatialJoin:
     def test_routing_and_equivalence(self, monkeypatch):

@@ -147,6 +147,94 @@ class TestRest:
             assert e.code == 400
 
 
+class TestWebAuthGate:
+    """Opt-in shared bearer token on the mutating endpoints (POST
+    /rest/write, POST /rest/delete, DELETE /rest/schemas): 403 without
+    the token when configured, everything open when not."""
+
+    TOKEN = "s3kr1t"
+
+    def _request(self, srv, method, path, data=None, token=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}", data=data,
+            method=method)
+        if token is not None:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def test_gated_endpoints_403_without_token(self):
+        srv = GeoMesaWebServer(seeded_store(),
+                               auth_token=self.TOKEN).start()
+        try:
+            for method, path, data in [
+                    ("POST", "/rest/write/people", b"x"),
+                    ("POST", "/rest/delete/people", b'["p0"]'),
+                    ("DELETE", "/rest/schemas/people", None)]:
+                st, body = self._request(srv, method, path, data)
+                assert st == 403
+                assert json.loads(body) == {"error": "forbidden"}
+                # wrong token is as forbidden as none
+                st, _ = self._request(srv, method, path, data,
+                                      token="wrong")
+                assert st == 403
+            # the read surface stays open without credentials
+            st, _, body = _get(srv,
+                               "/rest/query/people?cql=age%20%3C%205")
+            assert st == 200 and json.loads(body)["count"] == 5
+            assert srv.store.count("people") == 100  # nothing mutated
+        finally:
+            srv.stop()
+
+    def test_bearer_token_authorizes_mutations(self):
+        srv = GeoMesaWebServer(seeded_store(),
+                               auth_token=self.TOKEN).start()
+        try:
+            st, body = self._request(srv, "POST", "/rest/delete/people",
+                                     b'["p0", "p1"]', token=self.TOKEN)
+            assert st == 200 and json.loads(body) == {"deleted": 2}
+            assert srv.store.count("people") == 98
+            st, _ = self._request(srv, "DELETE", "/rest/schemas/people",
+                                  token=self.TOKEN)
+            assert st == 200
+            assert srv.store.get_type_names() == []
+        finally:
+            srv.stop()
+
+    def test_remote_store_client_sends_token(self):
+        from geomesa_tpu.features import parse_spec
+        from geomesa_tpu.store import RemoteDataStore
+        srv = GeoMesaWebServer(InMemoryDataStore(),
+                               auth_token=self.TOKEN).start()
+        try:
+            ds = RemoteDataStore("127.0.0.1", srv.port,
+                                 auth_token=self.TOKEN)
+            ds.create_schema(parse_spec("t", "name:String,*geom:Point"))
+            ds.write_dict("t", ["a", "b"],
+                          {"name": ["x", "y"],
+                           "geom": ([0.0, 1.0], [0.0, 1.0])})
+            assert ds.count("t") == 2
+            # a client WITHOUT the token is rejected on the gated path
+            bare = RemoteDataStore("127.0.0.1", srv.port)
+            with pytest.raises(Exception, match="forbidden"):
+                bare.delete("t", ["a"])
+            assert ds.count("t") == 2
+        finally:
+            srv.stop()
+
+    def test_unset_token_leaves_endpoints_open(self):
+        srv = GeoMesaWebServer(seeded_store()).start()
+        try:
+            st, body = self._request(srv, "POST", "/rest/delete/people",
+                                     b'["p0"]')
+            assert st == 200 and json.loads(body) == {"deleted": 1}
+        finally:
+            srv.stop()
+
+
 class TestNativeApi:
     def test_insert_query(self):
         idx = GeoMesaIndex.memory(PickleSerializer())
