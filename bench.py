@@ -17,6 +17,11 @@ Additional configs (BASELINE.md table):
       the coalesced `query_batched` path (one fused vmapped scan per
       admission batch; scan/batcher.py), plus the single-query p50
       through the QueryBatcher passthrough vs direct `query()`
+  #7  durable ingest (wal/ subsystem): chunked 1M-row ingest into an
+      InMemoryDataStore with durable_dir= at each fsync policy
+      (never / interval / always) vs the non-durable baseline, plus
+      crash-recovery time for the resulting 1M-row log and the
+      checkpoint-bounded reopen
   north star: p50 latency of a 100M-point BBOX+time query through the
   in-memory store (index-pruned gather scan), reported as p50_ms_100m.
 
@@ -34,7 +39,8 @@ Prints ONE JSON line:
 
 Env knobs: GEOMESA_TPU_BENCH_N (10M), GEOMESA_TPU_BENCH_REPS (512),
 GEOMESA_TPU_BENCH_TRIALS (3), GEOMESA_TPU_BENCH_CONFIGS
-("1,2,3,4,5,6,northstar" — comma list to run a subset).
+("1,2,3,4,5,6,7,northstar" — comma list to run a subset),
+GEOMESA_TPU_BENCH_WAL_ROWS (1M — config #7 ingest/recovery size).
 
 Config #6 also honors the batcher's own knobs (utils/properties
 resolution: thread-local override -> env var -> default):
@@ -42,10 +48,21 @@ resolution: thread-local override -> env var -> default):
       max queries per fused dispatch; <= 1 disables coalescing
   geomesa.batch.linger.micros / GEOMESA_BATCH_LINGER_MICROS (2000) —
       how long an admission-queue leader waits for followers
+  geomesa.batch.linger.adaptive / GEOMESA_BATCH_LINGER_ADAPTIVE (true)
+      — EWMA-derived linger clamped to [0, linger_us]; idle schemas
+      pay ~zero linger, saturated ones grow batches
+Config #7 honors the WAL's knobs (same resolution order):
+  geomesa.wal.fsync           / GEOMESA_WAL_FSYNC           (always) —
+      group-commit policy: always | interval | never
+  geomesa.wal.segment.bytes   / GEOMESA_WAL_SEGMENT_BYTES   (64MiB) —
+      segment rotation threshold
+  geomesa.wal.interval.ms     / GEOMESA_WAL_INTERVAL_MS     (50) —
+      flush cadence for the interval policy
 The web tier's write gate (not benched, documented for completeness):
   geomesa.web.auth.token      / GEOMESA_WEB_AUTH_TOKEN      (unset) —
       opt-in shared bearer token for POST /rest/write, POST
-      /rest/delete, DELETE /rest/schemas.
+      /rest/delete, DELETE /rest/schemas, POST /rest/wal/* and the
+      `wal truncate` CLI.
 """
 
 import functools
@@ -62,7 +79,7 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,6,northstar").split(","))
+                             "1,2,3,4,5,6,7,northstar").split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
 T0_DAY, T1_DAY = 17_000, 17_100
@@ -504,6 +521,111 @@ def bench_config6(rng, x, y, ms):
     }
 
 
+# -- config 7: durable ingest overhead + crash recovery -------------------
+
+def bench_config7(rng):
+    """What durability costs at ingest and buys at reopen. The same
+    chunked ingest runs non-durable, then with the WAL at each fsync
+    policy; each durable run then measures a full cold recovery (reopen
+    replays the whole log), and the `never` run also measures the
+    checkpoint-bounded reopen (snapshot load + empty tail) — the two
+    ends of the recovery-time spectrum."""
+    import shutil
+    import tempfile
+
+    from geomesa_tpu.features import parse_spec
+    from geomesa_tpu.metrics import metrics
+    from geomesa_tpu.store import InMemoryDataStore
+
+    def fsync_count():
+        return metrics.snapshot()["counters"].get("wal.fsyncs", 0)
+
+    rows = int(os.environ.get("GEOMESA_TPU_BENCH_WAL_ROWS", 1_000_000))
+    chunk = max(rows // 100, 1)
+    spec = "dtg:Date,*geom:Point:srid=4326"
+    x = rng.uniform(-180, 180, rows)
+    y = rng.uniform(-90, 90, rows)
+    ms = rng.integers(T0_DAY * MS_DAY, T1_DAY * MS_DAY,
+                      rows).astype(np.int64)
+    ids = np.arange(rows).astype(str).astype(object)
+
+    def ingest(ds):
+        t0 = time.perf_counter()
+        for lo in range(0, rows, chunk):
+            hi = min(lo + chunk, rows)
+            ds.write_dict("ais7", ids[lo:hi],
+                          {"dtg": ms[lo:hi],
+                           "geom": (x[lo:hi], y[lo:hi])})
+        return time.perf_counter() - t0
+
+    # warm the WAL encode path (pyarrow IPC import + first-stream cost)
+    # outside any timed region so the first policy isn't penalized
+    wd = tempfile.mkdtemp(prefix="geomesa-wal-bench-warm-")
+    try:
+        warm = InMemoryDataStore(durable_dir=wd, wal_fsync="never")
+        warm.create_schema(parse_spec("ais7", spec))
+        warm.write_dict("ais7", ids[:chunk],
+                        {"dtg": ms[:chunk], "geom": (x[:chunk], y[:chunk])})
+        warm.close()
+    finally:
+        shutil.rmtree(wd, ignore_errors=True)
+
+    base_ds = InMemoryDataStore()
+    base_ds.create_schema(parse_spec("ais7", spec))
+    base_s = ingest(base_ds)
+    out = {"rows": rows, "chunk_rows": chunk,
+           "non_durable_ingest_s": round(base_s, 3),
+           "non_durable_rows_per_s": round(rows / base_s, 1),
+           "policies": {}}
+
+    for policy in ("never", "interval", "always"):
+        d = tempfile.mkdtemp(prefix=f"geomesa-wal-bench-{policy}-")
+        try:
+            ds = InMemoryDataStore(durable_dir=d, wal_fsync=policy)
+            ds.create_schema(parse_spec("ais7", spec))
+            fs0 = fsync_count()
+            el = ingest(ds)
+            fsyncs = fsync_count() - fs0
+            wal_bytes = sum(os.path.getsize(p)
+                            for _, p in ds.journal.wal._segments())
+            ds.close()
+            # cold recovery: reopen replays the whole log
+            t0 = time.perf_counter()
+            ds2 = InMemoryDataStore(durable_dir=d, wal_fsync=policy)
+            reopen_s = time.perf_counter() - t0
+            rep = ds2.journal.last_report
+            exact = ds2.count("ais7") == rows
+            entry = {
+                "ingest_s": round(el, 3),
+                "rows_per_s": round(rows / el, 1),
+                "overhead_pct": round((el / base_s - 1.0) * 100, 1),
+                "wal_mb": round(wal_bytes / 1e6, 1),
+                "ingest_fsyncs": fsyncs,
+                "recovery_s": round(rep.wall_time_s, 3),
+                "recovery_rows_per_s": round(
+                    rows / rep.wall_time_s, 1) if rep.wall_time_s else 0,
+                "reopen_s": round(reopen_s, 3),
+                "rows_exact": bool(exact),
+            }
+            if policy == "never":
+                # checkpoint bounds recovery: snapshot + compacted log
+                ds2.checkpoint()
+                ds2.close()
+                t0 = time.perf_counter()
+                ds3 = InMemoryDataStore(durable_dir=d, wal_fsync=policy)
+                entry["reopen_after_checkpoint_s"] = round(
+                    time.perf_counter() - t0, 3)
+                entry["rows_exact"] = bool(entry["rows_exact"]
+                                           and ds3.count("ais7") == rows)
+                ds3.close()
+            else:
+                ds2.close()
+            out["policies"][policy] = entry
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 # -- north star: store-level 100M BBOX+time p50 ---------------------------
 
 def _build_big_store(x, y, ms):
@@ -596,6 +718,9 @@ def main():
         m = min(N, len(bx))
         out["configs"]["6_concurrent_bbox"] = bench_config6(
             rng, bx[:m], by[:m], bms[:m])
+
+    if "7" in CONFIGS:
+        out["configs"]["7_durable_ingest"] = bench_config7(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

@@ -31,11 +31,17 @@ Knobs (system properties / environment):
   queries per fused dispatch, default 32; <= 1 disables batching.
 - ``geomesa.batch.linger.micros`` (``GEOMESA_BATCH_LINGER_MICROS``) —
   how long a leader waits for followers, default 2000 µs.
+- ``geomesa.batch.linger.adaptive`` (``GEOMESA_BATCH_LINGER_ADAPTIVE``)
+  — derive the wait from an EWMA of per-schema inter-arrival time,
+  clamped to ``[0, linger_us]`` (the static knob stays the ceiling);
+  default true. Idle schemas (arrivals slower than the ceiling) pay
+  ~zero linger; saturated ones wait just long enough for the queue to
+  fill.
 
 Metrics (global registry): ``batcher.queries``, ``batcher.batches``,
 ``batcher.coalesced``, ``batcher.occupancy``, ``batcher.coalesce_ratio``,
-``batcher.linger`` (timer), ``batcher.plan_cache.hit`` / ``.miss``,
-``batcher.plan_cache.hit_rate``.
+``batcher.linger`` (timer), ``batcher.linger_effective_us``,
+``batcher.plan_cache.hit`` / ``.miss``, ``batcher.plan_cache.hit_rate``.
 """
 
 from __future__ import annotations
@@ -47,10 +53,18 @@ from ..metrics import metrics
 from ..utils.properties import SystemProperty
 from .zscan import next_pow2
 
-__all__ = ["QueryBatcher", "BATCH_MAX_SIZE", "BATCH_LINGER_MICROS"]
+__all__ = ["QueryBatcher", "BATCH_MAX_SIZE", "BATCH_LINGER_MICROS",
+           "BATCH_LINGER_ADAPTIVE"]
 
 BATCH_MAX_SIZE = SystemProperty("geomesa.batch.max.size", "32")
 BATCH_LINGER_MICROS = SystemProperty("geomesa.batch.linger.micros", "2000")
+BATCH_LINGER_ADAPTIVE = SystemProperty("geomesa.batch.linger.adaptive",
+                                       "true")
+
+# EWMA smoothing for the per-schema inter-arrival estimate: the most
+# recent ~5 arrivals dominate, so the estimate tracks load shifts
+# quickly without whiplashing on one outlier gap
+_EWMA_ALPHA = 0.2
 
 
 class _Pending:
@@ -74,11 +88,22 @@ class _Pending:
 
 
 class _TypeQueue:
-    __slots__ = ("items", "has_leader")
+    __slots__ = ("items", "has_leader", "last_arrival", "ewma_gap_s")
 
     def __init__(self):
         self.items: list[_Pending] = []
         self.has_leader = False
+        self.last_arrival: float | None = None  # monotonic, admission
+        self.ewma_gap_s: float | None = None    # None until 2 arrivals
+
+    def observe_arrival(self, now: float):
+        """Fold one admission into the inter-arrival EWMA."""
+        if self.last_arrival is not None:
+            gap = now - self.last_arrival
+            self.ewma_gap_s = (gap if self.ewma_gap_s is None
+                               else _EWMA_ALPHA * gap
+                               + (1.0 - _EWMA_ALPHA) * self.ewma_gap_s)
+        self.last_arrival = now
 
 
 class QueryBatcher:
@@ -91,12 +116,16 @@ class QueryBatcher:
     """
 
     def __init__(self, store, max_batch: int | None = None,
-                 linger_us: float | None = None, registry=metrics):
+                 linger_us: float | None = None, adaptive: bool | None = None,
+                 registry=metrics):
         self.store = store
         self.max_batch = int(max_batch if max_batch is not None
                              else BATCH_MAX_SIZE.get())
         self.linger_us = float(linger_us if linger_us is not None
                                else BATCH_LINGER_MICROS.get())
+        self.adaptive = (adaptive if adaptive is not None
+                         else str(BATCH_LINGER_ADAPTIVE.get()).lower()
+                         in ("true", "1", "yes"))
         self.registry = registry
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -131,6 +160,7 @@ class QueryBatcher:
         p = _Pending(q)
         with self._cond:
             tq = self._queues.setdefault(q.type_name, _TypeQueue())
+            tq.observe_arrival(time.monotonic())
             tq.items.append(p)
             if not tq.has_leader:
                 tq.has_leader = True
@@ -173,9 +203,12 @@ class QueryBatcher:
             # followers already queued behind this leader. An idle
             # singleton dispatches immediately — a lone query must not
             # see the linger window as added latency.
-            if self.linger_us > 0 and (self._in_flight > 0
-                                       or len(tq.items) > 1):
-                deadline = time.monotonic() + self.linger_us / 1e6
+            linger_s = self._effective_linger_s(tq)
+            self.registry.gauge("batcher.linger_effective_us",
+                                linger_s * 1e6)
+            if linger_s > 0 and (self._in_flight > 0
+                                 or len(tq.items) > 1):
+                deadline = time.monotonic() + linger_s
                 while len(tq.items) < self.max_batch:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -193,6 +226,27 @@ class QueryBatcher:
         finally:
             with self._cond:
                 self._in_flight -= 1
+
+    def _effective_linger_s(self, tq: _TypeQueue) -> float:
+        """The leader's wait budget for this dispatch, in seconds.
+
+        Static mode (``adaptive=False``) always returns the ceiling.
+        Adaptive mode sizes the wait from the schema's inter-arrival
+        EWMA: no samples yet -> the ceiling (a cold queue behaves like
+        the static knob); arrivals slower than the ceiling -> 0 (no
+        follower can land inside the window, so lingering is pure added
+        latency); otherwise enough gaps to fill the remaining batch
+        slots, clamped to the ceiling."""
+        ceiling = self.linger_us / 1e6
+        if not self.adaptive or ceiling <= 0:
+            return max(ceiling, 0.0)
+        gap = tq.ewma_gap_s
+        if gap is None:
+            return ceiling
+        if gap >= ceiling:
+            return 0.0
+        remaining_slots = max(self.max_batch - len(tq.items), 0)
+        return min(ceiling, gap * remaining_slots)
 
     def _observe_linger(self, seconds: float):
         ctx = self.registry.time("batcher.linger")

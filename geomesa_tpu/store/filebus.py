@@ -47,10 +47,28 @@ def segment_name(seq: int) -> str:
     return f"{seq:0{_SEQ_DIGITS}d}.msg"
 
 
+def fsync_dir(path: str):
+    """fsync a DIRECTORY so the rename/unlink entries inside it are
+    durable — os.replace alone only orders the data, not the dirent; a
+    crash can still lose the new name. Best-effort: some filesystems
+    refuse directory fsync."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def write_bytes_atomic(path: str, raw: bytes):
-    """Durable atomic write: tmp + fsync + rename (readers never see a
-    partial file). Tmp names are pid+thread-unique (the broker persists
-    from handler threads)."""
+    """Durable atomic write: tmp + fsync + rename + directory fsync
+    (readers never see a partial file, and the rename itself survives a
+    crash — the WAL checkpoint manifest relies on this). Tmp names are
+    pid+thread-unique (the broker persists from handler threads)."""
     import threading
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "wb") as f:
@@ -58,6 +76,7 @@ def write_bytes_atomic(path: str, raw: bytes):
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".")
 
 
 def write_json_atomic(path: str, obj):
@@ -65,7 +84,10 @@ def write_json_atomic(path: str, obj):
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
         json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".")
 
 
 def _encode(msg: GeoMessage) -> bytes:

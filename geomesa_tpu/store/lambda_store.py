@@ -30,10 +30,35 @@ LAMBDA_QUERY_TRANSIENT = "LAMBDA_QUERY_TRANSIENT"
 
 class LambdaDataStore(DataStore):
     def __init__(self, persistent=None, bus: MessageBus | None = None,
-                 persist_after_millis: int = 3_600_000):
-        self.transient = LiveDataStore(bus)
+                 persist_after_millis: int = 3_600_000,
+                 durable_dir: str | None = None,
+                 wal_fsync: str | None = None):
+        # durability guards the volatile half: crash-recovered transient
+        # rows reopen stamped "now", so the normal persist() cadence
+        # re-ages them toward the persistent tier
+        self.transient = LiveDataStore(bus, durable_dir=durable_dir,
+                                       wal_fsync=wal_fsync)
         self.persistent = persistent or InMemoryDataStore()
         self.persist_after = persist_after_millis
+        # create_schema registers types in BOTH tiers; recovery only
+        # repopulated the transient one — mirror the schemas across
+        for tn in self.transient.get_type_names():
+            if tn not in self.persistent.get_type_names():
+                self.persistent.create_schema(self.transient.get_schema(tn))
+
+    @property
+    def journal(self):
+        """The transient tier's WAL journal, or None when not durable."""
+        return self.transient.journal
+
+    def checkpoint(self, keep: int = 1) -> dict:
+        return self.transient.checkpoint(keep=keep)
+
+    def close(self):
+        self.transient.close()
+        close = getattr(self.persistent, "close", None)
+        if close is not None:
+            close()
 
     def create_schema(self, sft: SimpleFeatureType | str,
                       spec: str | None = None):

@@ -64,13 +64,39 @@ class LiveDataStore(DataStore):
     live cache."""
 
     def __init__(self, bus: MessageBus | None = None,
-                 ttl_millis: int | None = None):
+                 ttl_millis: int | None = None,
+                 durable_dir: str | None = None,
+                 wal_fsync: str | None = None):
         self.bus = bus or MessageBus()
         self.ttl_millis = ttl_millis
-        self._mem = InMemoryDataStore()
+        # the cache journals every applied mutation (bus-delivered ones
+        # included) and replays checkpoint + log on open
+        self._mem = InMemoryDataStore(durable_dir=durable_dir,
+                                      wal_fsync=wal_fsync)
         self._listeners: dict[str, list[Callable[[GeoMessage], None]]] = {}
         self._arrival_ms: dict[str, np.ndarray] = {}
         self._subscribed: set[str] = set()
+        # recovered types need the live-tier bookkeeping the replay
+        # bypassed: re-subscribe, and stamp rows with the reopen time
+        # (real arrival times aren't journaled — "now" gives them a
+        # full ttl lease instead of instant age-off)
+        now = int(time.time() * 1000)
+        for tn in self._mem.get_type_names():
+            self._arrival_ms[tn] = np.full(self._mem.count(tn), now,
+                                           dtype=np.int64)
+            self._subscribed.add(tn)
+            self.bus.subscribe(tn, self._on_message)
+
+    @property
+    def journal(self):
+        """The cache's WAL journal, or None when not durable."""
+        return self._mem.journal
+
+    def checkpoint(self, keep: int = 1) -> dict:
+        return self._mem.checkpoint(keep=keep)
+
+    def close(self):
+        self._mem.close()
 
     # -- schema ------------------------------------------------------------
 

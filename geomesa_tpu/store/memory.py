@@ -336,9 +336,11 @@ class _TypeState:
         self.flush()
         return self._batch
 
-    def append(self, batch: FeatureBatch, visibilities=None):
-        # validate everything BEFORE mutating: a failed write must not
-        # leave batch/vis misaligned
+    def validate(self, batch: FeatureBatch, visibilities=None):
+        """Pre-flight append checks WITHOUT mutating — also the durable
+        write path's guard: a record must be known applyable before it
+        is journaled, or replay would re-fail on it. Returns the
+        normalized (vis array, distinct labels)."""
         if visibilities is None:
             # fast path: no O(n) object scan for the common open write
             vis = np.full(batch.n, None, dtype=object)
@@ -350,6 +352,12 @@ class _TypeState:
             raise ValueError("visibilities length mismatch")
         from ..security import validate_labels
         validate_labels(self.sft, distinct)  # raises on malformed
+        return vis, distinct
+
+    def append(self, batch: FeatureBatch, visibilities=None):
+        # validate everything BEFORE mutating: a failed write must not
+        # leave batch/vis misaligned
+        vis, distinct = self.validate(batch, visibilities)
         if distinct:
             self.has_vis = True
         self._pending.append((batch, vis))
@@ -579,10 +587,19 @@ class _TypeState:
 class InMemoryDataStore(DataStore):
     """A GeoTools-DataStore-shaped API over device-resident batches."""
 
-    def __init__(self, audit=None):
+    def __init__(self, audit=None, durable_dir: str | None = None,
+                 wal_fsync: str | None = None):
         self._types: dict[str, _TypeState] = {}
         self.stats = DataStoreStats()
         self.audit = audit  # AuditLogger or None
+        # opt-in durability: journal mutations to a WAL under
+        # durable_dir (validate -> journal -> apply) and replay the
+        # last checkpoint + log tail on open (wal/ subsystem)
+        self.journal = None
+        if durable_dir:
+            from ..wal.durable import Journal
+            self.journal = Journal(durable_dir, fsync=wal_fsync)
+            self.journal.recover(self)
 
     # -- schema management (MetadataBackedDataStore surface) --------------
 
@@ -592,6 +609,8 @@ class InMemoryDataStore(DataStore):
             sft = parse_spec(sft, spec or "")
         if sft.type_name in self._types:
             raise ValueError(f"schema {sft.type_name!r} already exists")
+        if self.journal is not None:
+            self.journal.log_create_schema(sft)
         self._types[sft.type_name] = self._new_state(sft)
 
     def _new_state(self, sft: SimpleFeatureType) -> _TypeState:
@@ -604,6 +623,8 @@ class InMemoryDataStore(DataStore):
         return sorted(self._types)
 
     def remove_schema(self, type_name: str):
+        if self.journal is not None and type_name in self._types:
+            self.journal.log_drop_schema(type_name)
         st = self._types.pop(type_name, None)
         if st is not None:
             # outstanding small lazy results must not pin the dropped
@@ -627,6 +648,11 @@ class InMemoryDataStore(DataStore):
         st = self._state(type_name)
         if batch.sft != st.sft:
             raise ValueError("batch schema does not match store schema")
+        if self.journal is not None:
+            # write-ahead: validate (so the journaled record is known
+            # applyable), journal, then apply
+            st.validate(batch, visibilities)
+            self.journal.log_write(type_name, batch, visibilities)
         was_empty = st.n == 0
         st.append(batch, visibilities)
         # auto-maintained stats, the write-side StatsCombiner analog
@@ -649,7 +675,24 @@ class InMemoryDataStore(DataStore):
                     "lazy build on first read", exc_info=True)
 
     def delete(self, type_name: str, ids):
-        self._state(type_name).delete(set(map(str, ids)))
+        st = self._state(type_name)
+        ids = set(map(str, ids))
+        if self.journal is not None:
+            self.journal.log_delete(type_name, sorted(ids))
+        st.delete(ids)
+
+    # -- durability (wal/ subsystem, opt-in via durable_dir) ---------------
+
+    def checkpoint(self, keep: int = 1) -> dict:
+        """Snapshot current state and compact the journal; requires the
+        ``durable_dir`` knob."""
+        if self.journal is None:
+            raise ValueError("store is not durable (no durable_dir)")
+        return self.journal.checkpoint(self, keep=keep)
+
+    def close(self):
+        if self.journal is not None:
+            self.journal.close()
 
     def warm_index(self, type_name: str, state: dict):
         """Install persisted z-key sort orders (possibly memory-mapped)

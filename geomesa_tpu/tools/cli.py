@@ -14,6 +14,7 @@ Subcommands (mirroring the reference's tools/ command set):
     density         --path R --name T --bbox x1,y1,x2,y2 --size WxH [--cql F]
     sql             --path R 'SELECT ... WHERE ST_...'
     serve           --path R [--host H] [--port P]
+    wal inspect|replay|truncate --wal-dir D [--below-lsn N] [--token T]
     version / env
 """
 
@@ -253,6 +254,74 @@ def cmd_reindex(args) -> int:
     return 0
 
 
+def _wal_admin_ok(args) -> bool:
+    """Mutating wal commands honor the same shared bearer token that
+    gates the web tier's mutating endpoints: when
+    ``geomesa.web.auth.token`` is set, --token must match."""
+    from ..web.server import WEB_AUTH_TOKEN
+    expected = WEB_AUTH_TOKEN.get()
+    if not expected or getattr(args, "token", None) == expected:
+        return True
+    print("wal truncate is gated: pass --token matching "
+          "geomesa.web.auth.token", file=sys.stderr)
+    return False
+
+
+def cmd_wal(args) -> int:
+    """WAL administration over a durable root (the directory passed as
+    ``durable_dir=``, holding ``log/`` + ``snapshots/``)."""
+    import os
+    root = args.wal_dir
+    logdir = os.path.join(root, "log")
+    if args.wal_command == "inspect":
+        # read-only: never truncates a torn tail, safe on a live log
+        from ..wal.log import inspect_dir
+        from ..wal.snapshot import latest_checkpoint_lsn
+        out = inspect_dir(logdir)
+        out["checkpoint_lsn"] = latest_checkpoint_lsn(root)
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return 0
+    if args.wal_command == "replay":
+        # rebuild a store from checkpoint + log and report what replay
+        # did (opening the log repairs a torn tail, like a store reopen)
+        from ..store.memory import InMemoryDataStore
+        from ..wal.log import WriteAheadLog
+        from ..wal.recovery import recover
+        store = InMemoryDataStore()
+        wal = WriteAheadLog(logdir, fsync="never")
+        try:
+            report = recover(store, wal, root)
+        finally:
+            wal.close()
+        out = report.to_json_object()
+        out["types"] = {tn: store.count(tn)
+                        for tn in store.get_type_names()}
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return 0
+    if args.wal_command == "truncate":
+        if not _wal_admin_ok(args):
+            return 3
+        from ..wal.log import WriteAheadLog
+        from ..wal.snapshot import latest_checkpoint_lsn
+        lsn = (args.below_lsn if args.below_lsn is not None
+               else latest_checkpoint_lsn(root))
+        if lsn <= 0:
+            print("nothing to truncate: no checkpoint and no "
+                  "--below-lsn", file=sys.stderr)
+            return 2
+        wal = WriteAheadLog(logdir, fsync="never")
+        try:
+            dropped = wal.truncate_below(lsn)
+        finally:
+            wal.close()
+        print(f"dropped {dropped} segment(s) below lsn {lsn}")
+        return 0
+    print(f"unknown wal command {args.wal_command!r}", file=sys.stderr)
+    return 2
+
+
 def cmd_version(args) -> int:
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -315,6 +384,26 @@ def main(argv=None) -> int:
     add("serve", cmd_serve,
         (["--host"], {"default": "127.0.0.1"}),
         (["--port"], {"type": int, "default": 8080}))
+    walp = sub.add_parser("wal", help="write-ahead log administration")
+    walsub = walp.add_subparsers(dest="wal_command", required=True)
+    for wname, whelp in (("inspect", "summarize segments/records"),
+                         ("replay", "rebuild a store from the log and "
+                                    "report recovery"),
+                         ("truncate", "drop segments below a checkpoint "
+                                      "LSN (token-gated)")):
+        wp = walsub.add_parser(wname, help=whelp)
+        wp.add_argument("--wal-dir", required=True, dest="wal_dir",
+                        help="durable root (the durable_dir= directory)")
+        if wname == "truncate":
+            wp.add_argument("--below-lsn", type=int, default=None,
+                            dest="below_lsn",
+                            help="retention LSN (default: last "
+                                 "checkpoint)")
+            wp.add_argument("--token", default=None,
+                            help="admin bearer token "
+                                 "(geomesa.web.auth.token)")
+        wp.set_defaults(fn=cmd_wal)
+
     add("version", cmd_version, needs_store=False)
     add("env", cmd_env, needs_store=False)
 

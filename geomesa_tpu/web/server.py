@@ -17,6 +17,9 @@ stdlib http server — no framework dependency:
     GET  /rest/density/{type}?bbox=x0,y0,x1,y1&width=&height=&cql=
     GET  /rest/sql?q=SELECT...  (or POST /rest/sql, body = statement)
     GET  /rest/audit?type=&since=
+    GET  /rest/wal                          -> journal/WAL stats
+    POST /rest/wal/checkpoint               (bearer-gated)
+    POST /rest/wal/truncate?below=LSN       (bearer-gated)
 
 Queries run the normal planner/scan path; arrow responses stream IPC
 bytes (content-type application/vnd.apache.arrow.file).
@@ -43,8 +46,11 @@ __all__ = ["GeoMesaWebServer"]
 # without `Authorization: Bearer <token>` get 403.
 WEB_AUTH_TOKEN = SystemProperty("geomesa.web.auth.token", None)
 
-# the endpoints the shared token gates: (method, first path segment)
-_GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas")}
+# the endpoints the shared token gates: (method, first path segment) —
+# POST /rest/wal/* are the WAL admin mutations (checkpoint/truncate);
+# GET /rest/wal stays open (read-only stats)
+_GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas"),
+          ("POST", "wal")}
 
 
 class GeoMesaWebServer:
@@ -188,6 +194,8 @@ class GeoMesaWebServer:
             return 200, "application/json", _j(
                 {"columns": res.names,
                  "rows": [list(r) for r in res.rows()]})
+        if parts and parts[0] == "wal":
+            return self._wal(method, parts[1:], params)
         if parts == ["audit"]:
             if self.audit is None:
                 return 200, "application/json", _j([])
@@ -196,6 +204,33 @@ class GeoMesaWebServer:
                 int(params["since"][0]) if "since" in params else None)
             return 200, "application/json", _j(
                 [json.loads(e.to_json()) for e in evs])
+        return 404, "application/json", _j({"error": "not found"})
+
+    def _wal(self, method, parts, params):
+        """Durability admin: GET /rest/wal (stats, open), POST
+        /rest/wal/checkpoint and /rest/wal/truncate?below= (mutating,
+        bearer-gated via _GATED)."""
+        journal = getattr(self.store, "journal", None)
+        if journal is None:
+            return 404, "application/json", _j(
+                {"error": "store is not durable (no WAL journal)"})
+        if method == "GET" and not parts:
+            return 200, "application/json", _j(journal.stats())
+        if method == "POST" and parts == ["checkpoint"]:
+            info = self.store.checkpoint()
+            return 200, "application/json", _j(info)
+        if method == "POST" and parts == ["truncate"]:
+            if "below" in params:
+                lsn = int(params["below"][0])
+            else:
+                from ..wal.snapshot import latest_checkpoint_lsn
+                lsn = latest_checkpoint_lsn(journal.root)
+            if lsn <= 0:
+                return 400, "application/json", _j(
+                    {"error": "no checkpoint and no ?below= LSN"})
+            dropped = journal.wal.truncate_below(lsn)
+            return 200, "application/json", _j(
+                {"below": lsn, "segments_dropped": dropped})
         return 404, "application/json", _j({"error": "not found"})
 
     def _query(self, name, params):

@@ -16,7 +16,7 @@ import pytest
 
 from geomesa_tpu.features import parse_spec
 from geomesa_tpu.index.api import Query
-from geomesa_tpu.scan.batcher import QueryBatcher
+from geomesa_tpu.scan.batcher import QueryBatcher, _TypeQueue
 from geomesa_tpu.store import InMemoryDataStore
 
 MS = lambda s: int(np.datetime64(s, "ms").astype(np.int64))
@@ -148,7 +148,7 @@ class TestCoalescing:
     def test_batched_ids_exact(self):
         ds = RecordingStore()
         _fill(ds, "ships")
-        b = QueryBatcher(ds, max_batch=4, linger_us=1_000_000)
+        b = QueryBatcher(ds, max_batch=4, linger_us=1_000_000, adaptive=False)
         queries = [_bbox("ships", x0, y0) for x0, y0 in
                    ((-150, -60), (-40, -10), (10, 20), (80, -35))]
         results = _stage_coalesced(b, ds, queries)
@@ -164,7 +164,8 @@ class TestCoalescing:
         ds = RecordingStore()
         _fill(ds, "ships", seed=1)
         _fill(ds, "planes", seed=2)
-        b = QueryBatcher(ds, max_batch=2, linger_us=1_000_000)
+        b = QueryBatcher(ds, max_batch=2, linger_us=1_000_000,
+                         adaptive=False)
         ds.hold = threading.Event()
         warm = threading.Thread(target=b.query, args=(_gated("ships"),))
         warm.start()
@@ -203,7 +204,8 @@ class TestCoalescing:
         ds = RecordingStore()
         _fill(ds, "ships")
         linger_s = 0.12
-        b = QueryBatcher(ds, max_batch=8, linger_us=linger_s * 1e6)
+        b = QueryBatcher(ds, max_batch=8, linger_us=linger_s * 1e6,
+                         adaptive=False)
         ds.hold = threading.Event()
         warm = threading.Thread(target=b.query, args=(_gated("ships"),))
         warm.start()
@@ -235,7 +237,8 @@ class TestPlanCache:
     def test_counters_across_index_version_bump(self):
         ds = RecordingStore()
         _fill(ds, "ships")
-        b = QueryBatcher(ds, max_batch=2, linger_us=1_000_000)
+        b = QueryBatcher(ds, max_batch=2, linger_us=1_000_000,
+                         adaptive=False)
         key0 = b._shape_key("ships", 2)
 
         _stage_coalesced(b, ds, [_bbox("ships", -60, -30),
@@ -273,10 +276,80 @@ class TestErrorIsolation:
 
         ds = FlakyStore()
         _fill(ds, "ships")
-        b = QueryBatcher(ds, max_batch=2, linger_us=1_000_000)
+        b = QueryBatcher(ds, max_batch=2, linger_us=1_000_000,
+                         adaptive=False)
         queries = [_bbox("ships", -60, -30), _bbox("ships", 20, 0)]
         results = _stage_coalesced(b, ds, queries)
         assert len(ds.batched_calls) == 1
         for q, r in zip(queries, results):
             want = ds.query(q)
             assert np.array_equal(r.ids, want.ids)
+
+
+class TestAdaptiveLinger:
+    """The EWMA-derived linger budget: pure-function checks over
+    synthetic queue states (no sleeping, no thread races)."""
+
+    def _batcher(self, **kw):
+        kw.setdefault("max_batch", 8)
+        kw.setdefault("linger_us", 2000)
+        kw.setdefault("adaptive", True)
+        return QueryBatcher(RecordingStore(), **kw)
+
+    def test_cold_queue_uses_static_ceiling(self):
+        b = self._batcher()
+        tq = _TypeQueue()
+        assert b._effective_linger_s(tq) == pytest.approx(0.002)
+
+    def test_idle_schema_pays_zero_linger(self):
+        # arrivals slower than the window: no follower can land inside
+        # it, so lingering would be pure added latency
+        b = self._batcher()
+        tq = _TypeQueue()
+        tq.ewma_gap_s = 0.5
+        assert b._effective_linger_s(tq) == 0.0
+
+    def test_saturated_schema_scales_with_remaining_slots(self):
+        b = self._batcher()
+        tq = _TypeQueue()
+        tq.ewma_gap_s = 1e-4
+        tq.items = [object()]  # leader queued, 7 slots to fill
+        assert b._effective_linger_s(tq) == pytest.approx(7e-4)
+
+    def test_clamped_to_the_static_ceiling(self):
+        b = self._batcher()
+        tq = _TypeQueue()
+        tq.ewma_gap_s = 0.0015  # under the window, but 7 slots * gap over
+        tq.items = [object()]
+        assert b._effective_linger_s(tq) == pytest.approx(0.002)
+
+    def test_static_mode_ignores_the_estimate(self):
+        b = self._batcher(adaptive=False)
+        tq = _TypeQueue()
+        tq.ewma_gap_s = 10.0
+        assert b._effective_linger_s(tq) == pytest.approx(0.002)
+
+    def test_ewma_folds_arrivals(self):
+        tq = _TypeQueue()
+        tq.observe_arrival(10.0)
+        assert tq.ewma_gap_s is None  # one arrival = no gap yet
+        tq.observe_arrival(10.1)
+        assert tq.ewma_gap_s == pytest.approx(0.1)
+        tq.observe_arrival(10.2)  # 0.2*0.1 + 0.8*0.1
+        assert tq.ewma_gap_s == pytest.approx(0.1)
+        tq.observe_arrival(10.9)  # 0.2*0.7 + 0.8*0.1
+        assert tq.ewma_gap_s == pytest.approx(0.22)
+
+    def test_adaptive_dispatch_still_exact(self):
+        # end-to-end with the default adaptive policy: results must be
+        # id-for-id identical to per-query store.query()
+        ds = RecordingStore()
+        _fill(ds, "ships")
+        b = QueryBatcher(ds, max_batch=4, linger_us=2000, adaptive=True)
+        for k in range(3):
+            q = _bbox("ships", -60 + 40 * k, -30)
+            got = b.query(q)
+            want = ds.query(_bbox("ships", -60 + 40 * k, -30))
+            assert np.array_equal(got.ids, want.ids)
+        # fast sequential arrivals built an estimate for the schema
+        assert b._queues["ships"].ewma_gap_s is not None
