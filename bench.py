@@ -22,6 +22,12 @@ Additional configs (BASELINE.md table):
       (never / interval / always) vs the non-durable baseline, plus
       crash-recovery time for the resulting 1M-row log and the
       checkpoint-bounded reopen
+  #8  faulty network (resilience/ subsystem): the same BBOX query
+      stream through RemoteDataStore clean vs through a ChaosProxy
+      (1% connection resets + 10ms jitter) — must be id-identical
+      with zero client-visible errors; breaker fast-fail latency
+      against a black-holed endpoint; broker kill->restart recovery
+      time for a long-polling SocketBus consumer
   north star: p50 latency of a 100M-point BBOX+time query through the
   in-memory store (index-pruned gather scan), reported as p50_ms_100m.
 
@@ -40,7 +46,8 @@ Prints ONE JSON line:
 Env knobs: GEOMESA_TPU_BENCH_N (10M), GEOMESA_TPU_BENCH_REPS (512),
 GEOMESA_TPU_BENCH_TRIALS (3), GEOMESA_TPU_BENCH_CONFIGS
 ("1,2,3,4,5,6,7,northstar" — comma list to run a subset),
-GEOMESA_TPU_BENCH_WAL_ROWS (1M — config #7 ingest/recovery size).
+GEOMESA_TPU_BENCH_WAL_ROWS (1M — config #7 ingest/recovery size),
+GEOMESA_TPU_BENCH_CHAOS_QUERIES (300 — config #8 stream length).
 
 Config #6 also honors the batcher's own knobs (utils/properties
 resolution: thread-local override -> env var -> default):
@@ -58,6 +65,23 @@ Config #7 honors the WAL's knobs (same resolution order):
       segment rotation threshold
   geomesa.wal.interval.ms     / GEOMESA_WAL_INTERVAL_MS     (50) —
       flush cadence for the interval policy
+Config #8 exercises the resilience layer's knobs (same resolution):
+  geomesa.retry.attempts      / GEOMESA_RETRY_ATTEMPTS      (5) —
+      max attempts per retryable call (1 disables retries)
+  geomesa.retry.base.ms       / GEOMESA_RETRY_BASE_MS       (50) —
+      full-jitter backoff base; sleep ~ U(0, min(cap, base*2^k))
+  geomesa.retry.cap.ms        / GEOMESA_RETRY_CAP_MS        (2000) —
+      backoff ceiling per attempt
+  geomesa.retry.deadline      / GEOMESA_RETRY_DEADLINE      (30s) —
+      total wall-clock budget across one call's attempts
+  geomesa.breaker.failures    / GEOMESA_BREAKER_FAILURES    (5) —
+      consecutive failures before an endpoint's circuit opens
+  geomesa.breaker.reset.ms    / GEOMESA_BREAKER_RESET_MS    (5000) —
+      open -> half-open probe delay
+  geomesa.web.max.inflight    / GEOMESA_WEB_MAX_INFLIGHT    (unset) —
+      server load-shedding cap; excess requests get 503 + Retry-After
+  geomesa.web.retry.after.s   / GEOMESA_WEB_RETRY_AFTER_S   (1) —
+      the backpressure hint a shed response carries
 The web tier's write gate (not benched, documented for completeness):
   geomesa.web.auth.token      / GEOMESA_WEB_AUTH_TOKEN      (unset) —
       opt-in shared bearer token for POST /rest/write, POST
@@ -79,7 +103,7 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,6,7,northstar").split(","))
+                             "1,2,3,4,5,6,7,8,northstar").split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
 T0_DAY, T1_DAY = 17_000, 17_100
@@ -626,6 +650,151 @@ def bench_config7(rng):
     return out
 
 
+# -- config 8: remote tier on a faulty network ----------------------------
+
+def bench_config8(rng):
+    """What the resilience layer costs and buys. A web-served store
+    answers the same BBOX query stream twice from a RemoteDataStore —
+    direct, then through a ChaosProxy injecting 1% connection resets +
+    ~10ms jitter — and the faulty run must finish with ZERO
+    client-visible errors and id-identical results (the retry/breaker
+    stack absorbs the faults). Also measured: the breaker's fast-fail
+    latency against a black-holed endpoint (vs burning timeout_s per
+    call) and broker kill->restart recovery for a long-polling
+    SocketBus consumer (server-committed offsets resume exactly-once)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from geomesa_tpu.features import parse_spec
+    from geomesa_tpu.metrics import metrics
+    from geomesa_tpu.resilience import (BreakerBoard, ChaosProxy,
+                                        CircuitOpenError, RetryPolicy)
+    from geomesa_tpu.store import InMemoryDataStore
+    from geomesa_tpu.store.live import GeoMessage
+    from geomesa_tpu.store.remote import RemoteDataStore
+    from geomesa_tpu.store.socketbus import SocketBroker, SocketBus
+    from geomesa_tpu.web import GeoMesaWebServer
+
+    nq = int(os.environ.get("GEOMESA_TPU_BENCH_CHAOS_QUERIES", 300))
+    n = 200_000
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    ds = InMemoryDataStore()
+    ds.create_schema(parse_spec("pts8", "*geom:Point:srid=4326"))
+    ds.write_dict("pts8", np.arange(n).astype(str).astype(object),
+                  {"geom": (x, y)})
+    srv = GeoMesaWebServer(ds).start()
+
+    def boxes(seed):
+        q_rng = np.random.default_rng(seed)
+        for _ in range(nq):
+            x0 = float(q_rng.uniform(-170, 130))
+            y0 = float(q_rng.uniform(-80, 55))
+            yield f"BBOX(geom, {x0:.4f}, {y0:.4f}, {x0+5:.4f}, {y0+5:.4f})"
+
+    def run(client):
+        ids, times, errors = [], [], 0
+        for ecql in boxes(seed=77):
+            t0 = time.perf_counter()
+            try:
+                res = client.query(ecql, "pts8")
+                ids.append(tuple(sorted(res.ids.astype(str))))
+            except Exception:
+                errors += 1
+                ids.append(None)
+            times.append(time.perf_counter() - t0)
+        arr = np.asarray(times)
+        return ids, {"qps": round(nq / arr.sum(), 1),
+                     "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
+                     "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
+                     "client_errors": errors}
+
+    out = {"queries": nq, "n": n}
+    try:
+        direct = RemoteDataStore("127.0.0.1", srv.port)
+        direct.query("BBOX(geom, 0, 0, 5, 5)", "pts8")  # warm
+        clean_ids, out["clean"] = run(direct)
+
+        proxy = ChaosProxy("127.0.0.1", srv.port, reset_rate=0.01,
+                           jitter_s=0.010, seed=42).start()
+        try:
+            faulty = RemoteDataStore("127.0.0.1", proxy.port,
+                                     timeout_s=10.0)
+            r0 = metrics.snapshot()["counters"].get("resilience.retries", 0)
+            chaos_ids, chaos = run(faulty)
+            chaos["resets_injected"] = proxy.stats["resets"]
+            chaos["retries"] = (metrics.snapshot()["counters"]
+                                .get("resilience.retries", 0) - r0)
+            chaos["ids_exact"] = bool(chaos_ids == clean_ids)
+            out["chaos_1pct_resets"] = chaos
+        finally:
+            proxy.stop()
+
+        # breaker fast-fail: a black-holed endpoint costs timeout_s per
+        # attempt until the breaker opens, then microseconds
+        hole = ChaosProxy("127.0.0.1", srv.port, blackhole=True).start()
+        try:
+            dead = RemoteDataStore(
+                "127.0.0.1", hole.port, timeout_s=0.3,
+                retry_policy=RetryPolicy(max_attempts=1),
+                breakers=BreakerBoard(failure_threshold=2,
+                                      reset_timeout_s=60.0))
+            for _ in range(2):  # trip the breaker
+                try:
+                    dead.count("pts8")
+                except Exception:
+                    pass
+            ff = []
+            for _ in range(20):
+                t0 = time.perf_counter()
+                try:
+                    dead.count("pts8")
+                except CircuitOpenError:
+                    pass
+                ff.append(time.perf_counter() - t0)
+            out["breaker_fast_fail_us"] = round(_p50(ff) * 1e6, 1)
+        finally:
+            hole.stop()
+    finally:
+        srv.stop()
+
+    # broker kill -> restart while a consumer is parked in a long poll:
+    # wall time from the kill to the reconnected consumer delivering
+    # the first post-restart message
+    root = tempfile.mkdtemp(prefix="geomesa-bench8-")
+    try:
+        fast = dict(max_attempts=60, base_s=0.02, cap_s=0.25)
+        b1 = SocketBroker(root=root).start()
+        port = b1.port
+        prod = SocketBus(b1.host, port, group="prod",
+                         retry_policy=RetryPolicy(**fast))
+        got = []
+        cons = SocketBus(b1.host, port, group="cons",
+                         retry_policy=RetryPolicy(**fast))
+        cons.subscribe("t", lambda m: got.append(time.perf_counter()))
+        for i in range(3):
+            prod.publish("t", GeoMessage("delete", "t", ids=(f"m{i}",)))
+        cons.poll()
+        th = threading.Thread(target=lambda: cons.poll(wait_s=20.0))
+        th.start()
+        time.sleep(0.3)          # consumer parked broker-side
+        b1.stop()
+        t_kill = time.perf_counter()
+        b2 = SocketBroker(port=port, root=root).start()
+        prod.publish("t", GeoMessage("delete", "t", ids=("m3",)))
+        th.join(timeout=25)
+        out["broker_restart_recovery_ms"] = (
+            round((got[-1] - t_kill) * 1e3, 1) if got and not th.is_alive()
+            else None)
+        prod.close()
+        cons.close()
+        b2.stop()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 # -- north star: store-level 100M BBOX+time p50 ---------------------------
 
 def _build_big_store(x, y, ms):
@@ -721,6 +890,9 @@ def main():
 
     if "7" in CONFIGS:
         out["configs"]["7_durable_ingest"] = bench_config7(rng)
+
+    if "8" in CONFIGS:
+        out["configs"]["8_faulty_network"] = bench_config8(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

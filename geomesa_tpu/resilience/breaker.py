@@ -1,0 +1,149 @@
+"""Per-endpoint circuit breaker: closed / open / half-open.
+
+Against a down server, every call otherwise burns its full socket
+timeout before failing — with a 60s client timeout, ten queued queries
+are ten minutes of hang. The breaker watches consecutive transport
+failures per endpoint; past the threshold it OPENS and calls fail in
+microseconds (``CircuitOpenError``) until a reset timeout elapses, then
+HALF-OPEN lets a bounded number of probe calls through — one success
+re-closes, a failure re-opens. The same state machine HBase clients
+get from their RPC stack's fast-fail mode (SURVEY.md 2.6).
+
+State transitions and fast-fails count into the metrics registry
+(``resilience.breaker.opened`` / ``.half_open`` / ``.closed`` /
+``.fast_fail``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..metrics import metrics
+from ..utils.properties import SystemProperty
+
+__all__ = ["CircuitBreaker", "CircuitOpenError", "BreakerBoard",
+           "BREAKER_FAILURES", "BREAKER_RESET_MS"]
+
+BREAKER_FAILURES = SystemProperty("geomesa.breaker.failures", "5")
+BREAKER_RESET_MS = SystemProperty("geomesa.breaker.reset.ms", "5000")
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitOpenError(ConnectionError):
+    """Fast-fail: the endpoint's breaker is open. NOT retryable — the
+    point is to shed load off a known-dead endpoint immediately;
+    ``retry_after_s`` says when the next half-open probe is due."""
+
+    retryable = False
+
+    def __init__(self, name: str, retry_after_s: float):
+        super().__init__(
+            f"circuit open for {name!r} (retry in {retry_after_s:.2f}s)")
+        self.endpoint = name
+        self.retry_after_s = max(retry_after_s, 0.0)
+
+
+class CircuitBreaker:
+    """One endpoint's health gate. Callers bracket each attempt:
+
+        breaker.acquire()          # raises CircuitOpenError when open
+        ...transport attempt...
+        breaker.success() / breaker.failure()
+
+    Only TRANSPORT-level failures should be recorded as failures; an
+    application error in a well-formed response (404, 400) proves the
+    endpoint alive and should record success."""
+
+    def __init__(self, name: str = "", failure_threshold: int | None = None,
+                 reset_timeout_s: float | None = None,
+                 half_open_max: int = 1, clock=time.monotonic,
+                 registry=metrics):
+        self.name = name
+        self.failure_threshold = (BREAKER_FAILURES.as_int()
+                                  if failure_threshold is None
+                                  else int(failure_threshold))
+        self.reset_timeout_s = (
+            (BREAKER_RESET_MS.as_float() or 5000.0) / 1e3
+            if reset_timeout_s is None else float(reset_timeout_s))
+        self.half_open_max = int(half_open_max)
+        self._clock = clock
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probes_inflight = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def acquire(self):
+        with self._lock:
+            if self._state == CLOSED:
+                return
+            now = self._clock()
+            due = self._opened_at + self.reset_timeout_s
+            if self._state == OPEN:
+                if now < due:
+                    self._registry.counter("resilience.breaker.fast_fail")
+                    raise CircuitOpenError(self.name, due - now)
+                self._transition(HALF_OPEN)
+            # half-open: a bounded probe quota feels the endpoint out
+            if self._probes_inflight >= self.half_open_max:
+                self._registry.counter("resilience.breaker.fast_fail")
+                raise CircuitOpenError(self.name, self.reset_timeout_s)
+            self._probes_inflight += 1
+
+    def success(self):
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state != CLOSED:
+                self._probes_inflight = max(self._probes_inflight - 1, 0)
+                self._transition(CLOSED)
+
+    def failure(self):
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN:
+                self._probes_inflight = max(self._probes_inflight - 1, 0)
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+            elif self._state == CLOSED \
+                    and self._consecutive_failures >= self.failure_threshold:
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+
+    def _transition(self, state: str):
+        # lock held
+        if state != self._state:
+            self._state = state
+            if state == HALF_OPEN:
+                self._probes_inflight = 0
+            self._registry.counter(
+                f"resilience.breaker.{'opened' if state == OPEN else state}")
+
+
+class BreakerBoard:
+    """Lazily-built breaker per endpoint key (e.g. the REST route
+    segment), so one dead route fails fast without tripping the rest."""
+
+    def __init__(self, **breaker_kwargs):
+        self._kw = breaker_kwargs
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is None:
+                b = self._breakers[key] = CircuitBreaker(name=key,
+                                                         **self._kw)
+            return b
+
+    def states(self) -> dict[str, str]:
+        with self._lock:
+            return {k: b.state for k, b in self._breakers.items()}

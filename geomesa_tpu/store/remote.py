@@ -20,6 +20,7 @@ execution, Arrow results decoded back into columnar batches.
 
 from __future__ import annotations
 
+import http.client
 import io
 import json
 from typing import Any
@@ -29,6 +30,7 @@ import numpy as np
 
 from ..features.batch import FeatureBatch
 from ..features.sft import SimpleFeatureType, parse_spec
+from ..resilience import BreakerBoard, RetryBudget, RetryPolicy
 from ..index.api import FilterStrategy, Query, QueryHints
 from .api import DataStore
 
@@ -36,25 +38,82 @@ __all__ = ["RemoteDataStore"]
 
 
 class RemoteError(RuntimeError):
-    pass
+    """Server-reported failure. ``status`` is the HTTP code;
+    ``retryable`` tells RetryPolicy whether another attempt is safe
+    (5xx on idempotent calls, 503 sheds always — the server guarantees
+    a shed request was never executed)."""
+
+    def __init__(self, msg: str, status: int = 0,
+                 retryable: bool = False,
+                 retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.status = status
+        self.retryable = retryable
+        if retry_after_s is not None:
+            self.retry_after_s = retry_after_s
+
+
+def _breaker_counts(exc: BaseException) -> bool:
+    """Transport faults and 5xx responses trip the breaker; a
+    well-formed 4xx proves the endpoint alive."""
+    if isinstance(exc, RemoteError):
+        return exc.status >= 500
+    return isinstance(exc, (ConnectionError, TimeoutError, OSError,
+                            http.client.HTTPException))
 
 
 class RemoteDataStore(DataStore):
-    """DataStore client over the GeoMesaWebServer wire surface."""
+    """DataStore client over the GeoMesaWebServer wire surface.
+
+    Transient network faults are absorbed client-side (the role the
+    reference delegates to Accumulo/HBase client stacks): idempotent
+    calls — every GET, plus connect-phase failures and 503 sheds on
+    writes — retry with full-jitter backoff under a shared retry
+    budget, and a per-endpoint circuit breaker fast-fails once an
+    endpoint looks dead instead of burning ``timeout_s`` per call."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 60.0,
-                 auth_token: str | None = None):
+                 auth_token: str | None = None,
+                 retry_policy: RetryPolicy | None = None,
+                 breakers: BreakerBoard | None = None):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
         self.auth_token = auth_token  # bearer token for gated endpoints
         self._schemas: dict[str, SimpleFeatureType] = {}
+        self._retry = retry_policy if retry_policy is not None \
+            else RetryPolicy(budget=RetryBudget())
+        self._breakers = breakers if breakers is not None else BreakerBoard()
 
     # -- transport ---------------------------------------------------------
 
     def _request(self, method: str, path: str, params: dict | None = None,
-                 body: bytes | None = None):
-        import http.client
+                 body: bytes | None = None, idempotent: bool | None = None):
+        if idempotent is None:
+            idempotent = method == "GET"
+        # breaker per route segment ("/rest/query/t" -> "query"): one
+        # dead endpoint fails fast without gating the others
+        segs = path.strip("/").split("/")
+        endpoint = segs[1] if len(segs) > 1 else (segs[0] or "root")
+        breaker = self._breakers.get(endpoint)
+
+        def attempt():
+            breaker.acquire()  # CircuitOpenError fast-fail when open
+            try:
+                out = self._do_request(method, path, params, body,
+                                       idempotent)
+            except Exception as e:
+                if _breaker_counts(e):
+                    breaker.failure()
+                else:
+                    breaker.success()
+                raise
+            breaker.success()
+            return out
+
+        return self._retry.call(attempt, name=f"remote.{endpoint}")
+
+    def _do_request(self, method, path, params, body, idempotent):
         qs = ("?" + urlencode(params)) if params else ""
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout_s)
@@ -62,9 +121,23 @@ class RemoteDataStore(DataStore):
         if self.auth_token:
             headers["Authorization"] = f"Bearer {self.auth_token}"
         try:
-            conn.request(method, path + qs, body=body, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
+            try:
+                conn.connect()
+            except OSError as e:
+                # connect phase: nothing reached the server, always
+                # safe to retry — even for writes
+                e.retryable = True
+                raise
+            try:
+                conn.request(method, path + qs, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (ConnectionError, TimeoutError, OSError,
+                    http.client.HTTPException) as e:
+                # the request may have executed server-side; only
+                # idempotent calls can safely go again
+                e.retryable = idempotent
+                raise
             if resp.status == 404:
                 # the server maps KeyError -> 404; surface the SPI's
                 # unknown-type signal so the client stays a drop-in
@@ -78,7 +151,18 @@ class RemoteDataStore(DataStore):
                     msg = json.loads(data.decode()).get("error", "")
                 except Exception:
                     msg = data[:200].decode(errors="replace")
-                raise RemoteError(f"{resp.status} {path}: {msg}")
+                if resp.status == 503:
+                    # load shed: the server refused BEFORE executing,
+                    # so a retry is duplicate-safe for any method;
+                    # honor its explicit backpressure hint
+                    ra = resp.getheader("Retry-After")
+                    raise RemoteError(
+                        f"503 {path}: {msg}", status=503, retryable=True,
+                        retry_after_s=float(ra) if ra else None)
+                raise RemoteError(f"{resp.status} {path}: {msg}",
+                                  status=resp.status,
+                                  retryable=idempotent
+                                  and resp.status >= 500)
             return resp.getheader("Content-Type", ""), data
         finally:
             conn.close()

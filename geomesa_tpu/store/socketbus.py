@@ -40,13 +40,33 @@ import socketserver
 import struct
 import threading
 import time
+import uuid
+from collections import OrderedDict
 from typing import Callable
 
+from ..metrics import metrics
+from ..resilience import RetryPolicy
 from .filebus import (_SEQ_DIGITS, _decode, _encode, segment_name,
                       write_bytes_atomic, write_json_atomic)
 from .live import GeoMessage
 
-__all__ = ["SocketBroker", "SocketBus"]
+__all__ = ["SocketBroker", "SocketBus", "ProtocolError"]
+
+# frame hardening: declared lengths past these caps are garbage or
+# hostile input (port scan, HTTP probe) — reject BEFORE allocating,
+# not after an unbounded _recv_exact
+_MAX_HEADER_BYTES = 1 << 20    # 1 MiB of JSON header
+_MAX_PAYLOAD_BYTES = 1 << 28   # 256 MiB frame payload
+
+# how many publish idempotency keys the broker remembers per topic
+# (the dedup window for client retries)
+_PUB_KEY_WINDOW = 8192
+
+
+class ProtocolError(ConnectionError):
+    """Wire-protocol violation (oversized or truncated frame): the
+    stream position is unrecoverable, the connection must be dropped
+    and re-established."""
 
 
 def _send_frame(sock, header: dict, payload: bytes = b""):
@@ -66,6 +86,10 @@ def _recv_exact(sock, n: int) -> bytes:
 
 def _recv_frame(sock):
     hlen, plen = struct.unpack(">II", _recv_exact(sock, 8))
+    if hlen > _MAX_HEADER_BYTES or plen > _MAX_PAYLOAD_BYTES:
+        raise ProtocolError(
+            f"frame lengths {hlen}/{plen} exceed caps "
+            f"{_MAX_HEADER_BYTES}/{_MAX_PAYLOAD_BYTES}")
     header = json.loads(_recv_exact(sock, hlen).decode())
     payload = _recv_exact(sock, plen) if plen else b""
     return header, payload
@@ -80,6 +104,12 @@ class SocketBroker:
                  root: str | None = None):
         self._logs: dict[str, list[bytes]] = {}
         self._group_offsets: dict[str, dict[str, int]] = {}
+        # publish idempotency keys -> assigned seq, per topic (bounded
+        # window): a client retrying a publish whose ACK was lost gets
+        # the original seq back instead of a duplicate log entry
+        self._pub_keys: dict[str, OrderedDict[str, int]] = {}
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self.root = root
@@ -90,13 +120,16 @@ class SocketBroker:
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                broker._track(self.request)
                 try:
                     while True:
                         try:
                             header, payload = _recv_frame(self.request)
-                        except (json.JSONDecodeError, UnicodeDecodeError):
-                            # not our protocol (port scan, garbage):
-                            # drop the connection quietly
+                        except (json.JSONDecodeError, UnicodeDecodeError,
+                                ProtocolError):
+                            # not our protocol (port scan, garbage,
+                            # absurd declared lengths): drop the
+                            # connection quietly, allocate nothing
                             return
                         try:
                             broker._handle(self.request, header, payload)
@@ -105,8 +138,15 @@ class SocketBroker:
                                         {"error": f"bad request: {e}"})
                 except (ConnectionError, OSError, struct.error):
                     pass  # client went away
+                finally:
+                    broker._untrack(self.request)
 
-        self._srv = socketserver.ThreadingTCPServer((host, port), _Handler)
+        class _Server(socketserver.ThreadingTCPServer):
+            # a restarted broker must rebind its old port immediately
+            # (crash recovery), not wait out TIME_WAIT
+            allow_reuse_address = True
+
+        self._srv = _Server((host, port), _Handler)
         self._srv.daemon_threads = True
         self.host, self.port = self._srv.server_address[:2]
         self._thread = threading.Thread(target=self._srv.serve_forever,
@@ -117,8 +157,31 @@ class SocketBroker:
         return self
 
     def stop(self):
+        """Stop serving AND sever live client connections — a stopped
+        broker must look like a dead broker (clients see a closed
+        peer and run their reconnect path), not a half-alive one
+        whose surviving handler threads keep answering."""
         self._srv.shutdown()
         self._srv.server_close()
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _track(self, sock):
+        with self._conns_lock:
+            self._conns.add(sock)
+
+    def _untrack(self, sock):
+        with self._conns_lock:
+            self._conns.discard(sock)
 
     # -- request dispatch --------------------------------------------------
 
@@ -126,10 +189,24 @@ class SocketBroker:
         op = header.get("op")
         if op == "publish":
             topic = header["topic"]
+            key = header.get("key")
             with self._cond:
+                if key is not None:
+                    keys = self._pub_keys.setdefault(topic, OrderedDict())
+                    dup = keys.get(key)
+                    if dup is not None:
+                        # retried publish: already appended (and
+                        # persisted) under this key — ack, don't dup
+                        metrics.counter("resilience.socketbus.pub_dedup")
+                        _send_frame(sock, {"seq": dup, "dup": True})
+                        return
                 log = self._logs.setdefault(topic, [])
                 log.append(payload)
                 seq = len(log)
+                if key is not None:
+                    keys[key] = seq
+                    while len(keys) > _PUB_KEY_WINDOW:
+                        keys.popitem(last=False)
                 self._cond.notify_all()
             if self.root:
                 self._persist(topic, seq, payload)
@@ -229,19 +306,30 @@ class SocketBroker:
 
 class _Channel:
     """One broker connection + its lock (commands and long-polls ride
-    separate channels so a parked fetch never blocks a publish)."""
+    separate channels so a parked fetch never blocks a publish).
 
-    def __init__(self, host, port, timeout_s):
+    ``rpc`` reconnects transparently with backoff under ``policy``: a
+    reset connection (or a down broker, within the retry deadline) is
+    absorbed here, so callers only see failures that outlived the
+    policy. Safe because every broker op is idempotent at the protocol
+    level — fetch/offsets are reads against client-held offsets,
+    commit sets absolute offsets, and publish carries a dedup key."""
+
+    def __init__(self, host, port, timeout_s, policy=None):
         self.host, self.port, self.timeout_s = host, port, timeout_s
         self.lock = threading.Lock()
         self.sock = None
+        self.policy = policy if policy is not None else RetryPolicy()
+        self._ever_connected = False
 
-    def rpc(self, header: dict, payload: bytes = b"",
-            timeout_s: float | None = None):
+    def _attempt(self, header, payload, timeout_s):
         with self.lock:
             if self.sock is None:
                 self.sock = socket.create_connection(
                     (self.host, self.port), timeout=self.timeout_s)
+                if self._ever_connected:
+                    metrics.counter("resilience.socketbus.reconnects")
+                self._ever_connected = True
             self.sock.settimeout(timeout_s or self.timeout_s)
             try:
                 _send_frame(self.sock, header, payload)
@@ -252,6 +340,12 @@ class _Channel:
                 finally:
                     self.sock = None
                 raise
+
+    def rpc(self, header: dict, payload: bytes = b"",
+            timeout_s: float | None = None):
+        return self.policy.call(
+            lambda: self._attempt(header, payload, timeout_s),
+            name="socketbus")
 
     def close(self):
         with self.lock:
@@ -268,14 +362,15 @@ class SocketBus:
     offsets and long-poll wakeups."""
 
     def __init__(self, host: str, port: int, group: str = "default",
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0,
+                 retry_policy: RetryPolicy | None = None):
         self.host = host
         self.port = port
         self.group = group
         self.timeout_s = timeout_s
         self._subs: dict[str, list[Callable[[GeoMessage], None]]] = {}
-        self._cmd = _Channel(host, port, timeout_s)
-        self._fetch = _Channel(host, port, timeout_s)
+        self._cmd = _Channel(host, port, timeout_s, policy=retry_policy)
+        self._fetch = _Channel(host, port, timeout_s, policy=retry_policy)
         header, _ = self._cmd.rpc({"op": "offsets", "group": group})
         self._offsets: dict[str, int] = {
             k: int(v) for k, v in header.get("offsets", {}).items()}
@@ -302,8 +397,11 @@ class SocketBus:
     # -- producer / consumer -----------------------------------------------
 
     def publish(self, topic: str, msg: GeoMessage) -> int:
-        header, _ = self._cmd.rpc({"op": "publish", "topic": topic},
-                                  _encode(msg))
+        # the client-assigned idempotency key makes retried publishes
+        # (ACK lost to a reset) exactly-once: the broker dedups on it
+        header, _ = self._cmd.rpc(
+            {"op": "publish", "topic": topic, "key": uuid.uuid4().hex},
+            _encode(msg))
         return int(header["seq"])
 
     def subscribe(self, topic: str, fn: Callable[[GeoMessage], None]):
@@ -319,34 +417,57 @@ class SocketBus:
         topics = {t: self._offsets.get(t, 0) for t in list(self._subs)}
         if not topics:
             return 0
+        # the fetch channel reconnects under its retry policy: a
+        # broker restart mid-long-poll re-issues this fetch against
+        # the new broker, which resumes at our (server-committed)
+        # offsets — exactly-once from the last commit
         header, body = self._fetch.rpc(
             {"op": "fetch", "topics": topics, "max": max_messages,
              "wait_s": wait_s},
             timeout_s=self.timeout_s + wait_s)
         delivered = 0
         advanced = False
+        error: Exception | None = None
         pos = 0
-        for t, info in header.get("topics", {}).items():
-            off = self._offsets.get(t, 0)
-            count = int(info.get("count", 0))
-            for _ in range(count):
-                (mlen,) = struct.unpack(">I", body[pos:pos + 4])
-                raw = body[pos + 4:pos + 4 + mlen]
-                pos += 4 + mlen
-                off += 1
-                if not raw:
-                    continue  # replayed gap in the durable log
-                msg = _decode(raw)
-                # read the live subscriber list — consumer-side schema
-                # auto-create may append handlers mid-poll
-                for fn in self._subs.get(t, []):
-                    fn(msg)
-                delivered += 1
-            if count:
-                self._offsets[t] = off
-                advanced = True
+        try:
+            for t, info in header.get("topics", {}).items():
+                off = self._offsets.get(t, 0)
+                count = int(info.get("count", 0))
+                for _ in range(count):
+                    if pos + 4 > len(body):
+                        self._fetch.close()  # stream position is junk
+                        raise ProtocolError(
+                            f"truncated fetch body at {pos}/{len(body)}")
+                    (mlen,) = struct.unpack(">I", body[pos:pos + 4])
+                    if pos + 4 + mlen > len(body):
+                        self._fetch.close()
+                        raise ProtocolError(
+                            f"truncated fetch message ({mlen} declared, "
+                            f"{len(body) - pos - 4} available)")
+                    raw = body[pos + 4:pos + 4 + mlen]
+                    pos += 4 + mlen
+                    if raw:
+                        msg = _decode(raw)
+                        # read the live subscriber list — consumer-side
+                        # schema auto-create may append handlers mid-poll
+                        for fn in self._subs.get(t, []):
+                            fn(msg)
+                        delivered += 1
+                    # a message advances our offset only once every
+                    # handler ran; a raising subscriber leaves it due
+                    # for redelivery (at-least-once for that message)
+                    off += 1
+                    self._offsets[t] = off
+                    advanced = True
+        except Exception as e:
+            error = e
         if advanced:
+            # progress made before a failure still commits: a raising
+            # subscriber (or torn body) must not force redelivery of
+            # the messages that were already fully delivered
             self._commit()
+        if error is not None:
+            raise error
         return delivered
 
     def wait_for(self, predicate, timeout_s: float = 10.0,

@@ -20,15 +20,30 @@ stdlib http server — no framework dependency:
     GET  /rest/wal                          -> journal/WAL stats
     POST /rest/wal/checkpoint               (bearer-gated)
     POST /rest/wal/truncate?below=LSN       (bearer-gated)
+    GET  /rest/health                       -> liveness (always 200)
+    GET  /rest/ready                        -> readiness (503 if the
+         store is unreachable or the server is shedding load)
 
 Queries run the normal planner/scan path; arrow responses stream IPC
 bytes (content-type application/vnd.apache.arrow.file).
+
+Fault surface (resilience layer):
+
+- `geomesa.web.max.inflight` (unset = unlimited) caps concurrent
+  requests; excess requests are SHED with 503 + Retry-After before any
+  handler runs, so a retried shed is duplicate-safe even for writes.
+- Status codes distinguish retryability for clients: parse/plan errors
+  (ValueError, CQL/filter parse) are 400 (don't retry), unknown types
+  404, unexpected handler faults 500 (retryable on idempotent calls).
+- A client that disconnects mid-response (BrokenPipeError) is counted
+  (`resilience.web.client_disconnects`), not traceback-dumped.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, unquote, urlparse
 
@@ -36,6 +51,7 @@ import numpy as np
 
 from .. import __version__ as _version
 from ..index.api import Query, QueryHints
+from ..metrics import metrics
 from ..utils.properties import SystemProperty
 
 __all__ = ["GeoMesaWebServer"]
@@ -52,6 +68,13 @@ WEB_AUTH_TOKEN = SystemProperty("geomesa.web.auth.token", None)
 _GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas"),
           ("POST", "wal")}
 
+# load-shedding gate: max concurrent in-flight requests (unset ->
+# unlimited). Requests over the cap get 503 + Retry-After BEFORE any
+# handler state changes, so clients may retry them safely.
+WEB_MAX_INFLIGHT = SystemProperty("geomesa.web.max.inflight", None)
+# the Retry-After hint (seconds) a shed response carries
+WEB_RETRY_AFTER = SystemProperty("geomesa.web.retry.after.s", "1")
+
 
 class GeoMesaWebServer:
     """Bind a datastore to an HTTP port. ``start()`` serves on a daemon
@@ -64,7 +87,7 @@ class GeoMesaWebServer:
 
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
                  audit=None, auth_token: str | None = None,
-                 batcher=None):
+                 batcher=None, max_inflight: int | None = None):
         from ..scan.batcher import QueryBatcher
         self.store = store
         self.audit = audit if audit is not None \
@@ -74,8 +97,13 @@ class GeoMesaWebServer:
         if batcher is None and hasattr(store, "query_batched"):
             batcher = QueryBatcher(store)
         self.batcher = batcher
+        self.max_inflight = (max_inflight if max_inflight is not None
+                             else WEB_MAX_INFLIGHT.as_int())
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._started_at = time.monotonic()
         handler = _make_handler(self)
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd = _Httpd((host, port), handler)
         self._thread: threading.Thread | None = None
 
     @property
@@ -99,20 +127,80 @@ class GeoMesaWebServer:
 
     def handle(self, method: str, path: str, params: dict, body: bytes,
                headers=None):
-        """Route -> (status, content_type, payload bytes)."""
+        """Route -> (status, content_type, payload[, extra headers])."""
         parts = [unquote(p) for p in path.strip("/").split("/") if p]
         if not parts or parts[0] != "rest":
             return 404, "application/json", _j({"error": "not found"})
         parts = parts[1:]
-        if parts and (method, parts[0]) in _GATED \
-                and not self._authorized(headers):
-            return 403, "application/json", _j({"error": "forbidden"})
+        # health surface bypasses auth AND the shed gate: probes must
+        # see an overloaded-but-alive server, not a 503 liveness fail
+        if method == "GET" and parts == ["health"]:
+            return 200, "application/json", _j(
+                {"status": "ok", "version": _version,
+                 "uptime_s": round(time.monotonic() - self._started_at, 3)})
+        if method == "GET" and parts == ["ready"]:
+            return self._ready()
+        if not self._acquire_slot():
+            metrics.counter("resilience.web.sheds")
+            retry_after = WEB_RETRY_AFTER.get() or "1"
+            return (503, "application/json",
+                    _j({"error": "overloaded: in-flight request cap "
+                                 "reached", "retryable": True}),
+                    {"Retry-After": retry_after})
         try:
-            return self._route(method, parts, params, body)
-        except KeyError as e:
-            return 404, "application/json", _j({"error": str(e)})
-        except Exception as e:  # surface planner/parse errors as 400s
-            return 400, "application/json", _j({"error": repr(e)})
+            if parts and (method, parts[0]) in _GATED \
+                    and not self._authorized(headers):
+                return 403, "application/json", _j({"error": "forbidden"})
+            try:
+                return self._route(method, parts, params, body)
+            except KeyError as e:
+                return 404, "application/json", _j({"error": str(e)})
+            except ValueError as e:
+                # parse/plan errors (CQL/filter parse is a ValueError
+                # subclass): the request is malformed, do NOT retry
+                return 400, "application/json", _j({"error": repr(e)})
+            except Exception as e:
+                # unexpected server fault: 500 so clients know the
+                # request (not the server's health) might still be fine
+                metrics.counter("resilience.web.errors")
+                return 500, "application/json", _j({"error": repr(e)})
+        finally:
+            self._release_slot()
+
+    def _ready(self):
+        """Readiness: the store answers and we're under the shed cap.
+        Load balancers drain on 503 here while /rest/health stays 200."""
+        with self._inflight_lock:
+            inflight = self._inflight
+        shedding = (self.max_inflight is not None
+                    and inflight >= self.max_inflight)
+        store_ok = True
+        try:
+            self.store.get_type_names()
+        except Exception:
+            store_ok = False
+        ready = store_ok and not shedding
+        body = _j({"ready": ready, "store_ok": store_ok,
+                   "inflight": inflight,
+                   "max_inflight": self.max_inflight})
+        if ready:
+            return 200, "application/json", body
+        return (503, "application/json", body,
+                {"Retry-After": WEB_RETRY_AFTER.get() or "1"})
+
+    def _acquire_slot(self) -> bool:
+        with self._inflight_lock:
+            if self.max_inflight is not None \
+                    and self._inflight >= self.max_inflight:
+                return False
+            self._inflight += 1
+            metrics.gauge("resilience.web.inflight", self._inflight)
+            return True
+
+    def _release_slot(self):
+        with self._inflight_lock:
+            self._inflight -= 1
+            metrics.gauge("resilience.web.inflight", self._inflight)
 
     def _authorized(self, headers) -> bool:
         if not self.auth_token:
@@ -309,6 +397,19 @@ class GeoMesaWebServer:
              "grid": np.asarray(grid).tolist()})
 
 
+class _Httpd(ThreadingHTTPServer):
+    def handle_error(self, request, client_address):
+        # a client vanishing mid-exchange (reset, broken pipe — e.g.
+        # the internal wfile.flush after our handler) is routine on a
+        # real network; anything else keeps the stock traceback dump
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            metrics.counter("resilience.web.client_disconnects")
+            return
+        super().handle_error(request, client_address)
+
+
 def _j(obj) -> bytes:
     return json.dumps(obj, default=_default).encode()
 
@@ -337,13 +438,23 @@ def _make_handler(server: GeoMesaWebServer):
             params = parse_qs(u.query)
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
-            status, ctype, payload = server.handle(
+            out = server.handle(
                 self.command, u.path, params, body, headers=self.headers)
-            self.send_response(status)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
+            status, ctype, payload = out[:3]
+            extra = out[3] if len(out) > 3 else {}
+            try:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in extra.items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                self.wfile.write(payload)
+            except (BrokenPipeError, ConnectionResetError):
+                # the client hung up mid-response: its problem, not a
+                # server fault — count it, don't dump a traceback
+                metrics.counter("resilience.web.client_disconnects")
+                self.close_connection = True
 
         do_GET = do_POST = do_DELETE = _respond
 

@@ -25,3 +25,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running benchmarks excluded from tier-1 "
         "runs (-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection suites driving the chaos "
+        "proxy / broker kills (select with -m chaos)")

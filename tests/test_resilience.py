@@ -1,0 +1,616 @@
+"""Resilience layer: retry policy / circuit breaker units, chaos-proxy
+fault injection, and end-to-end recovery of the networked tier —
+RemoteDataStore query equivalence under connection resets, SocketBus
+reconnect + resume across a broker kill/restart, publish dedup under
+retries, frame hardening, and partial-progress offset commits."""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.metrics import metrics
+from geomesa_tpu.metrics.registry import MetricsRegistry
+from geomesa_tpu.resilience import (BreakerBoard, ChaosProxy,
+                                    CircuitBreaker, CircuitOpenError,
+                                    RetryBudget, RetryPolicy)
+from geomesa_tpu.store import InMemoryDataStore, RemoteDataStore
+from geomesa_tpu.store.live import GeoMessage
+from geomesa_tpu.store.socketbus import (ProtocolError, SocketBroker,
+                                         SocketBus)
+from geomesa_tpu.web import GeoMesaWebServer
+
+SPEC = "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326"
+
+
+class _MaxRng:
+    """Deterministic rng: backoff always lands on its ceiling."""
+
+    def uniform(self, a, b):
+        return b
+
+
+def _fast_policy(**kw):
+    """Aggressive reconnect policy so chaos tests converge quickly."""
+    kw.setdefault("max_attempts", 40)
+    kw.setdefault("base_s", 0.02)
+    kw.setdefault("cap_s", 0.25)
+    kw.setdefault("total_deadline_s", 30.0)
+    return RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+
+
+class TestRetryPolicy:
+    def test_retries_transient_then_succeeds(self):
+        sleeps = []
+        p = RetryPolicy(max_attempts=5, base_s=0.01, cap_s=0.05,
+                        total_deadline_s=None, sleep=sleeps.append,
+                        registry=MetricsRegistry())
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise ConnectionResetError("transient")
+            return "ok"
+
+        assert p.call(fn) == "ok"
+        assert calls[0] == 3 and len(sleeps) == 2
+        assert all(0.0 <= s <= 0.05 for s in sleeps)
+
+    def test_backoff_is_capped_exponential(self):
+        p = RetryPolicy(max_attempts=10, base_s=0.1, cap_s=0.4,
+                        total_deadline_s=None, rng=_MaxRng())
+        assert p.backoff_s(1) == pytest.approx(0.1)
+        assert p.backoff_s(2) == pytest.approx(0.2)
+        assert p.backoff_s(3) == pytest.approx(0.4)
+        assert p.backoff_s(7) == pytest.approx(0.4)  # capped
+
+    def test_non_retryable_raises_immediately(self):
+        p = RetryPolicy(max_attempts=5, sleep=lambda s: None,
+                        registry=MetricsRegistry())
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            raise ValueError("bad input")  # untagged, not conn-shaped
+
+        with pytest.raises(ValueError):
+            p.call(fn)
+        assert calls[0] == 1
+
+    def test_retryable_tag_overrides_type(self):
+        p = RetryPolicy(max_attempts=5, sleep=lambda s: None,
+                        registry=MetricsRegistry())
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            e = ConnectionError("looks transient")
+            e.retryable = False  # raiser knows better
+            raise e
+
+        with pytest.raises(ConnectionError):
+            p.call(fn)
+        assert calls[0] == 1
+
+    def test_attempt_cap(self):
+        p = RetryPolicy(max_attempts=3, base_s=0.001, cap_s=0.001,
+                        total_deadline_s=None, sleep=lambda s: None,
+                        registry=MetricsRegistry())
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            p.call(fn)
+        assert calls[0] == 3
+
+    def test_total_deadline_bounds_the_call(self):
+        # first computed backoff (1s) already overshoots the 50ms
+        # deadline: give up after one attempt instead of sleeping
+        p = RetryPolicy(max_attempts=10, base_s=1.0, cap_s=1.0,
+                        total_deadline_s=0.05, rng=_MaxRng(),
+                        sleep=lambda s: None, registry=MetricsRegistry())
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            p.call(fn)
+        assert calls[0] == 1
+
+    def test_server_retry_after_overrides_backoff(self):
+        sleeps = []
+        p = RetryPolicy(max_attempts=3, base_s=10.0, cap_s=10.0,
+                        total_deadline_s=None, sleep=sleeps.append,
+                        registry=MetricsRegistry())
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            if calls[0] == 1:
+                e = ConnectionError("shed")
+                e.retry_after_s = 0.123
+                raise e
+            return "ok"
+
+        assert p.call(fn) == "ok"
+        assert sleeps == [0.123]
+
+    def test_budget_bounds_retry_amplification(self):
+        budget = RetryBudget(capacity=1.0, ratio=0.0)
+        p = RetryPolicy(max_attempts=10, base_s=0.001, cap_s=0.001,
+                        total_deadline_s=None, budget=budget,
+                        sleep=lambda s: None, registry=MetricsRegistry())
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            p.call(fn)
+        # one token = one retry; the second retry is refused
+        assert calls[0] == 2
+        assert budget.tokens == 0.0
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+
+
+class TestCircuitBreaker:
+    def _breaker(self, clock, **kw):
+        kw.setdefault("failure_threshold", 2)
+        kw.setdefault("reset_timeout_s", 5.0)
+        return CircuitBreaker("ep", clock=lambda: clock[0],
+                              registry=MetricsRegistry(), **kw)
+
+    def test_opens_after_consecutive_failures_and_fast_fails(self):
+        clock = [0.0]
+        b = self._breaker(clock)
+        for _ in range(2):
+            b.acquire()
+            b.failure()
+        assert b.state == "open"
+        with pytest.raises(CircuitOpenError) as ei:
+            b.acquire()
+        assert ei.value.retry_after_s <= 5.0
+
+    def test_success_resets_consecutive_count(self):
+        clock = [0.0]
+        b = self._breaker(clock)
+        b.acquire(); b.failure()
+        b.acquire(); b.success()
+        b.acquire(); b.failure()
+        assert b.state == "closed"  # never 2 in a row
+
+    def test_half_open_probe_success_closes(self):
+        clock = [0.0]
+        b = self._breaker(clock)
+        b.acquire(); b.failure()
+        b.acquire(); b.failure()
+        clock[0] = 6.0  # past the reset timeout
+        b.acquire()     # the probe goes through
+        assert b.state == "half_open"
+        with pytest.raises(CircuitOpenError):
+            b.acquire()  # probe quota is 1: others still fast-fail
+        b.success()
+        assert b.state == "closed"
+
+    def test_half_open_probe_failure_reopens(self):
+        clock = [0.0]
+        b = self._breaker(clock)
+        b.acquire(); b.failure()
+        b.acquire(); b.failure()
+        clock[0] = 6.0
+        b.acquire()
+        b.failure()
+        assert b.state == "open"
+        clock[0] = 8.0  # reset window restarted at the probe failure
+        with pytest.raises(CircuitOpenError):
+            b.acquire()
+
+    def test_board_isolates_endpoints(self):
+        board = BreakerBoard(failure_threshold=1, reset_timeout_s=60,
+                             registry=MetricsRegistry())
+        board.get("query").acquire()
+        board.get("query").failure()
+        with pytest.raises(CircuitOpenError):
+            board.get("query").acquire()
+        board.get("write").acquire()  # separate endpoint unaffected
+        assert board.states() == {"query": "open", "write": "closed"}
+
+
+# ---------------------------------------------------------------------------
+# ChaosProxy
+
+
+def _echo_upstream():
+    """Tiny echo server to proxy at."""
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(8)
+
+    def serve():
+        while True:
+            try:
+                c, _ = lst.accept()
+            except OSError:
+                return
+
+            def pump(c=c):
+                try:
+                    while True:
+                        data = c.recv(65536)
+                        if not data:
+                            return
+                        c.sendall(data)
+                except OSError:
+                    pass
+                finally:
+                    c.close()
+
+            threading.Thread(target=pump, daemon=True).start()
+
+    threading.Thread(target=serve, daemon=True).start()
+    return lst
+
+
+class TestChaosProxy:
+    def test_clean_passthrough(self):
+        up = _echo_upstream()
+        proxy = ChaosProxy(*up.getsockname()).start()
+        try:
+            s = socket.create_connection((proxy.host, proxy.port),
+                                         timeout=5)
+            s.sendall(b"hello")
+            assert s.recv(16) == b"hello"
+            s.close()
+            assert proxy.stats["connections"] == 1
+            assert proxy.stats["resets"] == 0
+        finally:
+            proxy.stop()
+            up.close()
+
+    def test_reset_injection(self):
+        up = _echo_upstream()
+        proxy = ChaosProxy(*up.getsockname(), reset_rate=1.0,
+                           seed=11).start()
+        try:
+            s = socket.create_connection((proxy.host, proxy.port),
+                                         timeout=5)
+            s.settimeout(5)
+            with pytest.raises(OSError):
+                # push until the injected cut point trips (< 4096B)
+                for _ in range(64):
+                    s.sendall(b"x" * 1024)
+                    s.recv(4096)
+                raise AssertionError("proxy never cut the connection")
+            assert proxy.stats["resets"] >= 1
+        finally:
+            proxy.stop()
+            up.close()
+
+    def test_delay_injection(self):
+        up = _echo_upstream()
+        proxy = ChaosProxy(*up.getsockname(), delay_s=0.05).start()
+        try:
+            s = socket.create_connection((proxy.host, proxy.port),
+                                         timeout=5)
+            t0 = time.monotonic()
+            s.sendall(b"ping")
+            assert s.recv(16) == b"ping"
+            assert time.monotonic() - t0 >= 0.05
+            s.close()
+        finally:
+            proxy.stop()
+            up.close()
+
+    def test_blackhole_forces_client_timeout(self):
+        up = _echo_upstream()
+        proxy = ChaosProxy(*up.getsockname(), blackhole=True).start()
+        try:
+            s = socket.create_connection((proxy.host, proxy.port),
+                                         timeout=0.3)
+            s.sendall(b"anyone there?")
+            with pytest.raises(TimeoutError):
+                s.recv(16)
+            assert proxy.stats["blackholed"] == 1
+        finally:
+            proxy.stop()
+            up.close()
+
+    def test_drop_all_cuts_live_connections(self):
+        up = _echo_upstream()
+        proxy = ChaosProxy(*up.getsockname()).start()
+        try:
+            s = socket.create_connection((proxy.host, proxy.port),
+                                         timeout=5)
+            s.sendall(b"a")
+            assert s.recv(4) == b"a"
+            proxy.drop_all()
+            s.settimeout(5)
+            with pytest.raises(OSError):
+                got = s.recv(4)
+                if not got:
+                    raise ConnectionError("peer closed")
+        finally:
+            proxy.stop()
+            up.close()
+
+
+# ---------------------------------------------------------------------------
+# RemoteDataStore under chaos
+
+
+def _seeded_store(n=800):
+    rng = np.random.default_rng(42)
+    sft = parse_spec("pts", SPEC)
+    ds = InMemoryDataStore()
+    ds.create_schema(sft)
+    ds.write("pts", FeatureBatch.from_dict(
+        sft, [f"p{i}" for i in range(n)],
+        {"name": [f"n{i % 13}" for i in range(n)],
+         "age": np.arange(n),
+         "dtg": rng.integers(0, 10**12, n),
+         "geom": (rng.uniform(-100, -60, n), rng.uniform(25, 50, n))}))
+    return ds
+
+
+@pytest.mark.chaos
+class TestRemoteChaos:
+    def test_query_equivalence_under_resets_and_jitter(self):
+        """Acceptance: a 1k-query run through a proxy injecting 1%
+        connection resets (+ delay jitter) completes with ZERO
+        client-visible errors and ids identical to the fault-free
+        path."""
+        srv = GeoMesaWebServer(_seeded_store()).start()
+        proxy = ChaosProxy("127.0.0.1", srv.port, reset_rate=0.01,
+                           jitter_s=0.002, seed=7).start()
+        try:
+            direct = RemoteDataStore("127.0.0.1", srv.port)
+            faulty = RemoteDataStore(
+                "127.0.0.1", proxy.port, timeout_s=10.0,
+                retry_policy=_fast_policy())
+            rng = np.random.default_rng(3)
+            for _ in range(1000):
+                x0 = rng.uniform(-100, -65)
+                y0 = rng.uniform(25, 46)
+                cql = (f"BBOX(geom, {x0}, {y0}, "
+                       f"{x0 + rng.uniform(1, 10)}, "
+                       f"{y0 + rng.uniform(1, 6)})")
+                want = sorted(str(i) for i in
+                              direct.query(cql, "pts").ids)
+                got = sorted(str(i) for i in
+                             faulty.query(cql, "pts").ids)
+                assert got == want
+            # the run was actually faulty, not a lucky clean pass
+            assert proxy.stats["resets"] > 0
+        finally:
+            proxy.stop()
+            srv.stop()
+
+    def test_breaker_fast_fails_without_burning_timeout(self):
+        """Acceptance: against a dead (blackholed) server the breaker
+        opens and subsequent calls fail in microseconds, not one
+        socket timeout per call."""
+        srv = GeoMesaWebServer(_seeded_store(10)).start()
+        proxy = ChaosProxy("127.0.0.1", srv.port, blackhole=True).start()
+        try:
+            ds = RemoteDataStore(
+                "127.0.0.1", proxy.port, timeout_s=0.4,
+                retry_policy=RetryPolicy(max_attempts=1,
+                                         registry=MetricsRegistry()),
+                breakers=BreakerBoard(failure_threshold=2,
+                                      reset_timeout_s=30.0))
+            for _ in range(2):  # burn the threshold (timeout each)
+                with pytest.raises(OSError):
+                    ds.get_type_names()
+            t0 = time.perf_counter()
+            with pytest.raises(CircuitOpenError):
+                ds.get_type_names()
+            assert time.perf_counter() - t0 < 0.1
+        finally:
+            proxy.stop()
+            srv.stop()
+
+    def test_write_retries_connect_phase_only(self):
+        """A write against a down server (connect refused) retries and
+        succeeds once the server is back — connect-phase failures are
+        duplicate-safe for any method."""
+        store = _seeded_store(10)
+        sink = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sink.bind(("127.0.0.1", 0))
+        port = sink.getsockname()[1]
+        sink.close()  # nothing listening on `port` yet
+        # permissive breaker: this test exercises the RETRY path; the
+        # breaker's down-server behavior is asserted separately above
+        ds = RemoteDataStore("127.0.0.1", port,
+                             retry_policy=_fast_policy(),
+                             breakers=BreakerBoard(failure_threshold=100))
+        srv_box = {}
+
+        def bring_up():
+            time.sleep(0.4)
+            srv_box["srv"] = GeoMesaWebServer(store, port=port).start()
+
+        th = threading.Thread(target=bring_up)
+        th.start()
+        try:
+            sft = store.get_schema("pts")
+            ds.write("pts", FeatureBatch.from_dict(
+                sft, ["w0"], {"name": ["late"], "age": np.array([1]),
+                              "dtg": np.array([5]),
+                              "geom": (np.array([-70.0]),
+                                       np.array([30.0]))}))
+            assert store.count("pts") == 11
+        finally:
+            th.join()
+            srv_box["srv"].stop()
+
+
+# ---------------------------------------------------------------------------
+# SocketBus under chaos
+
+
+def _msg(i):
+    return GeoMessage("delete", "t", ids=(f"m{i}",))
+
+
+@pytest.mark.chaos
+class TestSocketBusChaos:
+    def test_broker_kill_restart_mid_long_poll_resumes_committed(
+            self, tmp_path):
+        """Acceptance: kill + restart a root=-backed broker while a
+        consumer is parked in a long poll; the consumer reconnects and
+        resumes at its committed offset — no duplicates, no loss."""
+        root = str(tmp_path / "log")
+        b1 = SocketBroker(root=root).start()
+        host, port = b1.host, b1.port
+        prod = SocketBus(host, port, group="prod",
+                         retry_policy=_fast_policy())
+        got = []
+        cons = SocketBus(host, port, group="cons",
+                         retry_policy=_fast_policy())
+        cons.subscribe("t", lambda m: got.append(m.ids[0]))
+        for i in range(3):
+            prod.publish("t", _msg(i))
+        assert cons.poll() == 3
+        assert cons.offset("t") == 3
+
+        result = {}
+
+        def consume():
+            result["n"] = cons.poll(wait_s=15.0)
+
+        th = threading.Thread(target=consume)
+        th.start()
+        time.sleep(0.3)          # consumer is parked in the broker
+        b1.stop()                # broker dies mid-long-poll
+        time.sleep(0.2)
+        b2 = SocketBroker(port=port, root=root).start()  # recovery
+        try:
+            for i in range(3, 5):
+                prod.publish("t", _msg(i))  # prod reconnects too
+            th.join(timeout=20)
+            assert not th.is_alive()
+            # the reconnected fetch may wake on the first new publish
+            # alone; drain the rest with follow-up polls
+            assert result["n"] >= 1
+            deadline = time.monotonic() + 10
+            while len(got) < 5 and time.monotonic() < deadline:
+                cons.poll(wait_s=0.5)
+            assert got == [f"m{i}" for i in range(5)]  # no dup, no loss
+            assert cons.offset("t") == 5
+        finally:
+            b2.stop()
+
+    def test_publish_retries_never_duplicate_through_resets(self):
+        """Publishes ride retried connections through a resetting
+        proxy; the idempotency key dedups broker-side, so the log has
+        each message exactly once, in order."""
+        broker = SocketBroker().start()
+        # rate 1.0: EVERY connection dies within its first 4 KiB — the
+        # persistent command channel is cut over and over, including
+        # between a publish landing broker-side and its ACK arriving
+        proxy = ChaosProxy(broker.host, broker.port, reset_rate=1.0,
+                           seed=5).start()
+        try:
+            pub = SocketBus(proxy.host, proxy.port, group="p",
+                            retry_policy=_fast_policy())
+            for i in range(30):
+                pub.publish("t", _msg(i))
+            # proof the path was actually faulty
+            assert proxy.stats["resets"] > 0
+            got = []
+            cons = SocketBus(broker.host, broker.port, group="c")
+            cons.subscribe("t", lambda m: got.append(m.ids[0]))
+            cons.poll()
+            assert got == [f"m{i}" for i in range(30)]
+        finally:
+            proxy.stop()
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# SocketBus hardening (satellites)
+
+
+@pytest.fixture
+def broker():
+    b = SocketBroker().start()
+    yield b
+    b.stop()
+
+
+class TestFrameHardening:
+    def test_oversized_declared_length_drops_connection(self, broker):
+        s = socket.create_connection((broker.host, broker.port),
+                                     timeout=5)
+        s.settimeout(5)
+        # declared 1 GiB payload: the broker must hang up, not allocate
+        s.sendall(struct.pack(">II", 8, 1 << 30))
+        assert s.recv(1) == b""
+        s.close()
+        # and the broker still serves well-formed clients
+        bus = SocketBus(broker.host, broker.port, group="after")
+        assert bus.publish("t", _msg(0)) == 1
+
+    def test_truncated_fetch_body_raises_protocol_error(self, broker):
+        bus = SocketBus(broker.host, broker.port, group="g")
+        bus.subscribe("t", lambda m: None)
+        bus._fetch.rpc = lambda header, payload=b"", timeout_s=None: (
+            {"topics": {"t": {"count": 2}}},
+            struct.pack(">I", 10) + b"abc")  # 10 declared, 3 present
+        with pytest.raises(ProtocolError):
+            bus.poll()
+        assert bus.offset("t") == 0  # nothing was delivered
+
+    def test_truncated_length_prefix_raises_protocol_error(self, broker):
+        bus = SocketBus(broker.host, broker.port, group="g2")
+        bus.subscribe("t", lambda m: None)
+        bus._fetch.rpc = lambda header, payload=b"", timeout_s=None: (
+            {"topics": {"t": {"count": 1}}}, b"\x00\x01")  # < 4 bytes
+        with pytest.raises(ProtocolError):
+            bus.poll()
+
+
+class TestPollPartialProgress:
+    def test_failing_subscriber_keeps_delivered_offsets(self, broker):
+        pub = SocketBus(broker.host, broker.port, group="p")
+        for i in range(3):
+            pub.publish("t", _msg(i))
+        seen = []
+        fail_once = [True]
+
+        def handler(m):
+            if m.ids[0] == "m1" and fail_once:
+                fail_once.clear()
+                raise RuntimeError("poisoned handler")
+            seen.append(m.ids[0])
+
+        cons = SocketBus(broker.host, broker.port, group="c")
+        cons.subscribe("t", handler)
+        with pytest.raises(RuntimeError):
+            cons.poll()
+        # m0 was fully delivered: its offset advance survived the
+        # failure and was committed broker-side
+        assert cons.offset("t") == 1
+        assert SocketBus(broker.host, broker.port,
+                         group="c").offset("t") == 1
+        # redelivery resumes AT the failing message, not past it
+        assert cons.poll() == 2
+        assert seen == ["m0", "m1", "m2"]

@@ -371,3 +371,137 @@ class TestRemoteStoreSemantics:
                 ds.count("nope")
         finally:
             server.stop()
+
+
+class _SlowStore:
+    """Wraps a store so query() blocks until released, and hides
+    query_batched (AttributeError) so the server builds NO batcher and
+    the blocking query() is actually what a request thread sits in."""
+
+    def __init__(self, inner, entered, release):
+        self._inner = inner
+        self._entered = entered
+        self._release = release
+
+    def __getattr__(self, name):
+        if name == "query_batched":
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    def query(self, *a, **k):
+        self._entered.set()
+        self._release.wait(10.0)
+        return self._inner.query(*a, **k)
+
+
+class TestWebResilience:
+    """Health surface, error-status mapping, and the load-shedding
+    gate (geomesa.web.max.inflight)."""
+
+    def _request(self, port, path, method="GET"):
+        import urllib.error
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", method=method)
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, dict(r.headers), r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), e.read()
+
+    def test_health_and_ready(self, server):
+        st, _, body = _get(server, "/rest/health")
+        d = json.loads(body)
+        assert st == 200 and d["status"] == "ok" and d["uptime_s"] >= 0
+        st, _, body = _get(server, "/rest/ready")
+        d = json.loads(body)
+        assert st == 200 and d["ready"] is True and d["store_ok"] is True
+
+    def test_unexpected_fault_is_500_not_400(self):
+        # parse errors are the client's fault (400, don't retry);
+        # anything else escaping a handler is a server fault (500)
+        class Exploding:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                if name == "query_batched":
+                    raise AttributeError(name)
+                return getattr(self._inner, name)
+
+            def stats_query(self, *a, **k):
+                raise RuntimeError("disk on fire")
+
+        srv = GeoMesaWebServer(Exploding(seeded_store())).start()
+        try:
+            st, _, _ = self._request(
+                srv.port, "/rest/query/people?cql=%3C%3C%3C")
+            assert st == 400
+            st, _, body = self._request(
+                srv.port, "/rest/stats/people?stat=MinMax(age)")
+            assert st == 500
+            assert "disk on fire" in json.loads(body)["error"]
+        finally:
+            srv.stop()
+
+    def test_shed_503_with_retry_after(self):
+        import threading
+        entered, release = threading.Event(), threading.Event()
+        srv = GeoMesaWebServer(
+            _SlowStore(seeded_store(), entered, release),
+            max_inflight=1).start()
+        try:
+            results = {}
+
+            def slow_call():
+                results["slow"] = self._request(
+                    srv.port, "/rest/query/people?cql=INCLUDE")
+
+            t = threading.Thread(target=slow_call, daemon=True)
+            t.start()
+            assert entered.wait(5.0)
+            # the single slot is held: the next request is shed BEFORE
+            # any handler runs, with an explicit backpressure hint
+            st, hdrs, body = self._request(srv.port, "/rest/version")
+            assert st == 503
+            assert float(hdrs["Retry-After"]) > 0
+            assert json.loads(body)["retryable"] is True
+            # readiness drains (503) while liveness stays 200
+            st, _, _ = self._request(srv.port, "/rest/ready")
+            assert st == 503
+            st, _, _ = self._request(srv.port, "/rest/health")
+            assert st == 200
+            release.set()
+            t.join(5.0)
+            assert results["slow"][0] == 200
+            st, _, _ = self._request(srv.port, "/rest/ready")
+            assert st == 200
+        finally:
+            release.set()
+            srv.stop()
+
+    def test_remote_client_absorbs_shed(self):
+        # a shed 503 is duplicate-safe by contract, so RemoteDataStore
+        # retries it transparently — the caller never sees the 503
+        import threading
+        from geomesa_tpu.store.remote import RemoteDataStore
+        from geomesa_tpu.web.server import WEB_RETRY_AFTER
+        entered, release = threading.Event(), threading.Event()
+        srv = GeoMesaWebServer(
+            _SlowStore(seeded_store(), entered, release),
+            max_inflight=1).start()
+        WEB_RETRY_AFTER.set("0.05")
+        try:
+            t = threading.Thread(
+                target=lambda: self._request(
+                    srv.port, "/rest/query/people?cql=INCLUDE"),
+                daemon=True)
+            t.start()
+            assert entered.wait(5.0)
+            threading.Timer(0.2, release.set).start()
+            ds = RemoteDataStore("127.0.0.1", srv.port)
+            assert ds.count("people") == 100
+            t.join(5.0)
+        finally:
+            WEB_RETRY_AFTER.set(None)
+            release.set()
+            srv.stop()
