@@ -45,9 +45,12 @@ Prints ONE JSON line:
 
 Env knobs: GEOMESA_TPU_BENCH_N (10M), GEOMESA_TPU_BENCH_REPS (512),
 GEOMESA_TPU_BENCH_TRIALS (3), GEOMESA_TPU_BENCH_CONFIGS
-("1,2,3,4,5,6,7,northstar" — comma list to run a subset),
+("1,2,3,4,5,6,7,8,9,northstar" — comma list to run a subset; the
+`--only` CLI flag does the same and also accepts full result names,
+e.g. `--only 9_replicated_reads`),
 GEOMESA_TPU_BENCH_WAL_ROWS (1M — config #7 ingest/recovery size),
-GEOMESA_TPU_BENCH_CHAOS_QUERIES (300 — config #8 stream length).
+GEOMESA_TPU_BENCH_CHAOS_QUERIES (300 — config #8 stream length),
+GEOMESA_TPU_BENCH_REPL_QUERIES (400 — config #9 read stream length).
 
 Config #6 also honors the batcher's own knobs (utils/properties
 resolution: thread-local override -> env var -> default):
@@ -82,6 +85,18 @@ Config #8 exercises the resilience layer's knobs (same resolution):
       server load-shedding cap; excess requests get 503 + Retry-After
   geomesa.web.retry.after.s   / GEOMESA_WEB_RETRY_AFTER_S   (1) —
       the backpressure hint a shed response carries
+Config #9 exercises the replication layer's knobs (same resolution):
+  geomesa.repl.max.lag.lsn    / GEOMESA_REPL_MAX_LAG_LSN    (1000) —
+      per-query staleness bound in log records
+  geomesa.repl.max.lag.s      / GEOMESA_REPL_MAX_LAG_S      (10) —
+      per-query staleness bound in seconds since full catch-up
+  geomesa.repl.ack.replicas   / GEOMESA_REPL_ACK_REPLICAS   (1) —
+      replicas that must apply a write before it is acknowledged
+  geomesa.repl.promote.auto   / GEOMESA_REPL_PROMOTE_AUTO   (true) —
+      promote the most-caught-up replica when the primary probe fails
+  geomesa.breaker.window      / GEOMESA_BREAKER_WINDOW      (unset) —
+      sliding error-rate breaker window (calls); unset keeps the
+      consecutive-failures trip condition
 The web tier's write gate (not benched, documented for completeness):
   geomesa.web.auth.token      / GEOMESA_WEB_AUTH_TOKEN      (unset) —
       opt-in shared bearer token for POST /rest/write, POST
@@ -103,7 +118,7 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,6,7,8,northstar").split(","))
+                             "1,2,3,4,5,6,7,8,9,northstar").split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
 T0_DAY, T1_DAY = 17_000, 17_100
@@ -795,6 +810,163 @@ def bench_config8(rng):
     return out
 
 
+def bench_config9(rng):
+    """What replication buys: read scaling and survivable failover.
+
+    Phase 1 — read qps through a ReplicatedDataStore at 1/2/4 replicas
+    (same BBOX count stream; all replicas caught up, so every read is
+    staleness-eligible) plus the staleness-bound hit rate (fraction of
+    reads served by a replica rather than falling back to the primary).
+
+    Phase 2 — failover: writes flow through the router into a primary
+    fronted by a ChaosProxy; mid-ingest the primary dies (web server +
+    shipper down, proxy partitioned). Reported: wall time from first
+    failed probe to completed auto-promotion, and whether every
+    replication-ACKed write survived (the zero-loss contract)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from geomesa_tpu.features import parse_spec
+    from geomesa_tpu.metrics import metrics
+    from geomesa_tpu.replication import (Replica, ReplicatedDataStore,
+                                         WalShipper)
+    from geomesa_tpu.resilience import ChaosProxy, RetryPolicy
+    from geomesa_tpu.store import InMemoryDataStore
+    from geomesa_tpu.store.remote import RemoteDataStore
+    from geomesa_tpu.web import GeoMesaWebServer
+
+    nq = int(os.environ.get("GEOMESA_TPU_BENCH_REPL_QUERIES", 400))
+    n = 200_000
+    spec = "*geom:Point:srid=4326"
+    out = {"queries": nq, "n": n}
+
+    def boxes(seed):
+        q_rng = np.random.default_rng(seed)
+        for _ in range(nq):
+            x0 = float(q_rng.uniform(-170, 130))
+            y0 = float(q_rng.uniform(-80, 55))
+            yield f"BBOX(geom, {x0:.4f}, {y0:.4f}, {x0+5:.4f}, {y0+5:.4f})"
+
+    def wait_for(cond, timeout_s=30.0):
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            if cond():
+                return True
+            time.sleep(0.02)
+        return False
+
+    # -- phase 1: read scaling over replica count -------------------------
+    root = tempfile.mkdtemp(prefix="geomesa-bench9-")
+    try:
+        ds = InMemoryDataStore(durable_dir=os.path.join(root, "p"),
+                               wal_fsync="never")
+        ds.create_schema(parse_spec("pts9", spec))
+        ds.write_dict("pts9", np.arange(n).astype(str).astype(object),
+                      {"geom": (rng.uniform(-180, 180, n),
+                                rng.uniform(-90, 90, n))})
+        ship = WalShipper(ds.journal)
+        try:
+            for k in (1, 2, 4):
+                replicas = [Replica(ship.host, ship.port, name=f"r{i}")
+                            for i in range(k)]
+                router = ReplicatedDataStore(ds, replicas, ack_replicas=0,
+                                             max_lag_lsn=10_000,
+                                             max_lag_s=600)
+                try:
+                    tail = ds.journal.wal.last_lsn
+                    wait_for(lambda: all(r.applied_lsn >= tail
+                                         for r in replicas))
+                    for r in replicas:  # warm every replica's index
+                        r.query_count("BBOX(geom, 0, 0, 5, 5)", "pts9")
+                    c0 = metrics.snapshot()["counters"]
+                    t0 = time.perf_counter()
+                    for ecql in boxes(seed=90 + k):
+                        router.query_count(ecql, "pts9")
+                    wall = time.perf_counter() - t0
+                    c1 = metrics.snapshot()["counters"]
+                    on_replica = (c1.get("replication.reads.replica", 0)
+                                  - c0.get("replication.reads.replica", 0))
+                    out[f"replicas_{k}"] = {
+                        "read_qps": round(nq / wall, 1),
+                        "staleness_hit_rate": round(on_replica / nq, 3)}
+                finally:
+                    # keep the primary: detach replicas only
+                    for r in replicas:
+                        r.stop()
+                    router._probe_stop.set()
+        finally:
+            ship.stop()
+
+        # -- phase 2: chaos failover ----------------------------------
+        primary = InMemoryDataStore(durable_dir=os.path.join(root, "f"),
+                                    wal_fsync="never")
+        primary.create_schema(parse_spec("pts9", spec))
+        srv = GeoMesaWebServer(primary).start()
+        proxy = ChaosProxy("127.0.0.1", srv.port).start()
+        remote = RemoteDataStore(
+            "127.0.0.1", proxy.port, timeout_s=2.0,
+            retry_policy=RetryPolicy(max_attempts=2, base_s=0.02,
+                                     cap_s=0.05, total_deadline_s=1.0))
+        ship2 = WalShipper(primary.journal)
+        replicas = [Replica(ship2.host, ship2.port, name=f"f{i}")
+                    for i in range(2)]
+        router = ReplicatedDataStore(primary=remote, replicas=replicas,
+                                     ack_replicas=1, auto_promote=True,
+                                     probe_ms=50, probe_failures=2,
+                                     max_lag_lsn=10_000, max_lag_s=600)
+        acked, failed_writes = [], [0]
+        sft9 = parse_spec("pts9", spec)
+        stop_ingest = threading.Event()
+
+        def ingest():
+            batch_no = 0
+            while not stop_ingest.is_set():
+                ids = [f"w{batch_no}_{i}" for i in range(50)]
+                from geomesa_tpu.features import FeatureBatch
+                b = FeatureBatch.from_dict(
+                    sft9, ids, {"geom": (np.random.uniform(-10, 10, 50),
+                                         np.random.uniform(-10, 10, 50))})
+                try:
+                    router.write("pts9", b)
+                    acked.extend(ids)
+                except Exception:
+                    failed_writes[0] += 1
+                batch_no += 1
+
+        th = threading.Thread(target=ingest, daemon=True)
+        th.start()
+        try:
+            time.sleep(1.0)          # ingest under healthy conditions
+            srv.stop()               # primary dies mid-ingest
+            ship2.stop()
+            proxy.stop()
+            promoted = wait_for(
+                lambda: isinstance(router.primary, Replica), 15.0)
+            stop_ingest.set()
+            th.join(timeout=10)
+            st = router.replication_status()
+            survived = set()
+            if promoted:
+                res = router.query("INCLUDE", "pts9")
+                survived = set(res.ids.astype(str))
+            lost = [i for i in acked if i not in survived]
+            out["failover"] = {
+                "auto_promoted": bool(promoted),
+                "failover_s": st.get("failover_seconds"),
+                "acked_writes": len(acked),
+                "acked_lost": len(lost),
+                "zero_acked_loss": promoted and not lost,
+                "unacked_write_errors": failed_writes[0]}
+        finally:
+            stop_ingest.set()
+            router.close()
+            proxy.stop()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 # -- north star: store-level 100M BBOX+time p50 ---------------------------
 
 def _build_big_store(x, y, ms):
@@ -846,7 +1018,24 @@ def bench_northstar(ds, write_s, x, y, ms):
             "n": len(x), "hits": res.n, "ids_exact": bool(ok)}
 
 
-def main():
+def main(argv=None):
+    global CONFIGS
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="geomesa-tpu benchmark driver")
+    ap.add_argument("--only", action="append", default=None,
+                    metavar="CONFIG",
+                    help="run only these configs (repeatable or "
+                         "comma-separated); accepts the bare key ('9', "
+                         "'northstar') or the full result name "
+                         "('9_replicated_reads')")
+    args = ap.parse_args(argv)
+    if args.only:
+        # "9_replicated_reads" and "9" both select config 9
+        keys = [k for spec in args.only for k in spec.split(",") if k]
+        CONFIGS = {k if k == "northstar" or k.isdigit()
+                   else k.split("_", 1)[0] for k in keys}
+
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -893,6 +1082,9 @@ def main():
 
     if "8" in CONFIGS:
         out["configs"]["8_faulty_network"] = bench_config8(rng)
+
+    if "9" in CONFIGS:
+        out["configs"]["9_replicated_reads"] = bench_config9(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

@@ -23,6 +23,7 @@ from __future__ import annotations
 import http.client
 import io
 import json
+import time
 from typing import Any
 from urllib.parse import quote, urlencode
 
@@ -99,6 +100,7 @@ class RemoteDataStore(DataStore):
 
         def attempt():
             breaker.acquire()  # CircuitOpenError fast-fail when open
+            t0 = time.perf_counter()
             try:
                 out = self._do_request(method, path, params, body,
                                        idempotent)
@@ -109,6 +111,10 @@ class RemoteDataStore(DataStore):
                     breaker.success()
                 raise
             breaker.success()
+            # only successful attempts feed the latency EWMA: timeouts
+            # and resets would teach the p99 the timeout value, and the
+            # hedging delay it informs applies to healthy calls
+            self._breakers.observe(endpoint, time.perf_counter() - t0)
             return out
 
         return self._retry.call(attempt, name=f"remote.{endpoint}")
@@ -211,12 +217,16 @@ class RemoteDataStore(DataStore):
         sink = io.BytesIO()
         with pa.ipc.new_file(sink, table.schema) as w:
             w.write_table(table)
-        self._json("POST", f"/rest/write/{quote(type_name)}",
-                   body=sink.getvalue())
+        out = self._json("POST", f"/rest/write/{quote(type_name)}",
+                         body=sink.getvalue())
+        # durable-LSN stamp when the server journals: the replication
+        # router waits on it for its replica ack
+        return out.get("lsn")
 
     def delete(self, type_name: str, ids):
-        self._json("POST", f"/rest/delete/{quote(type_name)}",
-                   body=json.dumps([str(i) for i in ids]).encode())
+        out = self._json("POST", f"/rest/delete/{quote(type_name)}",
+                         body=json.dumps([str(i) for i in ids]).encode())
+        return out.get("lsn")
 
     # -- queries -----------------------------------------------------------
 
@@ -302,3 +312,32 @@ class RemoteDataStore(DataStore):
                           "bbox": ",".join(str(v) for v in bbox),
                           "width": width, "height": height})
         return np.asarray(out["grid"], dtype=np.float32)
+
+    # -- health / replication ------------------------------------------------
+
+    def probe_health(self, timeout_s: float = 1.0) -> bool:
+        """One direct liveness probe: no retries, no breaker, short
+        timeout — the replication router's failure detector must see
+        the primary's real state NOW, not a retry-masked one."""
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout_s)
+        try:
+            conn.request("GET", "/rest/health")
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status == 200
+        except (ConnectionError, TimeoutError, OSError,
+                http.client.HTTPException):
+            return False
+        finally:
+            conn.close()
+
+    def replication_status(self) -> dict:
+        """GET /rest/replication (server must front a replicated or
+        shipping store)."""
+        return self._json("GET", "/rest/replication")
+
+    def promote(self) -> dict:
+        """POST /rest/replication/promote (bearer-gated like the other
+        mutating admin routes)."""
+        return self._json("POST", "/rest/replication/promote")

@@ -15,6 +15,7 @@ Subcommands (mirroring the reference's tools/ command set):
     sql             --path R 'SELECT ... WHERE ST_...'
     serve           --path R [--host H] [--port P]
     wal inspect|replay|truncate --wal-dir D [--below-lsn N] [--token T]
+    replication status|promote --path remote://h:p [--token T]
     version / env
 """
 
@@ -322,6 +323,48 @@ def cmd_wal(args) -> int:
     return 2
 
 
+def cmd_replication(args) -> int:
+    """Replication administration against a serving node: ``status``
+    reads /rest/replication (router or shipper view), ``promote``
+    forces failover (bearer-gated like the other mutating admin
+    surfaces — --token rides as the Authorization header)."""
+    path = args.path
+    if not path.startswith("remote://"):
+        # local roots have no replication role to interrogate — the
+        # router/shipper live in a serving process, not on disk
+        print("replication commands need --path remote://host:port",
+              file=sys.stderr)
+        return 2
+    from ..store import RemoteDataStore
+    host, _, port = path[len("remote://"):].partition(":")
+    ds = RemoteDataStore(host or "127.0.0.1", int(port) if port else 8080,
+                         auth_token=getattr(args, "token", None))
+    if args.repl_command == "status":
+        json.dump(ds.replication_status(), sys.stdout, indent=2)
+        print()
+        return 0
+    if args.repl_command == "promote":
+        from ..store.remote import RemoteError
+        try:
+            out = ds.promote()
+        except KeyError as e:
+            # server's 404: the node has no router role to promote
+            print(f"promote refused: {e.args[0]}", file=sys.stderr)
+            return 2
+        except RemoteError as e:
+            if e.status == 403:
+                print("promote is gated: pass --token matching "
+                      "geomesa.web.auth.token", file=sys.stderr)
+                return 3
+            raise
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return 0
+    print(f"unknown replication command {args.repl_command!r}",
+          file=sys.stderr)
+    return 2
+
+
 def cmd_version(args) -> int:
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -403,6 +446,20 @@ def main(argv=None) -> int:
                             help="admin bearer token "
                                  "(geomesa.web.auth.token)")
         wp.set_defaults(fn=cmd_wal)
+
+    replp = sub.add_parser("replication",
+                           help="replication administration")
+    replsub = replp.add_subparsers(dest="repl_command", required=True)
+    for rname, rhelp in (("status", "router/shipper replication state"),
+                         ("promote", "force failover to the most "
+                                     "caught-up replica (token-gated)")):
+        rp = replsub.add_parser(rname, help=rhelp)
+        rp.add_argument("--path", required=True,
+                        help="serving node, remote://host:port")
+        rp.add_argument("--token", default=None,
+                        help="admin bearer token "
+                             "(geomesa.web.auth.token)")
+        rp.set_defaults(fn=cmd_replication)
 
     add("version", cmd_version, needs_store=False)
     add("env", cmd_env, needs_store=False)

@@ -401,11 +401,29 @@ class WriteAheadLog:
         """Yield (lsn, kind, payload) for every valid record with
         ``lsn >= from_lsn``, in LSN order. Stops at the first invalid
         frame in a segment (torn tail — already truncated on open for
-        the live tail; mid-history corruption ends replay there)."""
-        for first_lsn, path in self._segments():
+        the live tail; mid-history corruption ends replay there).
+
+        Segments wholly below ``from_lsn`` are skipped without being
+        opened — segment file names carry their first LSN, so a segment
+        whose successor starts at or below ``from_lsn`` cannot contain
+        anything to yield. Replication shippers tail this call in a
+        loop from an advancing cursor; without the skip every tail
+        iteration would rescan the whole log."""
+        segs = self._segments()
+        for i, (first_lsn, path) in enumerate(segs):
+            nxt = segs[i + 1][0] if i + 1 < len(segs) else None
+            if nxt is not None and nxt <= from_lsn:
+                continue  # every record in [first_lsn, nxt) < from_lsn
             out: list = []
-            _scan_segment(path, on_record=out.append,
-                          min_lsn=from_lsn)
+            try:
+                _scan_segment(path, on_record=out.append,
+                              min_lsn=from_lsn)
+            except FileNotFoundError:
+                # checkpoint truncation unlinked it between the listing
+                # and the open: it was wholly below the checkpoint LSN,
+                # so a reader positioned at/above the checkpoint loses
+                # nothing by skipping it
+                continue
             for rec in out:
                 yield rec
 

@@ -20,6 +20,8 @@ stdlib http server — no framework dependency:
     GET  /rest/wal                          -> journal/WAL stats
     POST /rest/wal/checkpoint               (bearer-gated)
     POST /rest/wal/truncate?below=LSN       (bearer-gated)
+    GET  /rest/replication                  -> router/shipper status
+    POST /rest/replication/promote          (bearer-gated failover)
     GET  /rest/health                       -> liveness (always 200)
     GET  /rest/ready                        -> readiness (503 if the
          store is unreachable or the server is shedding load)
@@ -66,7 +68,7 @@ WEB_AUTH_TOKEN = SystemProperty("geomesa.web.auth.token", None)
 # POST /rest/wal/* are the WAL admin mutations (checkpoint/truncate);
 # GET /rest/wal stays open (read-only stats)
 _GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas"),
-          ("POST", "wal")}
+          ("POST", "wal"), ("POST", "replication")}
 
 # load-shedding gate: max concurrent in-flight requests (unset ->
 # unlimited). Requests over the cap get 503 + Retry-After BEFORE any
@@ -137,7 +139,8 @@ class GeoMesaWebServer:
         if method == "GET" and parts == ["health"]:
             return 200, "application/json", _j(
                 {"status": "ok", "version": _version,
-                 "uptime_s": round(time.monotonic() - self._started_at, 3)})
+                 "uptime_s": round(time.monotonic() - self._started_at, 3),
+                 "resilience": self._resilience_detail()})
         if method == "GET" and parts == ["ready"]:
             return self._ready()
         if not self._acquire_slot():
@@ -187,6 +190,18 @@ class GeoMesaWebServer:
             return 200, "application/json", body
         return (503, "application/json", body,
                 {"Retry-After": WEB_RETRY_AFTER.get() or "1"})
+
+    def _resilience_detail(self) -> dict:
+        """Per-endpoint latency estimates for the health surface — the
+        observability half of hedged requests: operators (and a future
+        hedging client) read the p99-ish numbers the breaker boards
+        publish as ``resilience.latency.p99.<key>`` gauges."""
+        snap = metrics.snapshot()
+        prefix = "resilience.latency.p99."
+        latency = {k[len(prefix):]: round(v, 3)
+                   for k, v in snap.get("gauges", {}).items()
+                   if k.startswith(prefix)}
+        return {"latency_p99_ms": latency}
 
     def _acquire_slot(self) -> bool:
         with self._inflight_lock:
@@ -258,11 +273,13 @@ class GeoMesaWebServer:
                                  FeatureBatch.concat_all(batches),
                                  visibilities=vis)
             n = sum(b.n for b in batches)
-            return 200, "application/json", _j({"written": n})
+            return 200, "application/json", _j(
+                {"written": n, "lsn": self._tail_lsn()})
         if len(parts) == 2 and parts[0] == "delete" and method == "POST":
             ids = json.loads(body.decode())
             self.store.delete(parts[1], ids)
-            return 200, "application/json", _j({"deleted": len(ids)})
+            return 200, "application/json", _j(
+                {"deleted": len(ids), "lsn": self._tail_lsn()})
         if len(parts) == 2 and parts[0] == "stats":
             stat = self.store.stats_query(
                 parts[1], params.get("stat", ["Count()"])[0],
@@ -284,6 +301,8 @@ class GeoMesaWebServer:
                  "rows": [list(r) for r in res.rows()]})
         if parts and parts[0] == "wal":
             return self._wal(method, parts[1:], params)
+        if parts and parts[0] == "replication":
+            return self._replication(method, parts[1:])
         if parts == ["audit"]:
             if self.audit is None:
                 return 200, "application/json", _j([])
@@ -292,6 +311,37 @@ class GeoMesaWebServer:
                 int(params["since"][0]) if "since" in params else None)
             return 200, "application/json", _j(
                 [json.loads(e.to_json()) for e in evs])
+        return 404, "application/json", _j({"error": "not found"})
+
+    def _tail_lsn(self) -> int | None:
+        """The WAL position after a mutation (None for non-durable
+        stores). Replication routers fronting this server via
+        RemoteDataStore use it as the write's ACK watermark."""
+        journal = getattr(self.store, "journal", None)
+        return journal.wal.last_lsn if journal is not None else None
+
+    def _replication(self, method, parts):
+        """Replication admin. GET /rest/replication reports whichever
+        role this store plays: a ``ReplicatedDataStore`` answers with
+        router status, a primary with a ``WalShipper`` attached as
+        ``store.shipper`` answers with shipper status. POST
+        /rest/replication/promote (bearer-gated) forces failover."""
+        if method == "GET" and not parts:
+            status = getattr(self.store, "replication_status", None)
+            if callable(status):
+                return 200, "application/json", _j(status())
+            shipper = getattr(self.store, "shipper", None)
+            if shipper is not None:
+                return 200, "application/json", _j(shipper.status())
+            return 404, "application/json", _j(
+                {"error": "store has no replication role"})
+        if method == "POST" and parts == ["promote"]:
+            promote = getattr(self.store, "promote", None)
+            if not callable(promote):
+                return 404, "application/json", _j(
+                    {"error": "store cannot promote (not a replication "
+                              "router)"})
+            return 200, "application/json", _j(promote())
         return 404, "application/json", _j({"error": "not found"})
 
     def _wal(self, method, parts, params):

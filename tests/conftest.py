@@ -28,3 +28,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: fault-injection suites driving the chaos "
         "proxy / broker kills (select with -m chaos)")
+    config.addinivalue_line(
+        "markers", "repl: replication suites (WAL shipping, replica "
+        "catch-up, failover; select with -m repl)")

@@ -1,0 +1,302 @@
+"""Replication subsystem: WAL shipping, replica catch-up equivalence,
+checkpoint bootstrap, bounded-staleness read routing, replication acks,
+and promote-on-failure (manual and chaos-driven) with zero
+acknowledged-write loss."""
+
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.replication import (ReadOnlyReplicaError, Replica,
+                                     ReplicatedDataStore,
+                                     ReplicationAckTimeout, WalShipper)
+from geomesa_tpu.resilience import ChaosProxy, RetryPolicy
+from geomesa_tpu.store import InMemoryDataStore, RemoteDataStore
+from geomesa_tpu.web import GeoMesaWebServer
+
+SPEC = "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326"
+
+pytestmark = pytest.mark.repl
+
+
+def _primary(tmp_path):
+    ds = InMemoryDataStore(durable_dir=str(tmp_path / "primary"))
+    ds.create_schema(parse_spec("pts", SPEC))
+    return ds
+
+
+def _write(ds, ids):
+    """Write one batch of features keyed by ``ids`` (through any
+    DataStore — primary, router, promoted replica)."""
+    sft = parse_spec("pts", SPEC)
+    n = len(ids)
+    return ds.write("pts", FeatureBatch.from_dict(
+        sft, list(ids),
+        {"name": [f"n{i % 7}" for i in range(n)],
+         "age": np.arange(n),
+         "dtg": np.full(n, 10 ** 11),
+         "geom": (np.linspace(-99.0, -61.0, n),
+                  np.linspace(26.0, 49.0, n))}))
+
+
+def _ids(ds):
+    return sorted(ds.query("INCLUDE", "pts").ids)
+
+
+def _wait(cond, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _caught_up(primary, *replicas):
+    tail = primary.journal.wal.last_lsn
+    return lambda: all(r.applied_lsn >= tail for r in replicas)
+
+
+class TestReplicaCatchUp:
+    def test_id_for_id_equivalence_streaming(self, tmp_path):
+        """Acceptance: after catch-up, a replica answers queries
+        id-for-id identically to the primary — including deletes."""
+        primary = _primary(tmp_path)
+        _write(primary, [f"a{i}" for i in range(60)])
+        ship = WalShipper(primary.journal)
+        r = Replica(ship.host, ship.port, name="r1")
+        try:
+            # history written BEFORE attach, plus live tail after
+            _write(primary, [f"b{i}" for i in range(40)])
+            primary.delete("pts", ["a0", "a1", "b39"])
+            _wait(_caught_up(primary, r), what="replica catch-up")
+            assert _ids(r) == _ids(primary)
+            assert r.count("pts") == primary.count("pts")
+            assert r.query_count("age < 10", "pts") == \
+                primary.query_count("age < 10", "pts")
+            # replica stays converged as the tail advances
+            _write(primary, ["late1", "late2"])
+            _wait(_caught_up(primary, r), what="tail catch-up")
+            assert _ids(r) == _ids(primary)
+        finally:
+            r.stop()
+            ship.stop()
+
+    def test_replica_refuses_writes_until_promoted(self, tmp_path):
+        primary = _primary(tmp_path)
+        ship = WalShipper(primary.journal)
+        r = Replica(ship.host, ship.port, name="ro")
+        try:
+            with pytest.raises(ReadOnlyReplicaError):
+                _write(r, ["x"])
+            with pytest.raises(ReadOnlyReplicaError):
+                r.delete("pts", ["x"])
+            _wait(_caught_up(primary, r), what="schema record")
+            r.promote()
+            _write(r, ["x"])  # unlocked
+            assert r.count("pts") == 1
+        finally:
+            r.stop()
+            ship.stop()
+
+    def test_bootstrap_from_checkpoint(self, tmp_path):
+        """A replica joining after checkpoint truncation loads the
+        snapshot over the wire, then streams the remainder — and ends
+        id-for-id identical (deletes included)."""
+        primary = _primary(tmp_path)
+        _write(primary, [f"a{i}" for i in range(50)])
+        primary.delete("pts", ["a7", "a8"])
+        info = primary.journal.checkpoint(primary, keep=1)
+        assert info["lsn"] > 0
+        primary.journal.wal.truncate_below(info["lsn"])
+        _write(primary, [f"post{i}" for i in range(10)])
+
+        ship = WalShipper(primary.journal)
+        r = Replica(ship.host, ship.port, name="boot")
+        try:
+            _wait(_caught_up(primary, r), what="bootstrap catch-up")
+            assert r.bootstraps == 1
+            assert _ids(r) == _ids(primary)
+            assert "a7" not in set(_ids(r))
+        finally:
+            r.stop()
+            ship.stop()
+
+
+class TestRouter:
+    def test_reads_fan_to_replicas_writes_ack(self, tmp_path):
+        from geomesa_tpu.metrics import metrics
+        primary = _primary(tmp_path)
+        ship = WalShipper(primary.journal)
+        replicas = [Replica(ship.host, ship.port, name=f"r{i}")
+                    for i in range(2)]
+        router = ReplicatedDataStore(primary, replicas, ack_replicas=1,
+                                     max_lag_lsn=10_000, max_lag_s=60)
+        try:
+            before = metrics.snapshot()["counters"].get(
+                "replication.reads.replica", 0)
+            _write(router, [f"f{i}" for i in range(30)])
+            # the ack already guarantees >= 1 replica holds the write
+            lsn = primary.journal.wal.last_lsn
+            assert max(r.applied_lsn for r in replicas) >= lsn
+            _wait(_caught_up(primary, *replicas), what="both replicas")
+            for _ in range(4):
+                assert router.count("pts") == 30
+            assert sorted(router.query("INCLUDE", "pts").ids) == \
+                _ids(primary)
+            after = metrics.snapshot()["counters"].get(
+                "replication.reads.replica", 0)
+            assert after - before >= 5  # reads actually hit replicas
+            st = router.replication_status()
+            assert {e["name"] for e in st["replicas"]} == {"r0", "r1"}
+            assert all(e["eligible"] for e in st["replicas"])
+        finally:
+            router.close()
+            ship.stop()
+
+    def test_staleness_bound_falls_back_to_primary(self, tmp_path):
+        from geomesa_tpu.metrics import metrics
+        primary = _primary(tmp_path)
+        _write(primary, [f"f{i}" for i in range(20)])
+        # attached but never started: applied_lsn stays 0 (maximally
+        # stale), so any finite bound routes the read to the primary
+        stale = Replica("127.0.0.1", 1, name="stale", start=False)
+        router = ReplicatedDataStore(primary, [stale], ack_replicas=0)
+        try:
+            before = metrics.snapshot()["counters"].get(
+                "replication.reads.fallback", 0)
+            assert router.query_count(
+                "INCLUDE", "pts", max_lag_lsn=0) == 20
+            assert router.count("pts") == 20  # default bound: also stale
+            after = metrics.snapshot()["counters"].get(
+                "replication.reads.fallback", 0)
+            assert after - before == 2
+            st = router.replication_status()
+            assert st["replicas"][0]["eligible"] is False
+        finally:
+            router.close()
+
+    def test_unreplicated_write_times_out_ack(self, tmp_path):
+        primary = _primary(tmp_path)
+        mute = Replica("127.0.0.1", 1, name="mute", start=False)
+        router = ReplicatedDataStore(primary, [mute], ack_replicas=1)
+        router.ack_timeout_s = 0.3
+        try:
+            with pytest.raises(ReplicationAckTimeout):
+                _write(router, ["w1"])
+            # the write itself reached the primary (just not replicated)
+            assert primary.count("pts") == 1
+        finally:
+            router.close()
+
+    def test_ack_skipped_with_no_attached_replicas(self, tmp_path):
+        primary = _primary(tmp_path)
+        router = ReplicatedDataStore(primary, [], ack_replicas=2)
+        try:
+            _write(router, ["solo"])  # must not block or raise
+            assert router.count("pts") == 1
+        finally:
+            router.close()
+
+
+class TestFailover:
+    def test_manual_promote_keeps_acked_writes(self, tmp_path):
+        """Acceptance core: every write acknowledged before the primary
+        died is present after promotion (ack LSN <= replica applied LSN
+        => inside the promoted prefix)."""
+        primary = _primary(tmp_path)
+        ship = WalShipper(primary.journal)
+        replicas = [Replica(ship.host, ship.port, name=f"r{i}")
+                    for i in range(2)]
+        router = ReplicatedDataStore(primary, replicas, ack_replicas=1,
+                                     auto_promote=False)
+        acked = []
+        try:
+            for batch in range(5):
+                ids = [f"b{batch}_{i}" for i in range(10)]
+                _write(router, ids)
+                acked.extend(ids)
+            ship.stop()  # primary's shipping dies with it
+            info = router.promote()
+            assert info["promoted"] in {"r0", "r1"}
+            assert set(acked) <= set(_ids(router))
+            # the promoted store takes writes and serves reads
+            _write(router, ["after1", "after2"])
+            assert router.count("pts") == len(acked) + 2
+            st = router.replication_status()
+            assert st["promoted_to"] == info["promoted"]
+        finally:
+            router.close()
+
+    def test_promote_picks_most_caught_up(self, tmp_path):
+        primary = _primary(tmp_path)
+        ship = WalShipper(primary.journal)
+        ahead = Replica(ship.host, ship.port, name="ahead")
+        behind = Replica("127.0.0.1", 1, name="behind", start=False)
+        router = ReplicatedDataStore(primary, [ahead, behind],
+                                     ack_replicas=1, auto_promote=False)
+        try:
+            _write(router, [f"f{i}" for i in range(10)])
+            ship.stop()
+            info = router.promote()
+            assert info["promoted"] == "ahead"
+            assert "behind" in info["detached"]
+        finally:
+            router.close()
+
+
+@pytest.mark.chaos
+class TestChaosFailover:
+    def test_auto_promote_zero_acked_write_loss(self, tmp_path):
+        """Kill the primary mid-ingest (web server + shipper down,
+        proxy partitioned): the router's probe detects it, promotes the
+        most-caught-up replica automatically, every acknowledged write
+        survives, and reads keep working."""
+        primary = _primary(tmp_path)
+        srv = GeoMesaWebServer(primary).start()
+        proxy = ChaosProxy("127.0.0.1", srv.port).start()
+        remote = RemoteDataStore(
+            "127.0.0.1", proxy.port, timeout_s=2.0,
+            retry_policy=RetryPolicy(max_attempts=2, base_s=0.02,
+                                     cap_s=0.05, total_deadline_s=1.0))
+        ship = WalShipper(primary.journal)
+        replicas = [Replica(ship.host, ship.port, name=f"r{i}")
+                    for i in range(2)]
+        router = ReplicatedDataStore(primary=remote, replicas=replicas,
+                                     ack_replicas=1, auto_promote=True,
+                                     probe_ms=50, probe_failures=2,
+                                     max_lag_lsn=10_000, max_lag_s=60)
+        acked = []
+        try:
+            for batch in range(4):
+                ids = [f"b{batch}_{i}" for i in range(8)]
+                _write(router, ids)
+                acked.extend(ids)
+
+            # primary dies mid-ingest: server, shipper, and network
+            srv.stop()
+            ship.stop()
+            proxy.stop()
+            try:
+                _write(router, ["lost_in_flight"])
+            except Exception:
+                pass  # unacked: allowed to vanish
+
+            _wait(lambda: isinstance(router.primary, Replica),
+                  timeout_s=10.0, what="auto-promotion")
+            st = router.replication_status()
+            assert st["promoted_to"] in {"r0", "r1"}
+            assert st.get("failover_seconds", 0) >= 0
+
+            survived = set(_ids(router))
+            missing = set(acked) - survived
+            assert not missing, f"acked writes lost: {sorted(missing)}"
+            # service continues: reads and writes on the new primary
+            assert router.count("pts") >= len(acked)
+            _write(router, ["post_failover"])
+            assert "post_failover" in set(_ids(router))
+        finally:
+            router.close()
+            proxy.stop()

@@ -234,6 +234,133 @@ class TestCircuitBreaker:
         assert board.states() == {"query": "open", "write": "closed"}
 
 
+class TestWindowBreaker:
+    """Sliding error-rate trip condition (geomesa.breaker.window)."""
+
+    def _breaker(self, **kw):
+        kw.setdefault("window", 10)
+        kw.setdefault("error_rate", 0.5)
+        kw.setdefault("min_volume", 4)
+        kw.setdefault("reset_timeout_s", 5.0)
+        return CircuitBreaker("ep", clock=lambda: 0.0,
+                              registry=MetricsRegistry(), **kw)
+
+    def test_trips_on_error_rate_despite_interleaved_successes(self):
+        # strictly alternating failure/success: the consecutive counter
+        # never passes 1, but the 60% windowed error rate must trip
+        b = self._breaker()
+        for fail in (True, False, True, False, True):
+            b.acquire()
+            b.failure() if fail else b.success()
+        assert b.state == "open"
+
+    def test_min_volume_guards_cold_endpoints(self):
+        # 3 calls, all failures: 100% error rate but below min_volume,
+        # so one unlucky cold start doesn't trip the breaker
+        b = self._breaker(min_volume=4)
+        for _ in range(3):
+            b.acquire(); b.failure()
+        assert b.state == "closed"
+        b.acquire(); b.failure()  # 4th call reaches volume -> trips
+        assert b.state == "open"
+
+    def test_old_outcomes_age_out_of_the_window(self):
+        # a burst of early failures followed by a healthy run: the
+        # window forgets the burst, the breaker stays closed
+        b = self._breaker(window=4, min_volume=2, error_rate=0.5)
+        b.acquire(); b.failure()
+        for _ in range(4):
+            b.acquire(); b.success()
+        b.acquire(); b.failure()  # 1 of last 4 = 25% < 50%
+        assert b.state == "closed"
+
+    def test_reclosed_breaker_starts_clean(self):
+        b = self._breaker(window=10, min_volume=4, error_rate=0.5)
+        for _ in range(4):
+            b.acquire(); b.failure()
+        assert b.state == "open"
+        b.reset_timeout_s = -1.0  # half-open probe immediately due
+        b.acquire(); b.success()
+        assert b.state == "closed"
+        # without the clean slate, the 4 pre-open failures would still
+        # sit in the window (5 of 6 = 83%) and instantly re-trip here
+        b.acquire(); b.failure()
+        assert b.state == "closed"
+
+    def test_legacy_mode_unchanged_without_window(self):
+        b = CircuitBreaker("ep", failure_threshold=2, reset_timeout_s=5,
+                           clock=lambda: 0.0, registry=MetricsRegistry())
+        assert b.window is None
+        b.acquire(); b.failure()
+        b.acquire(); b.success()
+        b.acquire(); b.failure()
+        assert b.state == "closed"
+        b.acquire(); b.failure()
+        assert b.state == "open"
+
+    def test_window_knob_applies(self):
+        from geomesa_tpu.resilience.breaker import BREAKER_WINDOW
+        BREAKER_WINDOW.set("8")
+        try:
+            b = CircuitBreaker("ep", registry=MetricsRegistry())
+            assert b.window == 8
+        finally:
+            BREAKER_WINDOW.set(None)
+        b = CircuitBreaker("ep", registry=MetricsRegistry())
+        assert b.window is None
+
+
+class TestLatencyEwma:
+    def test_board_tracks_p99_and_gauges(self):
+        reg = MetricsRegistry()
+        board = BreakerBoard(registry=reg)
+        for ms in (10, 11, 9, 10, 12, 10):
+            board.observe("query", ms / 1e3)
+        lat = board.latencies()
+        assert lat["query"]["count"] == 6
+        # p99-ish sits above the mean, in the right decade
+        assert lat["query"]["p99_ms"] >= lat["query"]["mean_ms"]
+        assert 5 < lat["query"]["mean_ms"] < 20
+        p99 = board.latency_p99_s("query")
+        assert p99 == pytest.approx(lat["query"]["p99_ms"] / 1e3,
+                                    rel=1e-3)
+        gauges = reg.snapshot()["gauges"]
+        assert gauges["resilience.latency.p99.query"] == pytest.approx(
+            lat["query"]["p99_ms"], rel=1e-3)
+        assert board.latency_p99_s("never-called") is None
+
+    def test_tail_weight_moves_the_estimate(self):
+        board = BreakerBoard(registry=MetricsRegistry())
+        for _ in range(50):
+            board.observe("steady", 0.010)
+        for _ in range(50):
+            board.observe("spiky", 0.010)
+            board.observe("spiky", 0.100)
+        assert board.latency_p99_s("spiky") > board.latency_p99_s("steady")
+
+    def test_remote_store_feeds_latency_from_real_calls(self):
+        ds = _seeded_store(50)
+        srv = GeoMesaWebServer(ds)
+        srv.start()
+        try:
+            remote = RemoteDataStore("127.0.0.1", srv.port)
+            for _ in range(3):
+                remote.get_type_names()
+            lat = remote._breakers.latencies()
+            assert lat["schemas"]["count"] == 3
+            assert lat["schemas"]["p99_ms"] > 0
+            # and the health surface exposes the p99 detail
+            import http.client
+            import json as _json
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port)
+            conn.request("GET", "/rest/health")
+            body = _json.loads(conn.getresponse().read())
+            conn.close()
+            assert "latency_p99_ms" in body["resilience"]
+        finally:
+            srv.stop()
+
+
 # ---------------------------------------------------------------------------
 # ChaosProxy
 

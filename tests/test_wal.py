@@ -135,6 +135,77 @@ class TestWalLog:
         assert wal2.append(WRITE, b"after") == 4
         wal2.close()
 
+    def test_records_from_lsn_skips_whole_segments(self, tmp_path,
+                                                   monkeypatch):
+        """Regression: ``records(from_lsn)`` must not OPEN segments
+        wholly below the cursor — replication shippers tail it in a
+        loop, and rescanning the full history per poll would make the
+        tail O(log) instead of O(new)."""
+        from geomesa_tpu.wal import log as wal_log
+        root = str(tmp_path / "log")
+        wal = WriteAheadLog(root, fsync="never", segment_bytes=64)
+        for i in range(12):
+            wal.append(WRITE, b"x" * 40)
+        segs = list_segments(root)
+        assert len(segs) >= 3
+        cursor = segs[-1][0]  # first lsn of the live tail segment
+
+        opened = []
+        real_scan = wal_log._scan_segment
+
+        def spying_scan(path, *a, **kw):
+            opened.append(os.path.basename(path))
+            return real_scan(path, *a, **kw)
+
+        monkeypatch.setattr(wal_log, "_scan_segment", spying_scan)
+        got = [lsn for lsn, _, _ in wal.records(cursor)]
+        wal.close()
+        assert got == list(range(cursor, 13))
+        # every earlier segment ends at or below the cursor: only the
+        # tail segment may be opened
+        assert opened == [os.path.basename(segs[-1][1])]
+
+    def test_tailing_reader_survives_rotation_and_truncation(
+            self, tmp_path):
+        """A concurrent reader tailing ``records(cursor)`` (the shipper
+        pattern) while the writer rotates segments and truncates below
+        the reader's cursor sees every LSN exactly once, in order."""
+        import threading
+        root = str(tmp_path / "log")
+        wal = WriteAheadLog(root, fsync="never", segment_bytes=128)
+        total = 300
+        seen = []
+        reader_cursor = [1]
+        done = threading.Event()
+
+        def tail():
+            while True:
+                progressed = False
+                for lsn, kind, payload in wal.records(reader_cursor[0]):
+                    if lsn < reader_cursor[0]:
+                        continue
+                    seen.append(lsn)
+                    reader_cursor[0] = lsn + 1
+                    progressed = True
+                if reader_cursor[0] > total:
+                    return
+                if done.is_set() and not progressed:
+                    return
+
+        t = threading.Thread(target=tail, daemon=True)
+        t.start()
+        for i in range(1, total + 1):
+            wal.append(WRITE, f"r{i}".encode() + b"#" * 24)
+            if i % 50 == 0:
+                # checkpoint-style retention, never past the reader
+                wal.truncate_below(min(i - 10, reader_cursor[0]))
+        done.set()
+        t.join(timeout=20)
+        wal.close()
+        assert not t.is_alive()
+        # gapless, duplicate-free, in order
+        assert seen == list(range(1, total + 1))
+
     def test_inspect_dir_is_readonly(self, tmp_path):
         root = str(tmp_path / "log")
         wal = WriteAheadLog(root, fsync="never")
