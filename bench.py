@@ -45,7 +45,7 @@ Prints ONE JSON line:
 
 Env knobs: GEOMESA_TPU_BENCH_N (10M), GEOMESA_TPU_BENCH_REPS (512),
 GEOMESA_TPU_BENCH_TRIALS (3), GEOMESA_TPU_BENCH_CONFIGS
-("1,2,3,4,5,6,7,8,9,northstar" — comma list to run a subset; the
+("1,2,3,4,5,6,7,8,9,10,northstar" — comma list to run a subset; the
 `--only` CLI flag does the same and also accepts full result names,
 e.g. `--only 9_replicated_reads`),
 GEOMESA_TPU_BENCH_WAL_ROWS (1M — config #7 ingest/recovery size),
@@ -118,7 +118,7 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,6,7,8,9,northstar").split(","))
+                             "1,2,3,4,5,6,7,8,9,10,northstar").split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
 T0_DAY, T1_DAY = 17_000, 17_100
@@ -967,6 +967,138 @@ def bench_config9(rng):
     return out
 
 
+# -- config 10: storage integrity — scrub overhead + corrupt recovery -----
+
+def bench_config10(rng):
+    """What the integrity layer costs at ingest and buys at recovery.
+    A durable ingest takes two checkpoints (retention keeps both);
+    recovery is then timed three ways — clean reopen (newest
+    checkpoint + short tail), reopen after a bit flip corrupts the
+    newest checkpoint (must fall back to the PRIOR checkpoint, not a
+    full log replay, with id-exact state), and the same ingest again
+    with a background scrubber hashing every artifact on a tight
+    cadence (its steady-state overhead on ingest qps)."""
+    import shutil
+    import tempfile
+
+    from geomesa_tpu.features import parse_spec
+    from geomesa_tpu.integrity import flip_bit
+    from geomesa_tpu.integrity.scrub import Scrubber
+    from geomesa_tpu.integrity.verify import ids_digest
+    from geomesa_tpu.store import InMemoryDataStore
+    from geomesa_tpu.wal.snapshot import checkpoint_dirs
+
+    rows = int(os.environ.get("GEOMESA_TPU_BENCH_INTEGRITY_ROWS",
+                              200_000))
+    chunk = max(rows // 50, 1)
+    spec = "dtg:Date,*geom:Point:srid=4326"
+    x = rng.uniform(-180, 180, rows)
+    y = rng.uniform(-90, 90, rows)
+    ms = rng.integers(T0_DAY * MS_DAY, T1_DAY * MS_DAY,
+                      rows).astype(np.int64)
+    ids = np.arange(rows).astype(str).astype(object)
+
+    def ingest(ds, checkpoints_at=()):
+        t0 = time.perf_counter()
+        for i, lo in enumerate(range(0, rows, chunk)):
+            hi = min(lo + chunk, rows)
+            ds.write_dict("ais10", ids[lo:hi],
+                          {"dtg": ms[lo:hi],
+                           "geom": (x[lo:hi], y[lo:hi])})
+            if i in checkpoints_at:
+                ds.checkpoint()
+        return time.perf_counter() - t0
+
+    out: dict = {"rows": rows}
+    nchunks = (rows + chunk - 1) // chunk
+    d = tempfile.mkdtemp(prefix="geomesa-integrity-bench-")
+    try:
+        ds = InMemoryDataStore(durable_dir=d, wal_fsync="never")
+        ds.create_schema(parse_spec("ais10", spec))
+        # checkpoint mid-ingest and at the end: keep=2 retains both,
+        # plus the log back to the older one
+        base_s = ingest(ds, checkpoints_at={nchunks // 2 - 1,
+                                            nchunks - 1})
+        want = ids_digest(ds, "ais10")
+        ds.close()
+        out["ingest_s"] = round(base_s, 3)
+        out["ingest_rows_per_s"] = round(rows / base_s, 1)
+
+        ckpts = checkpoint_dirs(d)
+        newest_lsn, newest_path = ckpts[-1]
+        prior_lsn = ckpts[-2][0] if len(ckpts) > 1 else 0
+
+        # clean recovery: newest checkpoint + (near-empty) tail
+        t0 = time.perf_counter()
+        ds2 = InMemoryDataStore(durable_dir=d, wal_fsync="never")
+        clean_s = time.perf_counter() - t0
+        clean_rep = ds2.journal.last_report
+        ds2.close()
+
+        # silent corruption of the newest checkpoint's payload
+        flip_bit(os.path.join(newest_path, "ais10.bin"))
+        t0 = time.perf_counter()
+        ds3 = InMemoryDataStore(durable_dir=d, wal_fsync="never")
+        corrupt_s = time.perf_counter() - t0
+        rep = ds3.journal.last_report
+        got = ids_digest(ds3, "ais10")
+        ds3.close()
+        out["recovery"] = {
+            "clean_reopen_s": round(clean_s, 3),
+            "clean_checkpoint_lsn": clean_rep.checkpoint_lsn,
+            "corrupt_reopen_s": round(corrupt_s, 3),
+            "checkpoints_skipped": rep.checkpoints_skipped,
+            "fallback_checkpoint_lsn": rep.checkpoint_lsn,
+            # the gate: prior checkpoint used (not LSN-1 full replay)
+            # and the recovered id set matches the pre-crash store
+            "fell_back_to_prior": bool(rep.checkpoints_skipped == 1
+                                       and rep.checkpoint_lsn == prior_lsn
+                                       and prior_lsn > 0),
+            "full_replay_avoided": bool(rep.checkpoint_lsn > 0),
+            "ids_exact": bool(got == want),
+            "newest_lsn": newest_lsn,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    # scrub overhead: the same ingest with the scrubber re-hashing the
+    # whole durable root every 250ms. The comparison baseline is a
+    # second no-scrub ingest — the FIRST one above paid the jit/ingest
+    # warm-up and would make the scrubbed run look free (or negative)
+    def timed_ingest(with_scrubber):
+        d2 = tempfile.mkdtemp(prefix="geomesa-integrity-bench-scrub-")
+        try:
+            ds = InMemoryDataStore(durable_dir=d2, wal_fsync="never")
+            ds.create_schema(parse_spec("ais10", spec))
+            scrubber = (Scrubber(journal=ds.journal,
+                                 interval_s=0.25).start()
+                        if with_scrubber else None)
+            s = ingest(ds, checkpoints_at={nchunks // 2 - 1,
+                                           nchunks - 1})
+            if scrubber is not None:
+                scrubber.stop()
+                if scrubber.runs == 0:
+                    scrubber.run_once()  # ingest beat the first tick
+            ds.close()
+            return s, scrubber
+        finally:
+            shutil.rmtree(d2, ignore_errors=True)
+
+    warm_s, _ = timed_ingest(with_scrubber=False)
+    scrub_s, scrubber = timed_ingest(with_scrubber=True)
+    out["scrub"] = {
+        "interval_s": 0.25,
+        "baseline_ingest_s": round(warm_s, 3),
+        "ingest_s": round(scrub_s, 3),
+        "ingest_rows_per_s": round(rows / scrub_s, 1),
+        "overhead_pct": round((scrub_s / warm_s - 1.0) * 100, 1),
+        "scrub_runs": scrubber.runs,
+        "clean": bool(scrubber.last_report is None
+                      or scrubber.last_report["ok"]),
+    }
+    return out
+
+
 # -- north star: store-level 100M BBOX+time p50 ---------------------------
 
 def _build_big_store(x, y, ms):
@@ -1027,8 +1159,8 @@ def main(argv=None):
                     metavar="CONFIG",
                     help="run only these configs (repeatable or "
                          "comma-separated); accepts the bare key ('9', "
-                         "'northstar') or the full result name "
-                         "('9_replicated_reads')")
+                         "'10', 'northstar') or the full result name "
+                         "('9_replicated_reads', '10_integrity')")
     args = ap.parse_args(argv)
     if args.only:
         # "9_replicated_reads" and "9" both select config 9
@@ -1085,6 +1217,9 @@ def main(argv=None):
 
     if "9" in CONFIGS:
         out["configs"]["9_replicated_reads"] = bench_config9(rng)
+
+    if "10" in CONFIGS:
+        out["configs"]["10_integrity"] = bench_config10(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

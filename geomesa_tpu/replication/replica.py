@@ -68,6 +68,7 @@ class Replica(DataStore):
         self.primary_last_lsn = 0
         self.primary_durable_lsn = 0
         self.bootstraps = 0
+        self._client: ReplClient | None = None
         self.last_error: str | None = None
         # monotonic instant the replica last knew itself fully caught
         # up (applied == primary last); staleness-in-seconds anchor
@@ -94,10 +95,12 @@ class Replica(DataStore):
             try:
                 client = ReplClient(self.host, self.port,
                                     timeout_s=self.timeout_s)
+                self._client = client
                 try:
                     self._session(client)
                     backoff = _BACKOFF_MIN_S
                 finally:
+                    self._client = None
                     client.close()
             except (ConnectionError, TimeoutError, OSError,
                     BootstrapError) as e:
@@ -223,6 +226,20 @@ class Replica(DataStore):
                     "records_applied": self._report.records_replayed,
                     "records_failed": self._report.records_failed,
                     "last_error": self.last_error}
+
+    def request_rebootstrap(self):
+        """Anti-entropy escalation: the replica's state diverged from
+        the primary (scrubber digest mismatch). Mark the next session
+        as bootstrap-first and sever the current connection so the
+        apply loop reconnects immediately — the bootstrap clears local
+        state and reloads the primary's checkpoint, then streaming
+        resumes from its LSN."""
+        with self._lock:
+            self._needs_bootstrap = True
+        client = self._client
+        if client is not None:
+            client.close()  # unblocks the streaming recv
+        self._registry.counter("replication.rebootstraps.requested")
 
     # -- lifecycle -----------------------------------------------------------
 

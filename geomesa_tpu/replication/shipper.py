@@ -59,10 +59,14 @@ class WalShipper:
     """
 
     def __init__(self, journal, host: str = "127.0.0.1", port: int = 0,
-                 poll_ms: float | None = None, registry=metrics):
+                 poll_ms: float | None = None, store=None,
+                 registry=metrics):
         self.journal = journal
         self.wal = journal.wal
         self.root = journal.root
+        # optional: the primary store itself, enabling the ``digest``
+        # anti-entropy op (per-type row-count + content digest)
+        self.store = store
         self.poll_s = ((REPL_POLL_MS.as_float() or 20.0)
                        if poll_ms is None else float(poll_ms)) / 1e3
         self._registry = registry
@@ -110,6 +114,8 @@ class WalShipper:
                 _send_frame(sock, self._manifest())
             elif op == "fetch_ckpt":
                 self._fetch_ckpt(sock, header)
+            elif op == "digest":
+                _send_frame(sock, self._digest())
             elif op == "stream":
                 self._stream(sock, int(header.get("from_lsn", 1)))
                 return  # streaming is terminal for the connection
@@ -133,6 +139,23 @@ class WalShipper:
         _lsn, path = ckpts[-1]
         with open(os.path.join(path, "MANIFEST.json")) as f:
             return json.load(f)
+
+    def _digest(self) -> dict:
+        """Anti-entropy unit: per-type ``{rows, digest}`` bracketed by
+        the WAL position before and after the computation. Only when
+        the two LSNs agree (no concurrent writes) AND match the
+        replica's applied LSN is the comparison meaningful — the
+        replica-side scrubber enforces that."""
+        if self.store is None:
+            return {"error": "digest unavailable (shipper has no store)"}
+        from ..integrity.verify import ids_digest
+        pre = self.wal.last_lsn
+        types = {}
+        for name in self.store.get_type_names():
+            rows, digest = ids_digest(self.store, name)
+            types[name] = {"rows": rows, "digest": digest}
+        return {"last_lsn_pre": pre, "last_lsn": self.wal.last_lsn,
+                "types": types}
 
     def _fetch_ckpt(self, sock, header: dict):
         lsn = int(header.get("lsn", 0))

@@ -60,6 +60,13 @@ class ReplClient:
                                  f"{h['error']}")
         return payload
 
+    def digest(self) -> dict:
+        """Primary-side per-type ``{rows, digest}`` plus the bracketing
+        ``last_lsn_pre``/``last_lsn`` — the anti-entropy comparison
+        unit (valid only when the two LSNs agree)."""
+        h, _ = self._rpc({"op": "digest"})
+        return h
+
     def stream(self, from_lsn: int):
         """Yield ``(header, payload)`` frames until the peer drops the
         connection. Headers are records, heartbeats, or a terminal
@@ -95,6 +102,24 @@ def bootstrap_from_checkpoint(client: ReplClient, store,
         _ensure_schema(store, sft)
         if t.get("file"):
             raw = client.fetch_ckpt(lsn, t["file"])
+            # end-to-end: the manifest's digest covers the payload all
+            # the way from the primary's disk through the socket — a
+            # corrupt source file or truncated transfer fails HERE, not
+            # as garbage rows on the replica
+            want_bytes = t.get("bytes")
+            if want_bytes is not None and int(want_bytes) != len(raw):
+                registry.counter("integrity.bootstrap.rejects")
+                raise BootstrapError(
+                    f"checkpoint file {t['file']!r}@{lsn}: got "
+                    f"{len(raw)} bytes, manifest says {want_bytes}")
+            want_sha = t.get("sha256")
+            if want_sha is not None:
+                from ..integrity.verify import sha256_hex
+                if sha256_hex(raw) != want_sha:
+                    registry.counter("integrity.bootstrap.rejects")
+                    raise BootstrapError(
+                        f"checkpoint file {t['file']!r}@{lsn}: "
+                        f"sha256 mismatch")
             tn, batch, vis = decode_write(raw)
             if batch is not None and batch.n:
                 store.write(tn, batch,

@@ -68,24 +68,32 @@ def write_bytes_atomic(path: str, raw: bytes):
     """Durable atomic write: tmp + fsync + rename + directory fsync
     (readers never see a partial file, and the rename itself survives a
     crash — the WAL checkpoint manifest relies on this). Tmp names are
-    pid+thread-unique (the broker persists from handler threads)."""
+    pid+thread-unique (the broker persists from handler threads).
+
+    Both the data write and the fsync route through the fault shim
+    keyed by the LOGICAL destination path, so chaos tests can tear or
+    bit-flip a checkpoint file without knowing the tmp name."""
     import threading
+
+    from ..integrity import faultfs
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "wb") as f:
-        f.write(raw)
+        faultfs.write(f, raw, path)
         f.flush()
-        os.fsync(f.fileno())
+        faultfs.fsync(f.fileno(), path)
     os.replace(tmp, path)
     fsync_dir(os.path.dirname(path) or ".")
 
 
 def write_json_atomic(path: str, obj):
     import threading
+
+    from ..integrity import faultfs
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-    with open(tmp, "w") as f:
-        json.dump(obj, f)
+    with open(tmp, "wb") as f:
+        faultfs.write(f, json.dumps(obj).encode(), path)
         f.flush()
-        os.fsync(f.fileno())
+        faultfs.fsync(f.fileno(), path)
     os.replace(tmp, path)
     fsync_dir(os.path.dirname(path) or ".")
 

@@ -51,7 +51,7 @@ class LambdaDataStore(DataStore):
         """The transient tier's WAL journal, or None when not durable."""
         return self.transient.journal
 
-    def checkpoint(self, keep: int = 1) -> dict:
+    def checkpoint(self, keep: int = 2) -> dict:
         return self.transient.checkpoint(keep=keep)
 
     def close(self):

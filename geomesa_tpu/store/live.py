@@ -92,7 +92,7 @@ class LiveDataStore(DataStore):
         """The cache's WAL journal, or None when not durable."""
         return self._mem.journal
 
-    def checkpoint(self, keep: int = 1) -> dict:
+    def checkpoint(self, keep: int = 2) -> dict:
         return self._mem.checkpoint(keep=keep)
 
     def close(self):

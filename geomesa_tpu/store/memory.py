@@ -683,9 +683,11 @@ class InMemoryDataStore(DataStore):
 
     # -- durability (wal/ subsystem, opt-in via durable_dir) ---------------
 
-    def checkpoint(self, keep: int = 1) -> dict:
+    def checkpoint(self, keep: int = 2) -> dict:
         """Snapshot current state and compact the journal; requires the
-        ``durable_dir`` knob."""
+        ``durable_dir`` knob. ``keep=2`` retains the prior checkpoint
+        (and the log back to it) so recovery can fall back id-exactly
+        if the newest snapshot is later found corrupt."""
         if self.journal is None:
             raise ValueError("store is not durable (no durable_dir)")
         return self.journal.checkpoint(self, keep=keep)

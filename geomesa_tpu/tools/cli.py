@@ -15,6 +15,7 @@ Subcommands (mirroring the reference's tools/ command set):
     sql             --path R 'SELECT ... WHERE ST_...'
     serve           --path R [--host H] [--port P]
     wal inspect|replay|truncate --wal-dir D [--below-lsn N] [--token T]
+    integrity verify|scrub --wal-dir D [--token T]
     replication status|promote --path remote://h:p [--token T]
     version / env
 """
@@ -263,7 +264,7 @@ def _wal_admin_ok(args) -> bool:
     expected = WEB_AUTH_TOKEN.get()
     if not expected or getattr(args, "token", None) == expected:
         return True
-    print("wal truncate is gated: pass --token matching "
+    print("this command is gated: pass --token matching "
           "geomesa.web.auth.token", file=sys.stderr)
     return False
 
@@ -320,6 +321,37 @@ def cmd_wal(args) -> int:
         print(f"dropped {dropped} segment(s) below lsn {lsn}")
         return 0
     print(f"unknown wal command {args.wal_command!r}", file=sys.stderr)
+    return 2
+
+
+def cmd_integrity(args) -> int:
+    """Storage integrity over a durable root: ``verify`` is a read-only
+    sweep (WAL segment CRCs + checkpoint digests; rc 1 when anything is
+    corrupt), ``scrub`` additionally quarantines corrupt checkpoints
+    (``*.corrupt``) and is token-gated like the other mutating admin
+    commands."""
+    root = args.wal_dir
+    if args.integrity_command == "verify":
+        from ..integrity.scrub import integrity_report
+        out = integrity_report(root)
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return 0 if out["ok"] else 1
+    if args.integrity_command == "scrub":
+        if not _wal_admin_ok(args):
+            return 3
+        from ..integrity.scrub import Scrubber
+        from ..wal.durable import Journal
+        journal = Journal(root, fsync="never")
+        try:
+            out = Scrubber(journal=journal).run_once()
+        finally:
+            journal.close()
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return 0 if out["ok"] else 1
+    print(f"unknown integrity command {args.integrity_command!r}",
+          file=sys.stderr)
     return 2
 
 
@@ -446,6 +478,23 @@ def main(argv=None) -> int:
                             help="admin bearer token "
                                  "(geomesa.web.auth.token)")
         wp.set_defaults(fn=cmd_wal)
+
+    intp = sub.add_parser("integrity",
+                          help="storage integrity verification / scrub")
+    intsub = intp.add_subparsers(dest="integrity_command", required=True)
+    for iname, ihelp in (("verify", "read-only sweep: WAL CRCs + "
+                                    "checkpoint digests (rc 1 on "
+                                    "corruption)"),
+                         ("scrub", "verify AND quarantine corrupt "
+                                   "checkpoints (token-gated)")):
+        ip = intsub.add_parser(iname, help=ihelp)
+        ip.add_argument("--wal-dir", required=True, dest="wal_dir",
+                        help="durable root (the durable_dir= directory)")
+        if iname == "scrub":
+            ip.add_argument("--token", default=None,
+                            help="admin bearer token "
+                                 "(geomesa.web.auth.token)")
+        ip.set_defaults(fn=cmd_integrity)
 
     replp = sub.add_parser("replication",
                            help="replication administration")

@@ -20,17 +20,17 @@ journal/checkpoint/replay discipline:
 """
 
 from .log import (CHECKPOINT_MARK, CREATE_SCHEMA, DELETE, DROP_SCHEMA,
-                  WRITE, WriteAheadLog, decode_delete, decode_schema,
-                  decode_write, encode_delete, encode_drop_schema,
-                  encode_schema, encode_write)
+                  WRITE, DurabilityError, WriteAheadLog, decode_delete,
+                  decode_schema, decode_write, encode_delete,
+                  encode_drop_schema, encode_schema, encode_write)
 from .snapshot import (latest_checkpoint_lsn, load_checkpoint,
                        write_checkpoint)
 from .recovery import RecoveryReport, recover, replay_into
 from .durable import DurableStore, Journal
 
 __all__ = [
-    "WriteAheadLog", "WRITE", "DELETE", "CREATE_SCHEMA", "DROP_SCHEMA",
-    "CHECKPOINT_MARK",
+    "WriteAheadLog", "DurabilityError", "WRITE", "DELETE",
+    "CREATE_SCHEMA", "DROP_SCHEMA", "CHECKPOINT_MARK",
     "encode_write", "decode_write", "encode_delete", "decode_delete",
     "encode_schema", "decode_schema", "encode_drop_schema",
     "write_checkpoint", "load_checkpoint", "latest_checkpoint_lsn",

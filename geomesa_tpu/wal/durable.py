@@ -88,37 +88,73 @@ class Journal:
             self.replaying = False
         return self.last_report
 
-    def checkpoint(self, store, keep: int = 1) -> dict:
+    def checkpoint(self, store, keep: int = 2) -> dict:
         """Snapshot ``store`` and compact the log.
 
         The covered LSN is captured BEFORE the snapshot: rows appended
         while the snapshot runs may land in both the snapshot and the
         replayed tail, which idempotent redo collapses — so appenders
-        are never blocked."""
+        are never blocked.
+
+        Two integrity couplings gate the compaction. First, the fresh
+        snapshot is read back and digest-verified before any log
+        truncation — a checkpoint silently corrupted on the way down
+        (bit flip) must never become the excuse for deleting the
+        records that could rebuild it. Second, the log is truncated
+        below the OLDEST checkpoint still retained (``keep`` defaults
+        to 2), so if the newest snapshot later rots, replaying from the
+        prior one is id-exact rather than lossy."""
+        from ..integrity.verify import quarantine, verify_checkpoint
+        from .snapshot import checkpoint_dirs
         lsn = self.wal.last_lsn
         self.wal.sync()  # records <= lsn must be durable before the
         #                  checkpoint claims to cover them
         path = write_checkpoint(self.root, iter_store_states(store), lsn,
                                 self.registry)
+        rep = verify_checkpoint(path)
+        if not rep["ok"]:
+            self.registry.counter("integrity.checkpoint.writeback_failures")
+            quarantine(path, self.registry)
+            raise OSError("checkpoint failed read-back verification "
+                          "(log NOT truncated): "
+                          + "; ".join(rep["errors"]))
         self.wal.append(CHECKPOINT_MARK,
                         json.dumps({"lsn": lsn}).encode())
-        dropped = self.wal.truncate_below(lsn)
         stale = drop_stale_checkpoints(self.root, keep=keep)
+        dirs = checkpoint_dirs(self.root)
+        floor = dirs[0][0] if dirs else lsn
+        dropped = self.wal.truncate_below(floor)
         return {"lsn": lsn, "path": path, "segments_dropped": dropped,
                 "checkpoints_dropped": stale}
 
     # -- inspection ---------------------------------------------------------
 
+    @property
+    def poisoned(self) -> bool:
+        """True once the WAL refused durability (failed fsync/write):
+        journal-before-apply then makes the owning store read-only —
+        every mutation raises ``DurabilityError`` at the journal step,
+        before any in-memory state changes."""
+        return self.wal.poisoned
+
     def stats(self) -> dict:
         out = self.wal.scan_stats()
         out["root"] = self.root
         out["checkpoint_lsn"] = latest_checkpoint_lsn(self.root)
+        out["poisoned"] = self.wal.poisoned
+        if self.wal.poison_cause is not None:
+            out["poison_cause"] = repr(self.wal.poison_cause)
         if self.last_report is not None:
             out["recovery"] = self.last_report.to_json_object()
         return out
 
     def close(self):
         self.wal.close()
+
+    def abort(self):
+        """Simulated-crash disposal: drop the WAL handle without
+        flushing (see ``WriteAheadLog.abort``)."""
+        self.wal.abort()
 
 
 class DurableStore(DataStore):
@@ -186,7 +222,7 @@ class DurableStore(DataStore):
 
     # -- durability surface ---------------------------------------------------
 
-    def checkpoint(self, keep: int = 1) -> dict:
+    def checkpoint(self, keep: int = 2) -> dict:
         return self.journal.checkpoint(self.inner, keep=keep)
 
     def close(self):

@@ -43,15 +43,31 @@ import threading
 import time
 import zlib
 
+from ..integrity import faultfs
 from ..metrics import metrics
 from ..utils.properties import SystemProperty
 
-__all__ = ["WriteAheadLog", "WRITE", "DELETE", "CREATE_SCHEMA",
-           "DROP_SCHEMA", "CHECKPOINT_MARK", "inspect_dir",
+__all__ = ["WriteAheadLog", "DurabilityError", "WRITE", "DELETE",
+           "CREATE_SCHEMA", "DROP_SCHEMA", "CHECKPOINT_MARK",
+           "inspect_dir",
            "WAL_FSYNC", "WAL_SEGMENT_BYTES", "WAL_INTERVAL_MS",
            "encode_write", "decode_write", "encode_delete",
            "decode_delete", "encode_schema", "decode_schema",
            "encode_drop_schema"]
+
+
+class DurabilityError(OSError):
+    """The WAL can no longer promise durability and has poisoned
+    itself. Raised by every subsequent append/sync; the owning store
+    degrades to read-only.
+
+    The trigger is a failed storage-side write or fsync. After a
+    failed fsync in particular the kernel may have already dropped the
+    dirty pages while keeping the file marked clean, so retrying the
+    same fsync can falsely succeed without the data being on disk
+    (fsyncgate — Rebello et al., ATC '20). The only honest move is to
+    refuse further writes on this log handle; recovery is a fresh
+    process re-reading what the disk actually holds."""
 
 # record kinds
 WRITE = 1
@@ -252,7 +268,9 @@ class WriteAheadLog:
         self._fd: io.BufferedWriter | None = None
         self._seg_start_lsn = 0
         self._seg_bytes = 0
+        self._seg_path = ""
         self._closed = False
+        self._poisoned: OSError | None = None
         self.torn_tail_records = 0  # dropped by open-time truncation
         self._cksum_algo, self._cksum = _resolve_checksum()
         self._recover_tail()
@@ -301,10 +319,11 @@ class WriteAheadLog:
         exists = os.path.exists(path)
         self._fd = open(path, "ab")
         self._seg_start_lsn = first_lsn
+        self._seg_path = path
         self._seg_bytes = self._fd.tell()
         if not exists or self._seg_bytes == 0:
-            self._fd.write(_HEADER.pack(_MAGIC, _SEG_VERSION,
-                                        self._cksum_algo))
+            faultfs.write(self._fd, _HEADER.pack(_MAGIC, _SEG_VERSION,
+                                                 self._cksum_algo), path)
             self._fd.flush()
             self._seg_bytes = _HEADER.size
 
@@ -324,24 +343,60 @@ class WriteAheadLog:
         ``always`` policy once append returns)."""
         return self._synced_lsn
 
+    @property
+    def poisoned(self) -> bool:
+        return self._poisoned is not None
+
+    @property
+    def poison_cause(self) -> OSError | None:
+        return self._poisoned
+
+    def _poison(self, cause: OSError):
+        """Mark the log permanently unusable for writes and raise the
+        typed refusal. Idempotent; wakes blocked group-committers so
+        they observe the poison instead of retrying the fsync."""
+        with self._sync_cond:
+            if self._poisoned is None:
+                self._poisoned = cause
+                self.registry.counter("wal.poisoned")
+            self._sync_cond.notify_all()
+        raise DurabilityError(
+            f"write-ahead log poisoned: {cause}") from cause
+
+    def _raise_if_poisoned(self):
+        if self._poisoned is not None:
+            raise DurabilityError(
+                f"write-ahead log poisoned: {self._poisoned}")
+
     def append(self, kind: int, payload: bytes) -> int:
         """Frame and write one record; returns its LSN after the fsync
-        policy is satisfied."""
+        policy is satisfied. A storage failure along the way (frame
+        write, rotation, fsync) poisons the log: the tail position is
+        no longer trustworthy, so every later append raises
+        ``DurabilityError`` rather than risk stranding valid frames
+        behind a torn one."""
         if self._closed:
             raise ValueError("log is closed")
+        self._raise_if_poisoned()
+        err: OSError | None = None
         with self._lock:
             lsn = self._next_lsn
             self._next_lsn += 1
             rest = struct.pack("<IQB", len(payload), lsn, kind)
             crc = self._cksum(rest + payload)
             frame = struct.pack("<I", crc) + rest + payload
-            if (self._seg_bytes + len(frame) > self.segment_bytes
-                    and self._seg_bytes > _HEADER.size):
-                self._rotate(lsn)
-            self._fd.write(frame)
-            self._fd.flush()  # to the OS; fsync is the policy's call
-            self._seg_bytes += len(frame)
-            self._appended_lsn = lsn
+            try:
+                if (self._seg_bytes + len(frame) > self.segment_bytes
+                        and self._seg_bytes > _HEADER.size):
+                    self._rotate(lsn)
+                faultfs.write(self._fd, frame, self._seg_path)
+                self._fd.flush()  # to the OS; fsync is the policy's call
+                self._seg_bytes += len(frame)
+                self._appended_lsn = lsn
+            except OSError as e:
+                err = e
+        if err is not None:
+            self._poison(err)
         reg = self.registry
         reg.counter("wal.appended.records")
         reg.counter("wal.appended.bytes", len(frame))
@@ -353,7 +408,7 @@ class WriteAheadLog:
         """Seal the current segment (fsync so earlier records stay
         durable regardless of policy timing) and start the next."""
         self._fd.flush()
-        os.fsync(self._fd.fileno())
+        faultfs.fsync(self._fd.fileno(), self._seg_path)
         self._fd.close()
         self._open_segment(first_lsn)
         self.registry.counter("wal.segments.rotated")
@@ -361,30 +416,44 @@ class WriteAheadLog:
     def _commit(self, lsn: int):
         """Group commit: one fsync covers every record appended so far;
         concurrent committers wait for the in-flight sync and return
-        without a second fsync when it already covered their LSN."""
+        without a second fsync when it already covered their LSN. A
+        failed fsync poisons the log — the kernel may have dropped the
+        dirty pages, so neither this committer nor a waiter may retry
+        (fsyncgate)."""
         with self._sync_cond:
             while self._sync_in_progress and self._synced_lsn < lsn:
                 self._sync_cond.wait()
+            self._raise_if_poisoned()
             if self._synced_lsn >= lsn:
                 return
             self._sync_in_progress = True
+        pending: int | None = None
+        err: OSError | None = None
         try:
             with self._lock:
-                fd, pending = self._fd, self._appended_lsn
+                fd, path = self._fd, self._seg_path
                 fd.flush()
-                os.fsync(fd.fileno())
+                faultfs.fsync(fd.fileno(), path)
+                pending = self._appended_lsn
+        except OSError as e:
+            err = e
         finally:
             with self._sync_cond:
-                batch = pending - self._synced_lsn
-                self._synced_lsn = max(self._synced_lsn, pending)
+                batch = 0
+                if pending is not None:
+                    batch = pending - self._synced_lsn
+                    self._synced_lsn = max(self._synced_lsn, pending)
                 self._sync_in_progress = False
                 self._sync_cond.notify_all()
+        if err is not None:
+            self._poison(err)
         self.registry.counter("wal.fsyncs")
         if batch > 0:
             self.registry.gauge("wal.group_commit.batch", batch)
 
     def sync(self):
         """Force-fsync everything appended so far (any policy)."""
+        self._raise_if_poisoned()
         if self._appended_lsn > self._synced_lsn:
             self._commit(self._appended_lsn)
 
@@ -397,11 +466,16 @@ class WriteAheadLog:
 
     # -- read / replay -----------------------------------------------------
 
-    def records(self, from_lsn: int = 1):
+    def records(self, from_lsn: int = 1, on_torn=None):
         """Yield (lsn, kind, payload) for every valid record with
-        ``lsn >= from_lsn``, in LSN order. Stops at the first invalid
-        frame in a segment (torn tail — already truncated on open for
-        the live tail; mid-history corruption ends replay there).
+        ``lsn >= from_lsn``, in LSN order. Iteration ends ENTIRELY at
+        the first invalid frame — in the tail segment that is the
+        normal crash residue, but mid-history it means silent
+        corruption, and continuing into later segments would replay
+        across a hole (records applied out of prefix order; deletes or
+        overwrites before the hole replayed, their predecessors lost).
+        ``on_torn(path, frames)`` fires when iteration stops early so
+        recovery can report exactly where.
 
         Segments wholly below ``from_lsn`` are skipped without being
         opened — segment file names carry their first LSN, so a segment
@@ -416,8 +490,8 @@ class WriteAheadLog:
                 continue  # every record in [first_lsn, nxt) < from_lsn
             out: list = []
             try:
-                _scan_segment(path, on_record=out.append,
-                              min_lsn=from_lsn)
+                _good_end, torn = _scan_segment(path, on_record=out.append,
+                                                min_lsn=from_lsn)
             except FileNotFoundError:
                 # checkpoint truncation unlinked it between the listing
                 # and the open: it was wholly below the checkpoint LSN,
@@ -426,6 +500,11 @@ class WriteAheadLog:
                 continue
             for rec in out:
                 yield rec
+            if torn:
+                self.registry.counter("wal.replay.stopped")
+                if on_torn is not None:
+                    on_torn(path, torn)
+                return
 
     def scan_stats(self) -> dict:
         """Inspection summary over the whole log (CLI surface)."""
@@ -497,13 +576,29 @@ class WriteAheadLog:
         if self._flusher is not None:
             self._flusher.join(timeout=2.0)
         try:
-            if self.fsync_policy != "never":
+            if self.fsync_policy != "never" and self._poisoned is None:
                 self.sync()
         finally:
             with self._lock:
                 if self._fd is not None:
                     self._fd.close()
                     self._fd = None
+
+    def abort(self):
+        """Drop the log handle without flushing or syncing — the
+        simulated-crash close. The crash harness uses this so an
+        injected failure's aftermath reaches the next open exactly as
+        the disk holds it; also the right disposal for a poisoned log,
+        where a clean close would imply durability it can't promise."""
+        self._closed = True
+        self._flusher_stop.set()
+        with self._lock:
+            if self._fd is not None:
+                try:
+                    self._fd.close()
+                except OSError:
+                    pass
+                self._fd = None
 
 
 def _scan_segment(path: str, on_record, min_lsn: int = 0):

@@ -45,6 +45,9 @@ class RecoveryReport:
     rows_replayed: int = 0
     bytes_scanned: int = 0
     torn_records_dropped: int = 0
+    corrupt_frames: int = 0          # invalid frames that ENDED replay
+    replay_stopped_lsn: int = 0      # last LSN applied before the stop
+    checkpoints_skipped: int = 0     # corrupt snapshots fallen past
     last_lsn: int = 0
     wall_time_s: float = 0.0
     errors: list = field(default_factory=list)  # first few, for the CLI
@@ -110,6 +113,7 @@ def replay_into(store, records, report: RecoveryReport | None = None
                          lsn, kind, exc_info=True)
         else:
             report.records_replayed += 1
+        report.replay_stopped_lsn = lsn
     return report
 
 
@@ -121,7 +125,15 @@ def recover(store, wal, root: str, registry=metrics) -> RecoveryReport:
     report = RecoveryReport()
     report.torn_records_dropped = getattr(wal, "torn_tail_records", 0)
     from_lsn = 1
-    ckpt = load_checkpoint(root)
+
+    def skipped(path, why):
+        report.checkpoints_skipped += 1
+        if len(report.errors) < 5:
+            report.errors.append(f"checkpoint skipped: {path}: {why}")
+        _log.warning("recovery: skipping corrupt checkpoint %s (%s)",
+                     path, why)
+
+    ckpt = load_checkpoint(root, on_skip=skipped)
     if ckpt is not None:
         lsn0, states = ckpt
         report.checkpoint_lsn = lsn0
@@ -133,9 +145,20 @@ def recover(store, wal, root: str, registry=metrics) -> RecoveryReport:
                             visibilities=None if vis is None else list(vis))
                 report.snapshot_rows += int(batch.n)
             report.snapshot_types += 1
-    replay_into(store, wal.records(from_lsn), report)
+
+    def torn(path, frames):
+        report.corrupt_frames += frames
+        if len(report.errors) < 5:
+            report.errors.append(
+                f"replay stopped at lsn {report.replay_stopped_lsn}: "
+                f"{frames} invalid frame(s) in {path}")
+
+    replay_into(store, wal.records(from_lsn, on_torn=torn), report)
     report.last_lsn = wal.last_lsn
     report.wall_time_s = time.perf_counter() - t0
     registry.gauge("wal.recovery.seconds", report.wall_time_s)
     registry.counter("wal.recovery.records", report.records_replayed)
+    if report.checkpoints_skipped:
+        registry.counter("integrity.recovery.checkpoints_skipped",
+                         report.checkpoints_skipped)
     return report

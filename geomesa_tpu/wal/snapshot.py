@@ -27,7 +27,7 @@ import shutil
 import time
 
 from ..metrics import metrics
-from ..store.filebus import write_bytes_atomic, write_json_atomic
+from ..store.filebus import fsync_dir, write_bytes_atomic, write_json_atomic
 
 __all__ = ["write_checkpoint", "load_checkpoint", "latest_checkpoint_lsn",
            "iter_store_states", "drop_stale_checkpoints"]
@@ -90,11 +90,22 @@ def write_checkpoint(root: str, states, lsn: int,
                      registry=metrics) -> str:
     """Write a snapshot of ``states`` (an ``iter_store_states``-shaped
     iterable) tagged with the log position ``lsn`` it covers. Returns
-    the checkpoint directory path (manifest written last, atomically)."""
+    the checkpoint directory path.
+
+    The whole directory is staged as a ``.tmp`` sibling and renamed
+    into place once complete — re-using the final directory would let a
+    crashed earlier attempt at the same LSN leave stale ``.bin`` files
+    the new manifest doesn't reference. Within the staged dir the
+    manifest is still written last and carries each payload's SHA-256 +
+    length, so loaders and replicas can verify end-to-end."""
+    from ..integrity.verify import sha256_hex
     from .log import encode_write
     base = _snap_root(root)
     path = os.path.join(base, f"{_DIR_PREFIX}{lsn:020d}")
-    os.makedirs(path, exist_ok=True)
+    tmp = path + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)  # debris from a crashed earlier attempt
+    os.makedirs(tmp)
     types = []
     total_bytes = 0
     for sft, batch, vis in states:
@@ -107,52 +118,83 @@ def write_checkpoint(root: str, states, lsn: int,
             # the (empty) schema without a CREATE_SCHEMA log record
             raw = b""
         from ..features.sft import encode_spec
-        types.append({"name": sft.type_name, "rows": int(n),
-                      "index_version": sft.index_version,
-                      "spec": encode_spec(sft),
-                      "file": fname if raw else None})
+        entry = {"name": sft.type_name, "rows": int(n),
+                 "index_version": sft.index_version,
+                 "spec": encode_spec(sft),
+                 "file": fname if raw else None}
         if raw:
-            write_bytes_atomic(os.path.join(path, fname), raw)
+            entry["sha256"] = sha256_hex(raw)
+            entry["bytes"] = len(raw)
+            write_bytes_atomic(os.path.join(tmp, fname), raw)
             total_bytes += len(raw)
-    write_json_atomic(os.path.join(path, "MANIFEST.json"),
+        types.append(entry)
+    write_json_atomic(os.path.join(tmp, "MANIFEST.json"),
                       {"lsn": int(lsn), "types": types,
                        "created_ms": int(time.time() * 1000)})
+    if os.path.exists(path):
+        shutil.rmtree(path)  # same-LSN predecessor being replaced
+    os.rename(tmp, path)
+    fsync_dir(base)
     registry.counter("wal.checkpoints")
     registry.counter("wal.checkpoint.bytes", total_bytes)
     return path
 
 
-def load_checkpoint(root: str):
-    """Load the newest durable checkpoint.
+def load_checkpoint(root: str, on_skip=None):
+    """Load the newest durable checkpoint that VERIFIES.
 
-    Returns ``(lsn, [(sft, batch | None, vis | None)])`` or ``None``
-    when no checkpoint exists."""
-    from .log import decode_write
+    Each candidate (newest first) is digest-checked against its
+    manifest before a byte of it is trusted; a corrupt one is reported
+    via ``on_skip(path, why)``, quarantined (renamed ``*.corrupt``)
+    when ``geomesa.integrity.quarantine`` is on, and the next-newest
+    tried — degrading to ``None`` (full WAL replay) when none survive.
+    Returns ``(lsn, [(sft, batch | None, vis | None)])`` or ``None``."""
     from ..features.sft import parse_spec
-    dirs = checkpoint_dirs(root)
-    if not dirs:
-        return None
-    lsn, path = dirs[-1]
-    with open(os.path.join(path, "MANIFEST.json")) as f:
-        manifest = json.load(f)
-    out = []
-    for t in manifest["types"]:
-        sft = parse_spec(t["name"], t.get("spec") or "")
-        if t.get("file"):
-            with open(os.path.join(path, t["file"]), "rb") as f:
-                _tn, batch, vis = decode_write(f.read())
-            out.append((sft, batch, vis))
-        else:
-            out.append((sft, None, None))
-    return int(manifest["lsn"]), out
+    from ..integrity.scrub import INTEGRITY_QUARANTINE
+    from ..integrity.verify import quarantine, verify_checkpoint
+    from .log import decode_write
+    for lsn, path in reversed(checkpoint_dirs(root)):
+        rep = verify_checkpoint(path)
+        if not rep["ok"]:
+            metrics.counter("integrity.load.fallbacks")
+            if on_skip is not None:
+                on_skip(path, "; ".join(rep["errors"]) or "corrupt")
+            if INTEGRITY_QUARANTINE.as_bool():
+                quarantine(path)
+            continue
+        with open(os.path.join(path, "MANIFEST.json")) as f:
+            manifest = json.load(f)
+        out = []
+        for t in manifest["types"]:
+            sft = parse_spec(t["name"], t.get("spec") or "")
+            if t.get("file"):
+                with open(os.path.join(path, t["file"]), "rb") as f:
+                    _tn, batch, vis = decode_write(f.read())
+                out.append((sft, batch, vis))
+            else:
+                out.append((sft, None, None))
+        return int(manifest["lsn"]), out
+    return None
 
 
 def drop_stale_checkpoints(root: str, keep: int = 1) -> int:
     """Remove all but the ``keep`` newest checkpoints (retention after
-    a successful new checkpoint). Returns directories removed."""
+    a successful new checkpoint). Returns directories removed.
+
+    The manifest is deleted first and the deletion fsynced before the
+    rest of the tree goes: a crash mid-``rmtree`` then leaves a
+    manifest-less directory that ``checkpoint_dirs`` already ignores,
+    never a manifest-bearing husk that ``load_checkpoint`` would select
+    and crash on."""
     dirs = checkpoint_dirs(root)
     removed = 0
     for _lsn, path in dirs[:-keep] if keep else dirs:
+        manifest = os.path.join(path, "MANIFEST.json")
+        try:
+            os.unlink(manifest)
+        except FileNotFoundError:
+            pass
+        fsync_dir(path)
         shutil.rmtree(path, ignore_errors=True)
         removed += 1
     return removed

@@ -55,6 +55,7 @@ from .. import __version__ as _version
 from ..index.api import Query, QueryHints
 from ..metrics import metrics
 from ..utils.properties import SystemProperty
+from ..wal.log import DurabilityError
 
 __all__ = ["GeoMesaWebServer"]
 
@@ -68,7 +69,7 @@ WEB_AUTH_TOKEN = SystemProperty("geomesa.web.auth.token", None)
 # POST /rest/wal/* are the WAL admin mutations (checkpoint/truncate);
 # GET /rest/wal stays open (read-only stats)
 _GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas"),
-          ("POST", "wal"), ("POST", "replication")}
+          ("POST", "wal"), ("POST", "replication"), ("POST", "integrity")}
 
 # load-shedding gate: max concurrent in-flight requests (unset ->
 # unlimited). Requests over the cap get 503 + Retry-After BEFORE any
@@ -140,7 +141,8 @@ class GeoMesaWebServer:
             return 200, "application/json", _j(
                 {"status": "ok", "version": _version,
                  "uptime_s": round(time.monotonic() - self._started_at, 3),
-                 "resilience": self._resilience_detail()})
+                 "resilience": self._resilience_detail(),
+                 "durability": self._durability_detail()})
         if method == "GET" and parts == ["ready"]:
             return self._ready()
         if not self._acquire_slot():
@@ -158,6 +160,16 @@ class GeoMesaWebServer:
                 return self._route(method, parts, params, body)
             except KeyError as e:
                 return 404, "application/json", _j({"error": str(e)})
+            except DurabilityError as e:
+                # the WAL poisoned itself (failed fsync/write): the
+                # store is read-only degraded. 503 tells clients the
+                # SERVER can't take writes — reads still work — and
+                # retrying here is pointless until an operator recycles
+                # the process
+                metrics.counter("integrity.web.write_rejects")
+                return (503, "application/json",
+                        _j({"error": repr(e), "retryable": False,
+                            "degraded": "read-only"}))
             except ValueError as e:
                 # parse/plan errors (CQL/filter parse is a ValueError
                 # subclass): the request is malformed, do NOT retry
@@ -190,6 +202,21 @@ class GeoMesaWebServer:
             return 200, "application/json", body
         return (503, "application/json", body,
                 {"Retry-After": WEB_RETRY_AFTER.get() or "1"})
+
+    def _durability_detail(self) -> dict | None:
+        """Durability health: None for non-durable stores, otherwise
+        whether the WAL has poisoned itself (read-only degraded mode)
+        and why — the operator-facing face of fsyncgate semantics."""
+        journal = getattr(self.store, "journal", None)
+        if journal is None:
+            return None
+        out = {"poisoned": bool(journal.poisoned)}
+        if journal.poisoned:
+            out["mode"] = "read-only"
+            cause = journal.wal.poison_cause
+            if cause is not None:
+                out["cause"] = repr(cause)
+        return out
 
     def _resilience_detail(self) -> dict:
         """Per-endpoint latency estimates for the health surface — the
@@ -301,6 +328,8 @@ class GeoMesaWebServer:
                  "rows": [list(r) for r in res.rows()]})
         if parts and parts[0] == "wal":
             return self._wal(method, parts[1:], params)
+        if parts and parts[0] == "integrity":
+            return self._integrity(method, parts[1:])
         if parts and parts[0] == "replication":
             return self._replication(method, parts[1:])
         if parts == ["audit"]:
@@ -369,6 +398,28 @@ class GeoMesaWebServer:
             dropped = journal.wal.truncate_below(lsn)
             return 200, "application/json", _j(
                 {"below": lsn, "segments_dropped": dropped})
+        return 404, "application/json", _j({"error": "not found"})
+
+    def _integrity(self, method, parts):
+        """Storage integrity surface: GET /rest/integrity (read-only
+        verification sweep of WAL CRCs + checkpoint digests, open) and
+        POST /rest/integrity/scrub (one scrub pass WITH quarantine per
+        the knob — mutating, bearer-gated via _GATED)."""
+        journal = getattr(self.store, "journal", None)
+        if journal is None:
+            return 404, "application/json", _j(
+                {"error": "store is not durable (no WAL journal)"})
+        if method == "GET" and not parts:
+            from ..integrity.scrub import integrity_report
+            rep = integrity_report(journal.root)
+            rep["poisoned"] = bool(journal.poisoned)
+            return 200, "application/json", _j(rep)
+        if method == "POST" and parts == ["scrub"]:
+            scrubber = getattr(self.store, "scrubber", None)
+            if scrubber is None:
+                from ..integrity.scrub import Scrubber
+                scrubber = Scrubber(journal=journal)
+            return 200, "application/json", _j(scrubber.run_once())
         return 404, "application/json", _j({"error": "not found"})
 
     def _query(self, name, params):

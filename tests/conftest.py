@@ -31,3 +31,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "repl: replication suites (WAL shipping, replica "
         "catch-up, failover; select with -m repl)")
+    config.addinivalue_line(
+        "markers", "integrity: storage fault-tolerance suites (disk "
+        "fault injection, checkpoint digests, scrub/quarantine, fsync "
+        "poisoning; select with -m integrity — the randomized "
+        "crash-consistency loop is additionally marked slow)")

@@ -1,0 +1,843 @@
+"""Storage fault-tolerance tests: the injectable fault disk, end-to-end
+checkpoint digests with fallback-past-corruption recovery, fsyncgate
+poisoning (read-only degraded mode across store/REST/health), the
+scrubber + quarantine + replica anti-entropy, WAL mid-segment corruption
+semantics, the admin surfaces, and the randomized crash-consistency
+harness with its 1k-write chaos acceptance gate."""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import parse_spec
+from geomesa_tpu.integrity import (CrashPoint, FaultDisk, Scrubber,
+                                   flip_bit, integrity_report,
+                                   run_crash_workload, verify_checkpoint,
+                                   verify_wal)
+from geomesa_tpu.integrity import faultfs
+from geomesa_tpu.replication import Replica, WalShipper
+from geomesa_tpu.replication.sync import (BootstrapError, ReplClient,
+                                          bootstrap_from_checkpoint)
+from geomesa_tpu.store.memory import InMemoryDataStore
+from geomesa_tpu.tools.cli import main as cli_main
+from geomesa_tpu.wal import WRITE, DurabilityError, DurableStore, \
+    WriteAheadLog
+from geomesa_tpu.wal.log import list_segments
+from geomesa_tpu.wal.snapshot import checkpoint_dirs, drop_stale_checkpoints
+from geomesa_tpu.web import GeoMesaWebServer
+from geomesa_tpu.web.server import WEB_AUTH_TOKEN
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+BBOX_ALL = "BBOX(geom, -110, 20, -50, 55)"
+
+pytestmark = pytest.mark.integrity
+
+
+def make_batch(sft, ids, seed=7):
+    rng = np.random.default_rng(seed)
+    n = len(ids)
+    return FeatureBatch.from_dict(sft, ids, {
+        "name": [f"n{i % 5}" for i in range(n)],
+        "dtg": rng.integers(0, 10**12, n),
+        "geom": (rng.uniform(-100, -60, n), rng.uniform(25, 50, n))})
+
+
+def durable_mem(tmp_path, name="d", **kw):
+    kw.setdefault("wal_fsync", "never")
+    return InMemoryDataStore(durable_dir=str(tmp_path / name), **kw)
+
+
+def _ids(ds, tn="t"):
+    res = ds.query("INCLUDE", tn)
+    return sorted([] if res.batch is None else map(str, res.ids))
+
+
+# -- fault disk -----------------------------------------------------------
+
+class TestFaultDisk:
+    def _write_through(self, tmp_path, data=b"0123456789abcdef"):
+        path = str(tmp_path / "victim")
+        with open(path, "wb") as f:
+            faultfs.write(f, data, path)
+        return path
+
+    def test_passthrough_when_uninstalled(self, tmp_path):
+        path = self._write_through(tmp_path)
+        assert open(path, "rb").read() == b"0123456789abcdef"
+        with open(path, "r+b") as f:
+            faultfs.fsync(f.fileno(), path)  # plain os.fsync
+
+    def test_eio_and_enospc_raise(self, tmp_path):
+        for kind in ("eio", "enospc"):
+            disk = FaultDisk().add("write", match="victim", kind=kind)
+            with disk, pytest.raises(OSError):
+                self._write_through(tmp_path)
+            assert disk.injected == [
+                ("write", str(tmp_path / "victim"), kind)]
+            assert disk.pending() == 0
+
+    def test_torn_write_leaves_prefix(self, tmp_path):
+        disk = FaultDisk().add("write", match="victim", kind="torn")
+        with disk, pytest.raises(CrashPoint):
+            self._write_through(tmp_path)
+        # only the first half of the buffer reached the file
+        assert open(str(tmp_path / "victim"), "rb").read() == b"01234567"
+
+    def test_bitflip_succeeds_silently(self, tmp_path):
+        disk = FaultDisk().add("write", match="victim", kind="bitflip")
+        with disk:
+            path = self._write_through(tmp_path)
+        got = open(path, "rb").read()
+        assert got != b"0123456789abcdef"  # corrupted...
+        assert len(got) == 16              # ...but full-length: no error
+        diff = [i for i in range(16) if got[i] != b"0123456789abcdef"[i]]
+        assert len(diff) == 1  # exactly one byte (one bit) flipped
+
+    def test_fsync_fault_raises(self, tmp_path):
+        path = self._write_through(tmp_path)
+        disk = FaultDisk().add("fsync", match="victim", kind="fsync")
+        with disk, open(path, "r+b") as f:
+            with pytest.raises(OSError):
+                faultfs.fsync(f.fileno(), path)
+            faultfs.fsync(f.fileno(), path)  # one-shot: next call clean
+
+    def test_skip_arms_later_call(self, tmp_path):
+        disk = FaultDisk().add("write", match="victim", kind="eio",
+                               skip=2)
+        with disk:
+            self._write_through(tmp_path)  # skipped
+            self._write_through(tmp_path)  # skipped
+            with pytest.raises(OSError):
+                self._write_through(tmp_path)  # fires
+
+    def test_match_filters_paths(self, tmp_path):
+        disk = FaultDisk().add("write", match="elsewhere", kind="eio")
+        with disk:
+            self._write_through(tmp_path)  # no match: clean
+        assert disk.injected == [] and disk.pending() == 1
+
+    def test_flip_bit_at_rest(self, tmp_path):
+        path = str(tmp_path / "f")
+        with open(path, "wb") as f:
+            f.write(b"\x00" * 64)
+        flip_bit(path)
+        raw = open(path, "rb").read()
+        assert len(raw) == 64 and raw[32] == 0x01
+        flip_bit(path, offset=0)
+        assert open(path, "rb").read()[0] == 0x01
+
+
+# -- artifact verification ------------------------------------------------
+
+class TestVerify:
+    def _ckpt(self, tmp_path, n=20):
+        ds = durable_mem(tmp_path)
+        sft = parse_spec("t", SPEC)
+        ds.create_schema(sft)
+        ds.write("t", make_batch(sft, [f"f{i}" for i in range(n)]))
+        ds.checkpoint()
+        ds.close()
+        root = str(tmp_path / "d")
+        return root, checkpoint_dirs(root)[-1][1]
+
+    def test_checkpoint_digests_verify(self, tmp_path):
+        _root, path = self._ckpt(tmp_path)
+        rep = verify_checkpoint(path)
+        assert rep["ok"] and rep["files_checked"] == 1
+        assert rep["errors"] == [] and rep["unreferenced"] == []
+        manifest = json.load(open(os.path.join(path, "MANIFEST.json")))
+        entry = manifest["types"][0]
+        assert len(entry["sha256"]) == 64 and entry["bytes"] > 0
+
+    def test_bit_rot_detected(self, tmp_path):
+        _root, path = self._ckpt(tmp_path)
+        flip_bit(os.path.join(path, "t.bin"))
+        rep = verify_checkpoint(path)
+        assert not rep["ok"]
+        assert any("sha256 mismatch" in e for e in rep["errors"])
+
+    def test_truncation_detected(self, tmp_path):
+        _root, path = self._ckpt(tmp_path)
+        f = os.path.join(path, "t.bin")
+        with open(f, "r+b") as fh:
+            fh.truncate(os.path.getsize(f) // 2)
+        rep = verify_checkpoint(path)
+        assert not rep["ok"] and any("length" in e for e in rep["errors"])
+
+    def test_unreferenced_flagged_not_failed(self, tmp_path):
+        _root, path = self._ckpt(tmp_path)
+        open(os.path.join(path, "stale.bin"), "wb").write(b"debris")
+        rep = verify_checkpoint(path)
+        assert rep["ok"] and rep["unreferenced"] == ["stale.bin"]
+
+    def test_legacy_manifest_verifies_by_existence(self, tmp_path):
+        _root, path = self._ckpt(tmp_path)
+        mpath = os.path.join(path, "MANIFEST.json")
+        manifest = json.load(open(mpath))
+        for t in manifest["types"]:
+            t.pop("sha256", None)
+            t.pop("bytes", None)
+        json.dump(manifest, open(mpath, "w"))
+        flip_bit(os.path.join(path, "t.bin"))
+        assert verify_checkpoint(path)["ok"]  # no digest: can't condemn
+        os.unlink(os.path.join(path, "t.bin"))
+        rep = verify_checkpoint(path)
+        assert not rep["ok"] and any("missing" in e for e in rep["errors"])
+
+    def _segmented_wal(self, tmp_path, n=9):
+        root = str(tmp_path / "log")
+        wal = WriteAheadLog(root, fsync="never", segment_bytes=64)
+        for i in range(n):
+            wal.append(WRITE, f"payload-{i:04d}".encode() + b"#" * 30)
+        wal.close()
+        segs = list_segments(root)
+        assert len(segs) >= 3
+        return root, segs
+
+    def test_verify_wal_clean_and_tail_torn(self, tmp_path):
+        root, segs = self._segmented_wal(tmp_path)
+        rep = verify_wal(root)
+        assert rep["ok"] and rep["records"] == 9
+        with open(segs[-1][1], "ab") as f:
+            f.write(b"\xba\xad partial tail frame")
+        rep = verify_wal(root)
+        # crash residue in the live tail is normal, not corruption
+        assert rep["ok"] and rep["tail_torn_records"] >= 1
+        assert rep["corrupt_segments"] == []
+
+    def test_verify_wal_mid_history_corruption_fails(self, tmp_path):
+        root, segs = self._segmented_wal(tmp_path)
+        flip_bit(segs[1][1])  # an interior, non-tail segment
+        rep = verify_wal(root)
+        assert not rep["ok"]
+        assert rep["corrupt_segments"] == [os.path.basename(segs[1][1])]
+
+
+# -- checkpoint fallback + recovery ---------------------------------------
+
+class TestCheckpointFallback:
+    def _two_checkpoints(self, tmp_path):
+        """30 rows, checkpoint A, 30 more, checkpoint B (keep=2 keeps
+        both and retains the log back to A)."""
+        ds = durable_mem(tmp_path)
+        sft = parse_spec("t", SPEC)
+        ds.create_schema(sft)
+        ds.write("t", make_batch(sft, [f"a{i}" for i in range(30)]))
+        info_a = ds.checkpoint()
+        ds.write("t", make_batch(sft, [f"b{i}" for i in range(30)], seed=2))
+        info_b = ds.checkpoint()
+        ds.write("t", make_batch(sft, ["tail"], seed=3))
+        want = _ids(ds)
+        ds.close()
+        return str(tmp_path / "d"), info_a, info_b, want
+
+    def test_falls_back_to_prior_checkpoint(self, tmp_path):
+        root, info_a, info_b, want = self._two_checkpoints(tmp_path)
+        newest = checkpoint_dirs(root)[-1][1]
+        flip_bit(os.path.join(newest, "t.bin"))
+        re = durable_mem(tmp_path)
+        rep = re.journal.last_report
+        # corrupt newest skipped, prior selected — NOT a full replay
+        assert rep.checkpoints_skipped == 1
+        assert rep.checkpoint_lsn == info_a["lsn"]
+        assert _ids(re) == want
+        re.close()
+        # the corrupt snapshot was quarantined out of the candidate set
+        assert not os.path.exists(newest)
+        assert os.path.exists(newest + ".corrupt")
+        assert checkpoint_dirs(root)[-1][0] == info_a["lsn"]
+
+    def test_all_corrupt_degrades_to_full_replay(self, tmp_path):
+        root, _a, _b, want = self._two_checkpoints(tmp_path)
+        for _lsn, path in checkpoint_dirs(root):
+            flip_bit(os.path.join(path, "t.bin"))
+        re = durable_mem(tmp_path)
+        rep = re.journal.last_report
+        assert rep.checkpoints_skipped == 2
+        assert rep.checkpoint_lsn == 0  # full replay from the log
+        assert _ids(re) == want
+        re.close()
+
+    def test_gutted_dir_skipped(self, tmp_path):
+        """Satellite (a) regression: a crash between retention's
+        manifest unlink and its rmtree leaves a manifest-less husk —
+        ``checkpoint_dirs`` must ignore it and recovery select the
+        intact snapshot."""
+        root, info_a, info_b, want = self._two_checkpoints(tmp_path)
+        dirs = checkpoint_dirs(root)
+        os.unlink(os.path.join(dirs[-1][1], "MANIFEST.json"))
+        assert [lsn for lsn, _ in checkpoint_dirs(root)] == [info_a["lsn"]]
+        re = durable_mem(tmp_path)
+        assert re.journal.last_report.checkpoint_lsn == info_a["lsn"]
+        assert _ids(re) == want
+        re.close()
+
+    def test_drop_stale_checkpoints_retention(self, tmp_path):
+        root, _a, info_b, _want = self._two_checkpoints(tmp_path)
+        assert drop_stale_checkpoints(root, keep=1) == 1
+        assert [lsn for lsn, _ in checkpoint_dirs(root)] == [info_b["lsn"]]
+
+    def test_tmp_staging_never_visible(self, tmp_path):
+        """Satellite (b): checkpoints stage into a ``.tmp`` sibling and
+        rename into place — success leaves no staging dir, and a torn
+        checkpoint write leaves ONLY debris no loader selects."""
+        ds = durable_mem(tmp_path)
+        sft = parse_spec("t", SPEC)
+        ds.create_schema(sft)
+        ds.write("t", make_batch(sft, ["a", "b"]))
+        ds.checkpoint()
+        root = str(tmp_path / "d")
+        snapdir = os.path.join(root, "snapshots")
+        assert not any(d.endswith(".tmp") for d in os.listdir(snapdir))
+        ds.write("t", make_batch(sft, ["c"], seed=2))
+        disk = FaultDisk().add("write", match="snapshots", kind="torn")
+        with disk, pytest.raises(OSError):
+            ds.checkpoint()
+        tmps = [d for d in os.listdir(snapdir) if d.endswith(".tmp")]
+        assert len(tmps) == 1  # crash debris, flagged by the scrubber
+        assert len(checkpoint_dirs(root)) == 1  # only the intact one
+        want = _ids(ds)
+        ds.close()
+        re = durable_mem(tmp_path)
+        assert _ids(re) == want
+        re.close()
+
+    def test_checkpoint_readback_guards_truncation(self, tmp_path):
+        """A checkpoint corrupted ON THE WAY DOWN (silent bitflip) must
+        fail read-back verification and leave the log untruncated —
+        otherwise compaction would destroy the only good copy."""
+        ds = durable_mem(tmp_path)
+        sft = parse_spec("t", SPEC)
+        ds.create_schema(sft)
+        ds.write("t", make_batch(sft, [f"f{i}" for i in range(25)]))
+        want = _ids(ds)
+        disk = FaultDisk().add("write", match="t.bin", kind="bitflip")
+        with disk, pytest.raises(OSError, match="read-back"):
+            ds.checkpoint()
+        ds.close()
+        re = durable_mem(tmp_path)
+        rep = re.journal.last_report
+        assert rep.checkpoint_lsn == 0  # the bad snapshot was never kept
+        assert _ids(re) == want         # ...and the log replays it all
+        re.close()
+
+
+# -- WAL mid-segment corruption (satellite c) -----------------------------
+
+class TestMidSegmentCorruption:
+    def test_replay_stops_at_interior_corruption(self, tmp_path):
+        """A bit-flipped frame in a NON-tail segment ends replay at the
+        corruption point — continuing past it would replay across a
+        hole — and the RecoveryReport says exactly where."""
+        root = str(tmp_path / "w")
+        ds = DurableStore(InMemoryDataStore(), root, fsync="never",
+                          segment_bytes=256)
+        sft = parse_spec("t", SPEC)
+        ds.create_schema(sft)
+        for i in range(30):  # single-feature writes: lsn i+2 = row i
+            ds.write("t", make_batch(sft, [f"f{i}"], seed=i))
+        segs = list_segments(os.path.join(root, "log"))
+        assert len(segs) >= 3
+        ds.close()
+        flip_bit(segs[len(segs) // 2][1])
+        re = DurableStore(InMemoryDataStore(), root, fsync="never",
+                          segment_bytes=256)
+        rep = re.recovery
+        assert rep.corrupt_frames >= 1
+        assert 1 <= rep.replay_stopped_lsn < 31
+        assert any("replay stopped" in e for e in rep.errors)
+        # exactly the pre-corruption prefix survives: lsn 1 is the
+        # schema record, every lsn k >= 2 is row f{k-2}
+        got = _ids(re)
+        assert got == sorted(f"f{i}"
+                             for i in range(rep.replay_stopped_lsn - 1))
+        re.close()
+
+    def test_raw_records_stop_dont_skip(self, tmp_path):
+        root = str(tmp_path / "log")
+        wal = WriteAheadLog(root, fsync="never", segment_bytes=64)
+        for i in range(9):
+            wal.append(WRITE, f"payload-{i:04d}".encode() + b"#" * 30)
+        wal.close()
+        segs = list_segments(root)
+        flip_bit(segs[1][1])
+        wal2 = WriteAheadLog(root, fsync="never", segment_bytes=64)
+        torn_calls = []
+        lsns = [lsn for lsn, _, _ in
+                wal2.records(on_torn=lambda p, n: torn_calls.append((p, n)))]
+        wal2.close()
+        assert torn_calls and torn_calls[0][1] >= 1
+        # a contiguous prefix, never records from beyond the hole
+        assert lsns == list(range(1, len(lsns) + 1))
+        assert len(lsns) < 9
+
+
+# -- fsyncgate: poison + read-only degradation ----------------------------
+
+class TestFsyncPoison:
+    def _store(self, tmp_path):
+        ds = durable_mem(tmp_path, wal_fsync="always")
+        sft = parse_spec("t", SPEC)
+        ds.create_schema(sft)
+        ds.write("t", make_batch(sft, ["a", "b", "c"]))
+        return ds, sft
+
+    def test_failed_fsync_poisons_permanently(self, tmp_path):
+        ds, sft = self._store(tmp_path)
+        disk = FaultDisk().add("fsync", match="log", kind="fsync")
+        with disk:
+            with pytest.raises(DurabilityError):
+                ds.write("t", make_batch(sft, ["x"], seed=2))
+        assert ds.journal.poisoned
+        assert ds.journal.stats()["poisoned"]
+        # reads keep serving the acked prefix
+        assert _ids(ds) == ["a", "b", "c"]
+        # the poison is permanent: NO fault is armed now, yet writes
+        # still refuse (retrying the fsync would trust pages the kernel
+        # may have silently dropped — fsyncgate)
+        with pytest.raises(DurabilityError):
+            ds.write("t", make_batch(sft, ["y"], seed=3))
+        with pytest.raises(DurabilityError):
+            ds.delete("t", ["a"])
+        with pytest.raises(DurabilityError):
+            ds.checkpoint()
+        ds.close()  # must not raise (skips the doomed sync)
+        # a fresh process on the same root recovers every acked write;
+        # the in-flight "x" hit the log file before its failed fsync so
+        # it MAY survive (at-most-once tail) — but never partially, and
+        # nothing acked may be missing
+        re = durable_mem(tmp_path, wal_fsync="always")
+        got = _ids(re)
+        assert set(["a", "b", "c"]) <= set(got) <= {"a", "b", "c", "x"}
+        assert not re.journal.poisoned
+        re.write("t", make_batch(sft, ["new"], seed=4))  # healthy again
+        re.close()
+
+    def test_health_and_rest_report_degraded(self, tmp_path):
+        ds, sft = self._store(tmp_path)
+        disk = FaultDisk().add("fsync", match="log", kind="fsync")
+        with disk, pytest.raises(DurabilityError):
+            ds.write("t", make_batch(sft, ["x"], seed=2))
+        srv = GeoMesaWebServer(ds).start()
+        try:
+            st, body = _request(srv, "GET", "/rest/health")
+            assert st == 200
+            assert body["durability"]["poisoned"]
+            assert body["durability"]["mode"] == "read-only"
+            st, body = _request(srv, "GET", "/rest/integrity")
+            assert st == 200 and body["poisoned"]
+            # a mutating route surfaces the typed refusal as 503 +
+            # retryable false (an operator problem, not a client one)
+            st, body = _request(srv, "POST", "/rest/wal/checkpoint")
+            assert st == 503
+            assert body["degraded"] == "read-only"
+            assert body["retryable"] is False
+            # reads still flow
+            st, body = _request(srv, "GET", "/rest/count/t")
+            assert st == 200
+        finally:
+            srv.stop()
+            ds.journal.abort()
+
+    def test_healthy_store_reports_unpoisoned(self, tmp_path):
+        ds, _sft = self._store(tmp_path)
+        srv = GeoMesaWebServer(ds).start()
+        try:
+            st, body = _request(srv, "GET", "/rest/health")
+            assert st == 200
+            assert body["durability"] == {"poisoned": False}
+        finally:
+            srv.stop()
+            ds.close()
+
+
+def _request(srv, method, path, token=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", method=method,
+        data=b"" if method == "POST" else None)
+    if token is not None:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+# -- scrubber + quarantine ------------------------------------------------
+
+class TestScrubber:
+    def _seed(self, tmp_path):
+        ds = durable_mem(tmp_path)
+        sft = parse_spec("t", SPEC)
+        ds.create_schema(sft)
+        ds.write("t", make_batch(sft, [f"f{i}" for i in range(20)]))
+        ds.checkpoint()
+        ds.write("t", make_batch(sft, [f"g{i}" for i in range(20)], seed=2))
+        ds.checkpoint()
+        return ds, str(tmp_path / "d")
+
+    def test_clean_root_scrubs_clean(self, tmp_path):
+        ds, _root = self._seed(tmp_path)
+        scr = Scrubber(journal=ds.journal, interval_s=999)
+        out = scr.run_once()
+        assert out["ok"] and out["quarantined"] == []
+        assert out["wal"]["ok"] and len(out["checkpoints"]) == 2
+        assert scr.runs == 1 and scr.status()["last_report"] is out
+        ds.close()
+
+    def test_quarantines_corrupt_checkpoint(self, tmp_path):
+        ds, root = self._seed(tmp_path)
+        newest = checkpoint_dirs(root)[-1][1]
+        flip_bit(os.path.join(newest, "t.bin"))
+        out = Scrubber(journal=ds.journal, interval_s=999).run_once()
+        assert not out["ok"]
+        assert out["quarantined"] == [os.path.basename(newest) + ".corrupt"]
+        assert not os.path.exists(newest)
+        assert len(checkpoint_dirs(root)) == 1
+        # the quarantine heals the candidate set: next pass is clean
+        assert Scrubber(journal=ds.journal,
+                        interval_s=999).run_once()["ok"]
+        ds.close()
+
+    def test_quarantine_knob_off_detects_only(self, tmp_path):
+        ds, root = self._seed(tmp_path)
+        newest = checkpoint_dirs(root)[-1][1]
+        flip_bit(os.path.join(newest, "t.bin"))
+        out = Scrubber(journal=ds.journal, interval_s=999,
+                       quarantine_corrupt=False).run_once()
+        assert not out["ok"] and out["quarantined"] == []
+        assert os.path.exists(newest)  # reported, left in place
+        ds.close()
+
+    def test_flags_unreferenced_and_tmp_debris(self, tmp_path):
+        ds, root = self._seed(tmp_path)
+        newest = checkpoint_dirs(root)[-1][1]
+        open(os.path.join(newest, "orphan.bin"), "wb").write(b"x")
+        os.makedirs(os.path.join(root, "snapshots",
+                                 "ckpt-00000000000000000099.tmp"))
+        out = Scrubber(journal=ds.journal, interval_s=999).run_once()
+        assert out["ok"]  # debris is flagged, not corruption
+        assert any(u.endswith("orphan.bin") for u in out["unreferenced"])
+        assert any(u.endswith(".tmp") for u in out["unreferenced"])
+        ds.close()
+
+    def test_never_renames_wal_segments(self, tmp_path):
+        """Quarantining a corrupt WAL segment would turn a detected
+        replay stop into a silently shorter log — the scrubber reports
+        it and leaves the file alone."""
+        root = str(tmp_path / "w")
+        ds = DurableStore(InMemoryDataStore(), root, fsync="never",
+                          segment_bytes=256)
+        sft = parse_spec("t", SPEC)
+        ds.create_schema(sft)
+        for i in range(30):
+            ds.write("t", make_batch(sft, [f"f{i}"], seed=i))
+        segs = list_segments(os.path.join(root, "log"))
+        victim = segs[1][1]
+        flip_bit(victim)
+        out = Scrubber(journal=ds.journal, interval_s=999).run_once()
+        assert not out["ok"]
+        assert out["wal"]["corrupt_segments"] == [os.path.basename(victim)]
+        assert os.path.exists(victim)  # still in place
+        assert out["quarantined"] == []
+        ds.close()
+
+    def test_background_loop_runs(self, tmp_path):
+        import time
+        ds, _root = self._seed(tmp_path)
+        scr = Scrubber(journal=ds.journal, interval_s=0.05).start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while scr.runs < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert scr.runs >= 2
+            assert scr.status()["running"]
+        finally:
+            scr.stop()
+            ds.close()
+        assert not scr.status()["running"]
+
+
+# -- replica anti-entropy -------------------------------------------------
+
+@pytest.mark.repl
+class TestAntiEntropy:
+    def _wait(self, cond, timeout_s=10.0, what="condition"):
+        import time
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    def test_digest_mismatch_triggers_rebootstrap(self, tmp_path):
+        primary = durable_mem(tmp_path, name="primary",
+                              wal_fsync="never")
+        sft = parse_spec("t", SPEC)
+        primary.create_schema(sft)
+        primary.write("t", make_batch(sft, [f"f{i}" for i in range(25)]))
+        ship = WalShipper(primary.journal, store=primary)
+        r = Replica(ship.host, ship.port, name="ae")
+        try:
+            tail = primary.journal.wal.last_lsn
+            self._wait(lambda: r.applied_lsn >= tail, what="catch-up")
+            boots = r.bootstraps
+            # silent divergence: a row the primary never shipped
+            r._store.write("t", make_batch(sft, ["evil"], seed=99))
+            assert _ids(r) != _ids(primary)
+            out = Scrubber(replica=r, interval_s=999).run_once()
+            assert not out["ok"]
+            anti = out["anti_entropy"]
+            assert anti["checked"] and anti["mismatch"] == ["t"]
+            assert anti["rebootstrap"]
+            # the forced re-bootstrap reconverges the replica
+            self._wait(lambda: r.bootstraps > boots
+                       and r.applied_lsn >= tail
+                       and _ids(r) == _ids(primary),
+                       what="re-bootstrap convergence")
+            assert Scrubber(replica=r,
+                            interval_s=999).run_once()["ok"]
+        finally:
+            r.stop()
+            ship.stop()
+            primary.close()
+
+    def test_lagging_replica_not_condemned(self, tmp_path):
+        """A replica mid-catch-up legitimately differs from the
+        primary; anti-entropy must skip the comparison, not force a
+        bootstrap storm."""
+        primary = durable_mem(tmp_path, name="primary",
+                              wal_fsync="never")
+        sft = parse_spec("t", SPEC)
+        primary.create_schema(sft)
+        primary.write("t", make_batch(sft, ["a", "b"]))
+        ship = WalShipper(primary.journal, store=primary)
+        r = Replica(ship.host, ship.port, name="lag", start=False)
+        try:  # never started: applied_lsn stays 0 (maximally stale)
+            out = Scrubber(replica=r, interval_s=999).run_once()
+            assert out["ok"]
+            assert not out["anti_entropy"]["checked"]
+        finally:
+            r.stop()
+            ship.stop()
+            primary.close()
+
+    def test_bootstrap_rejects_tampered_checkpoint(self, tmp_path):
+        """End-to-end digest over the wire: a corrupt source file fails
+        the bootstrap with a typed, retryable error — it never becomes
+        garbage rows on the replica."""
+        primary = durable_mem(tmp_path, name="primary",
+                              wal_fsync="never")
+        sft = parse_spec("t", SPEC)
+        primary.create_schema(sft)
+        primary.write("t", make_batch(sft, [f"f{i}" for i in range(25)]))
+        primary.checkpoint()
+        root = str(tmp_path / "primary")
+        flip_bit(os.path.join(checkpoint_dirs(root)[-1][1], "t.bin"))
+        ship = WalShipper(primary.journal, store=primary)
+        target = InMemoryDataStore()
+        client = ReplClient(ship.host, ship.port)
+        try:
+            with pytest.raises(BootstrapError, match="sha256 mismatch"):
+                bootstrap_from_checkpoint(client, target)
+        finally:
+            client.close()
+            ship.stop()
+            primary.close()
+
+
+# -- admin surfaces -------------------------------------------------------
+
+class TestIntegrityCli:
+    def _seed(self, tmp_path):
+        ds = durable_mem(tmp_path)
+        sft = parse_spec("t", SPEC)
+        ds.create_schema(sft)
+        ds.write("t", make_batch(sft, ["a", "b", "c"]))
+        ds.checkpoint()
+        ds.write("t", make_batch(sft, ["d"], seed=2))
+        ds.checkpoint()
+        ds.close()
+        return str(tmp_path / "d")
+
+    def test_verify_rc_tracks_corruption(self, tmp_path, capsys):
+        root = self._seed(tmp_path)
+        assert cli_main(["integrity", "verify", "--wal-dir", root]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"] and out["wal"]["ok"]
+        assert len(out["checkpoints"]) == 2
+        flip_bit(os.path.join(checkpoint_dirs(root)[-1][1], "t.bin"))
+        assert cli_main(["integrity", "verify", "--wal-dir", root]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert not out["ok"]
+        # verify is read-only: nothing was quarantined
+        assert len(checkpoint_dirs(root)) == 2
+
+    def test_scrub_gated_and_quarantines(self, tmp_path, capsys):
+        root = self._seed(tmp_path)
+        newest = checkpoint_dirs(root)[-1][1]
+        flip_bit(os.path.join(newest, "t.bin"))
+        WEB_AUTH_TOKEN.set("sekrit")
+        try:
+            assert cli_main(["integrity", "scrub",
+                             "--wal-dir", root]) == 3
+            assert cli_main(["integrity", "scrub", "--wal-dir", root,
+                             "--token", "wrong"]) == 3
+            assert os.path.exists(newest)  # gated calls touched nothing
+            assert cli_main(["integrity", "scrub", "--wal-dir", root,
+                             "--token", "sekrit"]) == 1
+        finally:
+            WEB_AUTH_TOKEN.set(None)
+        capsys.readouterr()
+        assert not os.path.exists(newest)
+        assert os.path.exists(newest + ".corrupt")
+        # post-quarantine the root is healthy; ungated without a token
+        assert cli_main(["integrity", "scrub", "--wal-dir", root]) == 0
+
+
+class TestIntegrityRest:
+    def test_non_durable_store_404s(self):
+        srv = GeoMesaWebServer(InMemoryDataStore()).start()
+        try:
+            assert _request(srv, "GET", "/rest/integrity")[0] == 404
+        finally:
+            srv.stop()
+
+    def test_report_and_gated_scrub(self, tmp_path):
+        ds = durable_mem(tmp_path)
+        sft = parse_spec("t", SPEC)
+        ds.create_schema(sft)
+        ds.write("t", make_batch(sft, ["a", "b"]))
+        ds.checkpoint()
+        root = str(tmp_path / "d")
+        srv = GeoMesaWebServer(ds, auth_token="tok").start()
+        try:
+            st, body = _request(srv, "GET", "/rest/integrity")
+            assert st == 200 and body["ok"] and not body["poisoned"]
+            st, _ = _request(srv, "POST", "/rest/integrity/scrub")
+            assert st == 403  # mutating: bearer required
+            flip_bit(os.path.join(checkpoint_dirs(root)[-1][1], "t.bin"))
+            st, body = _request(srv, "POST", "/rest/integrity/scrub",
+                                token="tok")
+            assert st == 200 and not body["ok"]
+            assert len(body["quarantined"]) == 1
+            st, body = _request(srv, "GET", "/rest/integrity")
+            assert st == 200 and body["ok"]  # healed candidate set
+        finally:
+            srv.stop()
+            ds.close()
+
+
+# -- crash-consistency acceptance -----------------------------------------
+
+class TestChaosAcceptance:
+    def test_acceptance_gate_1k_writes(self, tmp_path):
+        """ISSUE acceptance: a 1k-feature acked workload surviving a
+        checkpoint bit-flip at rest, a torn checkpoint write, and one
+        injected fsync failure — zero acked-write loss, the poisoned
+        store serves reads and refuses writes with the typed error, and
+        recovery falls back to the PRIOR checkpoint, not full replay."""
+        ds = durable_mem(tmp_path, wal_fsync="always")
+        sft = parse_spec("t", SPEC)
+        ds.create_schema(sft)
+        acked = []
+
+        def write_rows(prefix, n, per_batch=20):
+            for lo in range(0, n, per_batch):
+                ids = [f"{prefix}{i}" for i in range(lo, lo + per_batch)]
+                ds.write("t", make_batch(sft, ids, seed=lo))
+                acked.extend(ids)
+
+        write_rows("a", 200)
+        info_a = ds.checkpoint()
+        write_rows("b", 200)
+        info_b = ds.checkpoint()
+        assert info_b["lsn"] > info_a["lsn"]
+        root = str(tmp_path / "d")
+        # fault 1: bit rot in the newest checkpoint, at rest
+        flip_bit(os.path.join(checkpoint_dirs(root)[-1][1], "t.bin"))
+        write_rows("c", 300)
+        # fault 2: torn checkpoint write (power cut mid-snapshot)
+        disk = FaultDisk().add("write", match="snapshots", kind="torn")
+        with disk, pytest.raises(OSError):
+            ds.checkpoint()
+        write_rows("d", 300)
+        assert len(acked) == 1000
+        # fault 3: one fsync failure -> permanent poison
+        disk = FaultDisk().add("fsync", match="log", kind="fsync")
+        with disk, pytest.raises(DurabilityError):
+            ds.write("t", make_batch(sft, ["never-acked"], seed=77))
+        assert ds.journal.poisoned
+        assert _ids(ds) == sorted(acked)  # reads serve the acked prefix
+        with pytest.raises(DurabilityError):
+            ds.write("t", make_batch(sft, ["still-refused"], seed=78))
+        ds.journal.abort()  # crash, never a clean close
+        # recovery: past the flipped checkpoint to the prior one
+        re = durable_mem(tmp_path, wal_fsync="always")
+        rep = re.journal.last_report
+        assert rep.checkpoints_skipped == 1
+        assert rep.checkpoint_lsn == info_a["lsn"]  # NOT full replay
+        got = _ids(re)
+        # zero acked loss; the one in-flight frame whose fsync failed
+        # MAY survive (it hit the log file first — at-most-once tail),
+        # but the post-poison refused write must not: poison rejects
+        # BEFORE a frame is written
+        assert set(acked) <= set(got) <= set(acked) | {"never-acked"}
+        assert "still-refused" not in got
+        assert len(got) == len(set(got))   # no duplicates
+        assert not re.journal.poisoned     # fresh process is healthy
+        re.close()
+
+    def test_harness_randomized_short(self, tmp_path):
+        """A short deterministic slice of the randomized kill-point
+        loop (the full-length soak is the slow-marked test below)."""
+        out = run_crash_workload(str(tmp_path / "h"), rounds=3,
+                                 writes_per_round=12, seed=1234)
+        assert out["ok"], out["violations"]
+        assert out["rounds"] == 3
+        assert out["faults_injected"] >= 1
+        assert out["acked"] <= out["issued"]
+
+
+@pytest.mark.slow
+def test_crash_harness_soak(tmp_path):
+    """Long randomized crash-consistency soak: many seeds, many rounds;
+    every acked write survives every kill-point, no duplicates, no
+    garbage, poisoned stores degrade read-only."""
+    for seed in (1, 7, 42, 1234):
+        out = run_crash_workload(str(tmp_path / f"s{seed}"), rounds=8,
+                                 writes_per_round=25, seed=seed)
+        assert out["ok"], (seed, out["violations"])
+        assert out["faults_injected"] >= 1
+
+
+# -- package surface ------------------------------------------------------
+
+class TestIntegritySurface:
+    def test_integrity_report_shape(self, tmp_path):
+        ds = durable_mem(tmp_path)
+        sft = parse_spec("t", SPEC)
+        ds.create_schema(sft)
+        ds.write("t", make_batch(sft, ["a"]))
+        ds.checkpoint()
+        ds.close()
+        rep = integrity_report(str(tmp_path / "d"))
+        assert rep["ok"] and rep["wal"]["ok"]
+        assert [c["ok"] for c in rep["checkpoints"]] == [True]
+
+    def test_lazy_exports(self):
+        import geomesa_tpu.integrity as integ
+        for name in ("CrashPoint", "Fault", "FaultDisk", "flip_bit",
+                     "verify_checkpoint", "verify_wal", "ids_digest",
+                     "quarantine", "Scrubber", "integrity_report",
+                     "CrashHarness", "run_crash_workload"):
+            assert callable(getattr(integ, name)), name
+        with pytest.raises(AttributeError):
+            integ.no_such_symbol
