@@ -9,10 +9,11 @@ import numpy as np
 from ..features.batch import PointColumn
 from ..index.api import Query
 from ..stats import EnumerationStat, MinMax
-from .join import dwithin_join, knn
+from .join import contains_join, dwithin_join, knn, knn_batched
 from .tube import TubeBuilder, tube_select_mask
 
-__all__ = ["knn_process", "knn_spiral_process", "proximity_process",
+__all__ = ["knn_process", "knn_batch_process", "contains_process",
+           "knn_spiral_process", "proximity_process",
            "unique_process", "minmax_process", "tube_select_process",
            "sampling_process", "query_process", "join_process",
            "point2point_process", "track_label_process",
@@ -99,10 +100,16 @@ def _knn_zring(st, col, qx: float, qy: float, k: int):
     return None
 
 
-def knn_process(store, type_name: str, qx: float, qy: float, k: int,
-                ecql=None):
+def knn_process(store, type_name: str, qx, qy, k: int, ecql=None):
     """KNearestNeighborSearchProcess (knn/KNearestNeighborSearchProcess.scala:30):
-    k nearest features to the query point, optionally pre-filtered."""
+    k nearest features to the query point, optionally pre-filtered.
+
+    ``qx``/``qy`` may be arrays — the reference process takes a
+    *collection* of query features; a multi-query call routes through
+    the fused batched dispatch (knn_batch_process) and returns a list
+    of (ids, distances) pairs, one per query point."""
+    if np.ndim(qx) > 0:
+        return knn_batch_process(store, type_name, qx, qy, k, ecql=ecql)
     st, col = _point_cols(store, type_name)
     if col is None:
         return np.empty(0, object), np.empty(0)
@@ -121,6 +128,55 @@ def knn_process(store, type_name: str, qx: float, qy: float, k: int,
     d, idx = knn(col.x, col.y, qx, qy, min(k, st.n),
                  device_xy=_resident_xy(st))
     return st.batch.ids[idx], d
+
+
+def knn_batch_process(store, type_name: str, qx, qy, k: int, ecql=None):
+    """Batched KNN: ONE fused device dispatch answers every query point
+    (analytics/join.knn_batched) against the resident coordinate
+    columns — Q queries cost one kernel launch + one transfer instead
+    of Q round trips. Returns [(ids, distances), ...] per query,
+    distances ascending with the id-stable tiebreak."""
+    qx = np.atleast_1d(np.asarray(qx, np.float64))
+    qy = np.atleast_1d(np.asarray(qy, np.float64))
+    st, col = _point_cols(store, type_name)
+    if col is None:
+        return [(np.empty(0, object), np.empty(0)) for _ in qx]
+    if ecql is not None:
+        res = store.query(Query(type_name, ecql))
+        sub = res.batch
+        if sub is None or sub.n == 0:
+            return [(np.empty(0, object), np.empty(0)) for _ in qx]
+        scol = sub.col(st.sft.geom_field)
+        d, idx = knn_batched(scol.x, scol.y, qx, qy, min(k, sub.n))
+        return [(sub.ids[idx[i]], d[i]) for i in range(len(qx))]
+    d, idx = knn_batched(col.x, col.y, qx, qy, min(k, st.n),
+                         device_xy=_resident_xy(st))
+    return [(st.batch.ids[idx[i]], d[i]) for i in range(len(qx))]
+
+
+def contains_process(store, type_name: str, polygons,
+                     counts_only: bool = True):
+    """Batched ST_Contains over the resident point columns: counts (and
+    optionally matching feature ids) per polygon via the fused x-slab +
+    crossing-number kernel (analytics/join.contains_join) — the
+    points-vs-polygons join surface BASELINE config #5 measures.
+    Returns (counts, None) or (counts, [ids_per_polygon, ...])."""
+    st, col = _point_cols(store, type_name)
+    k = len(polygons)
+    if col is None:
+        return (np.zeros(k, np.int64),
+                None if counts_only else [np.empty(0, object)] * k)
+    counts, pairs = contains_join(polygons, col.x, col.y,
+                                  counts_only=counts_only,
+                                  device_xy=_resident_xy(st))
+    if counts_only:
+        return counts, None
+    ids = []
+    for j in range(k):
+        rows = pairs[pairs[:, 1] == j, 0] if len(pairs) else \
+            np.empty(0, np.int64)
+        ids.append(st.batch.ids[rows])
+    return counts, ids
 
 
 def knn_spiral_process(store, type_name: str, qx: float, qy: float, k: int,

@@ -37,6 +37,10 @@ Knobs (system properties / environment):
   default true. Idle schemas (arrivals slower than the ceiling) pay
   ~zero linger; saturated ones wait just long enough for the queue to
   fill.
+- ``geomesa.knn.batch`` (``GEOMESA_KNN_BATCH``) — coalesce concurrent
+  ``knn()`` calls into one fused multi-query top-k dispatch
+  (analytics/join.knn_batched), the way bbox queries already coalesce;
+  default true. Disabled, each KNN request dispatches on its own.
 
 Metrics (global registry): ``batcher.queries``, ``batcher.batches``,
 ``batcher.coalesced``, ``batcher.occupancy``, ``batcher.coalesce_ratio``,
@@ -49,17 +53,20 @@ from __future__ import annotations
 import threading
 import time
 
+import numpy as np
+
 from ..metrics import metrics
 from ..utils.properties import SystemProperty
 from .zscan import next_pow2
 
 __all__ = ["QueryBatcher", "BATCH_MAX_SIZE", "BATCH_LINGER_MICROS",
-           "BATCH_LINGER_ADAPTIVE"]
+           "BATCH_LINGER_ADAPTIVE", "KNN_BATCH"]
 
 BATCH_MAX_SIZE = SystemProperty("geomesa.batch.max.size", "32")
 BATCH_LINGER_MICROS = SystemProperty("geomesa.batch.linger.micros", "2000")
 BATCH_LINGER_ADAPTIVE = SystemProperty("geomesa.batch.linger.adaptive",
                                        "true")
+KNN_BATCH = SystemProperty("geomesa.knn.batch", "true")
 
 # EWMA smoothing for the per-schema inter-arrival estimate: the most
 # recent ~5 arrivals dominate, so the estimate tracks load shifts
@@ -174,6 +181,37 @@ class QueryBatcher:
         self._lead(q.type_name, tq)
         return p.get()
 
+    def knn(self, type_name: str, qx: float, qy: float, k: int):
+        """Submit one KNN query; blocks until (ids, distances) is
+        ready. Concurrent callers on the same (type, k) coalesce into
+        ONE fused multi-query top-k dispatch — the KNN analog of
+        ``query()``'s admission queue (``geomesa.knn.batch``)."""
+        from ..analytics.processes import knn_process
+        enabled = str(KNN_BATCH.get()).lower() in ("true", "1", "yes")
+        if not enabled or self.max_batch <= 1:
+            self._note(1)
+            return knn_process(self.store, type_name, float(qx),
+                               float(qy), k)
+        p = _Pending((float(qx), float(qy)))
+        key = f"{type_name}\x00knn\x00{int(k)}"
+        with self._cond:
+            tq = self._queues.setdefault(key, _TypeQueue())
+            tq.observe_arrival(time.monotonic())
+            tq.items.append(p)
+            if not tq.has_leader:
+                tq.has_leader = True
+                leader = True
+            else:
+                leader = False
+                if len(tq.items) >= self.max_batch:
+                    self._cond.notify_all()
+        if not leader:
+            return p.get()
+        self._lead(key, tq,
+                   dispatch=lambda _key, chunk:
+                   self._dispatch_knn(type_name, int(k), chunk))
+        return p.get()
+
     def stats(self) -> dict:
         """Batching counters (also mirrored into the metrics registry)."""
         total = self.total_queries
@@ -192,9 +230,11 @@ class QueryBatcher:
 
     # -- leader path -------------------------------------------------------
 
-    def _lead(self, type_name: str, tq: _TypeQueue):
+    def _lead(self, type_name: str, tq: _TypeQueue, dispatch=None):
         """Linger for followers (only under load), then drain the queue
-        in max_batch chunks and dispatch each as one fused scan."""
+        in max_batch chunks and dispatch each as one fused scan.
+        ``dispatch`` overrides the bbox-query dispatcher (the KNN path
+        shares the admission/linger machinery, not the plan cache)."""
         t0 = time.perf_counter()
         chunks: list[list[_Pending]] = []
         with self._cond:
@@ -220,9 +260,10 @@ class QueryBatcher:
             tq.has_leader = False
             self._in_flight += 1
         self._observe_linger(time.perf_counter() - t0)
+        dispatch = dispatch or self._dispatch
         try:
             for chunk in chunks:
-                self._dispatch(type_name, chunk)
+                dispatch(type_name, chunk)
         finally:
             with self._cond:
                 self._in_flight -= 1
@@ -273,6 +314,35 @@ class QueryBatcher:
             for p in chunk:
                 try:
                     p.resolve(result=self.store.query(p.q))
+                except Exception as e:  # noqa: BLE001
+                    p.resolve(error=e)
+
+    def _dispatch_knn(self, type_name: str, k: int,
+                      chunk: list[_Pending]):
+        """One fused multi-query top-k for a drained KNN chunk: stack
+        the query points and let the batched process answer all of them
+        in one device dispatch; demultiplex (ids, distances) per
+        caller. Failures replay per caller, same contract as
+        ``_dispatch``."""
+        from ..analytics.processes import knn_batch_process, knn_process
+        occupancy = len(chunk)
+        self._note(occupancy)
+        try:
+            if occupancy == 1:
+                qx, qy = chunk[0].q
+                chunk[0].resolve(result=knn_process(
+                    self.store, type_name, qx, qy, k))
+                return
+            qx = np.array([p.q[0] for p in chunk])
+            qy = np.array([p.q[1] for p in chunk])
+            results = knn_batch_process(self.store, type_name, qx, qy, k)
+            for p, r in zip(chunk, results):
+                p.resolve(result=r)
+        except Exception:
+            for p in chunk:
+                try:
+                    p.resolve(result=knn_process(
+                        self.store, type_name, p.q[0], p.q[1], k))
                 except Exception as e:  # noqa: BLE001
                     p.resolve(error=e)
 

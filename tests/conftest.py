@@ -36,3 +36,7 @@ def pytest_configure(config):
         "fault injection, checkpoint digests, scrub/quarantine, fsync "
         "poisoning; select with -m integrity — the randomized "
         "crash-consistency loop is additionally marked slow)")
+    config.addinivalue_line(
+        "markers", "bench_smoke: miniature end-to-end runs of the "
+        "bench.py perf configs (4: batched KNN, 5: contains join) at "
+        "toy sizes — exactness wiring, not performance")

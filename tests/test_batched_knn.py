@@ -1,0 +1,355 @@
+"""Batched KNN dispatch + fused/mesh contains joins (BASELINE configs
+4/5 perf work): exactness of the multi-query top-k path against an
+id-stable numpy oracle, the process/batcher/web surfaces above it, and
+the single-dispatch + mesh-sharded ST_Contains counts contracts."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.analytics.join import (contains_join, knn, knn_batched,
+                                        prewarm_join_kernels)
+from geomesa_tpu.analytics.processes import (contains_process,
+                                             knn_batch_process,
+                                             knn_process)
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.store import InMemoryDataStore
+
+
+def _knn_oracle(px, py, qx, qy, k):
+    """Exact f64 top-k with the id-stable tiebreak: ascending
+    (distance, id) lexicographic order."""
+    d2 = (px - qx) ** 2 + (py - qy) ** 2
+    order = np.lexsort((np.arange(len(px)), d2))[:k]
+    return order
+
+
+@pytest.fixture(scope="module")
+def cloud():
+    rng = np.random.default_rng(7)
+    n = 20_000
+    px = rng.uniform(-180, 180, n)
+    py = rng.uniform(-90, 90, n)
+    # duplicate coordinates: force distance ties across distinct ids
+    px[1000:1200] = px[:200]
+    py[1000:1200] = py[:200]
+    return px, py
+
+
+class TestKnnBatched:
+    @pytest.mark.parametrize("k", [1, 100])
+    @pytest.mark.parametrize("nq", [1, 8, 64])
+    def test_matches_oracle(self, cloud, k, nq):
+        px, py = cloud
+        rng = np.random.default_rng(nq * 100 + k)
+        qx = rng.uniform(-180, 180, nq)
+        qy = rng.uniform(-90, 90, nq)
+        d, ids = knn_batched(px, py, qx, qy, k)
+        assert d.shape == (nq, k) and ids.shape == (nq, k)
+        for i in range(nq):
+            want = _knn_oracle(px, py, qx[i], qy[i], k)
+            assert np.array_equal(ids[i], want)
+            assert np.all(np.diff(d[i]) >= 0)
+
+    def test_out_of_envelope_queries(self, cloud):
+        # queries far outside the data envelope still rank exactly
+        px, py = cloud
+        qx = np.array([-250.0, 250.0, 0.0, -250.0])
+        qy = np.array([-120.0, 120.0, 119.0, 0.0])
+        d, ids = knn_batched(px, py, qx, qy, 50)
+        for i in range(4):
+            assert np.array_equal(ids[i], _knn_oracle(px, py, qx[i],
+                                                      qy[i], 50))
+
+    def test_single_path_delegates_to_batched(self, cloud):
+        px, py = cloud
+        d1, i1 = knn(px, py, 12.5, -33.0, 25)
+        db, ib = knn_batched(px, py, np.array([12.5]),
+                             np.array([-33.0]), 25)
+        assert np.array_equal(i1, ib[0])
+        assert np.array_equal(d1, db[0])
+
+    def test_k_boundary_tie_is_id_stable(self):
+        # many points coincident with the query: the k-boundary cuts
+        # through a tie group; lowest ids must win, deterministically
+        px = np.zeros(500)
+        py = np.zeros(500)
+        px[400:] = 50.0  # distant filler
+        for _ in range(3):
+            d, ids = knn_batched(px, py, np.array([0.0]),
+                                 np.array([0.0]), 10)
+            assert np.array_equal(ids[0], np.arange(10))
+            assert np.all(d[0] == 0.0)
+
+    def test_k_clamped_and_empty(self):
+        d, ids = knn_batched(np.array([1.0, 2.0]), np.array([0.0, 0.0]),
+                             np.array([0.0]), np.array([0.0]), 10)
+        assert ids.shape == (1, 2) and np.array_equal(ids[0], [0, 1])
+        d, ids = knn_batched(np.empty(0), np.empty(0),
+                             np.array([0.0]), np.array([0.0]), 5)
+        assert ids.shape[0] == 1 and ids.size == 0
+
+    def test_two_stage_blocked_topk(self):
+        # n > 4*16384 triggers the blocked kernel; stays exact
+        rng = np.random.default_rng(11)
+        n = 70_000
+        px = rng.uniform(-10, 10, n)
+        py = rng.uniform(-10, 10, n)
+        qx = np.array([0.0, 9.0])
+        qy = np.array([0.0, -9.0])
+        d, ids = knn_batched(px, py, qx, qy, 100)
+        for i in range(2):
+            assert np.array_equal(ids[i],
+                                  _knn_oracle(px, py, qx[i], qy[i], 100))
+
+
+@pytest.fixture(scope="module")
+def pts_store(cloud):
+    px, py = cloud
+    ds = InMemoryDataStore()
+    ds.create_schema(parse_spec("pts", "*geom:Point:srid=4326"))
+    ds.write_dict("pts", np.arange(len(px)).astype(str).astype(object),
+                  {"geom": (px, py)})
+    return ds
+
+
+class TestKnnProcessSurface:
+    def test_array_query_routes_to_batch(self, cloud, pts_store):
+        px, py = cloud
+        qx = np.array([10.0, -120.0, 0.0])
+        qy = np.array([10.0, 40.0, 0.0])
+        res = knn_process(pts_store, "pts", qx, qy, 20)
+        assert isinstance(res, list) and len(res) == 3
+        for i in range(3):
+            ids, d = res[i]
+            want = _knn_oracle(px, py, qx[i], qy[i], 20)
+            assert np.array_equal(np.asarray(ids, np.int64), want)
+            assert np.all(np.diff(d) >= 0)
+
+    def test_batch_agrees_with_scalar_process(self, pts_store):
+        ids1, d1 = knn_process(pts_store, "pts", 5.0, 5.0, 15)
+        [(idsb, db)] = knn_batch_process(pts_store, "pts", [5.0], [5.0],
+                                         15)
+        assert list(ids1) == list(idsb)
+        np.testing.assert_allclose(d1, db)
+
+    def test_ecql_prefilter(self, cloud, pts_store):
+        from geomesa_tpu.filters import ast as fast
+        px, py = cloud
+        ecql = fast.BBox("geom", -90, -45, 90, 45)
+        res = knn_batch_process(pts_store, "pts", [0.0, 30.0],
+                                [0.0, 10.0], 10, ecql=ecql)
+        m = (px >= -90) & (px <= 90) & (py >= -45) & (py <= 45)
+        sx, sy = px[m], py[m]
+        sids = np.arange(len(px))[m]
+        for i, (qx, qy) in enumerate([(0.0, 0.0), (30.0, 10.0)]):
+            want = sids[_knn_oracle(sx, sy, qx, qy, 10)]
+            assert np.array_equal(np.asarray(res[i][0], np.int64), want)
+
+
+class TestBatcherKnn:
+    def test_concurrent_knn_coalesces_and_is_exact(self, cloud,
+                                                   pts_store):
+        from geomesa_tpu.scan.batcher import QueryBatcher
+        px, py = cloud
+        qb = QueryBatcher(pts_store, max_batch=8, linger_us=20_000)
+        rng = np.random.default_rng(3)
+        qs = [(float(a), float(b)) for a, b in
+              zip(rng.uniform(-170, 170, 8), rng.uniform(-80, 80, 8))]
+        out = [None] * len(qs)
+
+        def run(i):
+            out[i] = qb.knn("pts", qs[i][0], qs[i][1], 12)
+
+        ts = [threading.Thread(target=run, args=(i,))
+              for i in range(len(qs))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for i, (qx, qy) in enumerate(qs):
+            ids, d = out[i]
+            want = _knn_oracle(px, py, qx, qy, 12)
+            assert np.array_equal(np.asarray(ids, np.int64), want)
+
+    def test_knob_disables_coalescing(self, cloud, pts_store):
+        from geomesa_tpu.scan.batcher import KNN_BATCH, QueryBatcher
+        px, py = cloud
+        qb = QueryBatcher(pts_store, max_batch=8)
+        KNN_BATCH.thread_local_set("false")
+        try:
+            ids, d = qb.knn("pts", 1.0, 2.0, 5)
+        finally:
+            KNN_BATCH.thread_local_set(None)
+        assert np.array_equal(np.asarray(ids, np.int64),
+                              _knn_oracle(px, py, 1.0, 2.0, 5))
+
+
+def _rect(cx, cy, w, h):
+    from geomesa_tpu.geometry.base import Polygon
+    return Polygon([(cx - w, cy - h), (cx + w, cy - h),
+                    (cx + w, cy + h), (cx - w, cy + h)])
+
+
+def _contains_oracle(polys, px, py):
+    want = np.zeros(len(polys), np.int64)
+    for j, p in enumerate(polys):
+        env = p.envelope
+        m = ((px >= env.xmin) & (px <= env.xmax)
+             & (py >= env.ymin) & (py <= env.ymax))
+        ridx = np.flatnonzero(m)
+        want[j] = int(p.contains_points(px[ridx], py[ridx]).sum())
+    return want
+
+
+class TestContainsJoin:
+    def test_counts_match_exact_oracle(self, cloud):
+        px, py = cloud
+        rng = np.random.default_rng(21)
+        polys = [_rect(rng.uniform(-170, 170), rng.uniform(-80, 80),
+                       rng.uniform(2, 15), rng.uniform(2, 15))
+                 for _ in range(40)]
+        counts, _ = contains_join(polys, px, py, counts_only=True)
+        assert np.array_equal(counts, _contains_oracle(polys, px, py))
+
+    def test_on_edge_points_band_patch(self):
+        # points exactly on the boundary land in the f32 uncertainty
+        # band and must be resolved by the exact f64 host patch
+        # (closed-boundary semantics: edges count as inside)
+        rng = np.random.default_rng(5)
+        px = rng.uniform(-5, 5, 4000)
+        py = rng.uniform(-5, 5, 4000)
+        px[:50] = 1.0            # on the right edge of the unit rect
+        py[:50] = np.linspace(-1, 1, 50)
+        px[50:80] = np.linspace(-1, 1, 30)
+        py[50:80] = -1.0         # on the bottom edge
+        polys = [_rect(0.0, 0.0, 1.0, 1.0), _rect(3.0, 3.0, 0.5, 0.5)]
+        counts, _ = contains_join(polys, px, py, counts_only=True)
+        assert np.array_equal(counts, _contains_oracle(polys, px, py))
+
+    def test_pairs_path(self, cloud):
+        px, py = cloud
+        polys = [_rect(0.0, 0.0, 20.0, 20.0), _rect(100.0, 50.0, 10.0,
+                                                    10.0)]
+        counts, pairs = contains_join(polys, px, py, counts_only=False)
+        assert np.array_equal(counts, _contains_oracle(polys, px, py))
+        for j, p in enumerate(polys):
+            rows = np.sort(pairs[pairs[:, 1] == j, 0])
+            want = np.flatnonzero(p.contains_points(px, py))
+            assert np.array_equal(rows, want)
+
+    def test_contains_process_ids(self, cloud, pts_store):
+        px, py = cloud
+        polys = [_rect(10.0, 10.0, 8.0, 8.0)]
+        counts, ids = contains_process(pts_store, "pts", polys,
+                                       counts_only=False)
+        want = np.flatnonzero(polys[0].contains_points(px, py))
+        assert counts[0] == len(want)
+        assert np.array_equal(np.sort(np.asarray(ids[0], np.int64)),
+                              want)
+
+    def test_empty_inputs(self):
+        counts, pairs = contains_join([], np.array([1.0]),
+                                      np.array([1.0]))
+        assert len(counts) == 0
+        counts, _ = contains_join([_rect(0, 0, 1, 1)], np.empty(0),
+                                  np.empty(0), counts_only=True)
+        assert counts[0] == 0
+
+
+class TestMeshContains:
+    def test_counts_exact_on_seeded_1m(self):
+        from geomesa_tpu.parallel.mesh import (data_mesh,
+                                               distributed_contains_counts,
+                                               shard_scan_data)
+        rng = np.random.default_rng(1234)  # the bench seed
+        n = 1_000_000
+        px = rng.uniform(-180, 180, n)
+        py = rng.uniform(-90, 90, n)
+        ms = np.zeros(n, np.int64)
+        mesh = data_mesh()
+        assert mesh.devices.size == 8  # conftest forces 8 devices
+        data = shard_scan_data(px, py, ms, mesh)
+        polys = [_rect(rng.uniform(-170, 170), rng.uniform(-80, 80),
+                       rng.uniform(0.5, 3), rng.uniform(0.5, 3))
+                 for _ in range(50)]
+        counts = distributed_contains_counts(data, polys)
+        assert np.array_equal(counts, _contains_oracle(polys, px, py))
+
+    def test_band_overflow_falls_back_to_host_recount(self):
+        from geomesa_tpu.parallel.mesh import (data_mesh,
+                                               distributed_contains_counts,
+                                               shard_scan_data)
+        rng = np.random.default_rng(6)
+        n = 20_000
+        px = rng.uniform(-2, 2, n)
+        py = rng.uniform(-2, 2, n)
+        # flood the boundary: way more band rows than band_cap=2
+        px[:600] = 1.0
+        py[:600] = np.linspace(-1, 1, 600)
+        mesh = data_mesh()
+        data = shard_scan_data(px, py, np.zeros(n, np.int64), mesh)
+        polys = [_rect(0.0, 0.0, 1.0, 1.0)]
+        counts = distributed_contains_counts(data, polys, band_cap=2)
+        assert np.array_equal(counts, _contains_oracle(polys, px, py))
+
+
+class TestWebKnnRoute:
+    def test_rest_knn_exact_and_param_errors(self, cloud, pts_store):
+        import json
+        import urllib.error
+        import urllib.request
+
+        from geomesa_tpu.web import GeoMesaWebServer
+        px, py = cloud
+        srv = GeoMesaWebServer(pts_store).start()
+        try:
+            url = (f"http://127.0.0.1:{srv.port}/rest/knn/pts"
+                   "?x=10.0&y=10.0&k=7")
+            with urllib.request.urlopen(url) as r:
+                assert r.status == 200
+                d = json.loads(r.read())
+            want = _knn_oracle(px, py, 10.0, 10.0, 7)
+            assert [int(i) for i in d["ids"]] == list(want)
+            assert len(d["distances"]) == 7
+            assert d["distances"] == sorted(d["distances"])
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/rest/knn/pts?x=nope")
+            assert ei.value.code == 400
+        finally:
+            srv.stop()
+
+
+class TestPrewarm:
+    def test_prewarm_compiles_without_error(self, cloud):
+        px, py = cloud
+        prewarm_join_kernels(px, py, query_counts=(16,),
+                             knn_batches=(1, 4), knn_k=8)
+
+    def test_ingest_hook_respects_knob(self, cloud, monkeypatch):
+        from geomesa_tpu.store import memory as mem
+        px, py = cloud
+        calls = []
+        monkeypatch.setattr(
+            "geomesa_tpu.analytics.join.prewarm_join_kernels",
+            lambda *a, **k: calls.append(1))
+        monkeypatch.setattr(mem.InMemoryDataStore,
+                            "_EAGER_INDEX_ROWS", 1000)
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("pw", "*geom:Point:srid=4326"))
+        mem.JOIN_PREWARM.thread_local_set("false")
+        try:
+            ds.write_dict("pw",
+                          np.arange(len(px)).astype(str).astype(object),
+                          {"geom": (px, py)})
+        finally:
+            mem.JOIN_PREWARM.thread_local_set(None)
+        assert not calls
+        ds2 = InMemoryDataStore()
+        ds2.create_schema(parse_spec("pw", "*geom:Point:srid=4326"))
+        ds2.write_dict("pw",
+                       np.arange(len(px)).astype(str).astype(object),
+                       {"geom": (px, py)})
+        assert calls

@@ -1,0 +1,62 @@
+"""Miniature end-to-end runs of the bench.py perf configs touched by
+the batching work (4: batched KNN, 5: fused contains join) — exercises
+the exact driver code the TPU round runs, at toy sizes, asserting the
+exactness flags and the new warm/cold + batching fields. Marked
+bench_smoke so perf triage can select them; they stay in tier-1."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+import bench  # noqa: E402
+
+
+@pytest.mark.bench_smoke
+def test_config4_batched_knn_smoke():
+    rng = np.random.default_rng(42)
+    n = 10_000
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    c = bench.bench_config4(rng, x, y)
+    assert c["ids_exact"] is True
+    assert c["batched"] is True
+    assert c["n"] == n and c["queries"] == 8
+    assert c["p50_ms"] == pytest.approx(c["batch_ms"] / 8, abs=0.011)
+    assert c["single_query_ms"] > 0 and c["cpu_ms"] > 0
+
+
+@pytest.mark.bench_smoke
+def test_config5_contains_smoke():
+    from geomesa_tpu.features import parse_spec
+    from geomesa_tpu.store import InMemoryDataStore
+
+    rng = np.random.default_rng(43)
+    n = 10_000
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    ms = np.zeros(n, np.int64)
+    ds = InMemoryDataStore()
+    ds.create_schema(parse_spec("ais", "dtg:Date,*geom:Point:srid=4326"))
+    ds.write_dict("ais", np.arange(n).astype(str).astype(object),
+                  {"dtg": ms, "geom": (x, y)})
+    c = bench.bench_config5(rng, ds, x, y, n_poly=50)
+    assert c["counts_exact"] is True
+    assert c["store_agrees"] is True
+    assert c["polygons"] == 50
+    assert c["first_s"] >= c["p50_s"] * 0 and c["first_s"] > 0
+    assert c["elapsed_s"] == c["p50_s"]
+
+
+@pytest.mark.bench_smoke
+def test_load_gate_reports_without_exiting(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "LOAD_MAX", 0.0)   # force over-ceiling
+    monkeypatch.setattr(bench, "LOAD_WAIT_S", 0.0)
+    monkeypatch.setattr(bench, "LOAD_STRICT", False)
+    monkeypatch.setattr(bench, "_load_1m", lambda: 7.5)
+    load = bench._load_gate()
+    assert load == 7.5
+    assert "WARNING" in capsys.readouterr().err
