@@ -132,7 +132,8 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,6,7,8,9,10,northstar").split(","))
+                             "1,2,3,4,5,6,7,8,9,10,11,northstar")
+              .split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
 T0_DAY, T1_DAY = 17_000, 17_100
@@ -1066,6 +1067,251 @@ def bench_config9(rng):
     return out
 
 
+def bench_config11(rng, n=None, nq=None):
+    """Cluster serving: scatter-gather scaling + partition tolerance.
+
+    Phase 1 — scatter count qps through a ClusterDataStore at 1/2/4
+    in-process shard groups vs the single-store baseline, every box
+    checked count-exact against the oracle.
+
+    Phase 2 — failover: two shard groups; group 0 is replicated with
+    its primary behind a ChaosProxy-fronted web server. Mid-ingest the
+    primary dies; the group auto-promotes INSIDE the cluster while a
+    concurrent query stream keeps running. Reported: failover_s, zero
+    acked-write loss, and the query accounting — every concurrent
+    query must be exact-or-typed-error, never silently wrong (reads
+    ride replica legs through the outage, so most stay exact).
+
+    Phase 3 — degraded completeness accounting with one group hard
+    down: typed failures with `geomesa.cluster.allow.partial` off,
+    flagged partials (completeness fraction + missing z-ranges) on."""
+    import shutil
+    import tempfile
+    import threading
+
+    from geomesa_tpu.cluster import ClusterDataStore, ShardUnavailableError
+    from geomesa_tpu.features import FeatureBatch, parse_spec
+    from geomesa_tpu.replication import (Replica, ReplicatedDataStore,
+                                         WalShipper)
+    from geomesa_tpu.resilience import ChaosProxy, RetryPolicy
+    from geomesa_tpu.store import InMemoryDataStore
+    from geomesa_tpu.store.remote import RemoteDataStore
+    from geomesa_tpu.web import GeoMesaWebServer
+
+    n = n if n is not None else int(
+        os.environ.get("GEOMESA_TPU_BENCH_CLUSTER_N", 200_000))
+    nq = nq if nq is not None else int(
+        os.environ.get("GEOMESA_TPU_BENCH_CLUSTER_QUERIES", 400))
+    spec = "*geom:Point:srid=4326"
+    sft = parse_spec("pts11", spec)
+    out = {"queries": nq, "n": n}
+
+    def boxes(seed, count=nq):
+        q_rng = np.random.default_rng(seed)
+        for _ in range(count):
+            x0 = float(q_rng.uniform(-170, 130))
+            y0 = float(q_rng.uniform(-80, 55))
+            yield f"BBOX(geom, {x0:.4f}, {y0:.4f}, {x0+5:.4f}, {y0+5:.4f})"
+
+    def wait_for(cond, timeout_s=30.0):
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            if cond():
+                return True
+            time.sleep(0.02)
+        return False
+
+    ids = np.arange(n).astype(str).astype(object)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+
+    # -- phase 1: scatter scaling over group count ------------------------
+    oracle = InMemoryDataStore()
+    oracle.create_schema(sft)
+    oracle.write_dict("pts11", ids, {"geom": (x, y)})
+    oracle.query_count("BBOX(geom, 0, 0, 5, 5)", "pts11")  # warm
+    t0 = time.perf_counter()
+    for ecql in boxes(seed=110):
+        oracle.query_count(ecql, "pts11")
+    out["single_qps"] = round(nq / (time.perf_counter() - t0), 1)
+
+    exact = True
+    for k in (1, 2, 4):
+        groups = [InMemoryDataStore() for _ in range(k)]
+        cluster = ClusterDataStore(groups, leg_deadline_s=60)
+        cluster.create_schema(sft)
+        cluster.write("pts11", FeatureBatch.from_dict(sft, ids,
+                                                      {"geom": (x, y)}))
+        cluster.query_count("BBOX(geom, 0, 0, 5, 5)", "pts11")  # warm
+        t0 = time.perf_counter()
+        for ecql in boxes(seed=110):
+            cluster.query_count(ecql, "pts11")
+        wall = time.perf_counter() - t0
+        for ecql in boxes(seed=111, count=max(nq // 10, 5)):
+            if cluster.query_count(ecql, "pts11") != \
+                    oracle.query_count(ecql, "pts11"):
+                exact = False
+        out[f"groups_{k}"] = {"scatter_qps": round(nq / wall, 1)}
+    out["counts_exact"] = exact
+
+    # -- phase 2: chaos failover inside one shard group -------------------
+    root = tempfile.mkdtemp(prefix="geomesa-bench11-")
+    try:
+        primary = InMemoryDataStore(durable_dir=os.path.join(root, "g0"),
+                                    wal_fsync="never")
+        primary.create_schema(sft)
+        srv = GeoMesaWebServer(primary).start()
+        proxy = ChaosProxy("127.0.0.1", srv.port).start()
+        remote = RemoteDataStore(
+            "127.0.0.1", proxy.port, timeout_s=2.0,
+            retry_policy=RetryPolicy(max_attempts=2, base_s=0.02,
+                                     cap_s=0.05, total_deadline_s=1.0))
+        ship = WalShipper(primary.journal)
+        replicas = [Replica(ship.host, ship.port, name=f"g0r{i}")
+                    for i in range(2)]
+        group0 = ReplicatedDataStore(primary=remote, replicas=replicas,
+                                     ack_replicas=1, auto_promote=True,
+                                     probe_ms=50, probe_failures=2,
+                                     max_lag_lsn=100_000, max_lag_s=600)
+        group1 = InMemoryDataStore()
+        group1.create_schema(sft)
+        cluster = ClusterDataStore([group0, group1],
+                                   names=["g0", "g1"],
+                                   leg_deadline_s=5, hedge_ms=50)
+        cluster._sfts["pts11"] = sft  # schemas pre-created per group
+        # static rows the concurrent queries assert against
+        n_static = min(n, 20_000)
+        cluster.write("pts11", FeatureBatch.from_dict(
+            sft, np.array([f"s{i}" for i in range(n_static)], object),
+            {"geom": (x[:n_static], y[:n_static])}))
+        acked, failed_writes = [], [0]
+        stop = threading.Event()
+
+        def ingest():
+            batch_no = 0
+            w_rng = np.random.default_rng(112)
+            while not stop.is_set():
+                wids = [f"w{batch_no}_{i}" for i in range(50)]
+                b = FeatureBatch.from_dict(
+                    sft, np.array(wids, dtype=object),
+                    {"geom": (w_rng.uniform(-180, 180, 50),
+                              w_rng.uniform(-90, 90, 50))})
+                try:
+                    cluster.write("pts11", b)
+                    acked.extend(wids)
+                except Exception:
+                    failed_writes[0] += 1
+                batch_no += 1
+
+        q_ok, q_err, q_wrong = [0], [0], [0]
+
+        def query_loop():
+            sq_rng = np.random.default_rng(113)
+            while not stop.is_set():
+                x0 = float(sq_rng.uniform(-170, 130))
+                y0 = float(sq_rng.uniform(-80, 55))
+                ecql = (f"BBOX(geom, {x0:.4f}, {y0:.4f}, "
+                        f"{x0+20:.4f}, {y0+20:.4f})")
+                try:
+                    res = cluster.query(ecql, "pts11")
+                except Exception:
+                    # typed failure (ShardUnavailableError or a write
+                    # race) — loud, never wrong
+                    q_err[0] += 1
+                    continue
+                got = set(res.ids.astype(str))
+                want = {f"s{i}" for i in range(n_static)
+                        if x0 <= x[i] <= x0 + 20 and y0 <= y[i] <= y0 + 20}
+                # static rows exact; extras must be concurrent ingest
+                if want - got or any(not g.startswith(("s", "w"))
+                                     for g in got - want):
+                    q_wrong[0] += 1
+                else:
+                    q_ok[0] += 1
+
+        t_ing = threading.Thread(target=ingest, daemon=True)
+        t_qry = threading.Thread(target=query_loop, daemon=True)
+        t_ing.start()
+        t_qry.start()
+        try:
+            time.sleep(1.0)           # healthy ingest + queries
+            srv.stop()                # group 0's primary dies
+            ship.stop()
+            proxy.stop()
+            promoted = wait_for(
+                lambda: isinstance(group0.primary, Replica), 15.0)
+            time.sleep(0.5)           # queries against promoted group
+            stop.set()
+            t_ing.join(timeout=10)
+            t_qry.join(timeout=10)
+            st = group0.replication_status()
+            survived = set()
+            if promoted:
+                res = cluster.query("INCLUDE", "pts11")
+                survived = set(res.ids.astype(str))
+            lost = [i for i in acked if i not in survived]
+            out["failover"] = {
+                "auto_promoted": bool(promoted),
+                "failover_s": st.get("failover_seconds"),
+                "acked_writes": len(acked),
+                "acked_lost": len(lost),
+                "zero_acked_loss": promoted and not lost,
+                "unacked_write_errors": failed_writes[0],
+                "queries_ok": q_ok[0],
+                "queries_typed_error": q_err[0],
+                "queries_silently_wrong": q_wrong[0]}
+        finally:
+            stop.set()
+            cluster.close()
+            proxy.stop()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    # -- phase 3: degraded completeness accounting ------------------------
+    class _Down:
+        """A shard group that lost every node: reads/writes all fail."""
+
+        def __getattr__(self, name):
+            def boom(*a, **kw):
+                raise ConnectionError("shard group down")
+            return boom
+
+    live = InMemoryDataStore()
+    live.create_schema(sft)
+    half = ClusterDataStore([live, _Down()], names=["up", "down"],
+                            leg_deadline_s=2, hedge_ms=20)
+    half._sfts["pts11"] = sft
+    live.write("pts11", FeatureBatch.from_dict(sft, ids,
+                                               {"geom": (x, y)}))
+    typed = partial = 0
+    nq3 = max(nq // 10, 5)
+    for ecql in boxes(seed=114, count=nq3):
+        try:
+            half.query_count(ecql, "pts11")
+        except ShardUnavailableError:
+            typed += 1
+    half_p = ClusterDataStore([live, _Down()], names=["up", "down"],
+                              leg_deadline_s=2, hedge_ms=20,
+                              allow_partial=True)
+    half_p._sfts["pts11"] = sft
+    got_rows = want_rows = 0
+    missing_ranges = []
+    for ecql in boxes(seed=114, count=nq3):
+        c = half_p.query_count(ecql, "pts11")
+        if getattr(c, "complete", True) is False:
+            partial += 1
+            missing_ranges = c.missing_z_ranges
+        got_rows += int(c)
+        want_rows += oracle.query_count(ecql, "pts11")
+    out["degraded"] = {
+        "queries": nq3,
+        "typed_errors_knob_off": typed,
+        "partial_flagged_knob_on": partial,
+        "completeness_fraction": round(got_rows / max(want_rows, 1), 3),
+        "missing_z_ranges": missing_ranges}
+    return out
+
+
 # -- config 10: storage integrity — scrub overhead + corrupt recovery -----
 
 def bench_config10(rng):
@@ -1320,6 +1566,9 @@ def main(argv=None):
 
     if "10" in CONFIGS:
         out["configs"]["10_integrity"] = bench_config10(rng)
+
+    if "11" in CONFIGS:
+        out["configs"]["11_cluster"] = bench_config11(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

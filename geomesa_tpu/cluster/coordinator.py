@@ -1,0 +1,707 @@
+"""ClusterDataStore: Z-sharded scatter-gather over shard groups.
+
+The reference scales horizontally by splitting z-ordered tables into
+tablets and fanning queries across region servers in the coprocessor
+scatter-gather shape (GeoMesaCoprocessor.scala:105-123): each server
+computes a partial (ids, counts, bin chunks, stat sketches, arrow
+batches) over its tablet ranges and the client merges. This module is
+that shape one level up the stack: N *shard groups* — each typically a
+primary + WAL-shipped replicas behind ``ReplicatedDataStore`` — own
+disjoint z-prefix ranges (partition.py), writes route to the owning
+group, and reads scatter to every group and merge exactly (the
+partition is disjoint, so unions/sums/sketch-merges are exact, never
+deduped or estimated).
+
+Failure semantics are the point (a cluster that hangs or silently
+drops a shard's rows is worse than a single store):
+
+- every scatter leg runs under ``geomesa.cluster.leg.deadline.s`` with
+  a hedged second attempt after ``geomesa.cluster.hedge.ms`` (for a
+  replicated group the hedge naturally lands on a different replica —
+  the router round-robins), and a per-group breaker
+  (resilience/breaker.py) fast-fails legs into a known-dead group;
+- a group losing its primary auto-promotes internally (PR 4 probe +
+  most-caught-up election, zero acked-write loss) — the cluster keeps
+  routing to the group object, which now fronts the promoted replica;
+- cross-shard read-your-writes: every acked write bumps a per-group
+  **LSN vector** (returned from ``write``/``delete`` and surfaced in
+  ``cluster_status``); scatter legs against replicated groups carry a
+  min-LSN gate — the staleness bound tightens to ``primary_estimate -
+  acked_lsn`` so no replica that has not applied this client's writes
+  can serve the leg (the PR 4 bounded-staleness contract, pointed at
+  consistency instead of freshness);
+- when a whole group stays down past its deadline the query fails
+  **typed** (``ShardUnavailableError`` naming the group and its owned
+  z-ranges) — or, behind ``geomesa.cluster.allow.partial``, returns a
+  result flagged ``complete=False`` with the missing z-ranges attached
+  (``missing_z_ranges``). Silent wrong answers are structurally
+  impossible: a merge only runs over legs that succeeded, and any
+  missing leg either raises or flags.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.sft import parse_spec
+from ..index.api import Explainer, FilterStrategy, Query
+from ..metrics import metrics
+from ..resilience.breaker import BreakerBoard, CircuitOpenError
+from ..store.api import DataStore
+from ..store.memory import QueryResult
+from ..utils.properties import SystemProperty
+from .partition import PREFIX_BITS, ZPrefixPartitioner
+
+__all__ = ["ClusterDataStore", "ClusterQueryResult",
+           "ShardUnavailableError", "PartialCount",
+           "CLUSTER_LEG_DEADLINE_S", "CLUSTER_HEDGE_MS",
+           "CLUSTER_ALLOW_PARTIAL"]
+
+# per-scatter-leg deadline: a group that cannot answer inside this is
+# treated as down for THIS query (typed failure or flagged partial)
+CLUSTER_LEG_DEADLINE_S = SystemProperty("geomesa.cluster.leg.deadline.s",
+                                        "5")
+# tail-latency hedge: when a leg's first attempt has not answered
+# after this long, a second attempt launches against the same group
+# (a replicated group round-robins it to a different replica)
+CLUSTER_HEDGE_MS = SystemProperty("geomesa.cluster.hedge.ms", "75")
+# partial-results mode: False (default) -> a down group fails the
+# query typed; True -> merge the live legs and flag the result
+# complete=False with the missing z-ranges
+CLUSTER_ALLOW_PARTIAL = SystemProperty("geomesa.cluster.allow.partial",
+                                       "false")
+
+
+class ShardUnavailableError(ConnectionError):
+    """One or more shard groups could not serve their scatter leg
+    inside the deadline. Carries which groups and which z-ranges of
+    the keyspace are therefore unreadable. NOT retryable as-is: the
+    breaker holds the group out until it half-opens."""
+
+    retryable = False
+
+    def __init__(self, groups, z_ranges, detail: str = ""):
+        self.groups = list(groups)
+        self.z_ranges = list(z_ranges)
+        msg = (f"shard group(s) unavailable: {', '.join(self.groups)}"
+               f" (missing z-ranges: "
+               f"{[(r['z_lo'], r['z_hi']) for r in self.z_ranges]})")
+        super().__init__(msg + (f": {detail}" if detail else ""))
+
+
+class PartialCount(int):
+    """An int count flagged incomplete — plain ints cannot carry the
+    partial-results metadata, and a count missing a shard must never
+    look like a complete one."""
+
+    complete = False
+    missing_groups: list = []
+    missing_z_ranges: list = []
+
+
+class _PartialGrid(np.ndarray):
+    """Density grid flagged incomplete (view-cast ndarray)."""
+
+    complete = False
+
+
+class _PartialBytes(bytes):
+    """bin/arrow payload flagged incomplete."""
+
+    complete = False
+
+
+class ClusterQueryResult(QueryResult):
+    """QueryResult plus the cluster contract: ``complete`` /
+    ``missing_groups`` / ``missing_z_ranges`` (partial-results mode)
+    and ``lsn_vector`` (the per-group acked-LSN snapshot this result
+    is consistent with)."""
+
+    def __init__(self, ids, batch, explain, plan, n=None):
+        super().__init__(ids, batch, explain, plan, n=n)
+        self.complete = True
+        self.missing_groups: list[str] = []
+        self.missing_z_ranges: list[dict] = []
+        self.lsn_vector: dict[str, int] = {}
+
+
+class ClusterDataStore(DataStore):
+    """One DataStore façade over N z-partitioned shard groups.
+
+    ``groups`` is a list of DataStores — typically
+    ``ReplicatedDataStore`` (primary + replicas; gives the cluster
+    intra-group failover and hedge-to-replica) or ``RemoteDataStore``
+    (a federation of web servers; ``cluster://h1:p1,h2:p2`` builds
+    this shape). ``names`` labels them for status/metrics/errors
+    (default ``shard0..shardN-1``).
+
+    Ctor overrides beat the system-property knobs; a ``None`` override
+    re-reads the knob per call so tests and operators can flip
+    ``geomesa.cluster.allow.partial`` on a live cluster.
+    """
+
+    def __init__(self, groups, names=None, leg_deadline_s=None,
+                 hedge_ms=None, allow_partial=None, registry=metrics):
+        if not groups:
+            raise ValueError("at least one shard group required")
+        self._groups = list(groups)
+        self._names = (list(names) if names is not None
+                       else [f"shard{i}" for i in range(len(groups))])
+        if len(self._names) != len(self._groups):
+            raise ValueError("names/groups length mismatch")
+        if len(set(self._names)) != len(self._names):
+            raise ValueError("duplicate group names")
+        self._part = ZPrefixPartitioner(len(self._groups))
+        self._leg_deadline_override = leg_deadline_s
+        self._hedge_override = hedge_ms
+        self._allow_partial_override = allow_partial
+        self._registry = registry
+        self._breakers = BreakerBoard(registry=registry)
+        self._lock = threading.Lock()
+        self._lsn_vector: dict[str, int] = {}
+        self._sfts: dict = {}
+        registry.gauge("cluster.groups", len(self._groups))
+
+    # -- knobs -------------------------------------------------------------
+
+    def _leg_deadline_s(self) -> float:
+        if self._leg_deadline_override is not None:
+            return float(self._leg_deadline_override)
+        return CLUSTER_LEG_DEADLINE_S.as_float() or 5.0
+
+    def _hedge_s(self) -> float:
+        if self._hedge_override is not None:
+            return float(self._hedge_override) / 1e3
+        return (CLUSTER_HEDGE_MS.as_float() or 75.0) / 1e3
+
+    def _allow_partial(self) -> bool:
+        if self._allow_partial_override is not None:
+            return bool(self._allow_partial_override)
+        return bool(CLUSTER_ALLOW_PARTIAL.as_bool())
+
+    # -- uri ---------------------------------------------------------------
+
+    @classmethod
+    def from_uri(cls, uri: str, auth_token: str | None = None,
+                 **kwargs) -> "ClusterDataStore":
+        """``cluster://host1:port1,host2:port2,...`` — one
+        RemoteDataStore shard group per endpoint (the two-process
+        federation shape)."""
+        if not uri.startswith("cluster://"):
+            raise ValueError(f"not a cluster uri: {uri!r}")
+        endpoints = [e.strip() for e in uri[len("cluster://"):].split(",")
+                     if e.strip()]
+        if not endpoints:
+            raise ValueError("cluster:// uri names no endpoints")
+        from ..store.remote import RemoteDataStore
+        groups = []
+        for ep in endpoints:
+            host, _, port = ep.rpartition(":")
+            if not port.isdigit():
+                raise ValueError(f"bad cluster endpoint {ep!r} "
+                                 "(want host:port)")
+            groups.append(RemoteDataStore(host or "127.0.0.1", int(port),
+                                          auth_token=auth_token))
+        return cls(groups, names=endpoints, **kwargs)
+
+    # -- scatter machinery -------------------------------------------------
+
+    def _leg(self, name: str, fn, deadline: float, hedge_s: float,
+             results: dict, failures: dict):
+        """Run one scatter leg: breaker-gated, deadline-bounded, with
+        one hedged retry (launched after ``hedge_s`` of silence, or
+        immediately when the first attempt fails fast)."""
+        breaker = self._breakers.get(name)
+        try:
+            breaker.acquire()
+        except CircuitOpenError as e:
+            self._registry.counter("cluster.leg.fastfails")
+            failures[name] = e
+            return
+        t0 = time.perf_counter()
+        cond = threading.Condition()
+        state = {"ok": None, "errs": [], "running": 0}
+
+        def attempt():
+            try:
+                v = fn()
+                with cond:
+                    if state["ok"] is None:
+                        state["ok"] = (v,)
+                    state["running"] -= 1
+                    cond.notify_all()
+            except Exception as e:  # noqa: BLE001 — leg boundary
+                with cond:
+                    state["errs"].append(e)
+                    state["running"] -= 1
+                    cond.notify_all()
+
+        def launch():
+            state["running"] += 1
+            threading.Thread(target=attempt, daemon=True,
+                             name=f"cluster-leg-{name}").start()
+
+        deadline_t = t0 + deadline
+        with cond:
+            launch()
+            hedged = False
+            while state["ok"] is None:
+                now = time.perf_counter()
+                if now >= deadline_t:
+                    break
+                if state["running"] == 0 and hedged:
+                    break          # every attempt failed
+                if not hedged and (state["running"] == 0
+                                   or now >= t0 + hedge_s):
+                    hedged = True
+                    self._registry.counter("cluster.leg.hedges")
+                    launch()
+                    continue
+                timeout = deadline_t - now
+                if not hedged:
+                    timeout = min(timeout, t0 + hedge_s - now)
+                cond.wait(max(timeout, 0.0005))
+            ok = state["ok"]
+            errs = list(state["errs"])
+        if ok is not None:
+            breaker.success()
+            self._breakers.observe(name, time.perf_counter() - t0)
+            results[name] = ok[0]
+        else:
+            breaker.failure()
+            self._registry.counter("cluster.leg.failures")
+            if errs:
+                failures[name] = errs[-1]
+            else:
+                self._registry.counter("cluster.leg.timeouts")
+                failures[name] = TimeoutError(
+                    f"shard leg {name!r} exceeded its {deadline:g}s "
+                    "deadline")
+
+    def _scatter(self, make_fn) -> tuple[dict, dict]:
+        """Fan one read out to every group. ``make_fn(name, group)``
+        returns the zero-arg leg callable. Returns
+        ``(results_by_name, failures_by_name)``."""
+        self._registry.counter("cluster.scatter.calls")
+        deadline, hedge_s = self._leg_deadline_s(), self._hedge_s()
+        results: dict = {}
+        failures: dict = {}
+        threads = []
+        for name, group in zip(self._names, self._groups):
+            t = threading.Thread(
+                target=self._leg,
+                args=(name, make_fn(name, group), deadline, hedge_s,
+                      results, failures),
+                daemon=True, name=f"cluster-scatter-{name}")
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join(deadline + 5.0)
+        return results, failures
+
+    def _missing(self, failures: dict) -> dict | None:
+        """Enforce the partial-results contract for a scatter with
+        failed legs: raise typed by default, or describe what is
+        missing for the caller to attach when the knob allows it."""
+        if not failures:
+            return None
+        names = sorted(failures)
+        z_ranges = [self._part.z_range(self._names.index(n))
+                    for n in names]
+        if not self._allow_partial():
+            self._registry.counter("cluster.scatter.failed")
+            raise ShardUnavailableError(
+                names, z_ranges,
+                detail="; ".join(f"{n}: {type(failures[n]).__name__}: "
+                                 f"{failures[n]}" for n in names)
+            ) from failures[names[0]]
+        self._registry.counter("cluster.scatter.partial")
+        return {"groups": names, "z_ranges": z_ranges}
+
+    def _ryw_kwargs(self, name: str, group) -> dict:
+        """Cross-shard read-your-writes: translate 'this leg must see
+        everything we have acked on this group' (min LSN) into the
+        replication router's max-lag bound — a replica is only
+        eligible when primary_estimate - applied <= bound, i.e. when
+        applied >= our acked LSN."""
+        from ..replication.router import ReplicatedDataStore
+        if not isinstance(group, ReplicatedDataStore):
+            return {}
+        with self._lock:
+            acked = self._lsn_vector.get(name, 0)
+        if not acked:
+            return {}
+        bound = max(group._primary_lsn_estimate() - acked, 0)
+        if group.max_lag_lsn is not None:
+            bound = min(bound, group.max_lag_lsn)
+        return {"max_lag_lsn": bound}
+
+    # -- schema management -------------------------------------------------
+
+    def create_schema(self, sft, spec=None):
+        if isinstance(sft, str):
+            sft = parse_spec(sft, spec or "")
+        for name, group in zip(self._names, self._groups):
+            ret = group.create_schema(sft)
+            self._bump_lsn(name, group, ret)
+        self._sfts[sft.type_name] = sft
+
+    def get_schema(self, type_name: str):
+        sft = self._sfts.get(type_name)
+        if sft is not None:
+            return sft
+        err = None
+        for group in self._groups:
+            try:
+                sft = group.get_schema(type_name)
+            except KeyError:
+                raise
+            except Exception as e:  # noqa: BLE001 — try next group
+                err = e
+                continue
+            self._sfts[type_name] = sft
+            return sft
+        raise err if err is not None else KeyError(type_name)
+
+    def get_type_names(self) -> list[str]:
+        err = None
+        for group in self._groups:
+            try:
+                return group.get_type_names()
+            except Exception as e:  # noqa: BLE001 — try next group
+                err = e
+        raise err if err is not None else RuntimeError("no groups")
+
+    def remove_schema(self, type_name: str):
+        for name, group in zip(self._names, self._groups):
+            ret = group.remove_schema(type_name)
+            self._bump_lsn(name, group, ret)
+        self._sfts.pop(type_name, None)
+
+    # -- write path --------------------------------------------------------
+
+    def _bump_lsn(self, name: str, group, returned):
+        """Record the group's acked WAL position after a mutation —
+        the component of the LSN vector later reads gate on."""
+        lsn = None
+        if isinstance(returned, (int, np.integer)):
+            lsn = int(returned)
+        elif isinstance(returned, dict):
+            lsn = returned.get("lsn")
+        if lsn is None:
+            est = getattr(group, "_primary_lsn_estimate", None)
+            if callable(est):
+                lsn = est()
+        if lsn is None:
+            journal = getattr(group, "journal", None)
+            if journal is not None:
+                lsn = journal.wal.last_lsn
+        if lsn:
+            with self._lock:
+                if lsn > self._lsn_vector.get(name, 0):
+                    self._lsn_vector[name] = int(lsn)
+
+    def lsn_vector(self) -> dict[str, int]:
+        """Per-group acked LSNs: results consistent with this vector
+        include every write this store instance has acknowledged."""
+        with self._lock:
+            return dict(self._lsn_vector)
+
+    def write(self, type_name: str, batch: FeatureBatch,
+              visibilities=None, **kwargs):
+        """Partition the batch by z-prefix owner and write each slice
+        to its owning group. Returns the updated LSN vector. Groups
+        are written in order; a failing group raises after earlier
+        groups applied their slices (at-least-once on retry — the
+        failed slice was never acked, so the zero-acked-loss contract
+        holds)."""
+        sft = self.get_schema(type_name)
+        owners = self._part.owners_for_batch(sft, batch)
+        vis_arr = (np.asarray(visibilities, dtype=object)
+                   if visibilities is not None else None)
+        routed = 0
+        for gi, (name, group) in enumerate(zip(self._names, self._groups)):
+            rows = np.flatnonzero(owners == gi)
+            if not len(rows):
+                continue
+            sub = batch if len(rows) == batch.n else batch.take(rows)
+            vis = None if vis_arr is None else list(vis_arr[rows])
+            ret = group.write(type_name, sub, visibilities=vis, **kwargs)
+            self._bump_lsn(name, group, ret)
+            routed += len(rows)
+        self._registry.counter("cluster.writes.routed", routed)
+        return self.lsn_vector()
+
+    def delete(self, type_name: str, ids):
+        """Broadcast: geometry-routed rows cannot be re-owned from ids
+        alone, and deleting absent ids is a no-op everywhere."""
+        for name, group in zip(self._names, self._groups):
+            ret = group.delete(type_name, ids)
+            self._bump_lsn(name, group, ret)
+        return self.lsn_vector()
+
+    # -- read path ---------------------------------------------------------
+
+    def _as_query(self, q, type_name) -> Query:
+        if isinstance(q, str):
+            if type_name is None:
+                raise ValueError("type_name required with a filter string")
+            q = Query(type_name, q)
+        return q
+
+    def query(self, q, type_name=None, explain_out=None):
+        q = self._as_query(q, type_name)
+
+        def make_fn(name, group):
+            def leg():
+                res = group.query(q, **self._ryw_kwargs(name, group))
+                # materialize lazy ids/batch INSIDE the leg, before
+                # slower sibling legs land: a replica apply between
+                # scatter and merge must not invalidate row indices
+                _ = res.ids
+                _ = res.batch
+                return res
+            return leg
+
+        results, failures = self._scatter(make_fn)
+        missing = self._missing(failures)
+        ids_parts, batch_parts = [], []
+        for name in self._names:
+            res = results.get(name)
+            if res is None or res.n == 0:
+                continue
+            ids_parts.append(np.asarray(res.ids, dtype=object))
+            batch_parts.append(res.batch)
+        ids = (np.concatenate(ids_parts) if ids_parts
+               else np.empty(0, dtype=object))
+        batch = None
+        if batch_parts:
+            batch = (batch_parts[0] if len(batch_parts) == 1
+                     else FeatureBatch.concat_all(batch_parts))
+        if q.sort_by is not None and batch is not None and batch.n:
+            from ..store.common import sort_order
+            order = sort_order(batch, q.sort_by, q.sort_desc)
+            batch = batch.take(order)
+            ids = ids[order]
+        if q.max_features is not None and len(ids) > q.max_features:
+            ids = ids[:q.max_features]
+            if batch is not None:
+                batch = batch.take(np.arange(q.max_features))
+        explain = Explainer(explain_out)
+        explain(lambda: f"Cluster scatter over {len(self._groups)} "
+                        f"groups ({len(failures)} missing)")
+        out = ClusterQueryResult(
+            ids, batch, explain,
+            FilterStrategy("cluster", q.filter, None), n=len(ids))
+        out.lsn_vector = self.lsn_vector()
+        if missing:
+            out.complete = False
+            out.missing_groups = missing["groups"]
+            out.missing_z_ranges = missing["z_ranges"]
+        return out
+
+    def query_count(self, q, type_name=None) -> int:
+        q = self._as_query(q, type_name)
+        results, failures = self._scatter(
+            lambda name, group:
+            lambda: group.query_count(q, **self._ryw_kwargs(name, group)))
+        missing = self._missing(failures)
+        total = int(sum(results.values()))
+        if q.max_features is not None:
+            total = min(total, q.max_features)
+        if missing:
+            out = PartialCount(total)
+            out.missing_groups = missing["groups"]
+            out.missing_z_ranges = missing["z_ranges"]
+            return out
+        return total
+
+    def count(self, type_name: str) -> int:
+        results, failures = self._scatter(
+            lambda name, group:
+            lambda: group.count(type_name,
+                                **self._ryw_kwargs(name, group)))
+        missing = self._missing(failures)
+        total = int(sum(results.values()))
+        if missing:
+            out = PartialCount(total)
+            out.missing_groups = missing["groups"]
+            out.missing_z_ranges = missing["z_ranges"]
+            return out
+        return total
+
+    # -- mergeable aggregates ----------------------------------------------
+
+    def stats_query(self, type_name: str, stat_spec: str, ecql=None):
+        """Scatter the sketch, merge exactly (Stat.merge — every
+        sketch in stats/sketches.py is a commutative monoid over
+        disjoint row sets, the StatsScan client reduce)."""
+        results, failures = self._scatter(
+            lambda name, group:
+            lambda: group.stats_query(type_name, stat_spec, ecql,
+                                      **self._ryw_kwargs(name, group)))
+        missing = self._missing(failures)
+        merged = None
+        for name in self._names:
+            s = results.get(name)
+            if s is None:
+                continue
+            if isinstance(s, dict):
+                raise NotImplementedError(
+                    "cluster stats merge needs Stat-returning groups "
+                    "(in-process or replicated); a RemoteDataStore "
+                    "group returned a JSON summary")
+            merged = s if merged is None else merged.merge(s)
+        if merged is None:
+            from ..stats import parse_stat
+            merged = parse_stat(stat_spec)
+        merged.complete = missing is None
+        if missing:
+            merged.missing_groups = missing["groups"]
+            merged.missing_z_ranges = missing["z_ranges"]
+        return merged
+
+    def density(self, type_name: str, ecql, bbox, width: int, height: int,
+                weight_attr: str | None = None) -> np.ndarray:
+        """Scatter the heatmap; grids over disjoint partitions sum
+        exactly (the DensityScan client reduce)."""
+        kwargs = {} if weight_attr is None else {"weight_attr": weight_attr}
+        results, failures = self._scatter(
+            lambda name, group:
+            lambda: group.density(type_name, ecql, bbox, width, height,
+                                  **kwargs,
+                                  **self._ryw_kwargs(name, group)))
+        missing = self._missing(failures)
+        grid = np.zeros((height, width), dtype=np.float32)
+        for g in results.values():
+            grid += np.asarray(g, dtype=np.float32)
+        if missing:
+            grid = grid.view(_PartialGrid)
+            grid.missing_groups = missing["groups"]
+            grid.missing_z_ranges = missing["z_ranges"]
+        return grid
+
+    def bin_query(self, type_name: str, ecql, track: str | None = None,
+                  label: str | None = None, sort: bool = False) -> bytes:
+        """Scatter BIN encoding; sorted chunks k-way merge via
+        merge_sorted_bin_chunks (the BinSorter client reduce)."""
+        results, failures = self._scatter(
+            lambda name, group:
+            lambda: group.bin_query(type_name, ecql, track=track,
+                                    label=label, sort=sort,
+                                    **self._ryw_kwargs(name, group)))
+        missing = self._missing(failures)
+        chunks = [results[n] for n in self._names
+                  if results.get(n)]
+        if sort:
+            from ..scan.aggregations import merge_sorted_bin_chunks
+            data = merge_sorted_bin_chunks(chunks,
+                                           labeled=label is not None)
+        else:
+            data = b"".join(chunks)
+        if missing:
+            data = _PartialBytes(data)
+            data.missing_groups = missing["groups"]
+            data.missing_z_ranges = missing["z_ranges"]
+        return data
+
+    def arrow_ipc(self, type_name: str, ecql="INCLUDE",
+                  sort_by: str | None = None) -> bytes:
+        """Scatter arrow encoding, decode the per-group IPC payloads,
+        concat (+ optional global sort) and re-encode one stream."""
+        results, failures = self._scatter(
+            lambda name, group:
+            lambda: group.arrow_ipc(type_name, ecql,
+                                    **self._ryw_kwargs(name, group)))
+        missing = self._missing(failures)
+        sft = self.get_schema(type_name)
+        from ..arrow.io import read_ipc_batches, write_ipc
+        parts = []
+        for name in self._names:
+            payload = results.get(name)
+            if not payload:
+                continue
+            _, b = read_ipc_batches(payload, sft)
+            if b is not None and b.n:
+                parts.append(b)
+        if parts:
+            merged = (parts[0] if len(parts) == 1
+                      else FeatureBatch.concat_all(parts))
+        else:
+            merged = _empty_batch(sft)
+        if sort_by is not None and merged.n:
+            from ..store.common import sort_order
+            merged = merged.take(sort_order(merged, sort_by))
+        data = write_ipc(sft, merged)
+        if missing:
+            data = _PartialBytes(data)
+            data.missing_groups = missing["groups"]
+            data.missing_z_ranges = missing["z_ranges"]
+        return data
+
+    # -- admin -------------------------------------------------------------
+
+    def cluster_status(self) -> dict:
+        vec = self.lsn_vector()
+        groups = []
+        for i, (name, g) in enumerate(zip(self._names, self._groups)):
+            ent = {"name": name, "type": type(g).__name__,
+                   "acked_lsn": vec.get(name, 0),
+                   "breaker": self._breakers.get(name).state}
+            ent.update({k: v for k, v in self._part.z_range(i).items()
+                        if k != "group"})
+            rs = getattr(g, "replication_status", None)
+            if callable(rs):
+                try:
+                    ent["replication"] = rs()
+                except Exception as e:  # noqa: BLE001 — status, not control
+                    ent["replication_error"] = f"{type(e).__name__}: {e}"
+            groups.append(ent)
+        self._registry.gauge("cluster.groups", len(self._groups))
+        return {"role": "cluster",
+                "n_groups": len(self._groups),
+                "prefix_bits": PREFIX_BITS,
+                "allow_partial": self._allow_partial(),
+                "leg_deadline_s": self._leg_deadline_s(),
+                "hedge_ms": self._hedge_s() * 1e3,
+                "lsn_vector": vec,
+                "groups": groups,
+                "leg_latency": self._breakers.latencies()}
+
+    def promote_group(self, name: str | None = None) -> dict:
+        """Manually promote inside one shard group (the group must be
+        replicated, or a remote fronting a replicated store)."""
+        if name is None:
+            if len(self._groups) != 1:
+                raise ValueError(
+                    "group name required; have: " + ", ".join(self._names))
+            name = self._names[0]
+        if name not in self._names:
+            raise ValueError(f"no such group {name!r}; have: "
+                             + ", ".join(self._names))
+        group = self._groups[self._names.index(name)]
+        fn = getattr(group, "promote", None)
+        if not callable(fn):
+            raise ValueError(f"group {name!r} ({type(group).__name__}) "
+                             "does not support promotion")
+        out = dict(fn() or {})
+        out["group"] = name
+        self._registry.counter("cluster.promotions")
+        return out
+
+    def close(self):
+        for group in self._groups:
+            close = getattr(group, "close", None)
+            if callable(close):
+                close()
+
+
+def _empty_batch(sft) -> FeatureBatch:
+    return FeatureBatch.from_dict(
+        sft, np.empty(0, dtype=object),
+        {a.name: ((np.empty(0), np.empty(0)) if a.type.name == "Point"
+                  else []) for a in sft.attributes})

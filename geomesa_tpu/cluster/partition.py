@@ -1,0 +1,120 @@
+"""Z-prefix range partitioning: which shard group owns a feature.
+
+The reference scales by splitting the z-ordered keyspace into tablets
+and assigning tablet ranges to region servers (PAPER.md L4 splitter;
+``GeoMesaFeatureIndex.getSplits`` precomputes the split points from the
+curve). The cluster tier does the same thing one level up: the 62-bit
+z2 keyspace is range-partitioned by its top ``PREFIX_BITS`` bits into
+``n_groups`` contiguous prefix ranges, and a feature belongs to the
+group whose range covers its z-prefix.
+
+Properties the coordinator relies on:
+
+- **deterministic**: ownership is a pure function of (geometry,
+  n_groups) — any client computes the same routing with no metadata
+  service.
+- **disjoint + covering**: every prefix has exactly one owner, so
+  scatter-gather merges are exact set unions (no dedup pass).
+- **range-shaped**: a group's ownership is one contiguous z range, so
+  a down group's *missing data* is describable to callers as explicit
+  z-ranges (the partial-results contract) and, later, shard
+  split/migration is a range handoff.
+
+Features without a usable geometry (no geom field, or a null geometry,
+which normalizes to bin 0 deterministically) route by a stable hash of
+the feature id — NOT ``hash()``, which is per-process salted.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from ..curves import zorder
+from ..curves.sfc import Z2SFC
+
+__all__ = ["ZPrefixPartitioner", "PREFIX_BITS"]
+
+# top bits of the z2 key that drive ownership: 16 bits = 65536 split
+# points, plenty of resolution for any realistic group count while
+# keeping range descriptions human-readable
+PREFIX_BITS = 16
+
+_Z2_BITS = 2 * zorder.Z2_BITS          # 62-bit z2 keys
+_SHIFT = np.uint64(_Z2_BITS - PREFIX_BITS)
+_N_PREFIXES = 1 << PREFIX_BITS
+
+
+class ZPrefixPartitioner:
+    """Range-partition the z2 prefix space across ``n_groups``.
+
+    Group ``g`` owns prefixes ``[ceil(g*P/n), ceil((g+1)*P/n))`` where
+    ``P = 2**PREFIX_BITS`` — the proportional range split, so group
+    sizes differ by at most one prefix.
+    """
+
+    def __init__(self, n_groups: int):
+        if n_groups < 1:
+            raise ValueError("n_groups must be >= 1")
+        self.n_groups = int(n_groups)
+        self._sfc = Z2SFC()
+
+    # -- ownership ---------------------------------------------------------
+
+    def owners_xy(self, x, y) -> np.ndarray:
+        """Owning group index per coordinate pair (vectorized)."""
+        z = np.asarray(self._sfc.index(x, y, lenient=True)).astype(np.uint64)
+        prefix = (z >> _SHIFT).astype(np.int64)
+        return (prefix * self.n_groups) >> PREFIX_BITS
+
+    def owners_ids(self, ids) -> np.ndarray:
+        """Stable id-hash routing for features without a geometry
+        (crc32, not the per-process-salted ``hash()``)."""
+        return np.fromiter(
+            (zlib.crc32(str(i).encode()) % self.n_groups for i in ids),
+            dtype=np.int64, count=len(ids))
+
+    def owners_for_batch(self, sft, batch) -> np.ndarray:
+        """Owning group per row of a feature batch: point geometries by
+        their coordinates, extent geometries by their bbox centroid,
+        geometry-less schemas by id hash."""
+        geom = sft.geom_field
+        if geom is None:
+            return self.owners_ids(batch.ids)
+        col = batch.col(geom)
+        if hasattr(col, "x"):                      # PointColumn
+            return self.owners_xy(np.asarray(col.x, np.float64),
+                                  np.asarray(col.y, np.float64))
+        bounds = np.asarray(col.bounds, np.float64)  # GeometryColumn
+        cx = (bounds[:, 0] + bounds[:, 2]) * 0.5
+        cy = (bounds[:, 1] + bounds[:, 3]) * 0.5
+        bad = ~np.isfinite(cx) | ~np.isfinite(cy)
+        owners = self.owners_xy(np.where(bad, 0.0, cx),
+                                np.where(bad, 0.0, cy))
+        if bad.any():                               # null geometries
+            owners[bad] = self.owners_ids(batch.ids[bad])
+        return owners
+
+    # -- range descriptions ------------------------------------------------
+
+    def prefix_range(self, group: int) -> tuple[int, int]:
+        """The half-open prefix range ``[lo, hi)`` group ``group`` owns."""
+        if not 0 <= group < self.n_groups:
+            raise ValueError(f"group {group} out of range")
+        lo = -(-group * _N_PREFIXES // self.n_groups)        # ceil div
+        hi = -(-(group + 1) * _N_PREFIXES // self.n_groups)
+        return lo, hi
+
+    def z_range(self, group: int) -> dict:
+        """Human/JSON-facing description of a group's owned z range —
+        what a partial result reports as *missing* when the group is
+        unreachable."""
+        lo, hi = self.prefix_range(group)
+        return {"group": group,
+                "prefix_lo": lo, "prefix_hi": hi,
+                "z_lo": lo << (_Z2_BITS - PREFIX_BITS),
+                "z_hi": hi << (_Z2_BITS - PREFIX_BITS)}
+
+    def describe(self) -> list[dict]:
+        return [self.z_range(g) for g in range(self.n_groups)]

@@ -222,11 +222,11 @@ def _fn_date_to_string(fmt: str, millis: int) -> str:
     import datetime as _dt
     dt = _dt.datetime.fromtimestamp(int(millis) / 1000.0,
                                     tz=_dt.timezone.utc)
-    # java SSS means 3-digit millis; strftime %f is 6-digit micros —
-    # substitute millis through a placeholder instead
-    fmt2 = fmt.replace("SSS", "\x00")
-    out = dt.strftime(_java_fmt(fmt2))
-    return out.replace("\x00", f"{dt.microsecond // 1000:03d}")
+    # java SSS means 3-digit millis; strftime %f is 6-digit micros, and
+    # a placeholder char cannot ride through C strftime (glibc
+    # truncates the format at a NUL) — format around the SSS runs
+    ms = f"{dt.microsecond // 1000:03d}"
+    return ms.join(dt.strftime(_java_fmt(p)) for p in fmt.split("SSS"))
 
 
 def _line_geom(cls_wkt: str, arg):

@@ -94,10 +94,13 @@ def _ring_dwithin_fn(mesh: Mesh, r_in2: float, r_out2: float):
         # match the loop outputs under shard_map
         zeros = jnp.zeros(lx.shape, jnp.int32)
         pcast = getattr(lax, "pcast", None)
+        pvary = getattr(lax, "pvary", None)
         if pcast is not None:
             zeros = pcast(zeros, "data", to="varying")
-        else:  # older jax
-            zeros = lax.pvary(zeros, ("data",))
+        elif pvary is not None:
+            zeros = pvary(zeros, ("data",))
+        # else: jax predates varying-ness tracking; shard_map accepts
+        # the replicated carry as-is
         # k-1 [compute, rotate] steps, then the final block without the
         # rotation (its permuted output would be discarded)
         rx, ry, rvalid, sure, band = lax.fori_loop(

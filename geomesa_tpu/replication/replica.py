@@ -146,8 +146,18 @@ class Replica(DataStore):
                     self._needs_bootstrap = True
                 self._registry.counter("replication.stream.gaps")
                 return
+            failed_before = self._report.records_failed
             replay_into(self._store, [(lsn, int(header["kind"]), payload)],
                         self._report)
+            if self._report.records_failed > failed_before:
+                # the record did NOT land: advancing applied_lsn past it
+                # would turn the exact-prefix marker into a lie (an ack
+                # could then point at a row this replica silently lacks).
+                # Re-bootstrap from the primary's checkpoint instead.
+                with self._lock:
+                    self._needs_bootstrap = True
+                self._registry.counter("replication.apply.failed")
+                return
             with self._lock:
                 self.applied_lsn = lsn
                 if self.applied_lsn >= self.primary_last_lsn:
@@ -299,3 +309,23 @@ class Replica(DataStore):
 
     def count(self, type_name: str) -> int:
         return self._store.count(type_name)
+
+    # aggregate scans delegate too: the cluster tier scatters stats /
+    # density / bin / arrow legs to replicas under the same staleness
+    # bounds as plain queries
+    def stats_query(self, type_name: str, stat_spec: str, ecql=None):
+        return self._store.stats_query(type_name, stat_spec, ecql)
+
+    def density(self, type_name: str, ecql, bbox, width: int, height: int,
+                weight_attr: str | None = None):
+        return self._store.density(type_name, ecql, bbox, width, height,
+                                   weight_attr=weight_attr)
+
+    def bin_query(self, type_name: str, ecql, track: str | None = None,
+                  label: str | None = None, sort: bool = False) -> bytes:
+        return self._store.bin_query(type_name, ecql, track=track,
+                                     label=label, sort=sort)
+
+    def arrow_ipc(self, type_name: str, ecql="INCLUDE",
+                  sort_by: str | None = None) -> bytes:
+        return self._store.arrow_ipc(type_name, ecql, sort_by=sort_by)

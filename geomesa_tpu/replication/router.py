@@ -40,6 +40,7 @@ from ..utils.properties import SystemProperty
 from .replica import Replica
 
 __all__ = ["ReplicatedDataStore", "ReplicationAckTimeout",
+           "ReplicationAckLost",
            "REPL_MAX_LAG_LSN", "REPL_MAX_LAG_S", "REPL_ACK_REPLICAS",
            "REPL_ACK_TIMEOUT_S", "REPL_PROMOTE_AUTO", "REPL_PROBE_MS",
            "REPL_PROBE_FAILURES"]
@@ -62,6 +63,20 @@ class ReplicationAckTimeout(TimeoutError):
     yet replication-acknowledged, so it may be lost if the primary
     fails before a replica catches up. Not retryable as-is: a blind
     retry would duplicate the write."""
+
+    retryable = False
+
+
+class ReplicationAckLost(ConnectionError):
+    """A failover completed while this write awaited replication, and
+    the promoted replica's applied prefix does NOT cover it: the write
+    exists only on the deposed primary. Acking it would violate the
+    zero-acked-loss contract — a zombie primary (listener gone, an
+    established connection still accepting writes: the asymmetric
+    partition a chaos kill produces) would otherwise keep collecting
+    acks that the new primary never saw. Not retryable blindly: the
+    old primary may still hold the write, so a retry after it rejoins
+    could duplicate."""
 
     retryable = False
 
@@ -108,6 +123,11 @@ class ReplicatedDataStore(DataStore):
         self._last_write_lsn = 0
         self._rr = 0                     # round-robin cursor
         self._promoted_to: str | None = None
+        # applied prefix of the promoted replica, frozen at the moment
+        # its stream was cut: the durability watermark acks compare
+        # against once a failover has happened (None = no failover yet,
+        # or promotion in flight and the cut point is not known yet)
+        self._promote_cutoff: int | None = None
         self._failover_s: float | None = None
         self._primary_healthy = True
         self._probe = probe if probe is not None else getattr(
@@ -156,29 +176,50 @@ class ReplicatedDataStore(DataStore):
             return journal.wal.last_lsn
         return None
 
+    def _ack_state(self, lsn: int):
+        """One consistent snapshot deciding an ack wait. Returns
+        ``True`` (acked), ``False`` (keep waiting), or raises.
+        Promotion is checked FIRST and under the same lock that
+        ``promote()`` mutates: once a failover has begun, replica
+        counts no longer mean anything (``_replicas`` is cleared), and
+        a write is durable iff the promoted replica's frozen applied
+        prefix covers its lsn — never because ``need`` degraded to 0."""
+        with self._lock:
+            promoted = self._promoted_to is not None
+            cutoff = self._promote_cutoff
+            attached = [r for r in self._replicas if r.attached]
+        if promoted:
+            if cutoff is None:
+                return False  # promotion in flight; cut point pending
+            if lsn <= cutoff:
+                return True
+            self._registry.counter("replication.ack.lost")
+            raise ReplicationAckLost(
+                f"write lsn {lsn} was on the deposed primary only: "
+                f"failover promoted at applied lsn {cutoff}")
+        need = min(self.ack_replicas, len(attached))
+        if need <= 0:
+            return True
+        return sum(1 for r in attached if r.applied_lsn >= lsn) >= need
+
     def _await_ack(self, lsn: int | None):
         if not lsn:
             return
         with self._lock:
             self._last_write_lsn = max(self._last_write_lsn, lsn)
-        attached = self._attached()
-        need = min(self.ack_replicas, len(attached))
-        if need <= 0:
+        if self._ack_state(lsn):
             return
         self._registry.counter("replication.ack.waits")
         deadline = time.monotonic() + self.ack_timeout_s
         with self._ack_cond:
             while True:
-                attached = self._attached()
-                need = min(self.ack_replicas, len(attached))
-                have = sum(1 for r in attached if r.applied_lsn >= lsn)
-                if have >= need:
+                if self._ack_state(lsn):
                     return
                 left = deadline - time.monotonic()
                 if left <= 0:
                     self._registry.counter("replication.ack.timeouts")
                     raise ReplicationAckTimeout(
-                        f"write lsn {lsn}: {have}/{need} replicas applied "
+                        f"write lsn {lsn}: not enough replicas applied "
                         f"within {self.ack_timeout_s}s")
                 self._ack_cond.wait(left)
 
@@ -259,8 +300,40 @@ class ReplicatedDataStore(DataStore):
         return self._read("query_count", q, type_name,
                           max_lag_lsn=max_lag_lsn, max_lag_s=max_lag_s)
 
-    def count(self, type_name: str) -> int:
-        return self._read("count", type_name)
+    def count(self, type_name: str,
+              max_lag_lsn=None, max_lag_s=None) -> int:
+        return self._read("count", type_name,
+                          max_lag_lsn=max_lag_lsn, max_lag_s=max_lag_s)
+
+    # aggregate scans ride the same bounded-staleness fan-out: the
+    # cluster tier scatters these per shard group, and a replica that
+    # satisfies the lag bound serves them exactly (sketches/grids/bin
+    # chunks over its applied prefix)
+    def stats_query(self, type_name: str, stat_spec: str, ecql=None,
+                    max_lag_lsn=None, max_lag_s=None):
+        return self._read("stats_query", type_name, stat_spec, ecql,
+                          max_lag_lsn=max_lag_lsn, max_lag_s=max_lag_s)
+
+    def density(self, type_name: str, ecql, bbox, width: int, height: int,
+                weight_attr: str | None = None,
+                max_lag_lsn=None, max_lag_s=None):
+        kwargs = {} if weight_attr is None else {"weight_attr": weight_attr}
+        return self._read("density", type_name, ecql, bbox, width, height,
+                          max_lag_lsn=max_lag_lsn, max_lag_s=max_lag_s,
+                          **kwargs)
+
+    def bin_query(self, type_name: str, ecql, track: str | None = None,
+                  label: str | None = None, sort: bool = False,
+                  max_lag_lsn=None, max_lag_s=None) -> bytes:
+        return self._read("bin_query", type_name, ecql, track=track,
+                          label=label, sort=sort,
+                          max_lag_lsn=max_lag_lsn, max_lag_s=max_lag_s)
+
+    def arrow_ipc(self, type_name: str, ecql="INCLUDE",
+                  sort_by: str | None = None,
+                  max_lag_lsn=None, max_lag_s=None) -> bytes:
+        return self._read("arrow_ipc", type_name, ecql, sort_by=sort_by,
+                          max_lag_lsn=max_lag_lsn, max_lag_s=max_lag_s)
 
     def get_schema(self, type_name: str):
         try:
@@ -322,7 +395,15 @@ class ReplicatedDataStore(DataStore):
             self._primary_healthy = True
         self._probe_stop.set()
         best.promote()
+        # the stream is cut: best's applied prefix is now final. Freeze
+        # it as the ack watermark and wake waiters BEFORE the slow
+        # detach joins — pending acks must resolve against the cutoff,
+        # not hang behind replica thread teardown.
+        with self._lock:
+            self._promote_cutoff = best.applied_lsn
         self.primary = best
+        with self._ack_cond:
+            self._ack_cond.notify_all()
         for r in others:
             r.stop()
         with self._ack_cond:

@@ -193,18 +193,22 @@ class FsBackedDistributedDataStore(DistributedDataStore):
         return os.path.join(self.root, type_name, "index_mesh")
 
     def _ids_digest(self, type_name: str) -> str:
-        """Layout fingerprint (row count + strided id sample): sort
-        orders are permutations over ROW POSITIONS, so adopting them
-        onto a differently-ordered table would silently drop rows —
-        the digest must match before a sidecar installs."""
+        """Layout fingerprint over the FULL id column: sort orders are
+        permutations over ROW POSITIONS, so adopting them onto a
+        differently-ordered table would silently drop rows — the
+        digest must match before a sidecar installs. A strided sample
+        is NOT enough: two layouts agreeing on count and every sampled
+        position but differing between samples would adopt each
+        other's sidecars and serve wrong rows. Hashing is chunked so
+        a 100M-id column never builds one giant joined string."""
         import hashlib
         st = self._state(type_name)
         ids = (st.batch.ids if st.batch is not None
                else np.empty(0, dtype=object))
-        h = hashlib.sha1(str(len(ids)).encode())
-        step = max(1, len(ids) // 1_000_000)
-        for v in ids[::step]:
-            h.update(str(v).encode())
+        h = hashlib.sha256(str(len(ids)).encode())
+        for lo in range(0, len(ids), 1_000_000):
+            part = ids[lo:lo + 1_000_000]
+            h.update("\0".join(map(str, part)).encode())
             h.update(b"\0")
         return h.hexdigest()
 

@@ -20,6 +20,8 @@ This single-device path is the building block the mesh-sharded store
 from __future__ import annotations
 
 import dataclasses
+import functools
+import threading
 import weakref
 from typing import Any, Iterator
 
@@ -591,11 +593,28 @@ class _TypeState:
         return self.pallas_data
 
 
+def _synchronized(fn):
+    """Serialize a store operation on the per-store reentrant lock.
+    Reads mutate state too (pending-append flush, lazy index builds,
+    plan caches), so ANY two concurrent operations on one store may
+    race — a replica apply loop interleaving with scatter-gather query
+    legs would desync batch/vis and silently drop rows. Per-store
+    serialization keeps cross-store parallelism (each shard group owns
+    its lock) while making a single store safe to serve from many
+    threads."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._op_lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
+
+
 class InMemoryDataStore(DataStore):
     """A GeoTools-DataStore-shaped API over device-resident batches."""
 
     def __init__(self, audit=None, durable_dir: str | None = None,
                  wal_fsync: str | None = None):
+        self._op_lock = threading.RLock()
         self._types: dict[str, _TypeState] = {}
         self.stats = DataStoreStats()
         self.audit = audit  # AuditLogger or None
@@ -610,6 +629,7 @@ class InMemoryDataStore(DataStore):
 
     # -- schema management (MetadataBackedDataStore surface) --------------
 
+    @_synchronized
     def create_schema(self, sft: SimpleFeatureType | str,
                       spec: str | None = None):
         if isinstance(sft, str):
@@ -629,6 +649,7 @@ class InMemoryDataStore(DataStore):
     def get_type_names(self) -> list[str]:
         return sorted(self._types)
 
+    @_synchronized
     def remove_schema(self, type_name: str):
         if self.journal is not None and type_name in self._types:
             self.journal.log_drop_schema(type_name)
@@ -651,6 +672,7 @@ class InMemoryDataStore(DataStore):
     # query a ready index instead of a multi-second build
     _EAGER_INDEX_ROWS = 5_000_000
 
+    @_synchronized
     def write(self, type_name: str, batch: FeatureBatch, visibilities=None):
         st = self._state(type_name)
         if batch.sft != st.sft:
@@ -700,6 +722,7 @@ class InMemoryDataStore(DataStore):
         from ..analytics.join import prewarm_join_kernels
         prewarm_join_kernels(col.x, col.y, device_xy=device_xy)
 
+    @_synchronized
     def delete(self, type_name: str, ids):
         st = self._state(type_name)
         ids = set(map(str, ids))
@@ -709,6 +732,7 @@ class InMemoryDataStore(DataStore):
 
     # -- durability (wal/ subsystem, opt-in via durable_dir) ---------------
 
+    @_synchronized
     def checkpoint(self, keep: int = 2) -> dict:
         """Snapshot current state and compact the journal; requires the
         ``durable_dir`` knob. ``keep=2`` retains the prior checkpoint
@@ -722,12 +746,14 @@ class InMemoryDataStore(DataStore):
         if self.journal is not None:
             self.journal.close()
 
+    @_synchronized
     def warm_index(self, type_name: str, state: dict):
         """Install persisted z-key sort orders (possibly memory-mapped)
         to be adopted by the next index build — the fs store's sidecar
         reopen path. Stale states (row count mismatch) are ignored."""
         self._state(type_name).zindex_warm = state
 
+    @_synchronized
     def index_state(self, type_name: str) -> dict | None:
         """Built z-key sort orders for persistence, or None when no
         index has been built yet."""
@@ -737,9 +763,11 @@ class InMemoryDataStore(DataStore):
         out = st.zindex.state_dict()
         return out or None
 
+    @_synchronized
     def count(self, type_name: str) -> int:
         return self._state(type_name).n
 
+    @_synchronized
     def reindex(self, type_name: str, to_version: int | None = None):
         """Migrate the type's z-index layout to ``to_version`` (the
         WriteIndexJob / AttributeIndexJob reindex analog,
@@ -759,6 +787,7 @@ class InMemoryDataStore(DataStore):
         st.plan_cache.clear()
         st.ensure_index()  # rebuild + atomic swap
 
+    @_synchronized
     def analyze(self, type_name: str):
         """Recompute stats from scratch (stats are additive on write and
         go stale after deletes — the reference's `stats analyze` run)."""
@@ -769,6 +798,7 @@ class InMemoryDataStore(DataStore):
             self.stats.observe(st.sft, st.batch)
         return self.stats.get(type_name)
 
+    @_synchronized
     def density(self, type_name: str, ecql, bbox, width: int, height: int,
                 weight_attr: str | None = None) -> np.ndarray:
         """Density surface (DensityScan pushdown analog): heatmap grid of
@@ -792,6 +822,7 @@ class InMemoryDataStore(DataStore):
         y = np.where(gvalid, y, bbox[1])
         return density_grid(x, y, mask, bbox, width, height, w)
 
+    @_synchronized
     def bin_query(self, type_name: str, ecql, track: str | None = None,
                   label: str | None = None, sort: bool = False) -> bytes:
         """BIN-format results (BinAggregatingScan analog): compact
@@ -819,6 +850,7 @@ class InMemoryDataStore(DataStore):
                                   labels=labels, track_values=track_vals,
                                   sort=sort)
 
+    @_synchronized
     def arrow_query(self, type_name: str, ecql):
         """Arrow-encoded results (ArrowScan analog): a pyarrow
         RecordBatch of matching features."""
@@ -827,6 +859,7 @@ class InMemoryDataStore(DataStore):
             return None
         return res.batch.to_arrow()
 
+    @_synchronized
     def arrow_ipc(self, type_name: str, ecql="INCLUDE",
                   sort_by: str | None = None) -> bytes:
         """Arrow IPC stream of matching features, readable by
@@ -836,6 +869,7 @@ class InMemoryDataStore(DataStore):
         from ..arrow.scan import ArrowScan
         return ArrowScan(self).execute(type_name, ecql, sort_by=sort_by)
 
+    @_synchronized
     def stats_query(self, type_name: str, stat_spec: str,
                     ecql: str | ast.Filter = None):
         """Run a stat sketch over query results (StatsScan analog,
@@ -999,6 +1033,7 @@ class InMemoryDataStore(DataStore):
             explain(f"Sampling applied: rate={rate}")
         return idx, attr_mask
 
+    @_synchronized
     def query(self, q: Query | str, type_name: str | None = None,
               explain_out=None) -> QueryResult:
         if isinstance(q, str):
@@ -1101,6 +1136,7 @@ class InMemoryDataStore(DataStore):
                               len(idx))
         return QueryResult(ids, batch, explain, strategy, n=len(idx))
 
+    @_synchronized
     def query_count(self, q: Query | str,
                     type_name: str | None = None) -> int:
         """Count without materializing ids or columns: the shared
@@ -1129,6 +1165,7 @@ class InMemoryDataStore(DataStore):
                                     * 1000, 3), n)
         return n
 
+    @_synchronized
     def query_batched(self, queries: list[Query],
                       explain_out=None) -> list[QueryResult]:
         """Micro-batched execution: evaluate several queries with ONE

@@ -288,14 +288,28 @@ class RemoteDataStore(DataStore):
             if type_name is None:
                 raise ValueError("type_name required with a filter string")
             q = Query(type_name, q)
-        if q.hints or q.auths is not None or q.max_features is not None:
-            # the count endpoint is filter-only; hints (sampling,
-            # forced index), auths, and limits count via the full
-            # query surface so semantics match the local stores
+        mapped = {QueryHints.SAMPLING, QueryHints.SAMPLE_BY,
+                  QueryHints.QUERY_INDEX}
+        if set(q.hints) - mapped:
+            # a hint the count endpoint cannot express: evaluate via
+            # the full query surface so semantics stay exact
             return self.query(q).n
+        # hinted/sampled/limited counts evaluate SERVER-side through
+        # the same Query parse as /rest/query — the response is one
+        # number, never O(n) rows shipped just to be len()'d here
+        params: dict[str, Any] = {"cql": str(q.filter)}
+        if q.max_features is not None:
+            params["maxFeatures"] = q.max_features
+        if q.auths is not None:
+            params["auths"] = ",".join(q.auths)
+        if QueryHints.SAMPLING in q.hints:
+            params["sampling"] = q.hints[QueryHints.SAMPLING]
+        if QueryHints.SAMPLE_BY in q.hints:
+            params["sampleBy"] = q.hints[QueryHints.SAMPLE_BY]
+        if QueryHints.QUERY_INDEX in q.hints:
+            params["index"] = q.hints[QueryHints.QUERY_INDEX]
         return int(self._json(
-            "GET", f"/rest/count/{quote(q.type_name)}",
-            {"cql": str(q.filter)})["count"])
+            "GET", f"/rest/count/{quote(q.type_name)}", params)["count"])
 
     # -- server-side analytics ---------------------------------------------
 
@@ -341,3 +355,13 @@ class RemoteDataStore(DataStore):
         """POST /rest/replication/promote (bearer-gated like the other
         mutating admin routes)."""
         return self._json("POST", "/rest/replication/promote")
+
+    def cluster_status(self) -> dict:
+        """GET /rest/cluster (server must front a ClusterDataStore)."""
+        return self._json("GET", "/rest/cluster")
+
+    def promote_group(self, group: str | None = None) -> dict:
+        """POST /rest/cluster/promote?group=NAME (bearer-gated):
+        force intra-group failover on a cluster coordinator server."""
+        params = {"group": group} if group else None
+        return self._json("POST", "/rest/cluster/promote", params)

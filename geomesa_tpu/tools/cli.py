@@ -44,6 +44,9 @@ def _store(args):
         # no explicit port -> the serve command's default
         return RemoteDataStore(host or "127.0.0.1",
                                int(port) if port else 8080)
+    if path.startswith("cluster://"):
+        from ..cluster import ClusterDataStore
+        return ClusterDataStore.from_uri(path)
     if path.startswith("fs-mesh://"):
         from ..store import FsBackedDistributedDataStore
         return FsBackedDistributedDataStore(path[len("fs-mesh://"):])
@@ -397,6 +400,56 @@ def cmd_replication(args) -> int:
     return 2
 
 
+def cmd_cluster(args) -> int:
+    """Cluster administration: ``status`` reads the coordinator's
+    topology (shard groups, owned z-ranges, LSN vector, breakers) —
+    from a serving node's /rest/cluster or directly from a
+    ``cluster://`` federation uri; ``promote`` forces intra-group
+    failover (bearer-gated on remote nodes)."""
+    path = args.path
+    if path.startswith("cluster://"):
+        from ..cluster import ClusterDataStore
+        ds = ClusterDataStore.from_uri(path,
+                                       auth_token=getattr(args, "token",
+                                                          None))
+    elif path.startswith("remote://"):
+        from ..store import RemoteDataStore
+        host, _, port = path[len("remote://"):].partition(":")
+        ds = RemoteDataStore(host or "127.0.0.1",
+                             int(port) if port else 8080,
+                             auth_token=getattr(args, "token", None))
+    else:
+        print("cluster commands need --path remote://host:port or "
+              "cluster://h1:p1,h2:p2", file=sys.stderr)
+        return 2
+    if args.cluster_command == "status":
+        json.dump(ds.cluster_status(), sys.stdout, indent=2)
+        print()
+        return 0
+    if args.cluster_command == "promote":
+        from ..store.remote import RemoteError
+        try:
+            out = ds.promote_group(getattr(args, "group", None))
+        except KeyError as e:
+            print(f"promote refused: {e.args[0]}", file=sys.stderr)
+            return 2
+        except ValueError as e:
+            print(f"promote refused: {e}", file=sys.stderr)
+            return 2
+        except RemoteError as e:
+            if e.status == 403:
+                print("promote is gated: pass --token matching "
+                      "geomesa.web.auth.token", file=sys.stderr)
+                return 3
+            raise
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return 0
+    print(f"unknown cluster command {args.cluster_command!r}",
+          file=sys.stderr)
+    return 2
+
+
 def cmd_version(args) -> int:
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -509,6 +562,25 @@ def main(argv=None) -> int:
                         help="admin bearer token "
                              "(geomesa.web.auth.token)")
         rp.set_defaults(fn=cmd_replication)
+
+    clp = sub.add_parser("cluster",
+                         help="sharded cluster administration")
+    clsub = clp.add_subparsers(dest="cluster_command", required=True)
+    for cname, chelp in (("status", "shard topology, owned z-ranges, "
+                                    "LSN vector, leg breakers"),
+                         ("promote", "force intra-group failover "
+                                     "(token-gated)")):
+        cp = clsub.add_parser(cname, help=chelp)
+        cp.add_argument("--path", required=True,
+                        help="coordinator node remote://host:port, or "
+                             "federation cluster://h1:p1,h2:p2")
+        cp.add_argument("--token", default=None,
+                        help="admin bearer token "
+                             "(geomesa.web.auth.token)")
+        if cname == "promote":
+            cp.add_argument("--group", default=None,
+                            help="shard group name to promote inside")
+        cp.set_defaults(fn=cmd_cluster)
 
     add("version", cmd_version, needs_store=False)
     add("env", cmd_env, needs_store=False)

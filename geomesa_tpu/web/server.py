@@ -70,7 +70,8 @@ WEB_AUTH_TOKEN = SystemProperty("geomesa.web.auth.token", None)
 # POST /rest/wal/* are the WAL admin mutations (checkpoint/truncate);
 # GET /rest/wal stays open (read-only stats)
 _GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas"),
-          ("POST", "wal"), ("POST", "replication"), ("POST", "integrity")}
+          ("POST", "wal"), ("POST", "replication"), ("POST", "integrity"),
+          ("POST", "cluster")}
 
 # load-shedding gate: max concurrent in-flight requests (unset ->
 # unlimited). Requests over the cap get 503 + Retry-After BEFORE any
@@ -272,13 +273,27 @@ class GeoMesaWebServer:
         if len(parts) == 2 and parts[0] == "query":
             return self._query(parts[1], params)
         if len(parts) == 2 and parts[0] == "count":
-            if "cql" in params:
+            hinted = {"sampling", "sampleBy", "index", "auths",
+                      "maxFeatures", "properties"}
+            if hinted & params.keys():
+                # hinted/sampled/limited counts evaluate server-side
+                # through the full Query surface — the client gets one
+                # number either way, never O(n) rows over the wire
+                n = self.store.query_count(self._parse_query(parts[1],
+                                                             params))
+            elif "cql" in params:
                 n = self.store.query_count(params["cql"][0], parts[1])
             else:
                 # total stored features — the SPI count() contract
                 # (NOT visibility-filtered, matching local stores)
                 n = self.store.count(parts[1])
-            return 200, "application/json", _j({"count": int(n)})
+            out = {"count": int(n)}
+            if getattr(n, "complete", True) is False:
+                out["complete"] = False
+                out["missing_z_ranges"] = getattr(n, "missing_z_ranges", [])
+                return (200, "application/json", _j(out),
+                        _partial_headers(n))
+            return 200, "application/json", _j(out)
         if len(parts) == 2 and parts[0] == "write" and method == "POST":
             # body = Arrow IPC stream; a reserved __vis__ column (when
             # present) carries per-row visibility labels — the same
@@ -301,13 +316,21 @@ class GeoMesaWebServer:
                                  FeatureBatch.concat_all(batches),
                                  visibilities=vis)
             n = sum(b.n for b in batches)
-            return 200, "application/json", _j(
-                {"written": n, "lsn": self._tail_lsn()})
+            out = {"written": n, "lsn": self._tail_lsn()}
+            vec = getattr(self.store, "lsn_vector", None)
+            if callable(vec):
+                # cluster stores: the per-shard acked-LSN vector this
+                # write is included in (read-your-writes token)
+                out["lsn_vector"] = vec()
+            return 200, "application/json", _j(out)
         if len(parts) == 2 and parts[0] == "delete" and method == "POST":
             ids = json.loads(body.decode())
             self.store.delete(parts[1], ids)
-            return 200, "application/json", _j(
-                {"deleted": len(ids), "lsn": self._tail_lsn()})
+            out = {"deleted": len(ids), "lsn": self._tail_lsn()}
+            vec = getattr(self.store, "lsn_vector", None)
+            if callable(vec):
+                out["lsn_vector"] = vec()
+            return 200, "application/json", _j(out)
         if len(parts) == 2 and parts[0] == "knn":
             return self._knn(parts[1], params)
         if len(parts) == 2 and parts[0] == "stats":
@@ -335,6 +358,8 @@ class GeoMesaWebServer:
             return self._integrity(method, parts[1:])
         if parts and parts[0] == "replication":
             return self._replication(method, parts[1:])
+        if parts and parts[0] == "cluster":
+            return self._cluster(method, parts[1:], params)
         if parts == ["audit"]:
             if self.audit is None:
                 return 200, "application/json", _j([])
@@ -374,6 +399,27 @@ class GeoMesaWebServer:
                     {"error": "store cannot promote (not a replication "
                               "router)"})
             return 200, "application/json", _j(promote())
+        return 404, "application/json", _j({"error": "not found"})
+
+    def _cluster(self, method, parts, params):
+        """Cluster admin. GET /rest/cluster reports shard-group
+        topology, owned z-ranges, the acked LSN vector and per-leg
+        breaker/latency state; POST /rest/cluster/promote?group=NAME
+        (bearer-gated) forces intra-group failover."""
+        if method == "GET" and not parts:
+            status = getattr(self.store, "cluster_status", None)
+            if callable(status):
+                return 200, "application/json", _j(status())
+            return 404, "application/json", _j(
+                {"error": "store has no cluster role"})
+        if method == "POST" and parts == ["promote"]:
+            promote = getattr(self.store, "promote_group", None)
+            if not callable(promote):
+                return 404, "application/json", _j(
+                    {"error": "store cannot promote (not a cluster "
+                              "coordinator)"})
+            group = params.get("group", [None])[0]
+            return 200, "application/json", _j(promote(group))
         return 404, "application/json", _j({"error": "not found"})
 
     def _wal(self, method, parts, params):
@@ -425,10 +471,10 @@ class GeoMesaWebServer:
             return 200, "application/json", _j(scrubber.run_once())
         return 404, "application/json", _j({"error": "not found"})
 
-    def _query(self, name, params):
-        cql = params.get("cql", ["INCLUDE"])[0]
-        fmt = params.get("format", ["json"])[0]
-        q = Query(name, cql)
+    def _parse_query(self, name, params) -> Query:
+        """URL params -> Query; shared by /rest/query and the hinted
+        /rest/count path so both evaluate identical semantics."""
+        q = Query(name, params.get("cql", ["INCLUDE"])[0])
         if "maxFeatures" in params:
             q.max_features = int(params["maxFeatures"][0])
         if "sortBy" in params:
@@ -448,6 +494,11 @@ class GeoMesaWebServer:
             q.hints[QueryHints.QUERY_INDEX] = params["index"][0]
         if "auths" in params:
             q.auths = [a for a in params["auths"][0].split(",") if a]
+        return q
+
+    def _query(self, name, params):
+        fmt = params.get("format", ["json"])[0]
+        q = self._parse_query(name, params)
         if fmt == "arrow":
             from ..arrow.io import write_ipc
             res = self._run_query(q)
@@ -462,7 +513,8 @@ class GeoMesaWebServer:
                      for a in sft.attributes})
             # projected results carry a projected schema
             return (200, "application/vnd.apache.arrow.file",
-                    write_ipc(batch.sft, batch))
+                    write_ipc(batch.sft, batch),
+                    _partial_headers(res))
         res = self._run_query(q)
         sft = self.store.get_schema(name)
         if fmt == "geojson":
@@ -477,11 +529,15 @@ class GeoMesaWebServer:
                         "geometry": to_geojson(g) if g is not None else None,
                         "properties": {k: v for k, v in f.items()
                                        if k not in ("id", gf)}})
-            return 200, "application/geo+json", _j(
-                {"type": "FeatureCollection", "features": feats})
+            return (200, "application/geo+json", _j(
+                {"type": "FeatureCollection", "features": feats}),
+                _partial_headers(res))
         rows = list(res.features()) if res.batch is not None else []
-        return 200, "application/json", _j({"count": len(rows),
-                                            "features": rows})
+        out = {"count": len(rows), "features": rows}
+        if getattr(res, "complete", True) is False:
+            out["complete"] = False
+            out["missing_z_ranges"] = getattr(res, "missing_z_ranges", [])
+        return (200, "application/json", _j(out), _partial_headers(res))
 
     def _run_query(self, q: Query):
         """Queries coalesce through the batcher (one fused scan per
@@ -538,6 +594,20 @@ class _Httpd(ThreadingHTTPServer):
 
 def _j(obj) -> bytes:
     return json.dumps(obj, default=_default).encode()
+
+
+def _partial_headers(res) -> dict:
+    """Response headers for the cluster partial-results contract: a
+    result flagged ``complete=False`` (a shard group was down and
+    ``geomesa.cluster.allow.partial`` let the query degrade) is marked
+    so no transport strips the flag. Complete results add nothing."""
+    if getattr(res, "complete", True) is not False:
+        return {}
+    hdrs = {"X-GeoMesa-Complete": "false"}
+    groups = getattr(res, "missing_groups", None)
+    if groups:
+        hdrs["X-GeoMesa-Missing-Groups"] = ",".join(groups)
+    return hdrs
 
 
 def _default(o):

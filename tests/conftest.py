@@ -37,6 +37,10 @@ def pytest_configure(config):
         "poisoning; select with -m integrity — the randomized "
         "crash-consistency loop is additionally marked slow)")
     config.addinivalue_line(
+        "markers", "cluster: sharded scatter-gather suites (z-prefix "
+        "partitioning, hedged legs, partial-results contract, "
+        "federation, chaos failover; select with -m cluster)")
+    config.addinivalue_line(
         "markers", "bench_smoke: miniature end-to-end runs of the "
         "bench.py perf configs (4: batched KNN, 5: contains join) at "
         "toy sizes — exactness wiring, not performance")

@@ -52,6 +52,26 @@ def test_config5_contains_smoke():
 
 
 @pytest.mark.bench_smoke
+@pytest.mark.cluster
+def test_config11_cluster_smoke():
+    rng = np.random.default_rng(44)
+    c = bench.bench_config11(rng, n=3000, nq=20)
+    assert c["counts_exact"] is True
+    for k in (1, 2, 4):
+        assert c[f"groups_{k}"]["scatter_qps"] > 0
+    f = c["failover"]
+    assert f["auto_promoted"] is True
+    assert f["zero_acked_loss"] is True
+    assert f["acked_lost"] == 0 and f["acked_writes"] > 0
+    assert f["queries_silently_wrong"] == 0
+    d = c["degraded"]
+    assert d["typed_errors_knob_off"] == d["queries"]
+    assert d["partial_flagged_knob_on"] == d["queries"]
+    assert d["missing_z_ranges"]
+    assert 0 < d["completeness_fraction"] <= 1
+
+
+@pytest.mark.bench_smoke
 def test_load_gate_reports_without_exiting(monkeypatch, capsys):
     monkeypatch.setattr(bench, "LOAD_MAX", 0.0)   # force over-ceiling
     monkeypatch.setattr(bench, "LOAD_WAIT_S", 0.0)

@@ -1,0 +1,628 @@
+"""Cluster serving: Z-sharded scatter-gather that survives shard loss.
+
+Covers the partition function, exact scatter-gather merges against a
+single-store oracle (ids / counts / stats / density / bin / arrow),
+the partial-results contract (typed ``ShardUnavailableError`` vs
+flagged ``complete=False``), the cross-shard LSN vector and
+read-your-writes gate, the chaos acceptance gate (kill a group's
+primary mid-scatter: auto-promote, zero acked-write loss, never a
+silent wrong answer), the two-server federation equivalence
+(``cluster://`` URI), and the REST/CLI admin surfaces.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.cluster import (ClusterDataStore, PartialCount,
+                                 ShardUnavailableError, ZPrefixPartitioner)
+from geomesa_tpu.cluster.partition import PREFIX_BITS, _N_PREFIXES
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.store import InMemoryDataStore
+
+pytestmark = pytest.mark.cluster
+
+SPEC = "*geom:Point:srid=4326,dtg:Date,name:String"
+
+
+def seeded(n=400, seed=7):
+    rng = np.random.default_rng(seed)
+    ids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    cols = {
+        "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)),
+        "dtg": (np.int64(1704067200000)
+                + np.arange(n, dtype=np.int64) * 3_600_000),
+        "name": np.array([f"n{i % 13}" for i in range(n)], dtype=object),
+    }
+    return ids, cols
+
+
+def make_cluster(k, n=400, names=None, **kw):
+    """k in-memory shard groups + a single-store oracle, same rows."""
+    sft = parse_spec("pts", SPEC)
+    groups = [InMemoryDataStore() for _ in range(k)]
+    cluster = ClusterDataStore(groups, names=names, **kw)
+    cluster.create_schema(sft)
+    oracle = InMemoryDataStore()
+    oracle.create_schema(sft)
+    ids, cols = seeded(n)
+    cluster.write("pts", FeatureBatch.from_dict(sft, ids, cols))
+    oracle.write("pts", FeatureBatch.from_dict(sft, ids, cols))
+    return cluster, oracle, sft
+
+
+class _DownGroup:
+    """A shard group with every node gone: all calls fail fast."""
+
+    def __getattr__(self, name):
+        def boom(*a, **kw):
+            raise ConnectionError("shard group down")
+        return boom
+
+
+# -- partition function ------------------------------------------------------
+
+class TestPartitioner:
+    def test_ranges_cover_and_disjoint(self):
+        for n in (1, 2, 3, 4, 7, 16):
+            part = ZPrefixPartitioner(n)
+            ranges = [part.prefix_range(g) for g in range(n)]
+            assert ranges[0][0] == 0
+            assert ranges[-1][1] == _N_PREFIXES
+            for (_, hi), (lo2, _) in zip(ranges, ranges[1:]):
+                assert hi == lo2  # contiguous, no gap, no overlap
+
+    def test_owner_matches_range(self):
+        part = ZPrefixPartitioner(3)
+        rng = np.random.default_rng(0)
+        x, y = rng.uniform(-180, 180, 500), rng.uniform(-90, 90, 500)
+        owners = part.owners_xy(x, y)
+        assert set(np.unique(owners)) <= {0, 1, 2}
+        # recompute each owner from its z prefix range
+        from geomesa_tpu.curves.sfc import Z2SFC
+        z = np.asarray(Z2SFC().index(x, y, lenient=True)).astype(np.uint64)
+        prefix = (z >> np.uint64(62 - PREFIX_BITS)).astype(int)
+        for g in range(3):
+            lo, hi = part.prefix_range(g)
+            sel = (prefix >= lo) & (prefix < hi)
+            assert (owners[sel] == g).all()
+
+    def test_deterministic_across_instances(self):
+        rng = np.random.default_rng(1)
+        x, y = rng.uniform(-180, 180, 200), rng.uniform(-90, 90, 200)
+        a = ZPrefixPartitioner(4).owners_xy(x, y)
+        b = ZPrefixPartitioner(4).owners_xy(x, y)
+        assert (a == b).all()
+
+    def test_id_hash_routing_stable(self):
+        part = ZPrefixPartitioner(5)
+        ids = [f"feat-{i}" for i in range(100)]
+        a, b = part.owners_ids(ids), part.owners_ids(ids)
+        assert (a == b).all()
+        assert set(np.unique(a)) <= set(range(5))
+
+    def test_z_range_description(self):
+        part = ZPrefixPartitioner(2)
+        r = part.z_range(1)
+        assert r["prefix_lo"] == _N_PREFIXES // 2
+        assert r["prefix_hi"] == _N_PREFIXES
+        assert r["z_lo"] == r["prefix_lo"] << (62 - PREFIX_BITS)
+
+
+# -- healthy scatter-gather: id-exact vs oracle ------------------------------
+
+class TestScatterExactness:
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_query_ids_exact(self, k):
+        cluster, oracle, _ = make_cluster(k)
+        for ecql in ("INCLUDE", "BBOX(geom, -60, -30, 60, 30)",
+                     "name = 'n3'"):
+            got = set(cluster.query(ecql, "pts").ids.astype(str))
+            want = set(oracle.query(ecql, "pts").ids.astype(str))
+            assert got == want, ecql
+        cluster.close()
+
+    def test_counts_exact(self):
+        cluster, oracle, _ = make_cluster(3)
+        assert cluster.count("pts") == oracle.count("pts")
+        for ecql in ("INCLUDE", "BBOX(geom, 0, 0, 90, 45)"):
+            assert (cluster.query_count(ecql, "pts")
+                    == oracle.query_count(ecql, "pts"))
+        cluster.close()
+
+    def test_sort_and_max_features(self):
+        from geomesa_tpu.index.api import Query
+        cluster, oracle, _ = make_cluster(3)
+        q = Query("pts", "INCLUDE", sort_by="name", max_features=37)
+        got = cluster.query(q)
+        want = oracle.query(q)
+        assert got.n == want.n == 37
+        # global order by the sort key must hold across shard legs
+        names = [got.batch.col("name").value(i) for i in range(got.n)]
+        assert names == sorted(names)
+        cluster.close()
+
+    def test_stats_merge_exact(self):
+        cluster, oracle, _ = make_cluster(3)
+        spec = "MinMax(dtg);Count()"
+        got = cluster.stats_query("pts", spec)
+        want = oracle.stats_query("pts", spec)
+        assert got.to_json_object() == want.to_json_object()
+        assert got.complete is True
+        cluster.close()
+
+    def test_density_sums_exact(self):
+        cluster, oracle, _ = make_cluster(4)
+        bbox = (-180.0, -90.0, 180.0, 90.0)
+        got = cluster.density("pts", "INCLUDE", bbox, 32, 16)
+        want = oracle.density("pts", "INCLUDE", bbox, 32, 16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+        assert got.sum() > 0
+        cluster.close()
+
+    def test_bin_merge_exact(self):
+        cluster, oracle, _ = make_cluster(3)
+        got = cluster.bin_query("pts", "INCLUDE", sort=True)
+        want = oracle.bin_query("pts", "INCLUDE", sort=True)
+        assert len(got) == len(want)
+        # same record SET; the sorted merge must also be time-ordered
+        rec = 16
+        assert ({got[i:i + rec] for i in range(0, len(got), rec)}
+                == {want[i:i + rec] for i in range(0, len(want), rec)})
+        t = np.frombuffer(got, dtype="<i4").reshape(-1, 4)[:, 1]
+        assert (np.diff(t) >= 0).all()
+        cluster.close()
+
+    def test_arrow_ipc_merge_exact(self):
+        from geomesa_tpu.arrow.io import read_ipc_batches
+        cluster, oracle, sft = make_cluster(3)
+        got = cluster.arrow_ipc("pts", "BBOX(geom, -90, -45, 90, 45)")
+        want = oracle.arrow_ipc("pts", "BBOX(geom, -90, -45, 90, 45)")
+        _, gb = read_ipc_batches(got, sft)
+        _, wb = read_ipc_batches(want, sft)
+        assert set(gb.ids.astype(str)) == set(wb.ids.astype(str))
+        cluster.close()
+
+    def test_write_routes_disjoint_and_total(self):
+        cluster, _, _ = make_cluster(3, n=600)
+        per_group = [g.count("pts") for g in cluster._groups]
+        assert sum(per_group) == 600
+        # ids must not repeat across groups (disjoint ownership)
+        all_ids = [i for g in cluster._groups
+                   for i in g.query("INCLUDE", "pts").ids.astype(str)]
+        assert len(all_ids) == len(set(all_ids)) == 600
+        cluster.close()
+
+    def test_delete_broadcasts(self):
+        cluster, oracle, _ = make_cluster(2)
+        victims = [f"f{i}" for i in range(0, 50)]
+        cluster.delete("pts", victims)
+        oracle.delete("pts", victims)
+        assert (set(cluster.query("INCLUDE", "pts").ids.astype(str))
+                == set(oracle.query("INCLUDE", "pts").ids.astype(str)))
+        cluster.close()
+
+
+# -- partial-results contract ------------------------------------------------
+
+class TestPartialResults:
+    def make_half_down(self, allow_partial):
+        sft = parse_spec("pts", SPEC)
+        live = InMemoryDataStore()
+        live.create_schema(sft)
+        ids, cols = seeded(200)
+        live.write("pts", FeatureBatch.from_dict(sft, ids, cols))
+        cluster = ClusterDataStore([live, _DownGroup()],
+                                   names=["up", "down"],
+                                   leg_deadline_s=2, hedge_ms=10,
+                                   allow_partial=allow_partial)
+        cluster._sfts["pts"] = sft
+        return cluster, live
+
+    def test_down_group_raises_typed(self):
+        cluster, _ = self.make_half_down(allow_partial=False)
+        with pytest.raises(ShardUnavailableError) as ei:
+            cluster.query("INCLUDE", "pts")
+        err = ei.value
+        assert err.groups == ["down"]
+        assert err.z_ranges[0]["prefix_lo"] == _N_PREFIXES // 2
+        assert getattr(err, "retryable", True) is False
+        with pytest.raises(ShardUnavailableError):
+            cluster.query_count("INCLUDE", "pts")
+        with pytest.raises(ShardUnavailableError):
+            cluster.stats_query("pts", "Count()")
+
+    def test_partial_mode_flags_never_silent(self):
+        cluster, live = self.make_half_down(allow_partial=True)
+        res = cluster.query("INCLUDE", "pts")
+        assert res.complete is False
+        assert res.missing_groups == ["down"]
+        assert res.missing_z_ranges[0]["prefix_hi"] == _N_PREFIXES
+        # the live leg's rows all came through
+        assert (set(res.ids.astype(str))
+                == set(live.query("INCLUDE", "pts").ids.astype(str)))
+        c = cluster.query_count("INCLUDE", "pts")
+        assert isinstance(c, PartialCount)
+        assert c.complete is False
+        assert int(c) == live.query_count("INCLUDE", "pts")
+        grid = cluster.density("pts", "INCLUDE",
+                               (-180.0, -90.0, 180.0, 90.0), 16, 8)
+        assert getattr(grid, "complete", True) is False
+
+    def test_knob_flips_live(self):
+        from geomesa_tpu.cluster import CLUSTER_ALLOW_PARTIAL
+        cluster, _ = self.make_half_down(allow_partial=None)
+        old = CLUSTER_ALLOW_PARTIAL.get()
+        try:
+            CLUSTER_ALLOW_PARTIAL.set("false")
+            with pytest.raises(ShardUnavailableError):
+                cluster.query_count("INCLUDE", "pts")
+            CLUSTER_ALLOW_PARTIAL.set("true")
+            assert cluster.query_count("INCLUDE", "pts").complete is False
+        finally:
+            CLUSTER_ALLOW_PARTIAL.set(old)
+
+    def test_healthy_result_is_complete(self):
+        cluster, _, _ = make_cluster(2)
+        res = cluster.query("INCLUDE", "pts")
+        assert res.complete is True
+        assert res.missing_groups == []
+        cluster.close()
+
+
+# -- LSN vector + read-your-writes -------------------------------------------
+
+class TestLsnVector:
+    def test_write_returns_vector(self, tmp_path):
+        sft = parse_spec("pts", SPEC)
+        g0 = InMemoryDataStore(durable_dir=str(tmp_path / "g0"),
+                               wal_fsync="never")
+        g1 = InMemoryDataStore()
+        cluster = ClusterDataStore([g0, g1], names=["a", "b"])
+        cluster.create_schema(sft)
+        ids, cols = seeded(100)
+        vec = cluster.write("pts", FeatureBatch.from_dict(sft, ids, cols))
+        # durable group a journals -> vector carries its acked position
+        assert vec.get("a", 0) > 0
+        assert cluster.lsn_vector() == vec
+        st = cluster.cluster_status()
+        assert st["lsn_vector"] == vec
+        cluster.close()
+
+    def test_read_your_writes_through_replicas(self, tmp_path):
+        """ack_replicas=0 lets the primary ack before replicas apply;
+        the RYW min-LSN gate must still keep immediate reads exact
+        (lagging replicas are ineligible; the leg falls back to the
+        primary)."""
+        from geomesa_tpu.replication import (Replica, ReplicatedDataStore,
+                                             WalShipper)
+        sft = parse_spec("pts", SPEC)
+        primary = InMemoryDataStore(durable_dir=str(tmp_path / "p"),
+                                    wal_fsync="never")
+        primary.create_schema(sft)
+        ship = WalShipper(primary.journal)
+        replica = Replica(ship.host, ship.port, name="r0")
+        group = ReplicatedDataStore(primary=primary, replicas=[replica],
+                                    ack_replicas=0, auto_promote=False,
+                                    max_lag_lsn=10**9, max_lag_s=3600)
+        cluster = ClusterDataStore([group], names=["g"],
+                                   leg_deadline_s=10)
+        cluster._sfts["pts"] = sft
+        ids, cols = seeded(50)
+        try:
+            for i in range(20):
+                b = FeatureBatch.from_dict(
+                    sft, np.array([f"rw{i}_{j}" for j in range(50)],
+                                  dtype=object), cols)
+                cluster.write("pts", b)
+                # immediately read back: must include every acked write
+                n = cluster.query_count("INCLUDE", "pts")
+                assert n == (i + 1) * 50, f"write {i} invisible"
+        finally:
+            cluster.close()
+            ship.stop()
+
+
+# -- federation: two web servers, one cluster:// client ----------------------
+
+class TestFederation:
+    def test_two_server_scatter_matches_single_store(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        sft = parse_spec("pts", SPEC)
+        backends = [InMemoryDataStore(), InMemoryDataStore()]
+        servers = [GeoMesaWebServer(b).start() for b in backends]
+        try:
+            uri = "cluster://" + ",".join(
+                f"127.0.0.1:{s.port}" for s in servers)
+            cluster = ClusterDataStore.from_uri(uri, leg_deadline_s=30)
+            cluster.create_schema(sft)
+            oracle = InMemoryDataStore()
+            oracle.create_schema(sft)
+            ids, cols = seeded(300)
+            cluster.write("pts", FeatureBatch.from_dict(sft, ids, cols))
+            oracle.write("pts", FeatureBatch.from_dict(sft, ids, cols))
+            # partitions are disjoint over the wire too
+            per = [b.count("pts") for b in backends]
+            assert sum(per) == 300 and all(p > 0 for p in per)
+            for ecql in ("INCLUDE", "BBOX(geom, -120, -60, 120, 60)"):
+                got = set(cluster.query(ecql, "pts").ids.astype(str))
+                want = set(oracle.query(ecql, "pts").ids.astype(str))
+                assert got == want, ecql
+            assert (cluster.query_count("INCLUDE", "pts")
+                    == oracle.query_count("INCLUDE", "pts"))
+            cluster.close()
+        finally:
+            for s in servers:
+                s.stop()
+
+
+# -- chaos acceptance gate ---------------------------------------------------
+
+class TestChaosFailover:
+    @pytest.mark.chaos
+    def test_kill_primary_mid_scatter_zero_acked_loss(self, tmp_path):
+        """THE acceptance gate: ChaosProxy kills group 0's primary
+        mid-run; the group auto-promotes inside the cluster; zero
+        acked-write loss; every concurrent query id-exact or typed —
+        never silently wrong."""
+        from geomesa_tpu.replication import (Replica, ReplicatedDataStore,
+                                             WalShipper)
+        from geomesa_tpu.resilience import ChaosProxy, RetryPolicy
+        from geomesa_tpu.store.remote import RemoteDataStore
+        from geomesa_tpu.web import GeoMesaWebServer
+
+        sft = parse_spec("pts", "*geom:Point:srid=4326")
+        rng = np.random.default_rng(5)
+        n_static = 800
+        sx = rng.uniform(-180, 180, n_static)
+        sy = rng.uniform(-90, 90, n_static)
+
+        primary = InMemoryDataStore(durable_dir=str(tmp_path / "g0"),
+                                    wal_fsync="never")
+        primary.create_schema(sft)
+        srv = GeoMesaWebServer(primary).start()
+        proxy = ChaosProxy("127.0.0.1", srv.port).start()
+        remote = RemoteDataStore(
+            "127.0.0.1", proxy.port, timeout_s=2.0,
+            retry_policy=RetryPolicy(max_attempts=2, base_s=0.02,
+                                     cap_s=0.05, total_deadline_s=1.0))
+        ship = WalShipper(primary.journal)
+        replicas = [Replica(ship.host, ship.port, name=f"r{i}")
+                    for i in range(2)]
+        group0 = ReplicatedDataStore(primary=remote, replicas=replicas,
+                                     ack_replicas=1, auto_promote=True,
+                                     probe_ms=50, probe_failures=2,
+                                     max_lag_lsn=100_000, max_lag_s=600)
+        group1 = InMemoryDataStore()
+        group1.create_schema(sft)
+        cluster = ClusterDataStore([group0, group1], names=["g0", "g1"],
+                                   leg_deadline_s=5, hedge_ms=50)
+        cluster._sfts["pts"] = sft
+        cluster.write("pts", FeatureBatch.from_dict(
+            sft, np.array([f"s{i}" for i in range(n_static)], object),
+            {"geom": (sx, sy)}))
+
+        acked, failed = [], []
+        wrong = [0]
+        stop = threading.Event()
+
+        def ingest():
+            bno = 0
+            w = np.random.default_rng(6)
+            while not stop.is_set():
+                wids = [f"w{bno}_{j}" for j in range(20)]
+                b = FeatureBatch.from_dict(
+                    sft, np.array(wids, dtype=object),
+                    {"geom": (w.uniform(-180, 180, 20),
+                              w.uniform(-90, 90, 20))})
+                try:
+                    cluster.write("pts", b)
+                    acked.extend(wids)
+                except Exception:
+                    failed.append(bno)  # typed, unacked: allowed
+                bno += 1
+
+        def query_loop():
+            q = np.random.default_rng(8)
+            while not stop.is_set():
+                x0 = float(q.uniform(-170, 130))
+                y0 = float(q.uniform(-80, 55))
+                try:
+                    res = cluster.query(
+                        f"BBOX(geom, {x0:.4f}, {y0:.4f}, "
+                        f"{x0+25:.4f}, {y0+25:.4f})", "pts")
+                except Exception:
+                    continue  # typed failure: loud, never wrong
+                got = set(res.ids.astype(str))
+                want = {f"s{i}" for i in range(n_static)
+                        if x0 <= sx[i] <= x0 + 25
+                        and y0 <= sy[i] <= y0 + 25}
+                if (want - got
+                        or any(not g.startswith(("s", "w"))
+                               for g in got - want)):
+                    wrong[0] += 1
+
+        threads = [threading.Thread(target=ingest, daemon=True),
+                   threading.Thread(target=query_loop, daemon=True)]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(0.6)              # healthy concurrent traffic
+            srv.stop()                   # group 0's primary dies
+            ship.stop()
+            proxy.stop()
+            deadline = time.monotonic() + 15
+            while (not isinstance(group0.primary, Replica)
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert isinstance(group0.primary, Replica), "no auto-promote"
+            time.sleep(0.4)              # traffic against promoted group
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        try:
+            assert len(acked) > 0
+            survived = set(
+                cluster.query("INCLUDE", "pts").ids.astype(str))
+            lost = [i for i in acked if i not in survived]
+            assert lost == [], f"{len(lost)} acked writes lost"
+            assert wrong[0] == 0, "silent wrong answers"
+            st = group0.replication_status()
+            assert st.get("promoted_to") in ("r0", "r1")
+        finally:
+            cluster.close()
+            proxy.stop()
+
+
+# -- zombie-primary ack gate (the bug the chaos gate found) ------------------
+
+class TestPromotionAckGate:
+    def test_ack_rejected_past_promotion_cutoff(self, tmp_path):
+        """After failover, a write that only the DEPOSED primary holds
+        (lsn above the promoted replica's frozen prefix) must fail its
+        ack typed — never report success. Before this gate, promotion
+        clearing the replica list degraded need to 0 and a zombie
+        primary kept collecting acks for writes the new primary never
+        saw."""
+        from geomesa_tpu.replication import (Replica, ReplicatedDataStore,
+                                             WalShipper)
+        from geomesa_tpu.replication.router import ReplicationAckLost
+
+        sft = parse_spec("pts", "*geom:Point:srid=4326")
+        primary = InMemoryDataStore(durable_dir=str(tmp_path / "p"),
+                                    wal_fsync="never")
+        primary.create_schema(sft)
+        ship = WalShipper(primary.journal)
+        replica = Replica(ship.host, ship.port, name="r0")
+        router = ReplicatedDataStore(primary=primary, replicas=[replica],
+                                     ack_replicas=1, auto_promote=False)
+        ids, cols = seeded(30)
+        router.write("pts", FeatureBatch.from_dict(
+            sft, np.array([f"a{i}" for i in range(30)], object),
+            {"geom": cols["geom"]}))
+        ship.stop()
+        router.promote()
+        cutoff = router._promote_cutoff
+        assert cutoff is not None and cutoff >= 1
+        # a write the promoted replica holds: acked
+        router._await_ack(cutoff)
+        # a write past the cutoff (zombie-primary only): typed failure
+        with pytest.raises(ReplicationAckLost):
+            router._await_ack(cutoff + 5)
+        router.close() if hasattr(router, "close") else None
+
+
+# -- REST + CLI admin surfaces -----------------------------------------------
+
+def _http(method, url, token=None, data=None):
+    req = urllib.request.Request(url, method=method, data=data)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+class TestRestSurface:
+    def test_cluster_status_endpoint(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        cluster, _, _ = make_cluster(2, names=["east", "west"])
+        srv = GeoMesaWebServer(cluster).start()
+        try:
+            code, st = _http("GET",
+                             f"http://127.0.0.1:{srv.port}/rest/cluster")
+            assert code == 200
+            assert st["role"] == "cluster"
+            assert st["n_groups"] == 2
+            assert [g["name"] for g in st["groups"]] == ["east", "west"]
+            assert st["groups"][1]["prefix_hi"] == _N_PREFIXES
+        finally:
+            srv.stop()
+            cluster.close()
+
+    def test_non_cluster_store_404s(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        srv = GeoMesaWebServer(InMemoryDataStore()).start()
+        try:
+            code, _ = _http("GET",
+                            f"http://127.0.0.1:{srv.port}/rest/cluster")
+            assert code == 404
+        finally:
+            srv.stop()
+
+    def test_promote_is_token_gated(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        cluster, _, _ = make_cluster(2, names=["a", "b"])
+        srv = GeoMesaWebServer(cluster, auth_token="s3cret").start()
+        base = f"http://127.0.0.1:{srv.port}/rest/cluster"
+        try:
+            code, _ = _http("POST", base + "/promote?group=a", data=b"")
+            assert code == 403
+            # with the token the request is authorized; these in-memory
+            # groups cannot promote, which surfaces as a clean error,
+            # not a 403
+            code, out = _http("POST", base + "/promote?group=a",
+                              token="s3cret", data=b"")
+            assert code != 403
+            # status stays open (read-only)
+            code, _ = _http("GET", base)
+            assert code == 200
+        finally:
+            srv.stop()
+            cluster.close()
+
+    def test_partial_count_flagged_over_http(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        sft = parse_spec("pts", SPEC)
+        live = InMemoryDataStore()
+        live.create_schema(sft)
+        ids, cols = seeded(100)
+        live.write("pts", FeatureBatch.from_dict(sft, ids, cols))
+        cluster = ClusterDataStore([live, _DownGroup()],
+                                   names=["up", "down"],
+                                   leg_deadline_s=2, hedge_ms=10,
+                                   allow_partial=True)
+        cluster._sfts["pts"] = sft
+        srv = GeoMesaWebServer(cluster).start()
+        try:
+            url = (f"http://127.0.0.1:{srv.port}/rest/count/pts"
+                   "?cql=INCLUDE&maxFeatures=1000")
+            req = urllib.request.Request(url)
+            with urllib.request.urlopen(req, timeout=10) as r:
+                body = json.loads(r.read().decode())
+                assert r.headers.get("X-GeoMesa-Complete") == "false"
+                assert "down" in r.headers.get(
+                    "X-GeoMesa-Missing-Groups", "")
+            assert body["complete"] is False
+            assert body["count"] == 100
+            assert body["missing_z_ranges"][0]["prefix_lo"] \
+                == _N_PREFIXES // 2
+        finally:
+            srv.stop()
+
+
+class TestCli:
+    def test_cluster_status_cli(self, capsys):
+        from geomesa_tpu.tools.cli import main as cli_main
+        from geomesa_tpu.web import GeoMesaWebServer
+        cluster, _, _ = make_cluster(2, names=["a", "b"])
+        srv = GeoMesaWebServer(cluster).start()
+        try:
+            rc = cli_main(["cluster", "status",
+                           "--path", f"remote://127.0.0.1:{srv.port}"])
+            assert rc in (0, None)
+            out = json.loads(capsys.readouterr().out)
+            assert out["role"] == "cluster"
+            assert out["n_groups"] == 2
+        finally:
+            srv.stop()
+            cluster.close()

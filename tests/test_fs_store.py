@@ -508,6 +508,53 @@ class TestFsBackedMesh:
         assert not (set(f"f{i}" for i in range(50))
                     & set(re.query("INCLUDE", "ais").ids.astype(str)))
 
+    def test_foreign_sidecar_refused_on_single_id_mismatch(self, tmp_path):
+        """Two same-count layouts identical except ONE id mid-column
+        must refuse each other's sidecars. Regression for the sampled
+        digest: a strided fingerprint agreed on every probed position,
+        adopted the foreign permutation, and served wrong rows — the
+        digest now covers the FULL id column."""
+        import os
+        import shutil
+
+        from geomesa_tpu.parallel import data_mesh
+        from geomesa_tpu.store import FsBackedDistributedDataStore
+        rng = np.random.default_rng(37)
+        n = 5_000
+        dtg = rng.integers(MS("2021-03-01"), MS("2021-03-20"), n)
+        x = rng.uniform(-180, 180, n)
+        y = rng.uniform(-90, 90, n)
+
+        def build(root, ids):
+            ds = FsBackedDistributedDataStore(root, data_mesh())
+            ds.create_schema(parse_spec(
+                "ais", "dtg:Date,*geom:Point:srid=4326"))
+            ds.write_dict("ais", ids, {"dtg": dtg, "geom": (x, y)})
+            ds.query("BBOX(geom, -90, -45, 90, 45)", "ais")  # build index
+            assert ds.persist_index("ais")
+            return ds
+
+        ids_a = [f"f{i}" for i in range(n)]
+        ids_b = list(ids_a)
+        ids_b[2471] = "f2471x"  # same count, one id, mid-column
+        root_a, root_b = str(tmp_path / "a"), str(tmp_path / "b")
+        a, b = build(root_a, ids_a), build(root_b, ids_b)
+        assert a._ids_digest("ais") != b._ids_digest("ais")
+        # positive control: B reopened on its OWN sidecar adopts it
+        own = FsBackedDistributedDataStore(root_b, data_mesh())
+        assert own._state("ais").zindex_warm is not None
+        # plant A's sidecar into B's tree: the reopen must refuse it
+        shutil.copy(
+            os.path.join(root_a, "ais", "index_mesh", "orders.npz"),
+            os.path.join(root_b, "ais", "index_mesh", "orders.npz"))
+        re = FsBackedDistributedDataStore(root_b, data_mesh())
+        assert re._state("ais").zindex_warm is None
+        # and it still serves id-exact results via the lazy rebuild
+        got = set(re.query("BBOX(geom, -90, -45, 90, 45)",
+                           "ais").ids.astype(str))
+        hit = (x >= -90) & (x <= 90) & (y >= -45) & (y <= 45)
+        assert got == {ids_b[i] for i in np.flatnonzero(hit)}
+
     def test_reopen_with_quoted_partition_names(self, tmp_path):
         """Partition names needing URL-quoting (spaces, colons) must
         survive the write -> reopen round trip (review regression:

@@ -147,6 +147,63 @@ class TestRest:
             assert e.code == 400
 
 
+class TestRemoteCountPushdown:
+    """Hinted/sampled counts must evaluate SERVER-side through
+    /rest/count: the response carries one number, never O(n) feature
+    rows shipped across just to be len()'d by the client."""
+
+    def test_hinted_count_server_side_and_bounded(self):
+        from geomesa_tpu.index.api import Query, QueryHints
+        from geomesa_tpu.store import RemoteDataStore
+        backing = seeded_store(n=500)
+        srv = GeoMesaWebServer(backing).start()
+        try:
+            ds = RemoteDataStore("127.0.0.1", srv.port)
+            sizes = []
+            orig = ds._do_request
+
+            def spy(method, path, params, body, idempotent):
+                ct, data = orig(method, path, params, body, idempotent)
+                sizes.append((path, len(data)))
+                return ct, data
+
+            ds._do_request = spy
+
+            def no_rows(*a, **kw):
+                raise AssertionError(
+                    "count pulled the full row surface client-side")
+
+            ds.query = no_rows
+            queries = [
+                Query("people", "age < 400"),
+                Query("people", "INCLUDE", max_features=123),
+                Query("people", "INCLUDE",
+                      hints={QueryHints.SAMPLING: 0.1}),
+                Query("people", "age >= 0",
+                      hints={QueryHints.SAMPLING: 0.2,
+                             QueryHints.SAMPLE_BY: "name"}),
+            ]
+            for q in queries:
+                assert ds.query_count(q) == backing.query_count(q), q
+            counts = [(p, s) for p, s in sizes if "/rest/count/" in p]
+            assert len(counts) == len(queries)
+            # hundreds of matching rows, yet every response is tiny
+            assert all(s < 256 for _, s in counts), counts
+        finally:
+            srv.stop()
+
+    def test_unmapped_hint_falls_back_to_query(self):
+        from geomesa_tpu.index.api import Query
+        from geomesa_tpu.store import RemoteDataStore
+        srv = GeoMesaWebServer(seeded_store(n=50)).start()
+        try:
+            ds = RemoteDataStore("127.0.0.1", srv.port)
+            q = Query("people", "age < 10", hints={"BIN_TRACK": "name"})
+            assert ds.query_count(q) == 10  # exact via the row surface
+        finally:
+            srv.stop()
+
+
 class TestWebAuthGate:
     """Opt-in shared bearer token on the mutating endpoints (POST
     /rest/write, POST /rest/delete, DELETE /rest/schemas): 403 without
