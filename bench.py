@@ -132,7 +132,7 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,6,7,8,9,10,11,northstar")
+                             "1,2,3,4,5,6,7,8,9,10,11,12,northstar")
               .split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
@@ -141,6 +141,16 @@ T0_DAY, T1_DAY = 17_000, 17_100
 
 def _p50(samples):
     return float(np.median(np.asarray(samples)))
+
+
+def _pcts(samples) -> dict:
+    """p50/p95/p99 of one latency-sample list — every latency-emitting
+    config reports the tail, not just the median (hot-tile serving is
+    a p99 story: one cold recompute in 100 requests IS the number)."""
+    a = np.asarray(samples, dtype=np.float64)
+    return {"p50": float(np.percentile(a, 50)),
+            "p95": float(np.percentile(a, 95)),
+            "p99": float(np.percentile(a, 99))}
 
 
 # host-contention gate: r5 numbers swung 2-3x when another process
@@ -317,8 +327,11 @@ def bench_config1(rng):
     bp50 = _pinned_median(cpu_pass)
     bidx = cpu_pass()
     ok = np.array_equal(np.sort(res.ids.astype(int)), bidx)
-    p50 = _p50(times)
+    pc = _pcts(times)
+    p50 = pc["p50"]
     return {"p50_ms": round(p50 * 1e3, 2),
+            "p95_ms": round(pc["p95"] * 1e3, 2),
+            "p99_ms": round(pc["p99"] * 1e3, 2),
             "cpu_p50_ms": round(bp50 * 1e3, 2),
             "vs_baseline": round(bp50 / p50, 2),
             "n": n, "hits": res.n, "ids_exact": bool(ok)}
@@ -404,7 +417,11 @@ def bench_config3(rng, x, y):
          for i in range(kb)])
     ok = (np.array_equal(counts[:kb], base_counts)
           and total == int(counts.sum()))
-    return {"p50_s": round(dev_s, 3), "first_s": round(first_s, 2),
+    _pc = _pcts(times)
+    return {"p50_s": round(dev_s, 3),
+            "p95_s": round(_pc["p95"], 3),
+            "p99_s": round(_pc["p99"], 3),
+            "first_s": round(first_s, 2),
             "kernel_s": round(kernel_s, 3),
             "pairs_per_s": round(n * k / dev_s, 1),
             "cpu_elapsed_s_extrapolated": round(cpu_s, 3),
@@ -471,7 +488,10 @@ def bench_config4(rng, x, y):
         oracle = cand[np.lexsort((cand, d2[cand]))][:kk]
         got = np.asarray(results[i][0], dtype=np.int64)
         ok = ok and np.array_equal(got, oracle)
+    _pc = _pcts(trials)
     return {"p50_ms": round(p50 * 1e3, 2),
+            "p95_ms": round(_pc["p95"] / nq * 1e3, 2),
+            "p99_ms": round(_pc["p99"] / nq * 1e3, 2),
             "batch_ms": round(batch_s * 1e3, 2),
             "single_query_ms": round(single_s * 1e3, 2),
             "cpu_ms": round(cpu_s * 1e3, 2),
@@ -546,9 +566,12 @@ def bench_config5(rng, ds, x, y, n_poly=10_000):
     store_agrees = all(
         ds.query_count(Query("ais", fast.Intersects("geom", polys[i])))
         == int(counts[i]) for i in range(min(4, n_poly)))
+    _pc = _pcts(warm)
     return {"elapsed_s": round(scan_s, 2),
             "first_s": round(first_s, 2),
             "p50_s": round(scan_s, 2),
+            "p95_s": round(_pc["p95"], 2),
+            "p99_s": round(_pc["p99"], 2),
             "polygons_per_s": round(n_poly / scan_s, 1),
             "cpu_elapsed_s_extrapolated": round(cpu_s, 2),
             "vs_baseline": round(cpu_s / scan_s, 2),
@@ -630,8 +653,10 @@ def bench_config6(rng, x, y, ms):
     q1 = mk_queries(1, seed=999)[0]
     solo = QueryBatcher(ds)
     solo.query(q1)
-    direct_p50 = _p50([_timed(lambda: ds.query(q1)) for _ in range(15)])
-    via_p50 = _p50([_timed(lambda: solo.query(q1)) for _ in range(15)])
+    direct_samples = [_timed(lambda: ds.query(q1)) for _ in range(15)]
+    via_samples = [_timed(lambda: solo.query(q1)) for _ in range(15)]
+    direct_pc, via_pc = _pcts(direct_samples), _pcts(via_samples)
+    direct_p50, via_p50 = direct_pc["p50"], via_pc["p50"]
 
     # a threaded burst through the real admission queue: occupancy,
     # coalesce ratio and plan-cache behavior as a server would see them
@@ -650,7 +675,9 @@ def bench_config6(rng, x, y, ms):
         "concurrency": levels,
         "speedup_at_32": levels["32"]["speedup"],
         "p50_direct_ms": round(direct_p50 * 1e3, 3),
+        "p99_direct_ms": round(direct_pc["p99"] * 1e3, 3),
         "p50_via_batcher_ms": round(via_p50 * 1e3, 3),
+        "p99_via_batcher_ms": round(via_pc["p99"] * 1e3, 3),
         "single_query_overhead_pct": round(
             (via_p50 / direct_p50 - 1.0) * 100, 1),
         "threaded_burst_qps": round(len(bqs) / burst_s, 1),
@@ -822,6 +849,7 @@ def bench_config8(rng):
         arr = np.asarray(times)
         return ids, {"qps": round(nq / arr.sum(), 1),
                      "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
+                     "p95_ms": round(float(np.percentile(arr, 95)) * 1e3, 2),
                      "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
                      "client_errors": errors}
 
@@ -980,15 +1008,22 @@ def bench_config9(rng):
                     for r in replicas:  # warm every replica's index
                         r.query_count("BBOX(geom, 0, 0, 5, 5)", "pts9")
                     c0 = metrics.snapshot()["counters"]
+                    lat = []
                     t0 = time.perf_counter()
                     for ecql in boxes(seed=90 + k):
+                        tq = time.perf_counter()
                         router.query_count(ecql, "pts9")
+                        lat.append(time.perf_counter() - tq)
                     wall = time.perf_counter() - t0
                     c1 = metrics.snapshot()["counters"]
                     on_replica = (c1.get("replication.reads.replica", 0)
                                   - c0.get("replication.reads.replica", 0))
+                    _pc = _pcts(lat)
                     out[f"replicas_{k}"] = {
                         "read_qps": round(nq / wall, 1),
+                        "p50_ms": round(_pc["p50"] * 1e3, 2),
+                        "p95_ms": round(_pc["p95"] * 1e3, 2),
+                        "p99_ms": round(_pc["p99"] * 1e3, 2),
                         "staleness_hit_rate": round(on_replica / nq, 3)}
                 finally:
                     # keep the primary: detach replicas only
@@ -1143,15 +1178,22 @@ def bench_config11(rng, n=None, nq=None):
         cluster.write("pts11", FeatureBatch.from_dict(sft, ids,
                                                       {"geom": (x, y)}))
         cluster.query_count("BBOX(geom, 0, 0, 5, 5)", "pts11")  # warm
+        lat = []
         t0 = time.perf_counter()
         for ecql in boxes(seed=110):
+            tq = time.perf_counter()
             cluster.query_count(ecql, "pts11")
+            lat.append(time.perf_counter() - tq)
         wall = time.perf_counter() - t0
         for ecql in boxes(seed=111, count=max(nq // 10, 5)):
             if cluster.query_count(ecql, "pts11") != \
                     oracle.query_count(ecql, "pts11"):
                 exact = False
-        out[f"groups_{k}"] = {"scatter_qps": round(nq / wall, 1)}
+        _pc = _pcts(lat)
+        out[f"groups_{k}"] = {"scatter_qps": round(nq / wall, 1),
+                              "p50_ms": round(_pc["p50"] * 1e3, 2),
+                              "p95_ms": round(_pc["p95"] * 1e3, 2),
+                              "p99_ms": round(_pc["p99"] * 1e3, 2)}
     out["counts_exact"] = exact
 
     # -- phase 2: chaos failover inside one shard group -------------------
@@ -1309,6 +1351,258 @@ def bench_config11(rng, n=None, nq=None):
         "partial_flagged_knob_on": partial,
         "completeness_fraction": round(got_rows / max(want_rows, 1), 3),
         "missing_z_ranges": missing_ranges}
+    return out
+
+
+# -- config 12: hot-tile serving via the materialized result cache --------
+
+def bench_config12(rng, n=None, concurrency=None, nq=None,
+                   repl_writes=None):
+    """What LSN-keyed memoization buys on a hot-tile workload.
+
+    Mixed hot/cold density-tile traffic at c=32 against one store —
+    a p99 story, not a p50 one (a dashboard feels the slowest tile).
+    Phases: (A) uncached (kill switch off: every request recomputes),
+    (B) cached warm, (C) single-flight — c identical cold requests must
+    collapse into ONE device compute, (D) cached under sustained writes
+    with the background refresher re-materializing hot tiles, (E) the
+    exactness gate — a cached tile must be byte-identical to a fresh
+    recompute at the same version, and (F) a replicated probe: cached
+    reads through the staleness-bounded router never observe state
+    older than ``geomesa.repl.max.lag.lsn``."""
+    import shutil
+    import tempfile
+    import threading
+
+    from geomesa_tpu.cache import CACHE_ENABLED, CacheRefresher
+    from geomesa_tpu.features import parse_spec
+    from geomesa_tpu.store import InMemoryDataStore
+
+    n = int(n if n is not None
+            else os.environ.get("GEOMESA_TPU_BENCH_CACHE_N", N_BIG))
+    c = int(concurrency if concurrency is not None else 32)
+    nq = int(nq if nq is not None else 12)   # requests per worker/phase
+    out = {"n": n, "concurrency": c}
+
+    sft = parse_spec("tiles12", "dtg:Date,*geom:Point:srid=4326")
+    ds = InMemoryDataStore()
+    ds.create_schema(sft)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    ms = rng.integers(T0_DAY * MS_DAY, T1_DAY * MS_DAY, n).astype(np.int64)
+    ds.write_dict("tiles12", np.arange(n).astype(str).astype(object),
+                  {"dtg": ms, "geom": (x, y)})
+    del x, y, ms
+
+    # the tile universe: 32 tiles of a 45-degree world grid; the first
+    # 4 are "hot" (~80% of traffic), the rest long-tail cold
+    W = H = 256
+    n_tiles, n_hot = 32, 4
+
+    def tile_bbox(i):
+        x0 = -180.0 + (i % 8) * 45.0
+        y0 = -90.0 + ((i // 8) % 4) * 45.0
+        return (x0, y0, x0 + 45.0, y0 + 45.0)
+
+    def serve(i):
+        return ds.density("tiles12", "INCLUDE", tile_bbox(int(i)), W, H)
+
+    def run_phase(seed):
+        """c workers x nq requests each, ~80% hot / 20% cold; every
+        worker's schedule is fixed up front so phases are comparable."""
+        prng = np.random.default_rng(seed)
+        sched = [np.where(prng.random(nq) < 0.8,
+                          prng.integers(0, n_hot, nq),
+                          prng.integers(n_hot, n_tiles, nq))
+                 for _ in range(c)]
+        lat = [[] for _ in range(c)]
+        hot = [[] for _ in range(c)]
+        barrier = threading.Barrier(c)
+
+        def worker(w):
+            barrier.wait()
+            for i in sched[w]:
+                t0 = time.perf_counter()
+                serve(i)
+                dt = time.perf_counter() - t0
+                lat[w].append(dt)
+                if i < n_hot:
+                    hot[w].append(dt)
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(c)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        alls = [v for ws in lat for v in ws]
+        hots = [v for ws in hot for v in ws] or alls
+        pc, hpc = _pcts(alls), _pcts(hots)
+        return {"requests": len(alls), "qps": round(len(alls) / wall, 1),
+                "p50_ms": round(pc["p50"] * 1e3, 2),
+                "p95_ms": round(pc["p95"] * 1e3, 2),
+                "p99_ms": round(pc["p99"] * 1e3, 2),
+                "hot_p99_ms": round(hpc["p99"] * 1e3, 2)}
+
+    # -- phase A: uncached (process-wide kill switch, all threads) --------
+    serve(0)  # index build + compile outside the timed window
+    CACHE_ENABLED.set("false")
+    try:
+        out["uncached"] = run_phase(7)
+    finally:
+        CACHE_ENABLED.set(None)
+
+    # -- phase B: cached warm ---------------------------------------------
+    for i in range(n_tiles):
+        serve(i)  # prewarm every tile at the current version
+    h0, m0 = ds.result_cache.hits, ds.result_cache.misses
+    out["cached"] = run_phase(8)
+    served = ds.result_cache.hits - h0
+    out["cached"]["hit_rate"] = round(
+        served / max(served + ds.result_cache.misses - m0, 1), 4)
+    out["hot_p99_speedup"] = round(
+        out["uncached"]["hot_p99_ms"]
+        / max(out["cached"]["hot_p99_ms"], 1e-6), 1)
+
+    # -- phase C: single-flight collapse ----------------------------------
+    cache = ds.result_cache
+    cache.invalidate()
+    m0, sf0 = cache.misses, cache.singleflight_waits
+    barrier = threading.Barrier(c)
+
+    def cold(_w):
+        barrier.wait()
+        serve(0)
+
+    threads = [threading.Thread(target=cold, args=(w,)) for w in range(c)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    computes = cache.misses - m0
+    out["singleflight"] = {
+        "concurrent_identical_requests": c,
+        "device_computes": int(computes),
+        "waits": int(cache.singleflight_waits - sf0),
+        "collapsed": bool(computes == 1)}
+
+    # -- phase D: cached under sustained writes + hot refresher -----------
+    stop_w = threading.Event()
+    wrote = [0]
+
+    def writer():
+        w_rng = np.random.default_rng(999)
+        while not stop_w.is_set():
+            k = 100
+            ids = np.array([f"w{wrote[0] + j}" for j in range(k)],
+                           dtype=object)
+            ds.write_dict("tiles12", ids, {
+                "dtg": w_rng.integers(T0_DAY * MS_DAY, T1_DAY * MS_DAY,
+                                      k).astype(np.int64),
+                "geom": (w_rng.uniform(-180, 180, k),
+                         w_rng.uniform(-90, 90, k))})
+            wrote[0] += k
+            stop_w.wait(0.02)
+
+    refresher = CacheRefresher(ds, interval_s=0.05, top_k=n_hot)
+    refresher.start()
+    wt = threading.Thread(target=writer)
+    wt.start()
+    try:
+        out["cached_under_writes"] = run_phase(9)
+    finally:
+        stop_w.set()
+        wt.join()
+        refresher.stop()
+    out["cached_under_writes"]["rows_written_during"] = wrote[0]
+    out["cached_under_writes"]["refresh_passes"] = refresher.runs
+
+    # -- phase E: exactness gate (cached == fresh recompute, same LSN) ----
+    exact = True
+    for i in range(n_hot + 2):
+        g_cached = np.asarray(serve(i), np.float32)
+        CACHE_ENABLED.thread_local_set("false")
+        try:
+            g_fresh = np.asarray(serve(i), np.float32)
+        finally:
+            CACHE_ENABLED.thread_local_set(None)
+        exact = exact and g_cached.tobytes() == g_fresh.tobytes()
+    out["exact_at_lsn"] = bool(exact)
+    del ds
+
+    # -- phase F: replicated bounded-staleness probe ----------------------
+    # One feature per write => the primary's WAL LSN maps 1:1 onto the
+    # density grid's mass: a tile whose sum implies fewer rows than
+    # (primary LSN at request time - max_lag_lsn) is a staleness
+    # violation. Cached replica tiles are stamped with the replica's
+    # own applied version, so they can never be staler than the
+    # replica itself — the router's eligibility bound is the contract.
+    from geomesa_tpu.replication import (Replica, ReplicatedDataStore,
+                                         WalShipper)
+    lag_bound = 50
+    writes = int(repl_writes if repl_writes is not None else 150)
+    root = tempfile.mkdtemp(prefix="geomesa-bench12-")
+    violations = reads = 0
+    try:
+        prim = InMemoryDataStore(durable_dir=os.path.join(root, "p"),
+                                 wal_fsync="never")
+        prim.create_schema(parse_spec("pts12", "*geom:Point:srid=4326"))
+        base = 64
+        prim.write_dict("pts12",
+                        np.arange(base).astype(str).astype(object),
+                        {"geom": (np.full(base, 0.5),
+                                  np.full(base, 0.5))})
+        base_lsn = prim.journal.wal.last_lsn
+        ship = WalShipper(prim.journal)
+        replica = Replica(ship.host, ship.port, name="r0")
+        router = ReplicatedDataStore(prim, [replica], ack_replicas=0,
+                                     max_lag_lsn=lag_bound,
+                                     max_lag_s=600)
+        try:
+            deadline = time.perf_counter() + 30
+            while (replica.applied_lsn < base_lsn
+                   and time.perf_counter() < deadline):
+                time.sleep(0.02)
+            bb = (0.0, 0.0, 1.0, 1.0)
+            stop = threading.Event()
+
+            def repl_writer():
+                j = 0
+                while not stop.is_set() and j < writes:
+                    prim.write_dict("pts12", np.array([f"x{j}"],
+                                                      dtype=object),
+                                    {"geom": (np.full(1, 0.5),
+                                              np.full(1, 0.5))})
+                    j += 1
+                    stop.wait(0.002)
+
+            rw = threading.Thread(target=repl_writer)
+            rw.start()
+            try:
+                while rw.is_alive() or reads < 20:
+                    lsn_pre = prim.journal.wal.last_lsn
+                    grid = router.density("pts12", "INCLUDE", bb, 8, 8)
+                    implied_lsn = (base_lsn
+                                   + int(round(float(np.sum(grid))))
+                                   - base)
+                    reads += 1
+                    if implied_lsn < lsn_pre - lag_bound:
+                        violations += 1
+                    if reads > writes * 4:
+                        break
+            finally:
+                stop.set()
+                rw.join()
+        finally:
+            router.close()
+            ship.stop()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    out["replicated"] = {"reads": reads,
+                         "staleness_bound_lsn": lag_bound,
+                         "violations": int(violations)}
     return out
 
 
@@ -1486,8 +1780,11 @@ def bench_northstar(ds, write_s, x, y, ms):
     bidx = cpu_pass()
     cpu_s = _p50([_timed(cpu_pass) for _ in range(3)])
     ok = np.array_equal(np.sort(res.ids.astype(np.int64)), bidx)
-    p50 = _p50(times)
+    _pc = _pcts(times)
+    p50 = _pc["p50"]
     return {"p50_ms": round(p50 * 1e3, 2),
+            "p95_ms": round(_pc["p95"] * 1e3, 2),
+            "p99_ms": round(_pc["p99"] * 1e3, 2),
             "cpu_p50_ms": round(cpu_s * 1e3, 2),
             "vs_baseline": round(cpu_s / p50, 2),
             "first_query_s": round(first_s, 2),
@@ -1570,6 +1867,9 @@ def main(argv=None):
     if "11" in CONFIGS:
         out["configs"]["11_cluster"] = bench_config11(rng)
 
+    if "12" in CONFIGS:
+        out["configs"]["12_hot_tiles"] = bench_config12(rng)
+
     big_ds = None
     if CONFIGS & {"5", "northstar"}:
         big_ds, write_s = _build_big_store(bx, by, bms)
@@ -1578,6 +1878,7 @@ def main(argv=None):
         ns = bench_northstar(big_ds, write_s, bx, by, bms)
         out["configs"]["northstar_100m_bbox_time"] = ns
         out["p50_ms_100m"] = ns["p50_ms"]
+        out["p99_ms_100m"] = ns["p99_ms"]
 
     if "5" in CONFIGS:
         out["configs"]["5_contains_100m_x_10k"] = bench_config5(

@@ -1,0 +1,271 @@
+"""The materialized result cache: version-stamped entries, LRU byte
+budget, single-flight coalescing.
+
+An entry is ``(type_name, plan key) -> (version, payload)`` where
+``version`` is the store's pushdown version for the type — the WAL LSN
+on durable stores, a store-local mutation counter otherwise. The
+version is read BEFORE compute: a write landing mid-compute stamps the
+result with the older version, leaving it unreachable at the new one (a
+wasted recompute, never a stale serve).
+
+Single-flight: concurrent misses on one ``(type, key, version)`` elect
+a leader that computes once; followers park on an event and decode the
+leader's payload — they never touch the store's op lock, so a
+thundering herd of identical cold tiles costs exactly one device
+dispatch and zero lock convoys.
+
+Payloads are stored in an immutable-safe form (the caller's ``encode``)
+and every hit decodes a private copy, so a consumer mutating its result
+(the cluster's in-place ``Stat.merge``, a caller scribbling on a grid)
+can never corrupt the cached original.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ..metrics import metrics
+from ..utils.properties import SystemProperty
+
+__all__ = ["ResultCache", "CACHE_ENABLED", "CACHE_MAX_BYTES"]
+
+# kill switch for the materialized pushdown cache (off: every request
+# recomputes, the pre-cache behavior)
+CACHE_ENABLED = SystemProperty("geomesa.cache.enabled", "true")
+# LRU byte budget across one store's cached payloads
+CACHE_MAX_BYTES = SystemProperty("geomesa.cache.max.bytes",
+                                 str(256 * 1024 * 1024))
+
+# a wedged leader must not park followers forever; past this they
+# recompute for themselves
+_FLIGHT_WAIT_S = 600.0
+
+
+def _nbytes(stored) -> int:
+    if stored is None:
+        return 0
+    if isinstance(stored, (bytes, bytearray)):
+        return len(stored)
+    nb = getattr(stored, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    import sys
+    return sys.getsizeof(stored)
+
+
+class _Entry:
+    __slots__ = ("version", "stored", "nbytes", "hits", "compute", "encode")
+
+    def __init__(self, version, stored, nbytes, compute, encode):
+        self.version = version
+        self.stored = stored
+        self.nbytes = nbytes
+        self.hits = 0
+        self.compute = compute
+        self.encode = encode
+
+
+class _Flight:
+    __slots__ = ("event", "stored", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.stored = None
+        self.error = None
+
+
+class ResultCache:
+    """Per-store cache; ``version_fn(type_name)`` is the store's
+    pushdown-version accessor (the LSN face of invalidation)."""
+
+    def __init__(self, version_fn, registry=metrics):
+        self._version_fn = version_fn
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, _Entry] = OrderedDict()
+        self._inflight: dict[tuple, _Flight] = {}
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.singleflight_waits = 0
+        self.refreshes = 0
+        self.invalidations = 0
+
+    @staticmethod
+    def enabled() -> bool:
+        return bool(CACHE_ENABLED.as_bool())
+
+    @staticmethod
+    def max_bytes() -> int:
+        return int(CACHE_MAX_BYTES.as_int() or 0)
+
+    # -- the serving path --------------------------------------------------
+
+    def get_or_compute(self, type_name: str, key: str, compute,
+                       encode=None, decode=None):
+        """Serve ``(type_name, key)`` at the type's current version:
+        a memoized payload when the version is unchanged, one
+        single-flighted ``compute()`` otherwise."""
+        if not self.enabled():
+            return compute()
+        version = self._version_fn(type_name)
+        k = (type_name, key)
+        fk = (type_name, key, version)
+        with self._lock:
+            e = self._entries.get(k)
+            if e is not None and e.version == version:
+                e.hits += 1
+                self.hits += 1
+                self._entries.move_to_end(k)
+                stored = e.stored
+                leader = None
+            else:
+                fl = self._inflight.get(fk)
+                if fl is None:
+                    fl = self._inflight[fk] = _Flight()
+                    leader = True
+                else:
+                    leader = False
+                    self.singleflight_waits += 1
+        if leader is None:
+            self._registry.counter("cache.hits")
+            return decode(stored) if decode is not None else stored
+        if leader is False:
+            # follower: park on the leader's flight, decode a private
+            # copy of its payload — no store lock, no device dispatch
+            self._registry.counter("cache.singleflight.waits")
+            fl.event.wait(_FLIGHT_WAIT_S)
+            if fl.error is not None or not fl.event.is_set() \
+                    or fl.stored is None:
+                return compute()
+            return decode(fl.stored) if decode is not None else fl.stored
+        # leader: compute (the store's own synchronization applies),
+        # publish to followers, install the entry
+        self._registry.counter("cache.misses")
+        with self._lock:
+            self.misses += 1
+        try:
+            value = compute()
+        except BaseException as ex:
+            fl.error = ex
+            fl.event.set()
+            with self._lock:
+                self._inflight.pop(fk, None)
+            raise
+        stored = None
+        try:
+            stored = encode(value) if encode is not None else value
+        except Exception:
+            # unencodable payload: serve it, just don't memoize
+            self._registry.counter("cache.encode_errors")
+        if stored is not None:
+            self._install(k, version, stored, compute, encode)
+        fl.stored = stored
+        fl.event.set()
+        with self._lock:
+            self._inflight.pop(fk, None)
+        return value
+
+    def _install(self, k, version, stored, compute, encode):
+        nbytes = _nbytes(stored)
+        budget = self.max_bytes()
+        with self._lock:
+            old = self._entries.pop(k, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            if budget and nbytes > budget:
+                # a single payload larger than the whole budget would
+                # evict everything and still not fit
+                self._gauges_locked()
+                return
+            e = _Entry(version, stored, nbytes, compute, encode)
+            if old is not None:
+                e.hits = old.hits  # heat survives version bumps
+            self._entries[k] = e
+            self._bytes += nbytes
+            while budget and self._bytes > budget and self._entries:
+                _, ev = self._entries.popitem(last=False)
+                self._bytes -= ev.nbytes
+                self.evictions += 1
+                self._registry.counter("cache.evictions")
+            self._gauges_locked()
+
+    def _gauges_locked(self):
+        self._registry.gauge("cache.bytes", self._bytes)
+        self._registry.gauge("cache.entries", len(self._entries))
+
+    # -- maintenance -------------------------------------------------------
+
+    def invalidate(self, type_name: str | None = None) -> int:
+        """Drop entries (one type or all); returns the dropped count.
+        Version bumps already make stale entries unreachable — this is
+        the explicit memory-reclaim / operator face."""
+        with self._lock:
+            if type_name is None:
+                n = len(self._entries)
+                self._entries.clear()
+                self._bytes = 0
+            else:
+                keys = [k for k in self._entries if k[0] == type_name]
+                n = len(keys)
+                for k in keys:
+                    self._bytes -= self._entries.pop(k).nbytes
+            self.invalidations += n
+            self._gauges_locked()
+        if n:
+            self._registry.counter("cache.invalidated_entries", n)
+        return n
+
+    def refresh_hot(self, top_k: int = 8) -> int:
+        """Re-materialize the hottest stale entries at their type's
+        current version (the background refresher's unit). Returns the
+        number refreshed."""
+        if not self.enabled():
+            return 0
+        with self._lock:
+            hottest = sorted(self._entries.items(),
+                             key=lambda kv: kv[1].hits,
+                             reverse=True)[:max(int(top_k), 0)]
+        n = 0
+        for (tn, key), e in hottest:
+            version = self._version_fn(tn)
+            if e.version == version:
+                continue
+            try:
+                value = e.compute()
+                stored = (e.encode(value) if e.encode is not None
+                          else value)
+            except KeyError:
+                # schema dropped under us: reclaim its entries
+                self.invalidate(tn)
+                continue
+            except Exception:
+                self._registry.counter("cache.refresh.errors")
+                continue
+            self._install((tn, key), version, stored, e.compute, e.encode)
+            with self._lock:
+                self.refreshes += 1
+            self._registry.counter("cache.refreshes")
+            n += 1
+        return n
+
+    # -- observability -----------------------------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            per_type: dict[str, int] = {}
+            for (tn, _), _e in self._entries.items():
+                per_type[tn] = per_type.get(tn, 0) + 1
+            return {"enabled": self.enabled(),
+                    "entries": len(self._entries),
+                    "bytes": self._bytes,
+                    "max_bytes": self.max_bytes(),
+                    "hits": self.hits,
+                    "misses": self.misses,
+                    "evictions": self.evictions,
+                    "singleflight_waits": self.singleflight_waits,
+                    "refreshes": self.refreshes,
+                    "invalidations": self.invalidations,
+                    "types": per_type}

@@ -672,6 +672,34 @@ class ClusterDataStore(DataStore):
                 "groups": groups,
                 "leg_latency": self._breakers.latencies()}
 
+    def cache_status(self) -> dict:
+        """Per-leg materialized-cache view: each shard group's cache is
+        keyed by that group's own LSN, so a write routed to one shard
+        only invalidates that leg's tiles."""
+        groups: dict[str, dict] = {}
+        for name, g in zip(self._names, self._groups):
+            cs = getattr(g, "cache_status", None)
+            if not callable(cs):
+                continue
+            try:
+                groups[name] = cs()
+            except Exception as e:  # noqa: BLE001 — status, not control
+                groups[name] = {"error": f"{type(e).__name__}: {e}"}
+        return {"role": "cluster", "lsn_vector": self.lsn_vector(),
+                "groups": groups}
+
+    def invalidate_cache(self, type_name: str | None = None) -> int:
+        n = 0
+        for g in self._groups:
+            inv = getattr(g, "invalidate_cache", None)
+            if not callable(inv):
+                continue
+            try:
+                n += int(inv(type_name))
+            except Exception:  # noqa: BLE001 — best-effort fan-out
+                pass
+        return n
+
     def promote_group(self, name: str | None = None) -> dict:
         """Manually promote inside one shard group (the group must be
         replicated, or a remote fronting a replicated store)."""

@@ -329,3 +329,22 @@ class Replica(DataStore):
     def arrow_ipc(self, type_name: str, ecql="INCLUDE",
                   sort_by: str | None = None) -> bytes:
         return self._store.arrow_ipc(type_name, ecql, sort_by=sort_by)
+
+    # the materialized pushdown cache lives in the inner store; replicas
+    # expose its version/status faces so cached tiles served here carry
+    # the replica's own apply progress (bounded-staleness contract:
+    # entries can never be older than the replica's applied state)
+    @property
+    def result_cache(self):
+        return self._store.result_cache
+
+    def pushdown_version(self, type_name: str) -> int:
+        return self._store.pushdown_version(type_name)
+
+    def cache_status(self) -> dict:
+        out = self._store.cache_status()
+        out["applied_lsn"] = self.applied_lsn
+        return out
+
+    def invalidate_cache(self, type_name: str | None = None) -> int:
+        return self._store.invalidate_cache(type_name)

@@ -335,6 +335,42 @@ class ReplicatedDataStore(DataStore):
         return self._read("arrow_ipc", type_name, ecql, sort_by=sort_by,
                           max_lag_lsn=max_lag_lsn, max_lag_s=max_lag_s)
 
+    # -- materialized-cache faces (aggregate view over the group) ------------
+    # No ``pushdown_version`` here on purpose: reads fan out to whichever
+    # member satisfies the lag bound, so there is no single exact version
+    # to stamp an ETag with — that face stays on the members.
+
+    def cache_status(self) -> dict:
+        members: dict[str, dict] = {}
+        cs = getattr(self.primary, "cache_status", None)
+        if callable(cs):
+            try:
+                members["primary"] = cs()
+            except Exception as ex:  # remote primary may be down
+                members["primary"] = {"error": str(ex)}
+        for r in self._replicas:
+            try:
+                members[r.name] = r.cache_status()
+            except Exception as ex:
+                members[r.name] = {"error": str(ex)}
+        return {"role": "replicated", "max_lag_lsn": self.max_lag_lsn,
+                "members": members}
+
+    def invalidate_cache(self, type_name: str | None = None) -> int:
+        n = 0
+        inv = getattr(self.primary, "invalidate_cache", None)
+        if callable(inv):
+            try:
+                n += int(inv(type_name))
+            except Exception:
+                pass
+        for r in self._replicas:
+            try:
+                n += int(r.invalidate_cache(type_name))
+            except Exception:
+                pass
+        return n
+
     def get_schema(self, type_name: str):
         try:
             return self.primary.get_schema(type_name)

@@ -609,6 +609,13 @@ def _synchronized(fn):
     return wrapper
 
 
+def _grid_copy(grid: np.ndarray) -> np.ndarray:
+    """Cache encode/decode for density grids: every hit hands out a
+    private copy, so a caller scribbling on its grid (or a cluster leg
+    accumulating in place) cannot corrupt the memoized original."""
+    return np.asarray(grid).copy()
+
+
 class InMemoryDataStore(DataStore):
     """A GeoTools-DataStore-shaped API over device-resident batches."""
 
@@ -618,6 +625,16 @@ class InMemoryDataStore(DataStore):
         self._types: dict[str, _TypeState] = {}
         self.stats = DataStoreStats()
         self.audit = audit  # AuditLogger or None
+        # LSN-keyed materialized pushdown cache (cache/ subsystem):
+        # every mutation stamps the type's version — the WAL LSN when
+        # durable, a store-local counter otherwise — so density/stats/
+        # bin/arrow results memoize until the type actually changes.
+        # Created before the journal: recovery replays mutations
+        # through write()/delete(), which stamp versions.
+        from ..cache import ResultCache
+        self._pushdown_clock = 0
+        self._pushdown_versions: dict[str, int] = {}
+        self.result_cache = ResultCache(self.pushdown_version)
         # opt-in durability: journal mutations to a WAL under
         # durable_dir (validate -> journal -> apply) and replay the
         # last checkpoint + log tail on open (wal/ subsystem)
@@ -639,6 +656,7 @@ class InMemoryDataStore(DataStore):
         if self.journal is not None:
             self.journal.log_create_schema(sft)
         self._types[sft.type_name] = self._new_state(sft)
+        self._bump_pushdown_version(sft.type_name)
 
     def _new_state(self, sft: SimpleFeatureType) -> _TypeState:
         return _TypeState(sft)
@@ -658,11 +676,41 @@ class InMemoryDataStore(DataStore):
             # outstanding small lazy results must not pin the dropped
             # column snapshot
             st._detach_live()
+        self._bump_pushdown_version(type_name)
+        self.result_cache.invalidate(type_name)
 
     def _state(self, type_name: str) -> _TypeState:
         if type_name not in self._types:
             raise KeyError(f"no such schema: {type_name}")
         return self._types[type_name]
+
+    # -- pushdown versions (cache/ subsystem) ------------------------------
+
+    def _bump_pushdown_version(self, type_name: str):
+        """Stamp the type's version after a mutation: the WAL LSN when
+        the journal advanced, a store-local counter otherwise (replay
+        suppresses journaling, so the counter also covers recovery)."""
+        prev = self._pushdown_versions.get(type_name, 0)
+        v = self.journal.wal.last_lsn if self.journal is not None else 0
+        if v <= prev:
+            self._pushdown_clock += 1
+            v = max(prev + 1, self._pushdown_clock)
+        self._pushdown_versions[type_name] = v
+
+    def pushdown_version(self, type_name: str) -> int:
+        """Cache/ETag version for the type: any change to its rows or
+        schema advances it; unchanged version == identical pushdown
+        results. Per-type, so writes to one type never invalidate
+        another's cached tiles."""
+        return self._pushdown_versions.get(type_name, 0)
+
+    def cache_status(self) -> dict:
+        out = self.result_cache.status()
+        out["versions"] = dict(self._pushdown_versions)
+        return out
+
+    def invalidate_cache(self, type_name: str | None = None) -> int:
+        return self.result_cache.invalidate(type_name)
 
     # -- writes ------------------------------------------------------------
 
@@ -684,6 +732,7 @@ class InMemoryDataStore(DataStore):
             self.journal.log_write(type_name, batch, visibilities)
         was_empty = st.n == 0
         st.append(batch, visibilities)
+        self._bump_pushdown_version(type_name)
         # auto-maintained stats, the write-side StatsCombiner analog
         # (accumulo/data/stats/StatsCombiner.scala)
         self.stats.observe(st.sft, batch)
@@ -729,6 +778,7 @@ class InMemoryDataStore(DataStore):
         if self.journal is not None:
             self.journal.log_delete(type_name, sorted(ids))
         st.delete(ids)
+        self._bump_pushdown_version(type_name)
 
     # -- durability (wal/ subsystem, opt-in via durable_dir) ---------------
 
@@ -798,11 +848,68 @@ class InMemoryDataStore(DataStore):
             self.stats.observe(st.sft, st.batch)
         return self.stats.get(type_name)
 
-    @_synchronized
+    # -- materialized pushdowns (cache/ subsystem) -------------------------
+    #
+    # The public pushdowns are caching wrappers: canonical plan key +
+    # per-type version lookup and single-flight coalescing run OUTSIDE
+    # _op_lock, so repeated identical tiles cost a dict lookup and a
+    # thundering herd of cold ones costs one device dispatch with zero
+    # lock convoys. The _*_uncached bodies hold the synchronized
+    # compute; store subclasses override those, keeping the cache on
+    # every flavor.
+
     def density(self, type_name: str, ecql, bbox, width: int, height: int,
                 weight_attr: str | None = None) -> np.ndarray:
         """Density surface (DensityScan pushdown analog): heatmap grid of
         matching features over bbox at width x height pixels."""
+        from ..cache import density_key
+        flt, key = density_key(ecql, bbox, width, height, weight_attr)
+        return self.result_cache.get_or_compute(
+            type_name, key,
+            lambda: self._density_uncached(type_name, flt, bbox, width,
+                                           height, weight_attr),
+            encode=_grid_copy, decode=_grid_copy)
+
+    def bin_query(self, type_name: str, ecql, track: str | None = None,
+                  label: str | None = None, sort: bool = False) -> bytes:
+        """BIN-format results (BinAggregatingScan analog): compact
+        16/24-byte records for matching features."""
+        from ..cache import bin_key
+        flt, key = bin_key(ecql, track, label, sort)
+        return self.result_cache.get_or_compute(
+            type_name, key,
+            lambda: self._bin_query_uncached(type_name, flt, track=track,
+                                             label=label, sort=sort))
+
+    def arrow_ipc(self, type_name: str, ecql="INCLUDE",
+                  sort_by: str | None = None) -> bytes:
+        """Arrow IPC stream of matching features, readable by
+        FeatureArrowFileReader (the ARROW_ENCODE hint surface)."""
+        from ..cache import arrow_key
+        flt, key = arrow_key(ecql, sort_by)
+        return self.result_cache.get_or_compute(
+            type_name, key,
+            lambda: self._arrow_ipc_uncached(type_name, flt,
+                                             sort_by=sort_by))
+
+    def stats_query(self, type_name: str, stat_spec: str,
+                    ecql: str | ast.Filter = None):
+        """Run a stat sketch over query results (StatsScan analog):
+        returns the observed Stat. Cached in serialized-sketch form
+        (stats/serialize.py) so every caller gets a private copy —
+        the cluster's in-place merge cannot corrupt the original."""
+        from ..cache import stats_key
+        from ..stats.serialize import deserialize_stat, serialize_stat
+        flt, key = stats_key(ecql, stat_spec)
+        return self.result_cache.get_or_compute(
+            type_name, key,
+            lambda: self._stats_query_uncached(type_name, stat_spec, flt),
+            encode=serialize_stat, decode=deserialize_stat)
+
+    @_synchronized
+    def _density_uncached(self, type_name: str, ecql, bbox, width: int,
+                          height: int,
+                          weight_attr: str | None = None) -> np.ndarray:
         from ..scan.aggregations import density_grid
         st = self._state(type_name)
         if st.batch is None or st.n == 0:
@@ -823,10 +930,10 @@ class InMemoryDataStore(DataStore):
         return density_grid(x, y, mask, bbox, width, height, w)
 
     @_synchronized
-    def bin_query(self, type_name: str, ecql, track: str | None = None,
-                  label: str | None = None, sort: bool = False) -> bytes:
-        """BIN-format results (BinAggregatingScan analog): compact
-        16/24-byte records for matching features."""
+    def _bin_query_uncached(self, type_name: str, ecql,
+                            track: str | None = None,
+                            label: str | None = None,
+                            sort: bool = False) -> bytes:
         from ..scan.aggregations import encode_bin_records
         st = self._state(type_name)
         res = self.query(Query(type_name, ecql))
@@ -860,20 +967,17 @@ class InMemoryDataStore(DataStore):
         return res.batch.to_arrow()
 
     @_synchronized
-    def arrow_ipc(self, type_name: str, ecql="INCLUDE",
-                  sort_by: str | None = None) -> bytes:
-        """Arrow IPC stream of matching features, readable by
-        FeatureArrowFileReader (the ARROW_ENCODE hint surface). The
-        distributed store overrides this with the shard-local
-        dictionary-delta merge."""
+    def _arrow_ipc_uncached(self, type_name: str, ecql="INCLUDE",
+                            sort_by: str | None = None) -> bytes:
+        # the distributed store overrides this with the shard-local
+        # dictionary-delta merge
         from ..arrow.scan import ArrowScan
         return ArrowScan(self).execute(type_name, ecql, sort_by=sort_by)
 
     @_synchronized
-    def stats_query(self, type_name: str, stat_spec: str,
-                    ecql: str | ast.Filter = None):
-        """Run a stat sketch over query results (StatsScan analog,
-        index/iterators/StatsScan.scala): returns the observed Stat."""
+    def _stats_query_uncached(self, type_name: str, stat_spec: str,
+                              ecql: str | ast.Filter = None):
+        # StatsScan analog (index/iterators/StatsScan.scala)
         st = self._state(type_name)
         stat = parse_stat(stat_spec)
         if st.batch is None or st.n == 0:

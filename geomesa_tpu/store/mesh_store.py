@@ -254,21 +254,23 @@ class DistributedDataStore(InMemoryDataStore):
                               n)
         return n
 
-    def density(self, type_name: str, ecql, bbox, width: int, height: int,
-                weight_attr: str | None = None) -> np.ndarray:
+    def _density_uncached(self, type_name: str, ecql, bbox, width: int,
+                          height: int,
+                          weight_attr: str | None = None) -> np.ndarray:
         """Heatmap grid: shard-local scatter-add psum-merged over ICI
         (DensityScan -> client-reduce shape) for psum-eligible plans;
-        the shared host-binned path otherwise."""
+        the shared host-binned path otherwise. (The public ``density``
+        wrapper in the base class adds the materialized-result cache.)"""
         st = self._state(type_name)
         if st.n == 0 or weight_attr is not None:
-            return super().density(type_name, ecql, bbox, width, height,
-                                   weight_attr)
+            return super()._density_uncached(type_name, ecql, bbox, width,
+                                             height, weight_attr)
         st.ensure_index()
         q = Query(type_name, ecql)
         plan = self._psum_plan(st, q) if st.segments else None
         if plan is None:
-            return super().density(type_name, ecql, bbox, width, height,
-                                   weight_attr)
+            return super()._density_uncached(type_name, ecql, bbox, width,
+                                             height, weight_attr)
         _, boxes, intervals = plan
         sq = zscan.make_query(boxes, intervals)
         grid = np.zeros((height, width), dtype=np.float32)
@@ -301,8 +303,8 @@ class DistributedDataStore(InMemoryDataStore):
                                      jax.device_put(jnp.asarray(m), sh),
                                      self.mesh, nbins, lo, hi)
 
-    def arrow_ipc(self, type_name: str, ecql="INCLUDE",
-                  sort_by: str | None = None) -> bytes:
+    def _arrow_ipc_uncached(self, type_name: str, ecql="INCLUDE",
+                            sort_by: str | None = None) -> bytes:
         """Distributed Arrow output (DeltaWriter.scala:47,203 shape):
         the row-selection pipeline runs once, matched rows split along
         the mesh's shard boundaries, every shard encodes ITS rows as an

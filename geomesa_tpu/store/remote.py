@@ -365,3 +365,15 @@ class RemoteDataStore(DataStore):
         force intra-group failover on a cluster coordinator server."""
         params = {"group": group} if group else None
         return self._json("POST", "/rest/cluster/promote", params)
+
+    def cache_status(self) -> dict:
+        """GET /rest/cache: the server store's materialized-cache
+        status (entries, bytes, hit/miss counters, refresher state)."""
+        return self._json("GET", "/rest/cache")
+
+    def invalidate_cache(self, type_name: str | None = None) -> int:
+        """POST /rest/cache/invalidate[?type=NAME] (bearer-gated);
+        returns the number of entries dropped server-side."""
+        params = {"type": type_name} if type_name else None
+        out = self._json("POST", "/rest/cache/invalidate", params)
+        return int(out.get("invalidated", 0))

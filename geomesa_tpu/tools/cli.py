@@ -450,6 +450,46 @@ def cmd_cluster(args) -> int:
     return 2
 
 
+def cmd_cache(args) -> int:
+    """Materialized-cache administration against a serving node:
+    ``status`` dumps the store's cache/version state (entries, bytes,
+    hit/miss counters, refresher); ``invalidate`` drops entries (one
+    --type or all; bearer-gated on remote nodes)."""
+    path = args.path
+    if not path.startswith("remote://"):
+        print("cache commands need --path remote://host:port",
+              file=sys.stderr)
+        return 2
+    from ..store import RemoteDataStore
+    host, _, port = path[len("remote://"):].partition(":")
+    ds = RemoteDataStore(host or "127.0.0.1", int(port) if port else 8080,
+                         auth_token=getattr(args, "token", None))
+    if args.cache_command == "status":
+        json.dump(ds.cache_status(), sys.stdout, indent=2)
+        print()
+        return 0
+    if args.cache_command == "invalidate":
+        from ..store.remote import RemoteError
+        tn = getattr(args, "type", None)
+        try:
+            n = ds.invalidate_cache(tn)
+        except KeyError as e:
+            print(f"invalidate refused: {e.args[0]}", file=sys.stderr)
+            return 2
+        except RemoteError as e:
+            if e.status == 403:
+                print("invalidate is gated: pass --token matching "
+                      "geomesa.web.auth.token", file=sys.stderr)
+                return 3
+            raise
+        json.dump({"invalidated": n, "type": tn}, sys.stdout, indent=2)
+        print()
+        return 0
+    print(f"unknown cache command {args.cache_command!r}",
+          file=sys.stderr)
+    return 2
+
+
 def cmd_version(args) -> int:
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -581,6 +621,25 @@ def main(argv=None) -> int:
             cp.add_argument("--group", default=None,
                             help="shard group name to promote inside")
         cp.set_defaults(fn=cmd_cluster)
+
+    cap = sub.add_parser("cache",
+                         help="materialized pushdown-cache "
+                              "administration")
+    casub = cap.add_subparsers(dest="cache_command", required=True)
+    for aname, ahelp in (("status", "cache entries/bytes/counters and "
+                                    "pushdown versions"),
+                         ("invalidate", "drop cached entries "
+                                        "(token-gated)")):
+        ap = casub.add_parser(aname, help=ahelp)
+        ap.add_argument("--path", required=True,
+                        help="serving node, remote://host:port")
+        ap.add_argument("--token", default=None,
+                        help="admin bearer token "
+                             "(geomesa.web.auth.token)")
+        if aname == "invalidate":
+            ap.add_argument("--type", default=None,
+                            help="schema to invalidate (default: all)")
+        ap.set_defaults(fn=cmd_cache)
 
     add("version", cmd_version, needs_store=False)
     add("env", cmd_env, needs_store=False)

@@ -16,6 +16,10 @@ stdlib http server — no framework dependency:
     GET  /rest/knn/{type}?x=&y=&k=          -> {"ids": [...], "distances": [...]}
     GET  /rest/stats/{type}?stat=MinMax(attr)&cql=
     GET  /rest/density/{type}?bbox=x0,y0,x1,y1&width=&height=&cql=
+    GET  /rest/bin/{type}?cql=&track=&label=&sort=   -> BIN bytes
+    GET  /rest/metrics                      -> metrics registry snapshot
+    GET  /rest/cache                        -> materialized-cache status
+    POST /rest/cache/invalidate?type=       (bearer-gated)
     GET  /rest/sql?q=SELECT...  (or POST /rest/sql, body = statement)
     GET  /rest/audit?type=&since=
     GET  /rest/wal                          -> journal/WAL stats
@@ -29,6 +33,13 @@ stdlib http server — no framework dependency:
 
 Queries run the normal planner/scan path; arrow responses stream IPC
 bytes (content-type application/vnd.apache.arrow.file).
+
+Conditional requests: GET /rest/density, /rest/stats and /rest/bin
+carry an ETag derived from (canonical plan key, pushdown version) when
+the store exposes an exact version (``pushdown_version``); a matching
+If-None-Match gets 304 with no body. Stores without a single exact
+version (the replication router, the cluster coordinator) send no ETag
+— a 304 there could lie when differently-lagged members answer.
 
 Fault surface (resilience layer):
 
@@ -71,7 +82,7 @@ WEB_AUTH_TOKEN = SystemProperty("geomesa.web.auth.token", None)
 # GET /rest/wal stays open (read-only stats)
 _GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas"),
           ("POST", "wal"), ("POST", "replication"), ("POST", "integrity"),
-          ("POST", "cluster")}
+          ("POST", "cluster"), ("POST", "cache")}
 
 # load-shedding gate: max concurrent in-flight requests (unset ->
 # unlimited). Requests over the cap get 503 + Retry-After BEFORE any
@@ -107,6 +118,15 @@ class GeoMesaWebServer:
         self._inflight = 0
         self._inflight_lock = threading.Lock()
         self._started_at = time.monotonic()
+        # background hot-tile refresher: opt-in via the interval knob,
+        # and only for stores that actually own a result cache (the
+        # router/coordinator tiers delegate caching to their members)
+        self.refresher = None
+        from ..cache import CACHE_REFRESH_INTERVAL_S, CacheRefresher
+        interval = CACHE_REFRESH_INTERVAL_S.as_float() or 0.0
+        if interval > 0 and getattr(store, "result_cache", None) is not None:
+            self.refresher = CacheRefresher(
+                store, interval_s=interval).start()
         handler = _make_handler(self)
         self._httpd = _Httpd((host, port), handler)
         self._thread: threading.Thread | None = None
@@ -125,6 +145,8 @@ class GeoMesaWebServer:
         self._httpd.serve_forever()
 
     def stop(self):
+        if self.refresher is not None:
+            self.refresher.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -159,7 +181,7 @@ class GeoMesaWebServer:
                     and not self._authorized(headers):
                 return 403, "application/json", _j({"error": "forbidden"})
             try:
-                return self._route(method, parts, params, body)
+                return self._route(method, parts, params, body, headers)
             except KeyError as e:
                 return 404, "application/json", _j({"error": str(e)})
             except DurabilityError as e:
@@ -252,7 +274,7 @@ class GeoMesaWebServer:
         got = (headers or {}).get("Authorization", "")
         return got == f"Bearer {self.auth_token}"
 
-    def _route(self, method, parts, params, body):
+    def _route(self, method, parts, params, body, headers=None):
         if parts == ["version"]:
             return 200, "application/json", _j({"version": _version})
         if parts == ["schemas"]:
@@ -334,12 +356,15 @@ class GeoMesaWebServer:
         if len(parts) == 2 and parts[0] == "knn":
             return self._knn(parts[1], params)
         if len(parts) == 2 and parts[0] == "stats":
-            stat = self.store.stats_query(
-                parts[1], params.get("stat", ["Count()"])[0],
-                params.get("cql", [None])[0])
-            return 200, "application/json", _j(stat.to_json_object())
+            return self._stats(parts[1], params, headers)
         if len(parts) == 2 and parts[0] == "density":
-            return self._density(parts[1], params)
+            return self._density(parts[1], params, headers)
+        if len(parts) == 2 and parts[0] == "bin":
+            return self._bin(parts[1], params, headers)
+        if method == "GET" and parts == ["metrics"]:
+            return 200, "application/json", _j(metrics.snapshot())
+        if parts and parts[0] == "cache":
+            return self._cache(method, parts[1:], params)
         if parts == ["sql"]:
             # POST body or ?q= : a SELECT with ST_* predicates/joins
             stmt = (body.decode() if method == "POST" and body
@@ -568,15 +593,113 @@ class GeoMesaWebServer:
             {"ids": [str(i) for i in ids],
              "distances": np.asarray(dists, np.float64).tolist()})
 
-    def _density(self, name, params):
+    # -- conditional-request plumbing (ETag = plan key + LSN) --------------
+
+    def _etag_for(self, type_name: str, plan_key: str) -> str | None:
+        """ETag for a pushdown response: hash of (type, canonical plan
+        key, pushdown version). Computed BEFORE the result — a version
+        advancing mid-request makes the tag mismatch (full 200), never
+        a stale 304. None when the store has no exact single version
+        (router/cluster tiers)."""
+        pv = getattr(self.store, "pushdown_version", None)
+        if not callable(pv):
+            return None
+        try:
+            v = pv(type_name)
+        except Exception:
+            return None
+        import hashlib
+        h = hashlib.sha1(
+            f"{type_name}|{plan_key}|{v}".encode()).hexdigest()[:20]
+        return f'"{h}"'
+
+    @staticmethod
+    def _not_modified(etag: str, headers) -> bool:
+        if headers is None or etag is None:
+            return False
+        try:
+            inm = headers.get("If-None-Match")
+        except AttributeError:
+            inm = None
+        if not inm:
+            return False
+        if inm.strip() == "*":
+            return True
+        cands = [c.strip() for c in inm.split(",")]
+        return etag in cands or f"W/{etag}" in cands
+
+    def _stats(self, name, params, headers=None):
+        from ..cache.keys import stats_key
+        spec = params.get("stat", ["Count()"])[0]
+        flt, key = stats_key(params.get("cql", [None])[0], spec)
+        etag = self._etag_for(name, key)
+        if etag is not None and self._not_modified(etag, headers):
+            return 304, "application/json", b"", {"ETag": etag}
+        stat = self.store.stats_query(name, spec, flt)
+        extra = {"ETag": etag} if etag is not None else {}
+        return 200, "application/json", _j(stat.to_json_object()), extra
+
+    def _density(self, name, params, headers=None):
+        from ..cache.keys import density_key
         bbox = tuple(float(v) for v in params["bbox"][0].split(","))
         width = int(params.get("width", ["256"])[0])
         height = int(params.get("height", ["256"])[0])
         cql = params.get("cql", ["INCLUDE"])[0]
-        grid = self.store.density(name, cql, bbox, width, height)
-        return 200, "application/json", _j(
+        flt, key = density_key(cql, bbox, width, height)
+        etag = self._etag_for(name, key)
+        if etag is not None and self._not_modified(etag, headers):
+            return 304, "application/json", b"", {"ETag": etag}
+        grid = self.store.density(name, flt, bbox, width, height)
+        hdrs = _partial_headers(grid)
+        if etag is not None and getattr(grid, "complete", True) is not False:
+            hdrs["ETag"] = etag
+        return (200, "application/json", _j(
             {"bbox": bbox, "width": width, "height": height,
-             "grid": np.asarray(grid).tolist()})
+             "grid": np.asarray(grid).tolist()}), hdrs)
+
+    def _bin(self, name, params, headers=None):
+        """GET /rest/bin/{type}?cql=&track=&label=&sort= — the compact
+        BIN record stream (bin_query), conditional like density."""
+        from ..cache.keys import bin_key
+        cql = params.get("cql", ["INCLUDE"])[0]
+        track = params.get("track", [None])[0]
+        label = params.get("label", [None])[0]
+        sort = params.get("sort", ["false"])[0].lower() in ("1", "true",
+                                                            "yes")
+        flt, key = bin_key(cql, track, label, sort)
+        etag = self._etag_for(name, key)
+        if etag is not None and self._not_modified(etag, headers):
+            return 304, "application/octet-stream", b"", {"ETag": etag}
+        data = self.store.bin_query(name, flt, track=track, label=label,
+                                    sort=sort)
+        hdrs = _partial_headers(data)
+        if etag is not None and getattr(data, "complete", True) is not False:
+            hdrs["ETag"] = etag
+        return 200, "application/octet-stream", bytes(data), hdrs
+
+    def _cache(self, method, parts, params):
+        """Materialized-cache admin: GET /rest/cache (status, open),
+        POST /rest/cache/invalidate?type= (mutating, bearer-gated via
+        _GATED)."""
+        if method == "GET" and not parts:
+            cs = getattr(self.store, "cache_status", None)
+            if not callable(cs):
+                return 404, "application/json", _j(
+                    {"error": "store has no result cache"})
+            out = cs()
+            if self.refresher is not None:
+                out["refresher"] = self.refresher.status()
+            return 200, "application/json", _j(out)
+        if method == "POST" and parts == ["invalidate"]:
+            inv = getattr(self.store, "invalidate_cache", None)
+            if not callable(inv):
+                return 404, "application/json", _j(
+                    {"error": "store has no result cache"})
+            tn = params.get("type", [None])[0]
+            n = inv(tn)
+            return 200, "application/json", _j(
+                {"invalidated": int(n), "type": tn})
+        return 404, "application/json", _j({"error": "not found"})
 
 
 class _Httpd(ThreadingHTTPServer):

@@ -44,3 +44,7 @@ def pytest_configure(config):
         "markers", "bench_smoke: miniature end-to-end runs of the "
         "bench.py perf configs (4: batched KNN, 5: contains join) at "
         "toy sizes — exactness wiring, not performance")
+    config.addinivalue_line(
+        "markers", "cache: materialized pushdown-cache suites "
+        "(LSN-keyed invalidation, single-flight, ETag/304, hot-tile "
+        "refresh; select with -m cache)")

@@ -72,6 +72,25 @@ def test_config11_cluster_smoke():
 
 
 @pytest.mark.bench_smoke
+@pytest.mark.cache
+def test_config12_cache_smoke():
+    rng = np.random.default_rng(45)
+    c = bench.bench_config12(rng, n=3000, concurrency=8, nq=5,
+                             repl_writes=40)
+    assert c["exact_at_lsn"] is True
+    sf = c["singleflight"]
+    assert sf["collapsed"] is True and sf["device_computes"] == 1
+    # followers either parked on the leader's flight or arrived after
+    # the entry landed (then they're plain hits) — never a 2nd compute
+    assert 0 <= sf["waits"] <= sf["concurrent_identical_requests"] - 1
+    assert c["cached"]["hit_rate"] == 1.0
+    assert c["uncached"]["requests"] == c["cached"]["requests"] == 40
+    r = c["replicated"]
+    assert r["violations"] == 0 and r["reads"] > 0
+    assert c["cached_under_writes"]["rows_written_during"] > 0
+
+
+@pytest.mark.bench_smoke
 def test_load_gate_reports_without_exiting(monkeypatch, capsys):
     monkeypatch.setattr(bench, "LOAD_MAX", 0.0)   # force over-ceiling
     monkeypatch.setattr(bench, "LOAD_WAIT_S", 0.0)

@@ -1,0 +1,548 @@
+"""LSN-keyed materialized pushdown cache: key canonicalization,
+version-stamped invalidation, byte-exactness against fresh recompute,
+single-flight coalescing, LRU byte budget, the hot-tile refresher, the
+invalidation-race contracts (memory / replicated / cluster tiers), and
+the web conditional-request + cache-admin surfaces."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.cache import (CACHE_ENABLED, CACHE_MAX_BYTES,
+                               CacheRefresher, ResultCache, bin_key,
+                               canonical_filter, density_key, stats_key)
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import parse_spec
+from geomesa_tpu.store.memory import InMemoryDataStore
+
+SPEC = "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326"
+BB = (-100.0, 25.0, -60.0, 50.0)
+
+
+def make_store(n=200, type_name="pts", seed=7, **kwargs):
+    rng = np.random.default_rng(seed)
+    sft = parse_spec(type_name, SPEC)
+    ds = InMemoryDataStore(**kwargs)
+    ds.create_schema(sft)
+    ds.write(type_name, make_batch(sft, 0, n, seed))
+    return ds, sft
+
+
+def make_batch(sft, i0, n, seed=7):
+    rng = np.random.default_rng(seed + i0)
+    return FeatureBatch.from_dict(
+        sft, [f"p{i}" for i in range(i0, i0 + n)],
+        {"name": [f"n{i % 7}" for i in range(i0, i0 + n)],
+         "age": np.arange(i0, i0 + n),
+         "dtg": rng.integers(0, 10**12, n),
+         "geom": (rng.uniform(BB[0], BB[2], n),
+                  rng.uniform(BB[1], BB[3], n))})
+
+
+@pytest.mark.cache
+class TestKeys:
+    def test_whitespace_and_case_variants_collapse(self):
+        _, a = canonical_filter("age   <  5 AND name = 'x'")
+        _, b = canonical_filter("age < 5 and name = 'x'")
+        assert a == b
+
+    def test_none_is_include(self):
+        _, a = canonical_filter(None)
+        _, b = canonical_filter("INCLUDE")
+        assert a == b
+
+    def test_distinct_plans_get_distinct_keys(self):
+        _, k1 = density_key("INCLUDE", BB, 256, 256)
+        _, k2 = density_key("INCLUDE", BB, 256, 128)
+        _, k3 = density_key("INCLUDE", (0, 0, 1, 1), 256, 256)
+        _, k4 = density_key("age < 5", BB, 256, 256)
+        assert len({k1, k2, k3, k4}) == 4
+        _, s1 = stats_key(None, "Count()")
+        _, s2 = stats_key(None, "MinMax(age)")
+        assert s1 != s2
+        _, b1 = bin_key(None, track="name")
+        _, b2 = bin_key(None, track="name", sort=True)
+        assert b1 != b2
+
+    def test_key_carries_the_parsed_ast(self):
+        flt, _ = density_key("age < 5", BB, 64, 64)
+        from geomesa_tpu.filters import ast
+        assert isinstance(flt, ast.Filter)
+
+
+@pytest.mark.cache
+class TestStoreCaching:
+    def test_density_hits_after_first_compute(self):
+        ds, _ = make_store()
+        g1 = ds.density("pts", "INCLUDE", BB, 32, 32)
+        h0 = ds.result_cache.hits
+        g2 = ds.density("pts", "INCLUDE", BB, 32, 32)
+        assert ds.result_cache.hits == h0 + 1
+        assert np.asarray(g1).tobytes() == np.asarray(g2).tobytes()
+
+    def test_hits_hand_out_private_copies(self):
+        ds, _ = make_store()
+        ds.density("pts", "INCLUDE", BB, 16, 16)   # install
+        g = ds.density("pts", "INCLUDE", BB, 16, 16)  # hit -> a copy
+        np.asarray(g)[:] = -1.0  # caller scribbles on its grid
+        again = ds.density("pts", "INCLUDE", BB, 16, 16)
+        assert float(np.asarray(again).min()) >= 0.0
+
+    def test_stats_hits_decode_fresh_objects(self):
+        ds, _ = make_store()
+        s1 = ds.stats_query("pts", "Count()")
+        s2 = ds.stats_query("pts", "Count()")
+        assert s1 is not s2  # in-place Stat.merge can't corrupt the cache
+        assert s1.to_json() == s2.to_json()
+
+    def test_all_four_pushdowns_byte_exact_vs_fresh(self):
+        ds, _ = make_store()
+        cached = (np.asarray(ds.density("pts", "age < 150", BB, 32, 32),
+                             np.float32).tobytes(),
+                  bytes(ds.bin_query("pts", "INCLUDE", track="name")),
+                  bytes(ds.arrow_ipc("pts", "INCLUDE")),
+                  ds.stats_query("pts", "MinMax(age)").to_json())
+        # serve each again (now from cache), compare to a recompute
+        # with the cache disabled — identical bytes at the same LSN
+        cached2 = (np.asarray(ds.density("pts", "age < 150", BB, 32, 32),
+                              np.float32).tobytes(),
+                   bytes(ds.bin_query("pts", "INCLUDE", track="name")),
+                   bytes(ds.arrow_ipc("pts", "INCLUDE")),
+                   ds.stats_query("pts", "MinMax(age)").to_json())
+        CACHE_ENABLED.thread_local_set("false")
+        try:
+            fresh = (np.asarray(ds.density("pts", "age < 150", BB, 32, 32),
+                                np.float32).tobytes(),
+                     bytes(ds.bin_query("pts", "INCLUDE", track="name")),
+                     bytes(ds.arrow_ipc("pts", "INCLUDE")),
+                     ds.stats_query("pts", "MinMax(age)").to_json())
+        finally:
+            CACHE_ENABLED.thread_local_set(None)
+        assert cached == cached2 == fresh
+
+    def test_write_and_delete_advance_the_version(self, tmp_path):
+        ds, sft = make_store(durable_dir=str(tmp_path / "d"),
+                             wal_fsync="never")
+        v0 = ds.pushdown_version("pts")
+        assert v0 == ds.journal.wal.last_lsn  # LSN-keyed when durable
+        ds.density("pts", "INCLUDE", BB, 16, 16)
+        m0 = ds.result_cache.misses
+        ds.write("pts", make_batch(sft, 1000, 3))
+        assert ds.pushdown_version("pts") > v0
+        ds.density("pts", "INCLUDE", BB, 16, 16)  # stale -> recompute
+        assert ds.result_cache.misses == m0 + 1
+        v1 = ds.pushdown_version("pts")
+        ds.delete("pts", ["p1000"])
+        assert ds.pushdown_version("pts") > v1
+        ds.close()
+
+    def test_remove_schema_drops_entries(self):
+        ds, sft = make_store()
+        ds.density("pts", "INCLUDE", BB, 16, 16)
+        assert ds.result_cache.status()["types"].get("pts")
+        ds.remove_schema("pts")
+        assert "pts" not in ds.result_cache.status()["types"]
+
+    def test_types_are_isolated(self):
+        ds, _ = make_store()
+        sft2 = parse_spec("other", SPEC)
+        ds.create_schema(sft2)
+        ds.write("other", make_batch(sft2, 0, 50))
+        ds.density("pts", "INCLUDE", BB, 16, 16)
+        ds.density("other", "INCLUDE", BB, 16, 16)
+        h0 = ds.result_cache.hits
+        ds.write("other", make_batch(sft2, 500, 3))  # bump only "other"
+        ds.density("pts", "INCLUDE", BB, 16, 16)     # still a hit
+        assert ds.result_cache.hits == h0 + 1
+        assert ds.invalidate_cache("other") == 1
+        assert ds.result_cache.status()["types"].get("pts") == 1
+
+    def test_lru_byte_budget_evicts(self):
+        ds, _ = make_store()
+        # each 32x32 f32 grid is 4 KiB; budget fits only two
+        CACHE_MAX_BYTES.thread_local_set(str(9 * 1024))
+        try:
+            for i in range(4):
+                ds.density("pts", f"age < {100 + i}", BB, 32, 32)
+            st = ds.result_cache.status()
+            assert st["entries"] <= 2
+            assert st["bytes"] <= 9 * 1024
+            assert st["evictions"] >= 2
+        finally:
+            CACHE_MAX_BYTES.thread_local_set(None)
+
+    def test_kill_switch_disables_memoization(self):
+        ds, _ = make_store()
+        CACHE_ENABLED.thread_local_set("false")
+        try:
+            ds.density("pts", "INCLUDE", BB, 16, 16)
+            ds.density("pts", "INCLUDE", BB, 16, 16)
+            st = ds.result_cache.status()
+            assert st["entries"] == 0 and st["hits"] == 0
+        finally:
+            CACHE_ENABLED.thread_local_set(None)
+
+
+@pytest.mark.cache
+class TestSingleFlight:
+    def test_concurrent_misses_compute_once(self):
+        computed = []
+        release = threading.Event()
+
+        def compute():
+            computed.append(1)
+            release.wait(5.0)
+            return b"payload"
+
+        cache = ResultCache(lambda tn: 1)
+        results = [None] * 6
+
+        def run(i):
+            results[i] = cache.get_or_compute("t", "k", compute)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5
+        while cache.singleflight_waits < 5 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        release.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert len(computed) == 1
+        assert all(r == b"payload" for r in results)
+        assert cache.singleflight_waits == 5
+
+    def test_leader_error_propagates_and_clears_flight(self):
+        cache = ResultCache(lambda tn: 1)
+
+        def boom():
+            raise RuntimeError("device fell over")
+
+        with pytest.raises(RuntimeError):
+            cache.get_or_compute("t", "k", boom)
+        # the flight is gone: the next call computes normally
+        assert cache.get_or_compute("t", "k", lambda: b"ok") == b"ok"
+
+    def test_mid_compute_write_never_serves_stale(self):
+        version = [1]
+        cache = ResultCache(lambda tn: version[0])
+
+        def compute():
+            version[0] += 1  # a write lands while we compute
+            return b"old-state"
+
+        assert cache.get_or_compute("t", "k", compute) == b"old-state"
+        # the entry was stamped with the PRE-compute version, which no
+        # longer matches: the next read recomputes instead of serving
+        # the torn result
+        assert cache.get_or_compute("t", "k", lambda: b"new") == b"new"
+
+
+@pytest.mark.cache
+class TestInvalidationRace:
+    def test_reader_never_older_than_current_version_memory(self):
+        """Writer thread advances the version while readers hammer one
+        tile; every observed grid mass must correspond to a row count
+        between the version before and after its request window."""
+        ds, sft = make_store(n=50)
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set() and i < 30:
+                ds.write("pts", make_batch(sft, 1000 + i, 1))
+                i += 1
+                time.sleep(0.002)
+
+        def reader():
+            while not stop.is_set():
+                before = ds.count("pts")
+                g = ds.density("pts", "INCLUDE",
+                               (-180.0, -90.0, 180.0, 90.0), 8, 8)
+                after = ds.count("pts")
+                mass = int(round(float(np.sum(np.asarray(g)))))
+                if not (before - 1 <= mass <= after + 1):
+                    errors.append((before, mass, after))
+
+        w = threading.Thread(target=writer)
+        rs = [threading.Thread(target=reader) for _ in range(3)]
+        w.start()
+        for r in rs:
+            r.start()
+        w.join()
+        stop.set()
+        for r in rs:
+            r.join()
+        assert not errors, errors[:3]
+
+    @pytest.mark.repl
+    def test_replicated_reads_respect_staleness_bound(self, tmp_path):
+        """Cached tiles served by a replica are stamped with the
+        replica's own applied version, so a bounded-staleness read can
+        never observe state older than geomesa.repl.max.lag.lsn."""
+        from geomesa_tpu.replication import (Replica, ReplicatedDataStore,
+                                             WalShipper)
+        sft = parse_spec("rpts", "*geom:Point:srid=4326")
+        prim = InMemoryDataStore(durable_dir=str(tmp_path / "p"),
+                                 wal_fsync="never")
+        prim.create_schema(sft)
+        base = 20
+        prim.write("rpts", FeatureBatch.from_dict(
+            sft, [f"b{i}" for i in range(base)],
+            {"geom": (np.full(base, 0.5), np.full(base, 0.5))}))
+        base_lsn = prim.journal.wal.last_lsn
+        lag = 25
+        ship = WalShipper(prim.journal)
+        replica = Replica(ship.host, ship.port, name="r0")
+        router = ReplicatedDataStore(prim, [replica], ack_replicas=0,
+                                     max_lag_lsn=lag, max_lag_s=600)
+        try:
+            deadline = time.monotonic() + 15
+            while (replica.applied_lsn < base_lsn
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            violations = []
+            stop = threading.Event()
+
+            def writer():
+                j = 0
+                while not stop.is_set() and j < 60:
+                    prim.write("rpts", FeatureBatch.from_dict(
+                        sft, [f"x{j}"], {"geom": (np.full(1, 0.5),
+                                                  np.full(1, 0.5))}))
+                    j += 1
+                    time.sleep(0.002)
+
+            w = threading.Thread(target=writer)
+            w.start()
+            reads = 0
+            while w.is_alive() or reads < 10:
+                lsn_pre = prim.journal.wal.last_lsn
+                g = router.density("rpts", "INCLUDE",
+                                   (0.0, 0.0, 1.0, 1.0), 4, 4)
+                implied = (base_lsn - base
+                           + int(round(float(np.sum(np.asarray(g))))))
+                reads += 1
+                if implied < lsn_pre - lag:
+                    violations.append((lsn_pre, implied))
+                if reads > 400:
+                    break
+            w.join()
+            stop.set()
+            assert not violations, violations[:3]
+            assert reads >= 10
+        finally:
+            router.close()
+            ship.stop()
+
+    @pytest.mark.cluster
+    def test_cluster_per_leg_caches_are_independent(self):
+        """A write routed to one shard bumps only that group's
+        versions: the other leg's cached tiles keep serving hits, and
+        scattered results stay exact vs an unsharded oracle."""
+        from geomesa_tpu.cluster import ClusterDataStore
+        sft = parse_spec("cpts", "*geom:Point:srid=4326")
+        groups = [InMemoryDataStore(), InMemoryDataStore()]
+        cluster = ClusterDataStore(groups, names=["g0", "g1"])
+        cluster.create_schema(sft)
+        rng = np.random.default_rng(3)
+        n = 400
+        cluster.write("cpts", FeatureBatch.from_dict(
+            sft, [f"p{i}" for i in range(n)],
+            {"geom": (rng.uniform(-170, 170, n),
+                      rng.uniform(-80, 80, n))}))
+        bb = (-170.0, -80.0, 170.0, 80.0)
+        g1 = cluster.density("cpts", "INCLUDE", bb, 16, 16)
+        hits0 = [g.result_cache.hits for g in groups]
+        g2 = cluster.density("cpts", "INCLUDE", bb, 16, 16)
+        assert [g.result_cache.hits for g in groups] == \
+            [h + 1 for h in hits0]
+        assert np.asarray(g1).tobytes() == np.asarray(g2).tobytes()
+        # route one row to exactly one shard group
+        one = FeatureBatch.from_dict(sft, ["solo"],
+                                     {"geom": (np.full(1, 12.3),
+                                               np.full(1, 45.6))})
+        cluster.write("cpts", one)
+        touched = [g.result_cache.misses for g in groups]
+        cluster.density("cpts", "INCLUDE", bb, 16, 16)
+        recomputes = sum(g.result_cache.misses - t
+                         for g, t in zip(groups, touched))
+        assert recomputes == 1  # only the written leg recomputed
+        st = cluster.cache_status()
+        assert st["role"] == "cluster"
+        assert set(st["groups"]) == {"g0", "g1"}
+        assert cluster.invalidate_cache("cpts") >= 1
+
+
+@pytest.mark.cache
+class TestRefresher:
+    def test_run_once_rematerializes_hot_stale_entries(self):
+        ds, sft = make_store()
+        for _ in range(5):  # heat up one tile
+            ds.density("pts", "INCLUDE", BB, 16, 16)
+        ds.write("pts", make_batch(sft, 2000, 2))  # stale now
+        r = CacheRefresher(ds, interval_s=0, top_k=4)
+        out = r.run_once()
+        assert out["refreshed"] >= 1
+        m0 = ds.result_cache.misses
+        ds.density("pts", "INCLUDE", BB, 16, 16)  # already fresh
+        assert ds.result_cache.misses == m0
+        assert r.status()["running"] is False
+
+    def test_fresh_entries_are_skipped(self):
+        ds, _ = make_store()
+        ds.density("pts", "INCLUDE", BB, 16, 16)
+        assert CacheRefresher(ds, interval_s=0).run_once()["refreshed"] == 0
+
+    def test_background_loop_starts_and_stops(self):
+        ds, sft = make_store()
+        ds.density("pts", "INCLUDE", BB, 16, 16)
+        r = CacheRefresher(ds, interval_s=0.02, top_k=4).start()
+        try:
+            assert r.status()["running"] is True
+            ds.write("pts", make_batch(sft, 3000, 2))
+            deadline = time.monotonic() + 5
+            while r.runs == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert r.runs >= 1
+        finally:
+            r.stop()
+        assert r.status()["running"] is False
+
+    def test_refresher_requires_a_cache(self):
+        with pytest.raises(ValueError):
+            CacheRefresher(object())
+
+
+@pytest.mark.cache
+class TestWebSurface:
+    @pytest.fixture()
+    def server(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        ds, sft = make_store()
+        srv = GeoMesaWebServer(ds).start()
+        yield srv, ds, sft
+        srv.stop()
+
+    def _get(self, srv, path, headers=None):
+        out = srv.handle("GET", path, {}, b"", headers=headers or {})
+        status, ctype, payload = out[:3]
+        return status, payload, (out[3] if len(out) > 3 else {})
+
+    def test_density_etag_roundtrip(self, server):
+        srv, ds, sft = server
+        path = "/rest/density/pts"
+        params = {"bbox": [",".join(str(v) for v in BB)],
+                  "width": ["16"], "height": ["16"]}
+        out = srv.handle("GET", path, params, b"")
+        assert out[0] == 200 and "ETag" in out[3]
+        etag = out[3]["ETag"]
+        out2 = srv.handle("GET", path, params, b"",
+                          headers={"If-None-Match": etag})
+        assert out2[0] == 304 and out2[2] == b""
+        assert out2[3]["ETag"] == etag
+        # a write changes the version: same If-None-Match now misses
+        ds.write("pts", make_batch(sft, 4000, 1))
+        out3 = srv.handle("GET", path, params, b"",
+                          headers={"If-None-Match": etag})
+        assert out3[0] == 200 and out3[3]["ETag"] != etag
+
+    def test_stats_and_bin_etags(self, server):
+        srv, ds, _ = server
+        out = srv.handle("GET", "/rest/stats/pts",
+                         {"stat": ["Count()"]}, b"")
+        assert out[0] == 200 and "ETag" in out[3]
+        assert json.loads(out[2])["count"] == 200
+        out2 = srv.handle("GET", "/rest/stats/pts",
+                          {"stat": ["Count()"]}, b"",
+                          headers={"If-None-Match": out[3]["ETag"]})
+        assert out2[0] == 304
+        out3 = srv.handle("GET", "/rest/bin/pts", {"track": ["name"]},
+                          b"")
+        assert out3[0] == 200 and len(out3[2]) > 0
+        assert out3[1] == "application/octet-stream"
+        out4 = srv.handle("GET", "/rest/bin/pts", {"track": ["name"]},
+                          b"", headers={"If-None-Match": out3[3]["ETag"]})
+        assert out4[0] == 304
+
+    def test_metrics_endpoint(self, server):
+        srv, ds, _ = server
+        ds.density("pts", "INCLUDE", BB, 16, 16)
+        st, payload, _ = self._get(srv, "/rest/metrics")
+        snap = json.loads(payload)
+        assert st == 200
+        assert {"counters", "gauges"} <= set(snap)
+        assert "cache.misses" in snap["counters"]
+
+    def test_cache_status_and_gated_invalidate(self, server):
+        srv, ds, _ = server
+        ds.density("pts", "INCLUDE", BB, 16, 16)
+        st, payload, _ = self._get(srv, "/rest/cache")
+        cs = json.loads(payload)
+        assert st == 200 and cs["entries"] >= 1
+        assert cs["versions"]["pts"] >= 1
+        # open (no token configured) invalidate works
+        out = srv.handle("POST", "/rest/cache/invalidate",
+                         {"type": ["pts"]}, b"")
+        assert out[0] == 200
+        assert json.loads(out[2])["invalidated"] >= 1
+        # with a token configured, missing/bad tokens get 403
+        srv.auth_token = "sekret"
+        out = srv.handle("POST", "/rest/cache/invalidate", {}, b"")
+        assert out[0] == 403
+        out = srv.handle("POST", "/rest/cache/invalidate", {}, b"",
+                         headers={"Authorization": "Bearer sekret"})
+        assert out[0] == 200
+
+    def test_no_etag_without_exact_version(self):
+        """Stores lacking pushdown_version (router/cluster tiers) must
+        not emit ETags — a 304 could lie across differently-lagged
+        members."""
+        from geomesa_tpu.web import GeoMesaWebServer
+
+        class NoVersion:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def get_type_names(self):
+                return self._inner.get_type_names()
+
+            def density(self, *a, **k):
+                return self._inner.density(*a, **k)
+
+        ds, _ = make_store()
+        srv = GeoMesaWebServer(NoVersion(ds)).start()
+        try:
+            out = srv.handle(
+                "GET", "/rest/density/pts",
+                {"bbox": [",".join(str(v) for v in BB)],
+                 "width": ["8"], "height": ["8"]}, b"")
+            assert out[0] == 200
+            extra = out[3] if len(out) > 3 else {}
+            assert "ETag" not in extra
+        finally:
+            srv.stop()
+
+    def test_refresher_wired_by_knob(self):
+        from geomesa_tpu.cache import CACHE_REFRESH_INTERVAL_S
+        from geomesa_tpu.web import GeoMesaWebServer
+        ds, _ = make_store()
+        CACHE_REFRESH_INTERVAL_S.thread_local_set("0.05")
+        try:
+            srv = GeoMesaWebServer(ds).start()
+        finally:
+            CACHE_REFRESH_INTERVAL_S.thread_local_set(None)
+        try:
+            assert srv.refresher is not None
+            assert srv.refresher.status()["running"] is True
+            st, payload, _ = self._get(srv, "/rest/cache")
+            assert json.loads(payload)["refresher"]["interval_s"] == 0.05
+        finally:
+            srv.stop()
+        assert srv.refresher.status()["running"] is False
