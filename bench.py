@@ -99,6 +99,17 @@ Config #8 exercises the resilience layer's knobs (same resolution):
       server load-shedding cap; excess requests get 503 + Retry-After
   geomesa.web.retry.after.s   / GEOMESA_WEB_RETRY_AFTER_S   (1) —
       the backpressure hint a shed response carries
+Config #13 (tail-latency serving tier) exercises the hedging and
+shared-batcher knobs (same resolution):
+  geomesa.hedge.enabled        / GEOMESA_HEDGE_ENABLED       (true) —
+      speculative second attempts on idempotent GETs, p99-delayed
+  geomesa.hedge.min.delay.ms   / GEOMESA_HEDGE_MIN_DELAY_MS  (10) —
+      floor under the EWMA-derived hedge delay
+  geomesa.batch.latency.budget.ms / GEOMESA_BATCH_LATENCY_BUDGET_MS
+      (unset) — derive the effective batch cap from the per-shape
+      dispatch-cost EWMA; unset keeps the static cap
+  geomesa.batcher.registry.enabled / GEOMESA_BATCHER_REGISTRY_ENABLED
+      (true) — process-wide shared batcher per store identity
 Config #9 exercises the replication layer's knobs (same resolution):
   geomesa.repl.max.lag.lsn    / GEOMESA_REPL_MAX_LAG_LSN    (1000) —
       per-query staleness bound in log records
@@ -132,7 +143,7 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,6,7,8,9,10,11,12,northstar")
+                             "1,2,3,4,5,6,7,8,9,10,11,12,13,northstar")
               .split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
@@ -1606,6 +1617,257 @@ def bench_config12(rng, n=None, concurrency=None, nq=None,
     return out
 
 
+# -- config 13: tail-latency serving tier ---------------------------------
+
+def bench_config13(rng, n=None, c_web=None, c_emb=None, nq=None,
+                   slow_s=None):
+    """What the tail-latency serving tier buys, in three phases.
+
+    (A) Coalesce proof: web-tier HTTP requests and embedded callers
+        ask the process-wide ``BatcherRegistry`` for the same store's
+        batcher and must land in ONE fused device dispatch (counter
+        assertion, id-exact vs direct ``store.query``). Driven
+        deterministically: a gated sacrificial query holds a dispatch
+        in flight so the burst's leader load-gates into a long static
+        linger, and ``max_batch`` equals the caller count so the last
+        arrival releases the batch without waiting out the window.
+    (B) Hedged vs unhedged p99 through a ChaosProxy straggler profile
+        (``slow_rate``/``slow_s``): most requests are fast, a random
+        few stall a quarter second — the tail only a speculative
+        second attempt rescues. Both clients warm the latency EWMA on
+        a clean proxy first, then run the same stream with stragglers
+        on; reports win/loss/cancelled/suppressed counters, the
+        budget invariant, and an id-exactness probe under chaos.
+    (C) Latency-derived batch caps: with the per-shape-class cost
+        EWMA seeded by phase A's fused dispatch, setting
+        ``geomesa.batch.latency.budget.ms`` must shrink the effective
+        cap below the static ceiling (and leaving it unset must not).
+    """
+    import threading
+
+    from geomesa_tpu.features import parse_spec
+    from geomesa_tpu.index.api import Query
+    from geomesa_tpu.metrics import metrics
+    from geomesa_tpu.resilience import ChaosProxy
+    from geomesa_tpu.scan.batcher import (BATCH_LATENCY_BUDGET_MS,
+                                          BATCH_LINGER_ADAPTIVE,
+                                          BATCH_LINGER_MICROS,
+                                          BATCH_MAX_SIZE)
+    from geomesa_tpu.scan.registry import batcher_registry, shared_batcher
+    from geomesa_tpu.store import InMemoryDataStore
+    from geomesa_tpu.store.remote import RemoteDataStore
+    from geomesa_tpu.web.server import GeoMesaWebServer
+
+    n = int(n if n is not None
+            else os.environ.get("GEOMESA_TPU_BENCH_TAIL_N", 200_000))
+    cw = int(c_web if c_web is not None else 16)
+    ce = int(c_emb if c_emb is not None else 16)
+    nq = int(nq if nq is not None else 150)
+    slow = float(slow_s if slow_s is not None else 0.25)
+    total = cw + ce
+    out = {"n": n, "web_callers": cw, "embedded_callers": ce}
+
+    class GateStore(InMemoryDataStore):
+        """Holds a marked scalar query in flight so the coalesce
+        phase's leader load-gates into its linger window."""
+
+        def __init__(self):
+            super().__init__()
+            self.hold = threading.Event()
+
+        def query(self, q, *args, **kwargs):
+            if getattr(q, "hints", {}).get("_gate13"):
+                assert self.hold.wait(60.0), "gate never released"
+            return super().query(q, *args, **kwargs)
+
+    ds = GateStore()
+    ds.create_schema(parse_spec("tail13",
+                                "dtg:Date,*geom:Point:srid=4326"))
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    ms = rng.integers(T0_DAY * MS_DAY, T1_DAY * MS_DAY, n).astype(np.int64)
+    ds.write_dict("tail13", np.arange(n).astype(str).astype(object),
+                  {"dtg": ms, "geom": (x, y)})
+    del x, y, ms
+
+    def bbox_q(i, w=4.0, h=4.0):
+        x0 = -170.0 + (i * 37) % 330
+        y0 = -80.0 + (i * 23) % 150
+        return Query("tail13",
+                     f"BBOX(geom, {x0}, {y0}, {x0 + w}, {y0 + h})")
+
+    def _wait(pred, timeout=15.0):
+        deadline = time.perf_counter() + timeout
+        while not pred():
+            if time.perf_counter() > deadline:
+                raise AssertionError("config 13 staging timed out")
+            time.sleep(0.001)
+
+    # -- phase A: shared-registry coalesce proof --------------------------
+    batcher_registry.clear()
+    BATCH_LINGER_ADAPTIVE.set("false")
+    BATCH_LINGER_MICROS.set(str(int(5e6)))
+    BATCH_MAX_SIZE.set(str(total))
+    server = None
+    try:
+        server = GeoMesaWebServer(ds).start()
+        b = shared_batcher(ds)
+        # the tentpole contract: BOTH tiers hold the same instance
+        shared = server.batcher is b
+        client = RemoteDataStore("127.0.0.1", server.port, hedge=False)
+        client.get_schema("tail13")   # prefetch off the burst path
+        batches_pre = b.batches
+        gate = bbox_q(0, w=0.01, h=0.01)
+        gate.hints["_gate13"] = True
+        warm = threading.Thread(target=b.query, args=(gate,), daemon=True)
+        warm.start()
+        _wait(lambda: b._in_flight >= 1 and b.batches == batches_pre + 1)
+        batches0, co0 = b.batches, b.coalesced_queries
+        queries = [bbox_q(i + 1) for i in range(total)]
+        results: list = [None] * total
+        barrier = threading.Barrier(total)
+
+        def web_worker(i):
+            barrier.wait()
+            results[i] = client.query(queries[i])
+
+        def emb_worker(i):
+            barrier.wait()
+            results[i] = b.query(queries[i])
+
+        threads = [threading.Thread(
+            target=web_worker if i < cw else emb_worker, args=(i,),
+            daemon=True) for i in range(total)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        stuck = any(t.is_alive() for t in threads)
+        ds.hold.set()
+        warm.join(10.0)
+        exact = not stuck
+        for i, r in enumerate(results):
+            if r is None:
+                exact = False
+                continue
+            want = InMemoryDataStore.query(ds, queries[i])
+            exact = exact and np.array_equal(np.sort(r.ids),
+                                             np.sort(want.ids))
+        fused = int(b.batches - batches0)
+        out["coalesce"] = {
+            "callers": total,
+            "registry_shared_instance": bool(shared),
+            "fused_dispatches": fused,
+            "coalesced_queries": int(b.coalesced_queries - co0),
+            "single_fused_dispatch": bool(
+                fused == 1 and b.coalesced_queries - co0 == total),
+            "ids_exact": bool(exact)}
+        # the health surface must expose the registry's queue depths
+        import http.client as _hc
+        conn = _hc.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        try:
+            conn.request("GET", "/rest/health")
+            health = json.loads(conn.getresponse().read().decode())
+        finally:
+            conn.close()
+        out["coalesce"]["health_has_batcher"] = "batcher" in health \
+            and health["batcher"] is not None
+    finally:
+        BATCH_LINGER_ADAPTIVE.set(None)
+        BATCH_LINGER_MICROS.set(None)
+        BATCH_MAX_SIZE.set(None)
+        if server is not None:
+            server.stop()
+
+    # -- phase C (uses phase A's seeded cost EWMA) ------------------------
+    cost = max(b._cost_ewma.values()) if b._cost_ewma else 0.0
+    eff_unset = b.effective_max_batch("tail13")
+    want_cap = max(1, total // 2)
+    BATCH_LATENCY_BUDGET_MS.set(str(cost * (want_cap + 0.5) * 1e3))
+    try:
+        eff = b.effective_max_batch("tail13")
+    finally:
+        BATCH_LATENCY_BUDGET_MS.set(None)
+    out["batch_caps"] = {
+        "static_max_batch": int(b.max_batch),
+        "per_query_cost_ms": round(cost * 1e3, 3),
+        "effective_max_batch": int(eff),
+        "derived_below_static": bool(cost > 0 and eff < b.max_batch),
+        "uncapped_without_budget": bool(eff_unset == b.max_batch)}
+    batcher_registry.clear()
+
+    # -- phase B: hedged vs unhedged p99 under a straggler profile --------
+    server = GeoMesaWebServer(ds).start()
+    proxy = ChaosProxy("127.0.0.1", server.port, seed=7,
+                       slow_rate=0.0, slow_s=slow).start()
+    try:
+        unhedged = RemoteDataStore(proxy.host, proxy.port, hedge=False)
+        hedged = RemoteDataStore(proxy.host, proxy.port)
+
+        def stream(ds_client, count):
+            lat = []
+            for i in range(count):
+                t0 = time.perf_counter()
+                ds_client.query(bbox_q(i))
+                lat.append(time.perf_counter() - t0)
+            return lat
+
+        # clean-proxy warmup: both clients build their latency EWMA on
+        # healthy calls (the p99 estimate that picks the hedge delay)
+        stream(unhedged, max(nq // 5, 10))
+        stream(hedged, max(nq // 5, 10))
+
+        proxy.slow_rate = 0.1
+        c0 = metrics.snapshot()["counters"]
+        lat_u = stream(unhedged, nq)
+        lat_h = stream(hedged, nq)
+        c1 = metrics.snapshot()["counters"]
+
+        def delta(key):
+            return int(c1.get(key, 0) - c0.get(key, 0))
+
+        # id-exactness probe while stragglers are live
+        probe_ok = True
+        for i in range(5):
+            got = hedged.query(bbox_q(i))
+            want = InMemoryDataStore.query(ds, bbox_q(i))
+            probe_ok = probe_ok and np.array_equal(
+                np.sort(got.ids), np.sort(want.ids))
+        proxy.slow_rate = 0.0
+
+        pu, ph = _pcts(lat_u), _pcts(lat_h)
+        attempts = delta("resilience.hedge.attempts")
+        # budget invariant: hedges are charged to the shared retry
+        # budget (capacity 10, ratio 0.2 per first attempt)
+        budget_cap = (nq + max(nq // 5, 10) + 5) * 0.2 + 10.0
+        out["unhedged"] = {"requests": nq,
+                           "p50_ms": round(pu["p50"] * 1e3, 2),
+                           "p95_ms": round(pu["p95"] * 1e3, 2),
+                           "p99_ms": round(pu["p99"] * 1e3, 2)}
+        out["hedged"] = {"requests": nq,
+                         "p50_ms": round(ph["p50"] * 1e3, 2),
+                         "p95_ms": round(ph["p95"] * 1e3, 2),
+                         "p99_ms": round(ph["p99"] * 1e3, 2),
+                         "attempts": attempts,
+                         "wins": delta("resilience.hedge.wins"),
+                         "losses": delta("resilience.hedge.losses"),
+                         "cancelled": delta("resilience.hedge.cancelled"),
+                         "suppressed_budget": delta(
+                             "resilience.hedge.suppressed.budget"),
+                         "budget_ok": bool(attempts <= budget_cap),
+                         "ids_exact": bool(probe_ok)}
+        out["slow_profile"] = {"slow_rate": 0.1, "slow_s": slow,
+                               "slowed_connections": proxy.stats["slowed"]}
+        out["hedge_p99_speedup"] = round(
+            pu["p99"] / max(ph["p99"], 1e-9), 2)
+        out["hedged_beats_unhedged_p99"] = bool(ph["p99"] < pu["p99"])
+    finally:
+        proxy.stop()
+        server.stop()
+        batcher_registry.clear()
+    return out
+
+
 # -- config 10: storage integrity — scrub overhead + corrupt recovery -----
 
 def bench_config10(rng):
@@ -1869,6 +2131,9 @@ def main(argv=None):
 
     if "12" in CONFIGS:
         out["configs"]["12_hot_tiles"] = bench_config12(rng)
+
+    if "13" in CONFIGS:
+        out["configs"]["13_tail_latency"] = bench_config13(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

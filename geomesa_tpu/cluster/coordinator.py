@@ -16,7 +16,10 @@ Failure semantics are the point (a cluster that hangs or silently
 drops a shard's rows is worse than a single store):
 
 - every scatter leg runs under ``geomesa.cluster.leg.deadline.s`` with
-  a hedged second attempt after ``geomesa.cluster.hedge.ms`` (for a
+  a hedged second attempt through the shared ``HedgePolicy``
+  (resilience/hedge.py): after the group's observed p99-ish latency
+  once the EWMA has samples, else the static
+  ``geomesa.cluster.hedge.ms`` (for a
   replicated group the hedge naturally lands on a different replica —
   the router round-robins), and a per-group breaker
   (resilience/breaker.py) fast-fails legs into a known-dead group;
@@ -51,6 +54,8 @@ from ..features.sft import parse_spec
 from ..index.api import Explainer, FilterStrategy, Query
 from ..metrics import metrics
 from ..resilience.breaker import BreakerBoard, CircuitOpenError
+from ..resilience.hedge import HedgePolicy
+from ..resilience.policy import RetryBudget
 from ..store.api import DataStore
 from ..store.memory import QueryResult
 from ..utils.properties import SystemProperty
@@ -161,6 +166,10 @@ class ClusterDataStore(DataStore):
         self._allow_partial_override = allow_partial
         self._registry = registry
         self._breakers = BreakerBoard(registry=registry)
+        # shared hedging helper (resilience/hedge.py): scatter legs
+        # launch their backup attempt through it, charged to a
+        # cluster-wide retry budget
+        self._hedge = HedgePolicy(budget=RetryBudget(), registry=registry)
         self._lock = threading.Lock()
         self._lsn_vector: dict[str, int] = {}
         self._sfts: dict = {}
@@ -213,8 +222,13 @@ class ClusterDataStore(DataStore):
     def _leg(self, name: str, fn, deadline: float, hedge_s: float,
              results: dict, failures: dict):
         """Run one scatter leg: breaker-gated, deadline-bounded, with
-        one hedged retry (launched after ``hedge_s`` of silence, or
-        immediately when the first attempt fails fast)."""
+        one hedged backup through the shared ``HedgePolicy``
+        (resilience/hedge.py). The speculative delay prefers the
+        group's observed p99-ish latency over the static
+        ``geomesa.cluster.hedge.ms`` once the EWMA has samples — a
+        fast group hedges sooner, a slow one stops hedging on every
+        call — and hedges are charged to the cluster's retry budget so
+        a cluster-wide brownout can't double its own load."""
         breaker = self._breakers.get(name)
         try:
             breaker.acquire()
@@ -223,64 +237,31 @@ class ClusterDataStore(DataStore):
             failures[name] = e
             return
         t0 = time.perf_counter()
-        cond = threading.Condition()
-        state = {"ok": None, "errs": [], "running": 0}
-
-        def attempt():
-            try:
-                v = fn()
-                with cond:
-                    if state["ok"] is None:
-                        state["ok"] = (v,)
-                    state["running"] -= 1
-                    cond.notify_all()
-            except Exception as e:  # noqa: BLE001 — leg boundary
-                with cond:
-                    state["errs"].append(e)
-                    state["running"] -= 1
-                    cond.notify_all()
-
-        def launch():
-            state["running"] += 1
-            threading.Thread(target=attempt, daemon=True,
-                             name=f"cluster-leg-{name}").start()
-
-        deadline_t = t0 + deadline
-        with cond:
-            launch()
-            hedged = False
-            while state["ok"] is None:
-                now = time.perf_counter()
-                if now >= deadline_t:
-                    break
-                if state["running"] == 0 and hedged:
-                    break          # every attempt failed
-                if not hedged and (state["running"] == 0
-                                   or now >= t0 + hedge_s):
-                    hedged = True
-                    self._registry.counter("cluster.leg.hedges")
-                    launch()
-                    continue
-                timeout = deadline_t - now
-                if not hedged:
-                    timeout = min(timeout, t0 + hedge_s - now)
-                cond.wait(max(timeout, 0.0005))
-            ok = state["ok"]
-            errs = list(state["errs"])
-        if ok is not None:
-            breaker.success()
-            self._breakers.observe(name, time.perf_counter() - t0)
-            results[name] = ok[0]
-        else:
+        delay = self._hedge.delay_s(self._breakers.latency_p99_s(name))
+        if delay is None:
+            delay = hedge_s  # no estimate yet: the static knob
+        if self._hedge.budget is not None:
+            self._hedge.budget.deposit()  # first attempts earn tokens
+        try:
+            v = self._hedge.call(
+                fn, delay, deadline_s=deadline, name=f"cluster.{name}",
+                on_hedge=lambda: self._registry.counter(
+                    "cluster.leg.hedges"))
+        except TimeoutError:
             breaker.failure()
             self._registry.counter("cluster.leg.failures")
-            if errs:
-                failures[name] = errs[-1]
-            else:
-                self._registry.counter("cluster.leg.timeouts")
-                failures[name] = TimeoutError(
-                    f"shard leg {name!r} exceeded its {deadline:g}s "
-                    "deadline")
+            self._registry.counter("cluster.leg.timeouts")
+            failures[name] = TimeoutError(
+                f"shard leg {name!r} exceeded its {deadline:g}s "
+                "deadline")
+        except Exception as e:  # noqa: BLE001 — leg boundary
+            breaker.failure()
+            self._registry.counter("cluster.leg.failures")
+            failures[name] = e
+        else:
+            breaker.success()
+            self._breakers.observe(name, time.perf_counter() - t0)
+            results[name] = v
 
     def _scatter(self, make_fn) -> tuple[dict, dict]:
         """Fan one read out to every group. ``make_fn(name, group)``

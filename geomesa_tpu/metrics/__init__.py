@@ -1,6 +1,6 @@
 """Metrics registry (geomesa-metrics / Dropwizard analog): counters,
 timers and gauges with pluggable reporters."""
 
-from .registry import MetricsRegistry, metrics
+from .registry import MetricsRegistry, metrics, sanitize_key
 
-__all__ = ["MetricsRegistry", "metrics"]
+__all__ = ["MetricsRegistry", "metrics", "sanitize_key"]

@@ -4,10 +4,29 @@ snapshot API and delimited-file reporting."""
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 
-__all__ = ["MetricsRegistry", "metrics"]
+__all__ = ["MetricsRegistry", "metrics", "sanitize_key"]
+
+# metric-key material derived from user-controlled strings (type names,
+# endpoint routes) must not corrupt the registry dump: no whitespace or
+# control characters, bounded length
+_KEY_BAD = re.compile(r"[^0-9A-Za-z._:/-]+")
+_KEY_MAX = 64
+
+
+def sanitize_key(raw: str) -> str:
+    """Make untrusted text safe as a metric-key segment: collapse
+    anything outside [0-9A-Za-z._:/-] (spaces, newlines, quotes, ...)
+    to ``_`` and cap the length, so a hostile type name or endpoint
+    string cannot break the ``/rest/metrics`` registry dump or smuggle
+    newlines into delimited reports."""
+    s = _KEY_BAD.sub("_", str(raw))
+    if len(s) > _KEY_MAX:
+        s = s[:_KEY_MAX]
+    return s or "_"
 
 
 class _Timer:

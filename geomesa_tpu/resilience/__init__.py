@@ -1,14 +1,17 @@
 """Network resilience layer (the fault tolerance the reference gets
 for free from Accumulo/HBase client stacks, SURVEY.md 2.6): retry
 policies with backoff/jitter/budget (policy.py), per-endpoint circuit
-breakers (breaker.py), and a fault-injecting TCP proxy that proves
-recovery end-to-end (chaos.py). Wired through RemoteDataStore,
-SocketBus and the web tier; emits ``resilience.*`` metrics."""
+breakers (breaker.py), p99-delayed speculative hedging for idempotent
+reads (hedge.py), and a fault-injecting TCP proxy that proves recovery
+end-to-end (chaos.py). Wired through RemoteDataStore, SocketBus, the
+cluster scatter legs and the web tier; emits ``resilience.*``
+metrics."""
 
 from .breaker import (BreakerBoard, CircuitBreaker, CircuitOpenError)
 from .chaos import ChaosProxy
+from .hedge import HedgePolicy
 from .policy import (RetryBudget, RetryPolicy, default_retryable)
 
 __all__ = ["RetryPolicy", "RetryBudget", "default_retryable",
            "CircuitBreaker", "CircuitOpenError", "BreakerBoard",
-           "ChaosProxy"]
+           "ChaosProxy", "HedgePolicy"]

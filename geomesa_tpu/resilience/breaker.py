@@ -39,7 +39,7 @@ import threading
 import time
 from collections import deque
 
-from ..metrics import metrics
+from ..metrics import metrics, sanitize_key
 from ..utils.properties import SystemProperty
 
 __all__ = ["CircuitBreaker", "CircuitOpenError", "BreakerBoard",
@@ -245,14 +245,19 @@ class BreakerBoard:
     # -- latency ledger ----------------------------------------------------
 
     def observe(self, key: str, seconds: float):
-        """Record one successful call's latency for ``key``."""
+        """Record one successful call's latency for ``key``. The gauge
+        key is sanitized — ``key`` is often derived from request paths
+        or type names, and a hostile one (newlines, spaces, unbounded
+        length) must not corrupt the ``/rest/metrics`` registry dump
+        or a delimited report row."""
         with self._lock:
             e = self._latency.get(key)
             if e is None:
                 e = self._latency[key] = _LatencyEwma()
             e.update(seconds)
             p99_ms = e.p99_s * 1e3
-        self._registry.gauge(f"resilience.latency.p99.{key}", p99_ms)
+        self._registry.gauge(
+            f"resilience.latency.p99.{sanitize_key(key)}", p99_ms)
 
     def latency_p99_s(self, key: str) -> float | None:
         """Current p99-ish estimate for ``key`` (None before any

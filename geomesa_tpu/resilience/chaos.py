@@ -19,6 +19,11 @@ Faults (all runtime-mutable attributes):
 - ``partial_write_rate``: probability a chunk is truncated mid-write
   and the connection reset (torn frame on the wire);
 - ``bandwidth_bytes_s``: crude rate limit (sleep per chunk);
+- ``slow_rate`` + ``slow_s``: probability a CONNECTION is a straggler —
+  its first response chunk stalls ``slow_s`` before delivery. This is
+  the tail-at-scale profile hedged requests exist for: most calls are
+  fast, a random few hit a slow endpoint (GC pause, contended replica),
+  and only a speculative second attempt rescues the p99;
 - ``blackhole``: accept, read, forward NOTHING (client sees a silent
   peer and must rely on its own timeout);
 - ``drop_all()``: cut every live connection at once (partition /
@@ -60,7 +65,8 @@ class ChaosProxy:
                  reset_rate: float = 0.0, delay_s: float = 0.0,
                  jitter_s: float = 0.0, partial_write_rate: float = 0.0,
                  bandwidth_bytes_s: float | None = None,
-                 blackhole: bool = False, seed: int | None = None):
+                 blackhole: bool = False, seed: int | None = None,
+                 slow_rate: float = 0.0, slow_s: float = 0.0):
         self.upstream = (upstream_host, upstream_port)
         self.reset_rate = reset_rate
         self.delay_s = delay_s
@@ -68,10 +74,13 @@ class ChaosProxy:
         self.partial_write_rate = partial_write_rate
         self.bandwidth_bytes_s = bandwidth_bytes_s
         self.blackhole = blackhole
+        self.slow_rate = slow_rate
+        self.slow_s = slow_s
         self._rng = random.Random(seed)
         self._rng_lock = threading.Lock()
         self.stats = {"connections": 0, "resets": 0, "partial_writes": 0,
-                      "delayed_chunks": 0, "blackholed": 0, "dropped": 0}
+                      "delayed_chunks": 0, "blackholed": 0, "dropped": 0,
+                      "slowed": 0}
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -147,6 +156,13 @@ class ChaosProxy:
         reset_after = None
         if self.reset_rate > 0 and self._rand() < self.reset_rate:
             reset_after = int(self._rand() * 4096)
+        # straggler profile: decided per CONNECTION so a hedged second
+        # attempt (a fresh connection) rolls the dice again — mostly
+        # landing on a fast path, which is the whole bet of hedging
+        slow = 0.0
+        if self.slow_rate > 0 and self._rand() < self.slow_rate:
+            slow = self.slow_s
+            self.stats["slowed"] += 1
         ctl = {"forwarded": 0, "reset_after": reset_after,
                "done": threading.Event()}
         self._track(client)
@@ -154,7 +170,7 @@ class ChaosProxy:
         t1 = threading.Thread(target=self._pump, args=(client, up, ctl),
                               daemon=True)
         t2 = threading.Thread(target=self._pump, args=(up, client, ctl),
-                              daemon=True)
+                              kwargs={"stall_s": slow}, daemon=True)
         t1.start()
         t2.start()
         ctl["done"].wait()
@@ -165,7 +181,8 @@ class ChaosProxy:
             except OSError:
                 pass
 
-    def _pump(self, src: socket.socket, dst: socket.socket, ctl: dict):
+    def _pump(self, src: socket.socket, dst: socket.socket, ctl: dict,
+              stall_s: float = 0.0):
         try:
             while True:
                 try:
@@ -174,6 +191,12 @@ class ChaosProxy:
                     break
                 if not data:
                     break
+                if stall_s > 0:
+                    # straggler: one stall before the first response
+                    # chunk (total added latency = stall_s, however
+                    # many chunks follow)
+                    time.sleep(stall_s)
+                    stall_s = 0.0
                 if self.delay_s or self.jitter_s:
                     self.stats["delayed_chunks"] += 1
                     time.sleep(self.delay_s + self._rand() * self.jitter_s)

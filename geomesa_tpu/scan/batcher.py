@@ -41,11 +41,21 @@ Knobs (system properties / environment):
   ``knn()`` calls into one fused multi-query top-k dispatch
   (analytics/join.knn_batched), the way bbox queries already coalesce;
   default true. Disabled, each KNN request dispatches on its own.
+- ``geomesa.batch.latency.budget.ms``
+  (``GEOMESA_BATCH_LATENCY_BUDGET_MS``) — latency-derived batch caps:
+  derive the effective ``max_batch`` from the observed per-shape-class
+  dispatch-latency EWMA so one fused batch costs at most this budget
+  (the p99 a serving tier is willing to spend on coalescing), with the
+  static ``geomesa.batch.max.size`` staying the ceiling exactly like
+  adaptive linger. Unset (default) keeps the static cap.
 
 Metrics (global registry): ``batcher.queries``, ``batcher.batches``,
 ``batcher.coalesced``, ``batcher.occupancy``, ``batcher.coalesce_ratio``,
-``batcher.linger`` (timer), ``batcher.linger_effective_us``,
-``batcher.plan_cache.hit`` / ``.miss``, ``batcher.plan_cache.hit_rate``.
+``batcher.linger`` (timer), ``batcher.linger_effective_us.<type>``,
+``batcher.max_batch_effective.<type>``, ``batcher.queue_depth.<type>``,
+``batcher.plan_cache.hit`` / ``.miss``, ``batcher.plan_cache.hit_rate``
+(type-keyed gauges sanitize the type name — metrics/registry
+``sanitize_key``).
 """
 
 from __future__ import annotations
@@ -55,18 +65,21 @@ import time
 
 import numpy as np
 
-from ..metrics import metrics
+from ..metrics import metrics, sanitize_key
 from ..utils.properties import SystemProperty
 from .zscan import next_pow2
 
 __all__ = ["QueryBatcher", "BATCH_MAX_SIZE", "BATCH_LINGER_MICROS",
-           "BATCH_LINGER_ADAPTIVE", "KNN_BATCH"]
+           "BATCH_LINGER_ADAPTIVE", "KNN_BATCH",
+           "BATCH_LATENCY_BUDGET_MS"]
 
 BATCH_MAX_SIZE = SystemProperty("geomesa.batch.max.size", "32")
 BATCH_LINGER_MICROS = SystemProperty("geomesa.batch.linger.micros", "2000")
 BATCH_LINGER_ADAPTIVE = SystemProperty("geomesa.batch.linger.adaptive",
                                        "true")
 KNN_BATCH = SystemProperty("geomesa.knn.batch", "true")
+BATCH_LATENCY_BUDGET_MS = SystemProperty("geomesa.batch.latency.budget.ms",
+                                         None)
 
 # EWMA smoothing for the per-schema inter-arrival estimate: the most
 # recent ~5 arrivals dominate, so the estimate tracks load shifts
@@ -124,6 +137,7 @@ class QueryBatcher:
 
     def __init__(self, store, max_batch: int | None = None,
                  linger_us: float | None = None, adaptive: bool | None = None,
+                 latency_budget_ms: float | None = None,
                  registry=metrics):
         self.store = store
         self.max_batch = int(max_batch if max_batch is not None
@@ -133,6 +147,7 @@ class QueryBatcher:
         self.adaptive = (adaptive if adaptive is not None
                          else str(BATCH_LINGER_ADAPTIVE.get()).lower()
                          in ("true", "1", "yes"))
+        self._latency_budget_override = latency_budget_ms
         self.registry = registry
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -143,6 +158,12 @@ class QueryBatcher:
         # the trace is reused. Tracking it here (not in jax) gives the
         # serving layer observable recompile behavior.
         self._plan_keys: set[tuple] = set()
+        # latency-derived batch caps: per shape-class EWMA of the
+        # per-query cost of one fused dispatch (elapsed / occupancy)
+        # and the last observed shape class per type, so the effective
+        # cap can be read without touching the store
+        self._cost_ewma: dict[tuple, float] = {}
+        self._last_shape: dict[str, tuple] = {}
         self._in_flight = 0
         self.total_queries = 0
         self.coalesced_queries = 0
@@ -169,13 +190,16 @@ class QueryBatcher:
             tq = self._queues.setdefault(q.type_name, _TypeQueue())
             tq.observe_arrival(time.monotonic())
             tq.items.append(p)
+            depth = len(tq.items)
             if not tq.has_leader:
                 tq.has_leader = True
                 leader = True
             else:
                 leader = False
-                if len(tq.items) >= self.max_batch:
+                if depth >= self.effective_max_batch(q.type_name):
                     self._cond.notify_all()
+        self.registry.gauge(
+            f"batcher.queue_depth.{sanitize_key(q.type_name)}", depth)
         if not leader:
             return p.get()
         self._lead(q.type_name, tq)
@@ -198,13 +222,16 @@ class QueryBatcher:
             tq = self._queues.setdefault(key, _TypeQueue())
             tq.observe_arrival(time.monotonic())
             tq.items.append(p)
+            depth = len(tq.items)
             if not tq.has_leader:
                 tq.has_leader = True
                 leader = True
             else:
                 leader = False
-                if len(tq.items) >= self.max_batch:
+                if depth >= self.max_batch:
                     self._cond.notify_all()
+        self.registry.gauge(
+            f"batcher.queue_depth.{sanitize_key(key)}", depth)
         if not leader:
             return p.get()
         self._lead(key, tq,
@@ -243,22 +270,26 @@ class QueryBatcher:
             # followers already queued behind this leader. An idle
             # singleton dispatches immediately — a lone query must not
             # see the linger window as added latency.
+            cap = self.effective_max_batch(type_name)
             linger_s = self._effective_linger_s(tq)
-            self.registry.gauge("batcher.linger_effective_us",
-                                linger_s * 1e6)
+            self.registry.gauge(
+                "batcher.linger_effective_us."
+                f"{sanitize_key(type_name)}", linger_s * 1e6)
             if linger_s > 0 and (self._in_flight > 0
                                  or len(tq.items) > 1):
                 deadline = time.monotonic() + linger_s
-                while len(tq.items) < self.max_batch:
+                while len(tq.items) < cap:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
             while tq.items:
-                chunks.append(tq.items[:self.max_batch])
-                del tq.items[:self.max_batch]
+                chunks.append(tq.items[:cap])
+                del tq.items[:cap]
             tq.has_leader = False
             self._in_flight += 1
+        self.registry.gauge(
+            f"batcher.queue_depth.{sanitize_key(type_name)}", 0)
         self._observe_linger(time.perf_counter() - t0)
         dispatch = dispatch or self._dispatch
         try:
@@ -298,13 +329,21 @@ class QueryBatcher:
     def _dispatch(self, type_name: str, chunk: list[_Pending]):
         occupancy = len(chunk)
         self._note(occupancy)
+        shape = self._shape_key(type_name, occupancy)
         try:
             if occupancy == 1:
                 results = [self.store.query(chunk[0].q)]
             else:
-                self._probe_plan_cache(type_name, occupancy)
+                self._probe_plan_cache(shape)
+                t0 = time.perf_counter()
                 results = self.store.query_batched(
                     [p.q for p in chunk])
+                # only FUSED dispatches feed the cost EWMA: the cap
+                # decision is about how many queries one fused launch
+                # can carry inside the budget, and the scalar fast
+                # path has a different cost profile entirely
+                self._observe_cost(type_name, shape,
+                                   (time.perf_counter() - t0) / occupancy)
             for p, r in zip(chunk, results):
                 p.resolve(result=r)
         except Exception:
@@ -363,8 +402,7 @@ class QueryBatcher:
         reg.gauge("batcher.occupancy", occupancy)
         reg.gauge("batcher.coalesce_ratio", co / total if total else 0.0)
 
-    def _probe_plan_cache(self, type_name: str, occupancy: int):
-        key = self._shape_key(type_name, occupancy)
+    def _probe_plan_cache(self, key: tuple):
         with self._lock:
             hit = key in self._plan_keys
             if hit:
@@ -378,6 +416,57 @@ class QueryBatcher:
                     else "batcher.plan_cache.miss")
         reg.gauge("batcher.plan_cache.hit_rate",
                   hits / (hits + misses) if hits + misses else 0.0)
+
+    # -- latency-derived batch caps ----------------------------------------
+
+    def _latency_budget_s(self) -> float | None:
+        """Per-dispatch wall budget driving the effective batch cap;
+        None (the default) disables the derivation entirely."""
+        if self._latency_budget_override is not None:
+            return float(self._latency_budget_override) / 1e3
+        ms = BATCH_LATENCY_BUDGET_MS.as_float()
+        return None if ms is None else ms / 1e3
+
+    def _observe_cost(self, type_name: str, shape: tuple,
+                      per_query_s: float):
+        """Fold one dispatch's per-query cost into the shape-class EWMA.
+        Keyed by (type, index_version, data cap) — the part of the
+        shape class that predicts kernel cost independent of how many
+        queries happened to coalesce this time."""
+        cls = shape[:3]
+        with self._lock:
+            prev = self._cost_ewma.get(cls)
+            self._cost_ewma[cls] = (
+                per_query_s if prev is None
+                else _EWMA_ALPHA * per_query_s
+                + (1.0 - _EWMA_ALPHA) * prev)
+            self._last_shape[type_name] = cls
+
+    def effective_max_batch(self, type_name: str) -> int:
+        """The batch cap actually in force for ``type_name``: the
+        static knob, shrunk so one fused dispatch fits the latency
+        budget given the shape class's observed per-query cost. Pure
+        dict reads (never touches the store) so it is safe under the
+        admission lock; no budget or no cost samples yet -> the static
+        ceiling, mirroring adaptive linger's cold-start behavior."""
+        budget_s = self._latency_budget_s()
+        if budget_s is None or budget_s <= 0:
+            return self.max_batch
+        cls = self._last_shape.get(type_name)
+        cost = self._cost_ewma.get(cls) if cls is not None else None
+        if not cost or cost <= 0:
+            return self.max_batch
+        eff = min(self.max_batch, max(1, int(budget_s / cost)))
+        self.registry.gauge(
+            f"batcher.max_batch_effective.{sanitize_key(type_name)}", eff)
+        return eff
+
+    def queue_depths(self) -> dict[str, int]:
+        """Per-type pending-queue depth snapshot (the ``/rest/health``
+        batcher detail)."""
+        with self._lock:
+            return {k: len(tq.items) for k, tq in self._queues.items()
+                    if tq.items}
 
     def _shape_key(self, type_name: str, occupancy: int) -> tuple:
         """(type_name, index_version, padded data cap, padded batch

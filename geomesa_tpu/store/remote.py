@@ -31,7 +31,9 @@ import numpy as np
 
 from ..features.batch import FeatureBatch
 from ..features.sft import SimpleFeatureType, parse_spec
-from ..resilience import BreakerBoard, RetryBudget, RetryPolicy
+from ..resilience import (BreakerBoard, HedgePolicy, RetryBudget,
+                          RetryPolicy)
+from ..resilience.breaker import CLOSED
 from ..index.api import FilterStrategy, Query, QueryHints
 from .api import DataStore
 
@@ -71,12 +73,22 @@ class RemoteDataStore(DataStore):
     calls — every GET, plus connect-phase failures and 503 sheds on
     writes — retry with full-jitter backoff under a shared retry
     budget, and a per-endpoint circuit breaker fast-fails once an
-    endpoint looks dead instead of burning ``timeout_s`` per call."""
+    endpoint looks dead instead of burning ``timeout_s`` per call.
+
+    Idempotent GETs additionally HEDGE (resilience/hedge.py): once an
+    endpoint's latency EWMA has a p99-ish estimate, each attempt waits
+    that long for an answer, then launches one speculative second
+    attempt — first success wins, the loser is discarded. Hedges are
+    charged to the same retry budget, never fire on writes, and are
+    suppressed while the endpoint's breaker isn't CLOSED (a sick
+    endpoint needs shed load, not doubled load). ``hedge=False``
+    disables; a ``HedgePolicy`` instance overrides the default."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 60.0,
                  auth_token: str | None = None,
                  retry_policy: RetryPolicy | None = None,
-                 breakers: BreakerBoard | None = None):
+                 breakers: BreakerBoard | None = None,
+                 hedge: HedgePolicy | bool | None = None):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
@@ -85,6 +97,12 @@ class RemoteDataStore(DataStore):
         self._retry = retry_policy if retry_policy is not None \
             else RetryPolicy(budget=RetryBudget())
         self._breakers = breakers if breakers is not None else BreakerBoard()
+        if hedge is False:
+            self._hedge = None
+        elif hedge is None or hedge is True:
+            self._hedge = HedgePolicy(budget=self._retry.budget)
+        else:
+            self._hedge = hedge
 
     # -- transport ---------------------------------------------------------
 
@@ -117,7 +135,27 @@ class RemoteDataStore(DataStore):
             self._breakers.observe(endpoint, time.perf_counter() - t0)
             return out
 
-        return self._retry.call(attempt, name=f"remote.{endpoint}")
+        return self._retry.call(self._maybe_hedged(attempt, breaker,
+                                                   endpoint, idempotent),
+                                name=f"remote.{endpoint}")
+
+    def _maybe_hedged(self, attempt, breaker, endpoint: str,
+                      idempotent: bool):
+        """Wrap one retry attempt in a speculative hedge when every
+        eligibility gate passes; otherwise return it untouched. Gates,
+        re-checked per call so a flipped knob or a tripped breaker
+        takes effect immediately: hedging configured and enabled,
+        the call is idempotent (a hedge executes twice; only reads
+        survive that invisibly), the breaker is CLOSED, and the
+        endpoint has a latency estimate to derive the delay from."""
+        if self._hedge is None or not idempotent \
+                or not HedgePolicy.enabled() or breaker.state != CLOSED:
+            return attempt
+        delay = self._hedge.delay_s(self._breakers.latency_p99_s(endpoint))
+        if delay is None:
+            return attempt
+        return lambda: self._hedge.call(attempt, delay,
+                                        name=f"remote.{endpoint}")
 
     def _do_request(self, method, path, params, body, idempotent):
         qs = ("?" + urlencode(params)) if params else ""

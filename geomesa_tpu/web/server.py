@@ -104,14 +104,18 @@ class GeoMesaWebServer:
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
                  audit=None, auth_token: str | None = None,
                  batcher=None, max_inflight: int | None = None):
-        from ..scan.batcher import QueryBatcher
+        from ..scan.registry import shared_batcher
         self.store = store
         self.audit = audit if audit is not None \
             else getattr(store, "audit", None)
         self.auth_token = (auth_token if auth_token is not None
                            else WEB_AUTH_TOKEN.get())
         if batcher is None and hasattr(store, "query_batched"):
-            batcher = QueryBatcher(store)
+            # process-wide registry, not a private instance: embedded
+            # callers using shared_batcher(store) coalesce into the
+            # SAME fused dispatches as web requests and share one
+            # warmed plan cache (scan/registry.py)
+            batcher = shared_batcher(store)
         self.batcher = batcher
         self.max_inflight = (max_inflight if max_inflight is not None
                              else WEB_MAX_INFLIGHT.as_int())
@@ -166,6 +170,7 @@ class GeoMesaWebServer:
                 {"status": "ok", "version": _version,
                  "uptime_s": round(time.monotonic() - self._started_at, 3),
                  "resilience": self._resilience_detail(),
+                 "batcher": self._batcher_detail(),
                  "durability": self._durability_detail()})
         if method == "GET" and parts == ["ready"]:
             return self._ready()
@@ -241,6 +246,17 @@ class GeoMesaWebServer:
             if cause is not None:
                 out["cause"] = repr(cause)
         return out
+
+    def _batcher_detail(self) -> dict | None:
+        """Serving-tier batcher health: per-type pending-queue depth
+        across the process-wide registry (every caller coalescing into
+        this process, not just the web tier) plus this server's own
+        batcher counters. None when the store can't batch."""
+        if self.batcher is None:
+            return None
+        from ..scan.registry import batcher_registry
+        return {"queue_depths": batcher_registry.queue_depths(),
+                "stats": self.batcher.stats()}
 
     def _resilience_detail(self) -> dict:
         """Per-endpoint latency estimates for the health surface — the

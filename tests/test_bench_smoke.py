@@ -91,6 +91,33 @@ def test_config12_cache_smoke():
 
 
 @pytest.mark.bench_smoke
+@pytest.mark.chaos
+def test_config13_tail_latency_smoke():
+    rng = np.random.default_rng(46)
+    c = bench.bench_config13(rng, n=3000, c_web=2, c_emb=2, nq=25,
+                             slow_s=0.12)
+    co = c["coalesce"]
+    # the tentpole contract: web tier + embedded callers hold the SAME
+    # registry batcher and land in ONE fused dispatch, id-exact
+    assert co["registry_shared_instance"] is True
+    assert co["fused_dispatches"] == 1
+    assert co["single_fused_dispatch"] is True
+    assert co["coalesced_queries"] == co["callers"] == 4
+    assert co["ids_exact"] is True
+    assert co["health_has_batcher"] is True
+    bc = c["batch_caps"]
+    assert bc["uncapped_without_budget"] is True
+    assert bc["derived_below_static"] is True
+    assert bc["effective_max_batch"] < bc["static_max_batch"]
+    h = c["hedged"]
+    assert h["ids_exact"] is True
+    assert h["budget_ok"] is True
+    assert h["wins"] + h["losses"] <= h["attempts"]
+    assert c["unhedged"]["requests"] == h["requests"] == 25
+    assert "hedge_p99_speedup" in c  # the full-size run gates on it
+
+
+@pytest.mark.bench_smoke
 def test_load_gate_reports_without_exiting(monkeypatch, capsys):
     monkeypatch.setattr(bench, "LOAD_MAX", 0.0)   # force over-ceiling
     monkeypatch.setattr(bench, "LOAD_WAIT_S", 0.0)

@@ -1,0 +1,376 @@
+"""Tail-latency serving tier: HedgePolicy edge cases (deterministic
+fake-clock timing, loser discard, budget fallback, breaker gating),
+metric-key sanitization against hostile type names, the process-wide
+BatcherRegistry (identity, reopen survival, kill switch), and the
+latency-derived batch caps."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.index.api import Query
+from geomesa_tpu.metrics import MetricsRegistry, sanitize_key
+from geomesa_tpu.resilience import BreakerBoard, HedgePolicy, RetryBudget
+from geomesa_tpu.resilience.hedge import HEDGE_ENABLED
+from geomesa_tpu.scan.batcher import QueryBatcher
+from geomesa_tpu.scan.registry import (BATCHER_REGISTRY_ENABLED,
+                                       BatcherRegistry, shared_batcher,
+                                       store_identity)
+from geomesa_tpu.store import InMemoryDataStore
+
+
+def _counter(reg, name):
+    return reg.snapshot()["counters"].get(name, 0)
+
+
+def _wait_counter(reg, name, want, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while _counter(reg, name) < want:
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"{name} never reached {want} "
+                f"(at {_counter(reg, name)})")
+        time.sleep(0.002)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _fake_wait(clock):
+    """Advance the fake clock by exactly the requested timeout, then
+    briefly park on the condition so attempt threads can deliver."""
+
+    def wait(cond, timeout):
+        if timeout is not None:
+            clock.t += timeout
+        cond.wait(0.05)
+
+    return wait
+
+
+# -- metric-key sanitization ----------------------------------------------
+
+class TestSanitizeKey:
+    def test_strips_hostile_characters_and_caps_length(self):
+        assert sanitize_key("query") == "query"
+        assert sanitize_key("a b\nc\td") == "a_b_c_d"
+        assert "\n" not in sanitize_key("evil\nkey\r\n")
+        assert len(sanitize_key("x" * 500)) == 64
+        assert sanitize_key("") == "_"
+        # survives the delimited-report row format too
+        assert "\t" not in sanitize_key("a\tb")
+
+    def test_breaker_observe_sanitizes_gauge_keys(self):
+        reg = MetricsRegistry()
+        board = BreakerBoard(registry=reg)
+        hostile = "ships\nresilience.latency.p99.forged 999"
+        board.observe(hostile, 0.01)
+        gauges = reg.snapshot()["gauges"]
+        assert all("\n" not in k and " " not in k for k in gauges)
+        key = f"resilience.latency.p99.{sanitize_key(hostile)}"
+        assert key in gauges
+        # the raw-key ledger still answers for the original name
+        assert board.latency_p99_s(hostile) is not None
+
+
+# -- HedgePolicy ----------------------------------------------------------
+
+class TestHedgeDelay:
+    def test_no_estimate_means_no_hedge(self):
+        assert HedgePolicy(min_delay_s=0.01).delay_s(None) is None
+
+    def test_delay_is_p99_floored_at_min(self):
+        hp = HedgePolicy(min_delay_s=0.010)
+        assert hp.delay_s(0.050) == pytest.approx(0.050)
+        assert hp.delay_s(0.001) == pytest.approx(0.010)
+
+
+class TestHedgeCall:
+    def test_fast_first_attempt_never_hedges(self):
+        reg = MetricsRegistry()
+        hp = HedgePolicy(registry=reg)
+        assert hp.call(lambda: "v", 0.5) == "v"
+        assert _counter(reg, "resilience.hedge.attempts") == 0
+
+    def test_hedge_fires_exactly_at_p99_delay_fake_clock(self):
+        clock = _FakeClock()
+        reg = MetricsRegistry()
+        hp = HedgePolicy(registry=reg, clock=clock,
+                         wait=_fake_wait(clock))
+        release_first = threading.Event()
+        hedge_at = []
+        calls = [0]
+        lock = threading.Lock()
+
+        def fn():
+            with lock:
+                calls[0] += 1
+                mine = calls[0]
+            if mine == 1:
+                release_first.wait(10.0)  # first attempt: straggler
+                return "slow"
+            return "fast"
+
+        delay = 0.075
+        got = hp.call(fn, delay,
+                      on_hedge=lambda: hedge_at.append(clock.t))
+        assert got == "fast"
+        # the backup launched exactly when the p99-derived delay
+        # elapsed on the (fake) clock, not earlier, not later
+        assert hedge_at == [pytest.approx(delay)]
+        assert _counter(reg, "resilience.hedge.attempts") == 1
+        assert _counter(reg, "resilience.hedge.wins") == 1
+        # the straggler finishes later: discarded, never delivered
+        release_first.set()
+        _wait_counter(reg, "resilience.hedge.cancelled", 1)
+
+    def test_loser_result_discarded_no_double_delivery(self):
+        reg = MetricsRegistry()
+        hp = HedgePolicy(registry=reg, min_delay_s=0.0)
+        release_first = threading.Event()
+        delivered = []
+        calls = [0]
+        lock = threading.Lock()
+
+        def fn():
+            with lock:
+                calls[0] += 1
+                mine = calls[0]
+            if mine == 1:
+                release_first.wait(10.0)
+                return "loser"
+            return "winner"
+
+        delivered.append(hp.call(fn, 0.005))
+        release_first.set()
+        _wait_counter(reg, "resilience.hedge.cancelled", 1)
+        assert delivered == ["winner"]
+        assert _counter(reg, "resilience.hedge.wins") == 1
+        assert _counter(reg, "resilience.hedge.losses") == 0
+
+    def test_budget_exhausted_degrades_to_single_attempt(self):
+        reg = MetricsRegistry()
+        hp = HedgePolicy(budget=RetryBudget(capacity=0.0), registry=reg)
+
+        def fn():
+            time.sleep(0.03)
+            return "v"
+
+        # delay 0 wants to hedge immediately; the drained budget says
+        # no, and the call must still resolve off the single attempt
+        assert hp.call(fn, 0.0) == "v"
+        assert _counter(reg, "resilience.hedge.attempts") == 0
+        assert _counter(reg, "resilience.hedge.suppressed.budget") >= 1
+
+    def test_failed_first_attempt_hedges_immediately(self):
+        reg = MetricsRegistry()
+        hp = HedgePolicy(registry=reg)
+        calls = [0]
+        lock = threading.Lock()
+
+        def fn():
+            with lock:
+                calls[0] += 1
+                mine = calls[0]
+            if mine == 1:
+                raise ConnectionError("first attempt died")
+            return "v"
+
+        # huge delay: only the fail-fast path can launch the backup
+        assert hp.call(fn, 10.0) == "v"
+        assert _counter(reg, "resilience.hedge.attempts") == 1
+
+    def test_all_attempts_failing_raises_last_error(self):
+        hp = HedgePolicy(registry=MetricsRegistry())
+
+        def fn():
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError, match="down"):
+            hp.call(fn, 0.001)
+
+
+class TestRemoteHedgeGating:
+    """RemoteDataStore._maybe_hedged eligibility gates, exercised
+    without a server: the wrapper must return the attempt UNCHANGED
+    (no hedging) unless every gate passes."""
+
+    def _store(self):
+        from geomesa_tpu.store.remote import RemoteDataStore
+        return RemoteDataStore("127.0.0.1", 1)
+
+    def test_hedges_only_with_estimate_and_closed_breaker(self):
+        ds = self._store()
+        breaker = ds._breakers.get("query")
+        attempt = lambda: "x"  # noqa: E731
+        # no latency estimate yet -> untouched
+        assert ds._maybe_hedged(attempt, breaker, "query", True) is attempt
+        ds._breakers.observe("query", 0.02)
+        # estimate + closed breaker -> wrapped
+        wrapped = ds._maybe_hedged(attempt, breaker, "query", True)
+        assert wrapped is not attempt
+        assert wrapped() == "x"
+
+    def test_never_hedges_non_idempotent(self):
+        ds = self._store()
+        ds._breakers.observe("write", 0.02)
+        breaker = ds._breakers.get("write")
+        attempt = lambda: "x"  # noqa: E731
+        assert ds._maybe_hedged(attempt, breaker, "write",
+                                False) is attempt
+
+    def test_suppressed_while_breaker_open(self):
+        ds = self._store()
+        ds._breakers.observe("query", 0.02)
+        breaker = ds._breakers.get("query")
+        for _ in range(breaker.failure_threshold):
+            breaker.failure()
+        assert breaker.state == "open"
+        attempt = lambda: "x"  # noqa: E731
+        assert ds._maybe_hedged(attempt, breaker, "query",
+                                True) is attempt
+
+    def test_kill_switch(self):
+        ds = self._store()
+        ds._breakers.observe("query", 0.02)
+        breaker = ds._breakers.get("query")
+        attempt = lambda: "x"  # noqa: E731
+        HEDGE_ENABLED.set("false")
+        try:
+            assert ds._maybe_hedged(attempt, breaker, "query",
+                                    True) is attempt
+        finally:
+            HEDGE_ENABLED.set(None)
+
+    def test_hedge_false_ctor_disables(self):
+        from geomesa_tpu.store.remote import RemoteDataStore
+        ds = RemoteDataStore("127.0.0.1", 1, hedge=False)
+        ds._breakers.observe("query", 0.02)
+        breaker = ds._breakers.get("query")
+        attempt = lambda: "x"  # noqa: E731
+        assert ds._maybe_hedged(attempt, breaker, "query",
+                                True) is attempt
+
+
+# -- BatcherRegistry ------------------------------------------------------
+
+def _fill(ds, tn, n=200, seed=3):
+    ds.create_schema(parse_spec(tn, "*geom:Point:srid=4326"))
+    rng = np.random.default_rng(seed)
+    ds.write_dict(tn, [f"{tn}{i}" for i in range(n)],
+                  {"geom": (rng.uniform(-180, 180, n),
+                            rng.uniform(-90, 90, n))})
+
+
+class TestBatcherRegistry:
+    def test_object_identity_keeps_plain_stores_separate(self):
+        reg = BatcherRegistry(registry=MetricsRegistry())
+        a, b = InMemoryDataStore(), InMemoryDataStore()
+        assert reg.get(a) is reg.get(a)
+        assert reg.get(a) is not reg.get(b)
+
+    def test_remote_identity_is_host_port(self):
+        from geomesa_tpu.store.remote import RemoteDataStore
+        a = RemoteDataStore("10.0.0.1", 8080)
+        b = RemoteDataStore("10.0.0.1", 8080)
+        c = RemoteDataStore("10.0.0.1", 8081)
+        assert store_identity(a) == store_identity(b)
+        assert store_identity(a) != store_identity(c)
+
+    def test_survives_store_reopen(self, tmp_path):
+        reg = BatcherRegistry(registry=MetricsRegistry())
+        root = str(tmp_path / "store")
+        ds1 = InMemoryDataStore(durable_dir=root, wal_fsync="never")
+        _fill(ds1, "pts")
+        b1 = reg.get(ds1)
+        assert b1.store is ds1
+        ds1.close()
+        ds2 = InMemoryDataStore(durable_dir=root, wal_fsync="never")
+        b2 = reg.get(ds2)
+        # same identity -> same batcher (warmed caches survive),
+        # rebound to the live store object
+        assert b2 is b1
+        assert b2.store is ds2
+        got = b2.query(Query("pts", "BBOX(geom, -180, -90, 180, 90)"))
+        assert got.n == 200
+        ds2.close()
+
+    def test_kill_switch_returns_private_batcher(self):
+        ds = InMemoryDataStore()
+        BATCHER_REGISTRY_ENABLED.set("false")
+        try:
+            a, b = shared_batcher(ds), shared_batcher(ds)
+        finally:
+            BATCHER_REGISTRY_ENABLED.set(None)
+        assert a is not b
+
+    def test_queue_depths_aggregate(self):
+        reg = BatcherRegistry(registry=MetricsRegistry())
+        ds = InMemoryDataStore()
+        _fill(ds, "pts")
+        b = reg.get(ds)
+        assert reg.queue_depths() == {}
+        b.query(Query("pts", "BBOX(geom, -10, -10, 10, 10)"))
+        assert reg.queue_depths() == {}  # drained queues drop out
+
+
+# -- latency-derived batch caps -------------------------------------------
+
+class TestLatencyDerivedCaps:
+    def _seeded(self, budget_ms):
+        ds = InMemoryDataStore()
+        _fill(ds, "pts")
+        b = QueryBatcher(ds, max_batch=32, linger_us=0,
+                         latency_budget_ms=budget_ms,
+                         registry=MetricsRegistry())
+        # seed the shape-class cost EWMA: 10ms per query observed
+        shape = b._shape_key("pts", 8)
+        b._observe_cost("pts", shape, 0.010)
+        return b
+
+    def test_budget_shrinks_cap_static_stays_ceiling(self):
+        b = self._seeded(budget_ms=25.0)   # 25ms / 10ms -> 2 queries
+        assert b.effective_max_batch("pts") == 2
+
+    def test_generous_budget_clamps_to_static(self):
+        b = self._seeded(budget_ms=10_000.0)
+        assert b.effective_max_batch("pts") == 32
+
+    def test_tiny_budget_floors_at_one(self):
+        b = self._seeded(budget_ms=0.001)
+        assert b.effective_max_batch("pts") == 1
+
+    def test_no_budget_keeps_static_cap(self):
+        b = self._seeded(budget_ms=None)
+        assert b.effective_max_batch("pts") == 32
+
+    def test_no_observations_keeps_static_cap(self):
+        ds = InMemoryDataStore()
+        _fill(ds, "pts")
+        b = QueryBatcher(ds, max_batch=16, linger_us=0,
+                         latency_budget_ms=1.0,
+                         registry=MetricsRegistry())
+        assert b.effective_max_batch("pts") == 16
+
+    def test_linger_gauge_keyed_per_type(self):
+        reg = MetricsRegistry()
+        ds = InMemoryDataStore()
+        _fill(ds, "ships", seed=1)
+        _fill(ds, "planes", seed=2)
+        b = QueryBatcher(ds, max_batch=4, linger_us=100, registry=reg)
+        b.query(Query("ships", "BBOX(geom, -10, -10, 10, 10)"))
+        b.query(Query("planes", "BBOX(geom, -10, -10, 10, 10)"))
+        gauges = reg.snapshot()["gauges"]
+        assert "batcher.linger_effective_us.ships" in gauges
+        assert "batcher.linger_effective_us.planes" in gauges
+        # the old schema-oblivious key must be gone: one schema's
+        # linger no longer overwrites another's
+        assert "batcher.linger_effective_us" not in gauges
