@@ -51,6 +51,7 @@ e.g. `--only 9_replicated_reads`),
 GEOMESA_TPU_BENCH_WAL_ROWS (1M — config #7 ingest/recovery size),
 GEOMESA_TPU_BENCH_CHAOS_QUERIES (300 — config #8 stream length),
 GEOMESA_TPU_BENCH_REPL_QUERIES (400 — config #9 read stream length),
+GEOMESA_TPU_BENCH_STREAM_N (1M — config #14 streamed scan size),
 GEOMESA_TPU_BENCH_LOAD_MAX (1.5 — 1-minute load-average ceiling: runs
 on a busier host are flagged `load_ok: false` in the JSON),
 GEOMESA_TPU_BENCH_LOAD_WAIT_S (0 — if > 0, wait up to this long for
@@ -143,7 +144,7 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,6,7,8,9,10,11,12,13,northstar")
+                             "1,2,3,4,5,6,7,8,9,10,11,12,13,14,northstar")
               .split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
@@ -1868,6 +1869,116 @@ def bench_config13(rng, n=None, c_web=None, c_emb=None, nq=None,
     return out
 
 
+# -- config 14: streaming result plane ------------------------------------
+
+def bench_config14(rng, n=None, batch_rows=None):
+    """What the streaming result plane buys, in three gates.
+
+    (A) Time-to-first-batch: a remote ``query_stream`` must hand the
+        client its first record batch while the server is still
+        encoding the rest — gate: TTFB < 10% of the materialized
+        ``arrow_ipc`` fetch of the same hits.
+    (B) Constant client memory: tracemalloc peak while draining the
+        stream (batches discarded as consumed) must stay under two
+        wire batches' worth — the client never holds the result.
+    (C) Byte-exact reconstruction: reassembling the streamed batches
+        (arrow/delta.reassemble_ipc) must reproduce the materialized
+        IPC payload byte-for-byte on the quiesced store.
+    """
+    import tracemalloc
+
+    from geomesa_tpu.arrow.delta import iter_ipc, reassemble_ipc
+    from geomesa_tpu.features import parse_spec
+    from geomesa_tpu.index.api import Query
+    from geomesa_tpu.store import InMemoryDataStore
+    from geomesa_tpu.store.remote import RemoteDataStore
+    from geomesa_tpu.web.server import GeoMesaWebServer
+
+    n = int(n if n is not None
+            else os.environ.get("GEOMESA_TPU_BENCH_STREAM_N", 1_000_000))
+    rows = int(batch_rows if batch_rows is not None else 8096)
+    out = {"n": n, "batch_rows": rows}
+
+    ds = InMemoryDataStore()
+    ds.create_schema(parse_spec("s14", "dtg:Date,*geom:Point:srid=4326"))
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    ms = rng.integers(T0_DAY * MS_DAY, T1_DAY * MS_DAY, n).astype(np.int64)
+    ds.write_dict("s14", np.arange(n).astype(str).astype(object),
+                  {"dtg": ms, "geom": (x, y)})
+    del x, y, ms
+
+    server = GeoMesaWebServer(ds).start()
+    try:
+        client = RemoteDataStore("127.0.0.1", server.port, hedge=False)
+        client.get_schema("s14")
+        q = Query("s14")
+
+        # -- (A) TTFB vs the materialized fetch ---------------------------
+        t0 = time.perf_counter()
+        payload = client.arrow_ipc("s14")
+        full_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        stream = iter(client.query_stream(q, batch_rows=rows))
+        first = next(stream)
+        ttfb_s = time.perf_counter() - t0
+        streamed = first.n + sum(b.n for b in stream)
+        total_s = time.perf_counter() - t0
+        out["ttfb"] = {
+            "rows_streamed": int(streamed),
+            "materialized_fetch_s": round(full_s, 4),
+            "ttfb_s": round(ttfb_s, 4),
+            "stream_total_s": round(total_s, 4),
+            "ttfb_fraction": round(ttfb_s / max(full_s, 1e-9), 4),
+            "ttfb_under_10pct": bool(ttfb_s < 0.10 * full_s)}
+
+        # -- (B) constant-memory drain ------------------------------------
+        # "one batch's worth" is measured, not assumed: the tracemalloc
+        # peak of pulling a single warm batch (decode + python-side id
+        # strings). Phase A already warmed the server-side caches, so
+        # neither measurement below sees the server thread's one-time
+        # result materialization (server and client share this process).
+        wire_bytes = int(first.to_arrow().nbytes)
+        tracemalloc.start()
+        tracemalloc.reset_peak()
+        probe = iter(client.query_stream(q, batch_rows=rows))
+        next(probe)
+        _, batch_peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        for _ in probe:
+            pass
+        tracemalloc.start()
+        tracemalloc.reset_peak()
+        drained = 0
+        for b in client.query_stream(q, batch_rows=rows):
+            drained += b.n
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        out["client_memory"] = {
+            "rows_drained": int(drained),
+            "wire_batch_bytes": wire_bytes,
+            "one_batch_peak_bytes": int(batch_peak),
+            "drain_peak_bytes": int(peak),
+            "peak_batches": round(peak / max(batch_peak, 1), 2),
+            "under_two_batches": bool(peak < 2 * batch_peak)}
+
+        # -- (C) byte-exact reconstruction --------------------------------
+        rebuilt = reassemble_ipc(client.get_schema("s14"),
+                                 client.query_stream(q, batch_rows=rows))
+        out["reconstruction"] = {
+            "materialized_bytes": len(payload),
+            "rebuilt_bytes": len(rebuilt),
+            "byte_exact": bool(rebuilt == payload)}
+        out["gates_pass"] = bool(
+            out["ttfb"]["ttfb_under_10pct"]
+            and out["client_memory"]["under_two_batches"]
+            and out["reconstruction"]["byte_exact"]
+            and streamed == n and drained == n)
+    finally:
+        server.stop()
+    return out
+
+
 # -- config 10: storage integrity — scrub overhead + corrupt recovery -----
 
 def bench_config10(rng):
@@ -2134,6 +2245,8 @@ def main(argv=None):
 
     if "13" in CONFIGS:
         out["configs"]["13_tail_latency"] = bench_config13(rng)
+    if "14" in CONFIGS:
+        out["configs"]["14_streaming"] = bench_config14(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

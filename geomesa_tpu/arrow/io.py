@@ -31,7 +31,17 @@ def _schema_meta(sft: SimpleFeatureType) -> dict:
 class FeatureArrowFileWriter:
     """Stream FeatureBatches to an Arrow IPC file, re-chunked to a fixed
     vector capacity; SFT name/spec ride in the schema metadata so the
-    file is self-describing."""
+    file is self-describing.
+
+    The IPC *file* format allows exactly one dictionary per field
+    (no deltas/replacement), but incremental feeds — the streaming
+    scatter merges — hand this writer chunks whose vocabularies differ.
+    String columns therefore encode against a per-attribute global
+    ``ArrowDictionary`` that only appends; encoded batches buffer until
+    ``close``, when every batch is emitted against the one final
+    dictionary (valid for all of them, since each batch's codes index a
+    prefix). A single-vocabulary feed produces byte-identical output to
+    the old direct-write path."""
 
     def __init__(self, sink, sft: SimpleFeatureType,
                  batch_size: int = DEFAULT_BATCH_SIZE):
@@ -45,6 +55,11 @@ class FeatureArrowFileWriter:
         schema = probe.to_arrow().schema.with_metadata(_schema_meta(sft))
         self._writer = pa.ipc.new_file(sink, schema)
         self._schema = schema
+        from .vector import ArrowDictionary
+        self._dicts = {a.name: ArrowDictionary()
+                       for a in sft.attributes if a.type.name == "String"}
+        # (record batch, {string col -> (global codes, null mask)})
+        self._buffered: list = []
 
     def write(self, batch: FeatureBatch):
         self._pending = (batch if self._pending is None
@@ -58,16 +73,43 @@ class FeatureArrowFileWriter:
     def _flush(self, batch: FeatureBatch):
         import pyarrow as pa
         rb = batch.to_arrow()
-        # unify dictionaries with the declared schema by casting
+        # unify non-dictionary column types with the declared schema
         table = pa.Table.from_batches([rb]).cast(pa.schema(
             [self._schema.field(i) for i in range(len(self._schema.names))]))
+        recodes = {}
+        for name, d in self._dicts.items():
+            col = batch.columns[name]
+            vocab = [str(v) for v in col.vocab]
+            remap = (np.asarray(d.add_all(vocab), dtype=np.int32)
+                     if vocab else np.empty(0, dtype=np.int32))
+            null = col.codes < 0
+            gcodes = np.zeros(len(col.codes), dtype=np.int32)
+            if len(remap):
+                gcodes = remap[np.maximum(col.codes, 0)]
+            recodes[name] = (gcodes, null)
         for rb2 in table.to_batches():
-            self._writer.write_batch(rb2)
+            self._buffered.append((rb2, recodes))
 
     def close(self):
+        import pyarrow as pa
         if self._pending is not None and self._pending.n:
             self._flush(self._pending)
             self._pending = None
+        finals = {name: pa.array(d.delta_since(0), type=pa.string())
+                  for name, d in self._dicts.items()}
+        names = [self._schema.field(i).name
+                 for i in range(len(self._schema.names))]
+        for rb, recodes in self._buffered:
+            if recodes:
+                arrays = list(rb.columns)
+                for name, (gcodes, null) in recodes.items():
+                    arrays[names.index(name)] = \
+                        pa.DictionaryArray.from_arrays(
+                            pa.array(gcodes, type=pa.int32(), mask=null),
+                            finals[name])
+                rb = pa.RecordBatch.from_arrays(arrays, names)
+            self._writer.write_batch(rb)
+        self._buffered.clear()
         self._writer.close()
 
     def __enter__(self):
@@ -159,21 +201,32 @@ def merge_sorted_ipc(payloads: Iterable[bytes], sort_by: str,
                      reverse: bool = False,
                      sft: SimpleFeatureType | None = None) -> bytes:
     """K-way merge of sorted shard payloads into one sorted IPC file
-    (the reduce step of ArrowScan / SimpleFeatureArrowIO.sort)."""
-    merged = None
+    (the reduce step of ArrowScan / SimpleFeatureArrowIO.sort).
+
+    Payloads must each be pre-sorted on ``sort_by``; the merge streams
+    batch-at-a-time (arrow/delta.merge_sorted_streams) rather than
+    concatenating the union. ``reverse`` requires descending payloads.
+    """
+    from .delta import iter_ipc, merge_sorted_streams
+    import io as _io
+    sources = []
     out_sft = sft
     for p in payloads:
-        s, b = read_ipc_batches(p, sft)
+        s, it = iter_ipc(p, sft)
         out_sft = out_sft or s
-        if b is None:
-            continue
-        merged = b if merged is None else merged.concat(b)
+        sources.append(it)
     if out_sft is None:
         raise ValueError("no payloads to merge")
-    if merged is None:
+    sink = _io.BytesIO()
+    wrote = False
+    with FeatureArrowFileWriter(sink, out_sft) as w:
+        for b in merge_sorted_streams(sources, sort_by, reverse=reverse):
+            w.write(b)
+            wrote = True
+    if not wrote:
         return write_ipc(out_sft,
                          FeatureBatch.from_dict(
                              out_sft, np.empty(0, dtype=object),
                              {a.name: _empty_col(a)
                               for a in out_sft.attributes}))
-    return write_ipc(out_sft, sort_batches(merged, sort_by, reverse))
+    return sink.getvalue()

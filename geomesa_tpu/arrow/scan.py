@@ -57,30 +57,47 @@ class ArrowScan:
 
 def merge_deltas(payloads: Sequence[bytes],
                  sft: SimpleFeatureType | None = None,
-                 sort_by: str | None = None) -> bytes:
+                 sort_by: str | None = None,
+                 presorted: bool = False) -> bytes:
     """Merge shard-local IPC payloads into one payload with unified
-    dictionaries (DeltaWriter.reduce analog).
+    dictionaries (DeltaWriter.reduce analog) — as a *stream*: payloads
+    decode batch-at-a-time and feed an incremental writer, never
+    concatenating the full result set (the old eager reduce held every
+    shard's rows at once).
 
-    Each payload's string columns carry their own vocab; FeatureBatch
-    decoding re-dictionary-encodes on concat, so the merged file has one
-    global dictionary per column.
+    ``presorted`` declares each payload already sorted on ``sort_by``
+    (the mesh shards emit sorted payloads): the reduce is then a k-way
+    streaming merge holding one in-flight batch per payload. Without
+    it, each payload is sorted individually as it is first pulled —
+    still never the union.
     """
-    merged = None
+    from .delta import (empty_batch, iter_ipc, merge_sorted_streams,
+                        slice_batches)
+    sources = []
     out_sft = sft
     for p in payloads:
-        s, b = read_ipc_batches(p, sft)
+        s, it = iter_ipc(p, sft)
         out_sft = out_sft or s
-        if b is None:
-            continue
-        merged = b if merged is None else merged.concat(b)
+        sources.append(it)
     if out_sft is None:
         raise ValueError("no payloads")
-    if merged is None:
-        return write_ipc(out_sft, FeatureBatch.from_dict(
-            out_sft, np.empty(0, dtype=object),
-            {a.name: ((np.empty(0), np.empty(0))
-                      if a.type.name == "Point" else [])
-             for a in out_sft.attributes}))
-    if sort_by:
-        merged = sort_batches(merged, sort_by)
-    return write_ipc(out_sft, merged)
+    if sort_by and not presorted:
+        def _sorted(it):
+            parts = [b for b in it if b.n]
+            if parts:
+                whole = (parts[0] if len(parts) == 1
+                         else FeatureBatch.concat_all(parts))
+                yield from slice_batches(sort_batches(whole, sort_by))
+        sources = [_sorted(it) for it in sources]
+    merged = merge_sorted_streams(sources, sort_by or None)
+    import io as _io
+    sink = _io.BytesIO()
+    from .io import FeatureArrowFileWriter
+    wrote = False
+    with FeatureArrowFileWriter(sink, out_sft) as w:
+        for b in merged:
+            w.write(b)
+            wrote = True
+    if not wrote:
+        return write_ipc(out_sft, empty_batch(out_sft))
+    return sink.getvalue()

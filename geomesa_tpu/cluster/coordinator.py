@@ -120,6 +120,28 @@ class _PartialBytes(bytes):
     complete = False
 
 
+class _ClusterStream:
+    """Iterator of merged FeatureBatches from streamed scatter legs,
+    carrying the partial-results contract. ``complete`` /
+    ``missing_groups`` / ``missing_z_ranges`` are final once the
+    stream is exhausted (a leg can only drop out while it runs)."""
+
+    def __init__(self):
+        self._gen = iter(())
+        self.complete = True
+        self.missing_groups: list[str] = []
+        self.missing_z_ranges: list[dict] = []
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self):
+        self._gen.close()
+
+
 class ClusterQueryResult(QueryResult):
     """QueryResult plus the cluster contract: ``complete`` /
     ``missing_groups`` / ``missing_z_ranges`` (partial-results mode)
@@ -592,37 +614,155 @@ class ClusterDataStore(DataStore):
 
     def arrow_ipc(self, type_name: str, ecql="INCLUDE",
                   sort_by: str | None = None) -> bytes:
-        """Scatter arrow encoding, decode the per-group IPC payloads,
-        concat (+ optional global sort) and re-encode one stream."""
+        """Scatter arrow encoding (each leg sorts shard-locally), then
+        reduce the per-group IPC payloads as *streams*: the k-way merge
+        of arrow/delta.merge_sorted_streams holds one in-flight record
+        batch per leg instead of decoding and concatenating the union
+        before sorting."""
         results, failures = self._scatter(
             lambda name, group:
-            lambda: group.arrow_ipc(type_name, ecql,
+            lambda: group.arrow_ipc(type_name, ecql, sort_by=sort_by,
                                     **self._ryw_kwargs(name, group)))
         missing = self._missing(failures)
         sft = self.get_schema(type_name)
-        from ..arrow.io import read_ipc_batches, write_ipc
-        parts = []
-        for name in self._names:
-            payload = results.get(name)
-            if not payload:
-                continue
-            _, b = read_ipc_batches(payload, sft)
-            if b is not None and b.n:
-                parts.append(b)
-        if parts:
-            merged = (parts[0] if len(parts) == 1
-                      else FeatureBatch.concat_all(parts))
-        else:
-            merged = _empty_batch(sft)
-        if sort_by is not None and merged.n:
-            from ..store.common import sort_order
-            merged = merged.take(sort_order(merged, sort_by))
-        data = write_ipc(sft, merged)
+        import io as _io
+        from ..arrow.delta import iter_ipc, merge_sorted_streams
+        from ..arrow.io import FeatureArrowFileWriter, write_ipc
+        sources = [iter_ipc(results[name], sft)[1]
+                   for name in self._names if results.get(name)]
+        sink = _io.BytesIO()
+        wrote = False
+        with FeatureArrowFileWriter(sink, sft) as w:
+            for b in merge_sorted_streams(sources, sort_by):
+                w.write(b)
+                wrote = True
+        data = (sink.getvalue() if wrote
+                else write_ipc(sft, _empty_batch(sft)))
         if missing:
             data = _PartialBytes(data)
             data.missing_groups = missing["groups"]
             data.missing_z_ranges = missing["z_ranges"]
         return data
+
+    # -- streamed scatter-gather -------------------------------------------
+
+    def query_stream(self, q, type_name=None, batch_rows=None):
+        """Streamed scatter-gather: one producer thread per group feeds
+        a bounded queue (depth ``geomesa.stream.max.inflight.batches``
+        — a slow consumer backpressures the legs instead of buffering
+        them), and the consumer runs the k-way sort-merge over the
+        queues, so cluster results stream in bounded memory end to end.
+
+        Streaming legs are never hedged — a duplicate leg would
+        double-deliver rows. The per-leg deadline bounds the wait for a
+        group's *next* batch: a stalled group fails the stream typed
+        (``ShardUnavailableError``) mid-iteration, or under
+        ``geomesa.cluster.allow.partial`` drops out with its z-ranges
+        flagged on the returned handle (final once exhausted)."""
+        import queue as _queue
+        from ..arrow.delta import (STREAM_MAX_INFLIGHT,
+                                   merge_sorted_streams, slice_batches)
+        q = self._as_query(q, type_name)
+        deadline = self._leg_deadline_s()
+        depth = max(STREAM_MAX_INFLIGHT.as_int() or 4, 1)
+        self._registry.counter("cluster.scatter.calls")
+        stop = threading.Event()
+        failures: dict = {}
+        _BATCH, _DONE, _ERR = "batch", "done", "err"
+
+        def put(qq, item) -> bool:
+            # bounded put that gives up when the consumer walked away
+            while not stop.is_set():
+                try:
+                    qq.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def producer(name, group, qq):
+            breaker = self._breakers.get(name)
+            try:
+                breaker.acquire()
+            except CircuitOpenError as e:
+                self._registry.counter("cluster.leg.fastfails")
+                put(qq, (_ERR, e))
+                return
+            t0 = time.perf_counter()
+            try:
+                fn = getattr(group, "query_stream", None)
+                it = fn(q, batch_rows=batch_rows,
+                        **self._ryw_kwargs(name, group)) \
+                    if callable(fn) else slice_batches(
+                        group.query(
+                            q, **self._ryw_kwargs(name, group)).batch,
+                        batch_rows)
+                for b in it:
+                    if not put(qq, (_BATCH, b)):
+                        return
+            except Exception as e:  # noqa: BLE001 — leg boundary
+                breaker.failure()
+                self._registry.counter("cluster.leg.failures")
+                put(qq, (_ERR, e))
+            else:
+                breaker.success()
+                self._breakers.observe(name, time.perf_counter() - t0)
+                put(qq, (_DONE, None))
+
+        queues = []
+        for name, group in zip(self._names, self._groups):
+            qq = _queue.Queue(maxsize=depth)
+            threading.Thread(target=producer, args=(name, group, qq),
+                             daemon=True,
+                             name=f"cluster-stream-{name}").start()
+            queues.append((name, qq))
+
+        def leg_source(name, qq):
+            while True:
+                try:
+                    kind, val = qq.get(timeout=deadline + 5.0)
+                except _queue.Empty:
+                    self._registry.counter("cluster.leg.failures")
+                    self._registry.counter("cluster.leg.timeouts")
+                    failures[name] = TimeoutError(
+                        f"shard leg {name!r} produced no batch inside "
+                        f"its {deadline:g}s deadline")
+                    self._missing({name: failures[name]})
+                    return
+                if kind == _DONE:
+                    return
+                if kind == _ERR:
+                    failures[name] = val
+                    self._missing({name: val})  # raises typed unless
+                    return                      # partials are allowed
+                yield val
+
+        handle = _ClusterStream()
+
+        def merged():
+            try:
+                remaining = q.max_features
+                for b in merge_sorted_streams(
+                        [leg_source(name, qq) for name, qq in queues],
+                        q.sort_by, reverse=q.sort_desc,
+                        batch_rows=batch_rows):
+                    if remaining is not None:
+                        if remaining <= 0:
+                            return
+                        if b.n > remaining:
+                            b = b.take(np.arange(remaining))
+                        remaining -= b.n
+                    yield b
+            finally:
+                stop.set()
+            missing = self._missing(failures)
+            if missing:
+                handle.complete = False
+                handle.missing_groups = missing["groups"]
+                handle.missing_z_ranges = missing["z_ranges"]
+
+        handle._gen = merged()
+        return handle
 
     # -- admin -------------------------------------------------------------
 

@@ -295,6 +295,17 @@ class ReplicatedDataStore(DataStore):
         return self._read("query", q, type_name, explain_out=explain_out,
                           max_lag_lsn=max_lag_lsn, max_lag_s=max_lag_s)
 
+    def query_stream(self, q, type_name=None, batch_rows=None,
+                     max_lag_lsn=None, max_lag_s=None):
+        """Streamed read through the same bounded-staleness routing:
+        the chosen member's batch generator is returned as-is. Errors
+        *opening* the stream fail over to the next eligible member;
+        mid-stream errors surface typed to the consumer (failing over
+        mid-stream could re-deliver rows)."""
+        return self._read("query_stream", q, type_name,
+                          batch_rows=batch_rows,
+                          max_lag_lsn=max_lag_lsn, max_lag_s=max_lag_s)
+
     def query_count(self, q, type_name=None,
                     max_lag_lsn=None, max_lag_s=None) -> int:
         return self._read("query_count", q, type_name,

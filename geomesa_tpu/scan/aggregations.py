@@ -19,8 +19,8 @@ from ..utils.jaxcache import ensure_compile_cache
 
 ensure_compile_cache()
 
-__all__ = ["density_grid", "encode_bin_records", "decode_bin_records",
-           "merge_sorted_bin_chunks",
+__all__ = ["density_grid", "encode_bin_records", "encode_bin_batch",
+           "decode_bin_records", "merge_sorted_bin_chunks",
            "sample_mask"]
 
 
@@ -99,6 +99,41 @@ def encode_bin_records(ids: np.ndarray, x: np.ndarray, y: np.ndarray,
     rec["lat"] = np.asarray(y, np.float32)
     rec["lon"] = np.asarray(x, np.float32)
     return rec.tobytes()
+
+
+def encode_bin_batch(sft, ids: np.ndarray, batch,
+                     track: str | None = None,
+                     label: str | None = None,
+                     sort: bool = False) -> bytes:
+    """BIN-encode one FeatureBatch: the shared column-extraction front
+    half of every backend's ``bin_query`` (centroids, dtg millis,
+    track/label attribute values) over ``encode_bin_records``."""
+    if batch is None or not batch.n:
+        return b""
+    col = batch.col(sft.geom_field)
+    x = getattr(col, "x", None)
+    if x is not None:
+        x, y = col.x, col.y
+    else:
+        bounds = col.bounds
+        x = (bounds[:, 0] + bounds[:, 2]) / 2
+        y = (bounds[:, 1] + bounds[:, 3]) / 2
+    dtg = sft.dtg_field
+    millis = (batch.col(dtg).millis if dtg
+              else np.zeros(batch.n, dtype=np.int64))
+    track_vals = None
+    if track is not None and track != "id":
+        tc = batch.col(track)
+        track_vals = np.array([tc.value(i) for i in range(batch.n)],
+                              dtype=object)
+    labels = None
+    if label is not None:
+        lc = batch.col(label)
+        labels = np.array([lc.value(i) for i in range(batch.n)],
+                          dtype=object)
+    return encode_bin_records(np.asarray(ids), x, y, millis,
+                              labels=labels, track_values=track_vals,
+                              sort=sort)
 
 
 def merge_sorted_bin_chunks(chunks: list[bytes],

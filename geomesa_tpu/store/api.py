@@ -85,6 +85,19 @@ class DataStore(abc.ABC):
         / geomesa.force.count shape of the reference)."""
         return self.query(q, type_name).n
 
+    def query_stream(self, q: Query | str, type_name: str | None = None,
+                     batch_rows: int | None = None
+                     ) -> Iterator[FeatureBatch]:
+        """Stream matching features as fixed-size FeatureBatch slices
+        (``geomesa.stream.batch.rows`` each). Default runs the
+        vectorized scan and slices the materialized result — the
+        uniform surface the streaming wire/CLI/cluster paths consume;
+        wire-native backends (RemoteDataStore, ClusterDataStore)
+        override with true incremental streams."""
+        from ..arrow.delta import slice_batches
+        res = self.query(q, type_name)
+        return slice_batches(res.batch, batch_rows)
+
     # -- shared conveniences -------------------------------------------------
 
     def features(self, type_name: str,

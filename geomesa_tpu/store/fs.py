@@ -597,6 +597,23 @@ class FileSystemDataStore(DataStore):
         mem = self._load(st, self._files_for(st, None))
         return mem.count(type_name)
 
+    def bin_query(self, type_name: str, ecql="INCLUDE",
+                  track: str | None = None, label: str | None = None,
+                  sort: bool = False) -> bytes:
+        """BIN aggregation over the loaded partitions (the in-memory
+        scan core computes it; partition pruning still applies through
+        its query path)."""
+        st = self._state(type_name)
+        mem = self._load(st, self._files_for(st, None))
+        return mem.bin_query(type_name, ecql, track=track, label=label,
+                             sort=sort)
+
+    def arrow_ipc(self, type_name: str, ecql="INCLUDE",
+                  sort_by: str | None = None) -> bytes:
+        st = self._state(type_name)
+        mem = self._load(st, self._files_for(st, None))
+        return mem.arrow_ipc(type_name, ecql, sort_by=sort_by)
+
     def reindex(self, type_name: str, to_version: int | None = None):
         """Migrate the type's z-index layout: record the new version in
         the durable metadata, drop the old version's sidecars (their

@@ -218,3 +218,21 @@ class LambdaDataStore(DataStore):
     def count(self, type_name: str) -> int:
         q = Query(type_name)
         return self.query(q).n
+
+    def bin_query(self, type_name: str, ecql="INCLUDE",
+                  track: str | None = None, label: str | None = None,
+                  sort: bool = False) -> bytes:
+        """BIN aggregation over the merged tier view (transient rows
+        win over persistent, same as ``query``)."""
+        from ..scan.aggregations import encode_bin_batch
+        res = self.query(Query(type_name, ecql))
+        if res.batch is None or res.batch.n == 0:
+            return b""
+        return encode_bin_batch(self.get_schema(type_name), res.ids,
+                                res.batch, track=track, label=label,
+                                sort=sort)
+
+    def arrow_ipc(self, type_name: str, ecql="INCLUDE",
+                  sort_by: str | None = None) -> bytes:
+        from ..arrow.scan import ArrowScan
+        return ArrowScan(self).execute(type_name, ecql, sort_by=sort_by)

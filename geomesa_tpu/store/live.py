@@ -240,3 +240,15 @@ class LiveDataStore(DataStore):
 
     def count(self, type_name: str) -> int:
         return self._mem.count(type_name)
+
+    def bin_query(self, type_name: str, ecql="INCLUDE",
+                  track: str | None = None, label: str | None = None,
+                  sort: bool = False) -> bytes:
+        """BIN aggregation over the live view (delegates to the
+        in-memory scan core, version-keyed caching included)."""
+        return self._mem.bin_query(type_name, ecql, track=track,
+                                   label=label, sort=sort)
+
+    def arrow_ipc(self, type_name: str, ecql="INCLUDE",
+                  sort_by: str | None = None) -> bytes:
+        return self._mem.arrow_ipc(type_name, ecql, sort_by=sort_by)

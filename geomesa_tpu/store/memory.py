@@ -250,6 +250,13 @@ class _TypeState:
 
     def __init__(self, sft: SimpleFeatureType):
         self.sft = sft
+        # guards the lazy read-side mutations (pending flush, index
+        # build, deferred device upload): process helpers reach these
+        # through the state object directly, without the store-level
+        # _op_lock, so two concurrent fused dispatches must not race a
+        # rebuild. Store ops already hold _op_lock when they get here —
+        # the order is always store lock -> state lock, never reversed.
+        self._state_lock = threading.RLock()
         self._batch: FeatureBatch | None = None
         self._pending: list[tuple[FeatureBatch, np.ndarray]] = []
         self._pending_n = 0
@@ -294,8 +301,10 @@ class _TypeState:
         answered by the host z-index fast path never pay it. Reading
         this property materializes the upload."""
         if self._scan_data is None and self._scan_thunk is not None:
-            self._scan_data = self._scan_thunk()
-            self._scan_thunk = None
+            with self._state_lock:
+                if self._scan_thunk is not None:
+                    self._scan_data = self._scan_thunk()
+                    self._scan_thunk = None
         return self._scan_data
 
     @scan_data.setter
@@ -394,6 +403,10 @@ class _TypeState:
     def flush(self):
         """Materialize pending appends: one concat for the burst, then
         incremental index maintenance when the index is already built."""
+        with self._state_lock:
+            self._flush_locked()
+
+    def _flush_locked(self):
         if not self._pending:
             return
         delta = FeatureBatch.concat_all([b for b, _ in self._pending])
@@ -490,6 +503,10 @@ class _TypeState:
 
     def ensure_index(self):
         """(Re)build device arrays if writes happened."""
+        with self._state_lock:
+            self._ensure_index_locked()
+
+    def _ensure_index_locked(self):
         self.flush()  # may maintain the index incrementally
         if not self.dirty and (self.has_point_scan()
                                or self.has_extent_scan()):
@@ -934,28 +951,13 @@ class InMemoryDataStore(DataStore):
                             track: str | None = None,
                             label: str | None = None,
                             sort: bool = False) -> bytes:
-        from ..scan.aggregations import encode_bin_records
+        from ..scan.aggregations import encode_bin_batch
         st = self._state(type_name)
         res = self.query(Query(type_name, ecql))
         if res.batch is None or res.batch.n == 0:
             return b""
-        x, y, _ = _geom_centroids(res.batch, st.sft.geom_field)
-        dtg = st.sft.dtg_field
-        millis = (res.batch.col(dtg).millis if dtg
-                  else np.zeros(res.batch.n, dtype=np.int64))
-        track_vals = None
-        if track is not None and track != "id":
-            tc = res.batch.col(track)
-            track_vals = np.array([tc.value(i) for i in range(res.batch.n)],
-                                  dtype=object)
-        labels = None
-        if label is not None:
-            lc = res.batch.col(label)
-            labels = np.array([lc.value(i) for i in range(res.batch.n)],
-                              dtype=object)
-        return encode_bin_records(res.ids, x, y, millis,
-                                  labels=labels, track_values=track_vals,
-                                  sort=sort)
+        return encode_bin_batch(st.sft, res.ids, res.batch,
+                                track=track, label=label, sort=sort)
 
     @_synchronized
     def arrow_query(self, type_name: str, ecql):

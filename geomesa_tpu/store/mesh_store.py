@@ -313,7 +313,7 @@ class DistributedDataStore(InMemoryDataStore):
         (arrow/scan.merge_deltas). On hardware the per-shard encode is
         host work against that device's row range — the client-side
         reduce of the reference's server-side ArrowScan."""
-        from ..arrow.io import write_ipc
+        from ..arrow.io import sort_batches, write_ipc
         from ..arrow.scan import merge_deltas
         from ..features.batch import FeatureBatch
         from ..index.api import Query as _Q
@@ -349,8 +349,14 @@ class DistributedDataStore(InMemoryDataStore):
                     cols[a.name] = (_null_cells(col, bad) if bad.any()
                                     else col)
                 sub = FeatureBatch(sft, sub.ids, cols)
+            if sort_by:
+                # shard-local sort so the client reduce is a streaming
+                # k-way merge instead of a concat-then-sort (the
+                # reference's tablets return sorted batches too)
+                sub = sort_batches(sub, sort_by)
             payloads.append(write_ipc(sft, sub))
-        return merge_deltas(payloads, sft=sft, sort_by=sort_by)
+        return merge_deltas(payloads, sft=sft, sort_by=sort_by,
+                            presorted=True)
 
     def knn(self, type_name: str, qx: float, qy: float, k: int) -> np.ndarray:
         """k nearest feature ids: shard-local top-k prune per segment

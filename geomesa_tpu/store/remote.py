@@ -140,15 +140,18 @@ class RemoteDataStore(DataStore):
                                 name=f"remote.{endpoint}")
 
     def _maybe_hedged(self, attempt, breaker, endpoint: str,
-                      idempotent: bool):
+                      idempotent: bool, streaming: bool = False):
         """Wrap one retry attempt in a speculative hedge when every
         eligibility gate passes; otherwise return it untouched. Gates,
         re-checked per call so a flipped knob or a tripped breaker
         takes effect immediately: hedging configured and enabled,
         the call is idempotent (a hedge executes twice; only reads
-        survive that invisibly), the breaker is CLOSED, and the
-        endpoint has a latency estimate to derive the delay from."""
-        if self._hedge is None or not idempotent \
+        survive that invisibly), the call is NOT streaming (a hedged
+        chunked response would double-deliver rows to the consumer and
+        double-charge the budget for a transfer whose duration scales
+        with result size, not endpoint health), the breaker is CLOSED,
+        and the endpoint has a latency estimate for the delay."""
+        if streaming or self._hedge is None or not idempotent \
                 or not HedgePolicy.enabled() or breaker.state != CLOSED:
             return attempt
         delay = self._hedge.delay_s(self._breakers.latency_p99_s(endpoint))
@@ -268,13 +271,17 @@ class RemoteDataStore(DataStore):
 
     # -- queries -----------------------------------------------------------
 
-    def query(self, q: Query | str, type_name: str | None = None,
-              explain_out=None):
+    @staticmethod
+    def _as_query(q: Query | str, type_name: str | None) -> Query:
         if isinstance(q, str):
             if type_name is None:
                 raise ValueError("type_name required with a filter string")
             q = Query(type_name, q)
-        params: dict[str, Any] = {"cql": str(q.filter), "format": "arrow"}
+        return q
+
+    @staticmethod
+    def _query_params(q: Query, fmt: str) -> dict:
+        params: dict[str, Any] = {"cql": str(q.filter), "format": fmt}
         if q.max_features is not None:
             params["maxFeatures"] = q.max_features
         if q.properties is not None:
@@ -290,8 +297,9 @@ class RemoteDataStore(DataStore):
             params["sampleBy"] = q.hints[QueryHints.SAMPLE_BY]
         if QueryHints.QUERY_INDEX in q.hints:
             params["index"] = q.hints[QueryHints.QUERY_INDEX]
-        _, data = self._request("GET", f"/rest/query/{quote(q.type_name)}",
-                                params)
+        return params
+
+    def _result_sft(self, q: Query) -> SimpleFeatureType:
         sft = self.get_schema(q.type_name)
         if q.properties is not None:
             keep = set(q.properties)
@@ -299,6 +307,15 @@ class RemoteDataStore(DataStore):
                 sft.type_name,
                 [a for a in sft.attributes if a.name in keep],
                 sft.user_data)
+        return sft
+
+    def query(self, q: Query | str, type_name: str | None = None,
+              explain_out=None):
+        q = self._as_query(q, type_name)
+        params = self._query_params(q, "arrow")
+        _, data = self._request("GET", f"/rest/query/{quote(q.type_name)}",
+                                params)
+        sft = self._result_sft(q)
         import pyarrow as pa
         with pa.ipc.open_file(io.BytesIO(data)) as rd:
             table = rd.read_all()
@@ -315,6 +332,165 @@ class RemoteDataStore(DataStore):
         return QueryResult(batch.ids, batch, Explainer(),
                            FilterStrategy("remote", q.filter, None),
                            n=batch.n)
+
+    # -- streaming reads ---------------------------------------------------
+
+    def _open_stream(self, path: str, params: dict):
+        """Open a chunked streaming GET: retries/breakers cover only
+        the pre-stream phase (connect + status line); once headers are
+        back the connection is handed to the consuming generator. Never
+        hedged — see ``_maybe_hedged``."""
+        segs = path.strip("/").split("/")
+        endpoint = segs[1] if len(segs) > 1 else "root"
+        breaker = self._breakers.get(endpoint)
+        qs = ("?" + urlencode(params)) if params else ""
+        headers = {}
+        if self.auth_token:
+            headers["Authorization"] = f"Bearer {self.auth_token}"
+
+        def attempt():
+            breaker.acquire()
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=self.timeout_s)
+            try:
+                try:
+                    conn.connect()
+                except OSError as e:
+                    e.retryable = True
+                    raise
+                try:
+                    conn.request("GET", path + qs, headers=headers)
+                    resp = conn.getresponse()
+                except (ConnectionError, TimeoutError, OSError,
+                        http.client.HTTPException) as e:
+                    e.retryable = True  # no stream bytes delivered yet
+                    raise
+                if resp.status == 404:
+                    try:
+                        msg = json.loads(resp.read().decode()) \
+                            .get("error", path)
+                    except Exception:
+                        msg = path
+                    raise KeyError(msg)
+                if resp.status >= 400:
+                    data = resp.read()
+                    try:
+                        msg = json.loads(data.decode()).get("error", "")
+                    except Exception:
+                        msg = data[:200].decode(errors="replace")
+                    raise RemoteError(f"{resp.status} {path}: {msg}",
+                                      status=resp.status,
+                                      retryable=resp.status in (503,)
+                                      or resp.status >= 500)
+            except Exception as e:
+                conn.close()
+                if _breaker_counts(e):
+                    breaker.failure()
+                else:
+                    breaker.success()
+                raise
+            breaker.success()
+            return conn, resp
+
+        return self._retry.call(
+            self._maybe_hedged(attempt, breaker, endpoint, True,
+                               streaming=True),
+            name=f"remote.{endpoint}.stream")
+
+    def query_stream(self, q: Query | str, type_name: str | None = None,
+                     batch_rows: int | None = None):
+        """Stream matching features as FeatureBatches decoded
+        incrementally off a chunked ``format=arrow-stream`` response:
+        client-side memory is bounded by one wire batch regardless of
+        hit count, and the first batch arrives while the server is
+        still encoding the rest. A mid-stream transport fault or
+        truncated response raises a typed ``RemoteError`` — never a
+        silently-short result (the chunked framing carries an explicit
+        end-of-stream marker)."""
+        q = self._as_query(q, type_name)
+        params = self._query_params(q, "arrow-stream")
+        if batch_rows is not None:
+            params["batchRows"] = int(batch_rows)
+        conn, resp = self._open_stream(
+            f"/rest/query/{quote(q.type_name)}", params)
+        sft = self._result_sft(q)
+
+        def gen():
+            import pyarrow as pa
+            try:
+                try:
+                    rd = pa.ipc.open_stream(resp)
+                    for rb in rd:
+                        if rb.num_rows:
+                            yield FeatureBatch.from_arrow(sft, rb)
+                    resp.read()  # the chunked terminator must be intact
+                except (ConnectionError, TimeoutError, OSError,
+                        http.client.HTTPException, pa.ArrowInvalid) as e:
+                    raise RemoteError(
+                        f"stream interrupted mid-transfer: {e!r}",
+                        retryable=False) from e
+            finally:
+                conn.close()
+        return gen()
+
+    def bin_stream(self, q: Query | str, type_name: str | None = None,
+                   track: str | None = None, label: str | None = None):
+        """Stream the compact BIN wire encoding (16/24-byte records)
+        for a query: yields raw record chunks off a chunked
+        ``format=bin`` response. Same typed-error contract as
+        ``query_stream``."""
+        q = self._as_query(q, type_name)
+        params = self._query_params(q, "bin")
+        if track:
+            params["track"] = track
+        if label:
+            params["label"] = label
+        conn, resp = self._open_stream(
+            f"/rest/query/{quote(q.type_name)}", params)
+
+        def gen():
+            try:
+                try:
+                    while True:
+                        chunk = resp.read(65536)
+                        if not chunk:
+                            break
+                        yield chunk
+                except (ConnectionError, TimeoutError, OSError,
+                        http.client.HTTPException) as e:
+                    raise RemoteError(
+                        f"stream interrupted mid-transfer: {e!r}",
+                        retryable=False) from e
+            finally:
+                conn.close()
+        return gen()
+
+    def bin_query(self, type_name: str, ecql="INCLUDE",
+                  track: str | None = None, label: str | None = None,
+                  sort: bool = False) -> bytes:
+        """Server-side BIN aggregation (GET /rest/bin) — the contract
+        surface every local backend exposes, materialized."""
+        params: dict[str, Any] = {"cql": str(ecql or "INCLUDE")}
+        if track:
+            params["track"] = track
+        if label:
+            params["label"] = label
+        if sort:
+            params["sort"] = "true"
+        _, data = self._request("GET", f"/rest/bin/{quote(type_name)}",
+                                params)
+        return data
+
+    def arrow_ipc(self, type_name: str, ecql="INCLUDE",
+                  sort_by: str | None = None) -> bytes:
+        """Materialized Arrow IPC file bytes, encoded server-side."""
+        params: dict[str, Any] = {"cql": str(ecql or "INCLUDE"),
+                                  "format": "arrow"}
+        if sort_by:
+            params["sortBy"] = sort_by
+        _, data = self._request("GET", f"/rest/query/{quote(type_name)}",
+                                params)
+        return data
 
     def count(self, type_name: str) -> int:
         return int(self._json("GET", f"/rest/count/{quote(type_name)}")

@@ -7,7 +7,9 @@ Subcommands (mirroring the reference's tools/ command set):
     delete-schema   --path R --name T
     list-schemas    --path R
     ingest          --path R --name T --converter conf.json FILES...
-    export          --path R --name T [--cql F] [--format csv|tsv|geojson|gml|avro|bin|arrow]
+    export          --path R --name T [--cql F]
+                    [--format csv|tsv|geojson|gml|avro|arrow|arrow-stream|bin]
+                    (arrow-stream/bin stream: constant memory, SIGPIPE-clean)
     count           --path R --name T [--cql F]
     explain         --path R --name T --cql F
     stats           --path R --name T --stat-spec 'MinMax(a)' [--cql F]
@@ -124,8 +126,31 @@ def _query(args):
 
 
 def cmd_export(args) -> int:
-    ds, res = _query(args)
     fmt = args.format
+    if fmt in ("arrow-stream", "bin"):
+        # streaming formats never materialize the result: batches flow
+        # from query_stream straight to stdout in constant memory, so
+        # `export | head -c ...` over a 100M-row type is safe
+        from ..index.api import Query
+        ds = _store(args)
+        q = Query(args.name, args.cql or "INCLUDE")
+        if getattr(args, "max_features", None):
+            q.max_features = args.max_features
+        raw = sys.stdout.buffer
+        if fmt == "arrow-stream":
+            from ..arrow.delta import DeltaWriter
+            with DeltaWriter(raw, ds.get_schema(args.name)) as w:
+                for piece in ds.query_stream(q):
+                    w.write(piece)
+                    w.flush()
+        else:
+            from ..scan.aggregations import encode_bin_batch
+            sft = ds.get_schema(args.name)
+            for piece in ds.query_stream(q):
+                raw.write(encode_bin_batch(sft, piece.ids, piece))
+        raw.flush()
+        return 0
+    ds, res = _query(args)
     out = sys.stdout
     if res.batch is None or res.n == 0:
         print("0 features", file=sys.stderr)
@@ -154,11 +179,6 @@ def cmd_export(args) -> int:
     elif fmt == "arrow":
         from ..arrow.io import write_ipc
         sys.stdout.buffer.write(write_ipc(res.batch.sft, res.batch))
-    elif fmt == "bin":
-        mem = ds._load(ds._state(args.name),
-                       ds._files_for(ds._state(args.name), None))
-        data = mem.bin_query(args.name, args.cql or "INCLUDE")
-        sys.stdout.buffer.write(data)
     elif fmt == "avro":
         from ..convert.avro_writer import write_avro_batch
         sys.stdout.buffer.write(write_avro_batch(res.batch.sft, res.batch))
@@ -533,7 +553,11 @@ def main(argv=None) -> int:
         (["--converter"], {"required": True}),
         (["files"], {"nargs": "+"}))
     add("export", cmd_export, name_arg, cql_arg,
-        (["--format"], {"default": "csv"}),
+        (["--format"], {"default": "csv",
+                        "help": "csv|tsv|geojson|gml|avro|arrow "
+                                "(materialized) or arrow-stream|bin "
+                                "(streamed: constant memory, "
+                                "SIGPIPE-clean)"}),
         (["--max-features"], {"type": int, "default": None,
                               "dest": "max_features"}))
     add("count", cmd_count, name_arg, cql_arg)

@@ -181,12 +181,21 @@ class GeoMesaWebServer:
                     _j({"error": "overloaded: in-flight request cap "
                                  "reached", "retryable": True}),
                     {"Retry-After": retry_after})
+        slot_owned = True
         try:
             if parts and (method, parts[0]) in _GATED \
                     and not self._authorized(headers):
                 return 403, "application/json", _j({"error": "forbidden"})
             try:
-                return self._route(method, parts, params, body, headers)
+                out = self._route(method, parts, params, body, headers)
+                if len(out) >= 3 and not isinstance(
+                        out[2], (bytes, bytearray, str)):
+                    # streaming payload: the generator outlives this
+                    # frame, so the in-flight slot travels with it and
+                    # releases when the stream finishes (or dies)
+                    out = (*out[:2], self._slot_guard(out[2]), *out[3:])
+                    slot_owned = False
+                return out
             except KeyError as e:
                 return 404, "application/json", _j({"error": str(e)})
             except DurabilityError as e:
@@ -208,6 +217,14 @@ class GeoMesaWebServer:
                 # request (not the server's health) might still be fine
                 metrics.counter("resilience.web.errors")
                 return 500, "application/json", _j({"error": repr(e)})
+        finally:
+            if slot_owned:
+                self._release_slot()
+
+    def _slot_guard(self, gen):
+        """Hold the shed slot for a streaming response's lifetime."""
+        try:
+            yield from gen
         finally:
             self._release_slot()
 
@@ -540,6 +557,8 @@ class GeoMesaWebServer:
     def _query(self, name, params):
         fmt = params.get("format", ["json"])[0]
         q = self._parse_query(name, params)
+        if fmt in ("arrow-stream", "bin"):
+            return self._query_stream(name, q, params, fmt)
         if fmt == "arrow":
             from ..arrow.io import write_ipc
             res = self._run_query(q)
@@ -579,6 +598,32 @@ class GeoMesaWebServer:
             out["complete"] = False
             out["missing_z_ranges"] = getattr(res, "missing_z_ranges", [])
         return (200, "application/json", _j(out), _partial_headers(res))
+
+    def _query_stream(self, name, q: Query, params, fmt: str):
+        """format=arrow-stream|bin: chunked-transfer streaming. The
+        scan still runs the fused vectorized path eagerly (plan/CQL
+        errors map to 400 before any bytes leave), then the result
+        *encodes* incrementally — the first batch is on the wire while
+        the rest is still being encoded, and neither side ever holds
+        the full serialized payload."""
+        from ..arrow.delta import (ARROW_STREAM_MIME, empty_batch,
+                                   stream_bin, stream_ipc)
+        res = self._run_query(q)
+        sft = self.store.get_schema(name)
+        batch = res.batch if res.batch is not None else empty_batch(sft)
+        hdrs = _partial_headers(res)
+        rows = (int(params["batchRows"][0]) if "batchRows" in params
+                else None)
+        if fmt == "bin":
+            track = params.get("track", [None])[0]
+            label = params.get("label", [None])[0]
+            return (200, "application/octet-stream",
+                    stream_bin(sft, batch, track=track, label=label,
+                               batch_rows=rows),
+                    hdrs)
+        # projected results carry a projected schema (batch.sft)
+        return (200, ARROW_STREAM_MIME,
+                stream_ipc(batch.sft, batch, batch_rows=rows), hdrs)
 
     def _run_query(self, q: Query):
         """Queries coalesce through the batcher (one fused scan per
@@ -765,6 +810,12 @@ def _default(o):
 
 def _make_handler(server: GeoMesaWebServer):
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 so streaming responses may use chunked
+        # transfer-encoding — the framing is what makes a mid-stream
+        # server death *detectable* (no terminal chunk -> the client's
+        # read raises instead of returning a silently-truncated body)
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *a):  # quiet
             pass
 
@@ -777,6 +828,8 @@ def _make_handler(server: GeoMesaWebServer):
                 self.command, u.path, params, body, headers=self.headers)
             status, ctype, payload = out[:3]
             extra = out[3] if len(out) > 3 else {}
+            if not isinstance(payload, (bytes, bytearray, str)):
+                return self._respond_chunked(status, ctype, payload, extra)
             try:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
@@ -790,6 +843,61 @@ def _make_handler(server: GeoMesaWebServer):
                 # server fault — count it, don't dump a traceback
                 metrics.counter("resilience.web.client_disconnects")
                 self.close_connection = True
+
+        def _respond_chunked(self, status, ctype, payload, extra):
+            """Stream an iterator payload with chunked framing. A
+            producer fault mid-stream drops the connection WITHOUT the
+            terminal 0-chunk, so the client raises (IncompleteRead /
+            connection error) rather than seeing a short body."""
+            gen = iter(payload)
+            try:
+                try:
+                    first = next(gen)  # encode errors -> 500, pre-headers
+                except StopIteration:
+                    first = None
+                except Exception as e:
+                    metrics.counter("resilience.web.errors")
+                    err = _j({"error": repr(e)})
+                    self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(err)))
+                    self.end_headers()
+                    self.wfile.write(err)
+                    return
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Transfer-Encoding", "chunked")
+                for k, v in extra.items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                if first:
+                    self._chunk(first)
+                for chunk in gen:
+                    if chunk:
+                        self._chunk(chunk)
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                metrics.counter("resilience.web.client_disconnects")
+                self.close_connection = True
+            except Exception:
+                # producer died mid-stream: sever without the terminal
+                # chunk — truncation must be loud on the client
+                metrics.counter("resilience.web.stream_aborts")
+                self.close_connection = True
+                try:
+                    self.wfile.flush()
+                    self.connection.close()
+                except OSError:
+                    pass
+            finally:
+                close = getattr(gen, "close", None)
+                if close is not None:
+                    close()
+
+        def _chunk(self, data: bytes):
+            self.wfile.write(b"%x\r\n" % len(data))
+            self.wfile.write(data)
+            self.wfile.write(b"\r\n")
 
         do_GET = do_POST = do_DELETE = _respond
 

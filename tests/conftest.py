@@ -48,3 +48,7 @@ def pytest_configure(config):
         "markers", "cache: materialized pushdown-cache suites "
         "(LSN-keyed invalidation, single-flight, ETag/304, hot-tile "
         "refresh; select with -m cache)")
+    config.addinivalue_line(
+        "markers", "streaming: streaming result-plane suites (Arrow "
+        "delta batches, chunked wire endpoints, k-way stream merge, "
+        "continuous queries; select with -m streaming)")

@@ -126,3 +126,23 @@ def test_load_gate_reports_without_exiting(monkeypatch, capsys):
     load = bench._load_gate()
     assert load == 7.5
     assert "WARNING" in capsys.readouterr().err
+
+
+@pytest.mark.bench_smoke
+def test_config14_streaming_smoke():
+    rng = np.random.default_rng(47)
+    c = bench.bench_config14(rng, n=30_000, batch_rows=2048)
+    t = c["ttfb"]
+    assert t["rows_streamed"] == 30_000
+    assert t["ttfb_s"] < t["materialized_fetch_s"]
+    assert "ttfb_under_10pct" in t  # the full-size run gates on it
+    m = c["client_memory"]
+    assert m["rows_drained"] == 30_000
+    assert m["one_batch_peak_bytes"] > 0
+    # the constant-memory contract must hold even at toy sizes: the
+    # drain peak stays within two decoded batches' worth
+    assert m["under_two_batches"] is True
+    r = c["reconstruction"]
+    assert r["byte_exact"] is True
+    assert r["materialized_bytes"] == r["rebuilt_bytes"] > 0
+    assert "gates_pass" in c

@@ -259,6 +259,21 @@ class TestRemoteHedgeGating:
         assert ds._maybe_hedged(attempt, breaker, "query",
                                 True) is attempt
 
+    def test_streaming_never_hedges(self):
+        """Streamed reads are excluded from hedging even when every
+        other gate passes: a duplicate in-flight stream would
+        double-deliver rows to the consumer (and double-charge the
+        retry budget for a request that is expected to be slow)."""
+        ds = self._store()
+        ds._breakers.observe("query", 0.02)
+        breaker = ds._breakers.get("query")
+        attempt = lambda: "x"  # noqa: E731
+        # sanity: same gates WOULD hedge a non-streaming read
+        assert ds._maybe_hedged(attempt, breaker, "query",
+                                True) is not attempt
+        assert ds._maybe_hedged(attempt, breaker, "query", True,
+                                streaming=True) is attempt
+
 
 # -- BatcherRegistry ------------------------------------------------------
 
