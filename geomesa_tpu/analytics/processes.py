@@ -86,11 +86,17 @@ def _knn_zring(st, col, qx: float, qy: float, k: int):
             dx = col.x[rows] - qx
             dy = col.y[rows] - qy
             d2 = dx * dx + dy * dy
-            sel = np.argpartition(d2, k - 1)[:k]
-            dk = float(np.sqrt(d2[sel].max()))
+            # (distance, row) tiebreak, same as the fused kernel: an
+            # argpartition cut picks an arbitrary member of a distance
+            # tie at the k boundary, so gather every candidate within
+            # the kth distance first, then break ties on row id
+            part = (np.argpartition(d2, k - 1)[:k]
+                    if len(rows) > k else np.arange(len(rows)))
+            kth = d2[part].max()
+            cand = np.flatnonzero(d2 <= kth)
+            top = cand[np.lexsort((rows[cand], d2[cand]))[:k]]
+            dk = float(np.sqrt(d2[top].max()))
             if dk <= r:
-                order = np.argsort(d2[sel], kind="stable")
-                top = sel[order]
                 return np.sqrt(d2[top]), rows[top]
             # candidates found but the kth may lie outside the box:
             # one more round with the proven cover radius

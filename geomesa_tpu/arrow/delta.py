@@ -305,7 +305,15 @@ def _merge_keys(batch: FeatureBatch, sort_by: str) -> np.ndarray:
                 else np.full(len(codes), "", dtype=str))
         # nulls sort last (store/common.sort_order convention)
         return np.where(codes >= 0, vals, "\U0010ffff")
-    return np.asarray(col.values)
+    vals = np.asarray(col.values)
+    if vals.dtype.kind == "f":
+        # Null Double/Float is stored as NaN. sort_order argsorts
+        # ascending (NaN last) and reverses for descending, so NaN
+        # behaves like +inf in both directions — but a raw NaN key
+        # poisons the merge bound (every comparison is False and no
+        # cursor can advance). Substitute +inf to keep bounds total.
+        vals = np.where(np.isnan(vals), np.inf, vals)
+    return vals
 
 
 def _stable_order(keys: np.ndarray, reverse: bool) -> np.ndarray:

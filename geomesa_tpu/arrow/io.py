@@ -41,7 +41,14 @@ class FeatureArrowFileWriter:
     ``close``, when every batch is emitted against the one final
     dictionary (valid for all of them, since each batch's codes index a
     prefix). A single-vocabulary feed produces byte-identical output to
-    the old direct-write path."""
+    the old direct-write path.
+
+    Memory: when the SFT has String attributes, the whole encoded
+    result is held until ``close`` (the file format's one-dictionary
+    rule forces it) — so file-format sinks fed by a streaming reduce
+    are *not* constant-memory; only the IPC stream format
+    (``arrow/delta.DeltaWriter``) is. Schemas with no String columns
+    need no dictionary and write through batch by batch."""
 
     def __init__(self, sink, sft: SimpleFeatureType,
                  batch_size: int = DEFAULT_BATCH_SIZE):
@@ -76,6 +83,12 @@ class FeatureArrowFileWriter:
         # unify non-dictionary column types with the declared schema
         table = pa.Table.from_batches([rb]).cast(pa.schema(
             [self._schema.field(i) for i in range(len(self._schema.names))]))
+        if not self._dicts:
+            # no string columns → no dictionary to finalize: write
+            # through directly instead of buffering until close
+            for rb2 in table.to_batches():
+                self._writer.write_batch(rb2)
+            return
         recodes = {}
         for name, d in self._dicts.items():
             col = batch.columns[name]

@@ -754,12 +754,18 @@ class ClusterDataStore(DataStore):
                         remaining -= b.n
                     yield b
             finally:
+                # runs on every exit path — exhaustion, max_features
+                # truncation, consumer close — so a truncated stream
+                # still reports legs that failed before the cut. Only
+                # the partial-allowed branch of _missing is reachable
+                # here (strict mode raised typed during iteration);
+                # gating on it keeps the finally from raising anew.
                 stop.set()
-            missing = self._missing(failures)
-            if missing:
-                handle.complete = False
-                handle.missing_groups = missing["groups"]
-                handle.missing_z_ranges = missing["z_ranges"]
+                if failures and self._allow_partial():
+                    missing = self._missing(failures)
+                    handle.complete = False
+                    handle.missing_groups = missing["groups"]
+                    handle.missing_z_ranges = missing["z_ranges"]
 
         handle._gen = merged()
         return handle

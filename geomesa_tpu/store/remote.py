@@ -411,9 +411,11 @@ class RemoteDataStore(DataStore):
         params = self._query_params(q, "arrow-stream")
         if batch_rows is not None:
             params["batchRows"] = int(batch_rows)
+        # resolve the SFT (its own HTTP round-trip) before opening the
+        # stream: a failure here must not leak a live connection
+        sft = self._result_sft(q)
         conn, resp = self._open_stream(
             f"/rest/query/{quote(q.type_name)}", params)
-        sft = self._result_sft(q)
 
         def gen():
             import pyarrow as pa

@@ -48,6 +48,26 @@ class TestIpc:
         assert r.num_batches == 3  # 10 + 10 + 5
         assert r.read_all().n == 25
 
+    def test_no_string_columns_write_through(self):
+        """Without String attributes there is no dictionary to
+        finalize, so batches reach the sink as they flush instead of
+        buffering until close (the file writer is only forced to hold
+        everything when a global string dictionary must be built)."""
+        sft = parse_spec("t", "age:Integer,*geom:Point:srid=4326")
+        rng = np.random.default_rng(5)
+        batch = FeatureBatch.from_dict(
+            sft, [f"f{i}" for i in range(30)],
+            {"age": np.arange(30),
+             "geom": (rng.uniform(-10, 10, 30), rng.uniform(-10, 10, 30))})
+        sink = io.BytesIO()
+        w = FeatureArrowFileWriter(sink, sft, batch_size=10)
+        w.write(batch)
+        assert not w._buffered
+        assert len(sink.getvalue()) > 0   # batches already on the sink
+        w.close()
+        r = FeatureArrowFileReader(io.BytesIO(sink.getvalue()))
+        assert r.num_batches == 3 and r.read_all().n == 30
+
     def test_empty(self):
         sft, _ = make_batch(1)
         data = write_ipc(sft, FeatureBatch.from_dict(

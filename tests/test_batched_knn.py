@@ -134,6 +134,23 @@ class TestKnnProcessSurface:
         assert list(ids1) == list(idsb)
         np.testing.assert_allclose(d1, db)
 
+    def test_scalar_zring_tiebreak_is_id_stable(self, cloud, pts_store):
+        """The scalar z-ring path must apply the fused kernel's
+        (distance, id) tiebreak: a duplicated-coordinate pair cut by
+        the k boundary previously kept an arbitrary member
+        (argpartition), so a single-element batcher chunk could
+        disagree with a coalesced dispatch — the source of the
+        concurrent-coalesce flake."""
+        px, py = cloud
+        rng = np.random.default_rng(3)
+        qs = [(float(a), float(b)) for a, b in
+              zip(rng.uniform(-170, 170, 8), rng.uniform(-80, 80, 8))]
+        for qx, qy in qs:  # q[4]'s 12th neighbor is a tied pair
+            ids, d = knn_process(pts_store, "pts", qx, qy, 12)
+            want = _knn_oracle(px, py, qx, qy, 12)
+            assert np.array_equal(np.asarray(ids, np.int64), want)
+            assert np.all(np.diff(d) >= 0)
+
     def test_ecql_prefilter(self, cloud, pts_store):
         from geomesa_tpu.filters import ast as fast
         px, py = cloud

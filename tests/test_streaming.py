@@ -195,6 +195,49 @@ class TestMergeSortedStreams:
         got = np.concatenate([b.columns["dtg"].millis for b in merged])
         np.testing.assert_array_equal(got, dtg[order])
 
+    def test_null_double_key_terminates_nulls_last(self):
+        """Null Double keys are stored as NaN; a source batch ending
+        in NaN must not poison the merge bound (regression: merging
+        [1, 3, NaN] with [2, 4] spun forever — every `k <= NaN`
+        comparison is False, so no cursor ever advanced)."""
+        sft = parse_spec("t", "val:Double,*geom:Point:srid=4326")
+
+        def src(vals, prefix):
+            n = len(vals)
+            ids = np.array([f"{prefix}{i}" for i in range(n)],
+                           dtype=object)
+            return FeatureBatch.from_dict(sft, ids, {
+                "val": np.array(vals, dtype=np.float64),
+                "geom": (np.zeros(n), np.zeros(n))})
+
+        merged = list(merge_sorted_streams(
+            [iter([src([1.0, 3.0, np.nan], "a")]),
+             iter([src([2.0, 4.0], "b")])], "val"))
+        got = np.concatenate([m.columns["val"].values for m in merged])
+        np.testing.assert_array_equal(got[:4], [1.0, 2.0, 3.0, 4.0])
+        assert len(got) == 5 and np.isnan(got[4])
+
+    def test_null_double_key_reverse_nulls_first(self):
+        """Descending sources are reversed-ascending (sort_order
+        convention), so their nulls lead; the merge must honor that
+        and still terminate."""
+        sft = parse_spec("t", "val:Double,*geom:Point:srid=4326")
+
+        def src(vals, prefix):
+            n = len(vals)
+            ids = np.array([f"{prefix}{i}" for i in range(n)],
+                           dtype=object)
+            return FeatureBatch.from_dict(sft, ids, {
+                "val": np.array(vals, dtype=np.float64),
+                "geom": (np.zeros(n), np.zeros(n))})
+
+        merged = list(merge_sorted_streams(
+            [iter([src([np.nan, 3.0, 1.0], "a")]),
+             iter([src([4.0, 2.0], "b")])], "val", reverse=True))
+        got = np.concatenate([m.columns["val"].values for m in merged])
+        assert len(got) == 5 and np.isnan(got[0])
+        np.testing.assert_array_equal(got[1:], [4.0, 3.0, 2.0, 1.0])
+
     def test_no_sort_key_concatenates_in_source_order(self):
         sft = parse_spec("pts", SPEC)
         a, b = make_batch(sft, 30, id_prefix="a"), \
@@ -450,6 +493,20 @@ class TestClusterStreaming:
         stream = cluster.query_stream(Query("pts", sort_by="name"),
                                       batch_rows=32)
         assert sum(b.n for b in stream) == 200   # the live leg's rows
+        assert stream.complete is False
+        assert stream.missing_groups == ["down"]
+        assert stream.missing_z_ranges and \
+            "prefix_lo" in stream.missing_z_ranges[0]
+
+    def test_truncated_partial_stream_still_flags_missing_leg(self):
+        """max_features truncation must not bypass the partial-results
+        bookkeeping: a leg that failed before the cut is reported
+        (regression: the early return skipped the missing/handle
+        update, so truncated streams always claimed complete=True)."""
+        cluster = self._half_down(allow_partial=True)
+        stream = cluster.query_stream(
+            Query("pts", sort_by="name", max_features=10), batch_rows=4)
+        assert sum(b.n for b in stream) == 10
         assert stream.complete is False
         assert stream.missing_groups == ["down"]
         assert stream.missing_z_ranges and \
