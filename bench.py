@@ -144,7 +144,8 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,6,7,8,9,10,11,12,13,14,northstar")
+                             "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,"
+                             "northstar")
               .split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
@@ -1896,7 +1897,7 @@ def bench_config14(rng, n=None, batch_rows=None):
 
     n = int(n if n is not None
             else os.environ.get("GEOMESA_TPU_BENCH_STREAM_N", 1_000_000))
-    rows = int(batch_rows if batch_rows is not None else 8096)
+    rows = int(batch_rows if batch_rows is not None else 8192)
     out = {"n": n, "batch_rows": rows}
 
     ds = InMemoryDataStore()
@@ -1976,6 +1977,190 @@ def bench_config14(rng, n=None, batch_rows=None):
             and streamed == n and drained == n)
     finally:
         server.stop()
+    return out
+
+
+# -- config 15: device-resident geofencing ---------------------------------
+
+def _geofence_ecql(rng, i: int) -> str:
+    """One standing filter: mostly plain geofence boxes, with time /
+    numeric-range / residual-LIKE variants mixed in (the residual tenth
+    exercises the evaluate-on-survivors patch path)."""
+    cx = float(rng.uniform(-178, 178))
+    cy = float(rng.uniform(-88, 88))
+    w = float(rng.uniform(0.5, 2.5))
+    box = (f"bbox(geom,{cx - w:.4f},{cy - w:.4f},"
+           f"{cx + w:.4f},{cy + w:.4f})")
+    m = i % 10
+    if m == 3:
+        return (f"{box} AND dtg DURING "
+                f"2016-07-01T00:00:00Z/2016-09-01T00:00:00Z")
+    if m == 5:
+        lo = float(rng.uniform(0, 200))
+        return f"{box} AND speed BETWEEN {lo:.2f} AND {lo + 40:.2f}"
+    if m == 7:
+        return f"{box} AND name LIKE 'u{i % 100}%'"
+    return box
+
+
+def _geofence_batch(rng, sft, n, tag):
+    from geomesa_tpu.features.batch import FeatureBatch
+    ms = rng.integers(T0_DAY * MS_DAY, T1_DAY * MS_DAY, n).astype(np.int64)
+    return FeatureBatch.from_dict(
+        sft, [f"{tag}_{i}" for i in range(n)],
+        {"name": [f"u{i % 500}" for i in range(n)],
+         "speed": rng.uniform(0, 300, n),
+         "dtg": ms,
+         "geom": (rng.uniform(-180, 180, n), rng.uniform(-90, 90, n))})
+
+
+def bench_config15(rng, n_filters=None, n_filters_big=None,
+                   ingest_rows=None, n_batches=None, big_rows=None):
+    """Standing-query matching at geofence scale, in three gates.
+
+    (A) Throughput, 10k filters x sustained ingest through the real
+        ``ContinuousQueryPublisher``: the fused device kernel
+        (``geomesa.cq.device``) vs the per-filter host ``evaluate``
+        loop (kill switch off) on identical batches — gate: device
+        >= 20x host rows/s. The matched-row ids published per topic
+        must be identical between the two runs (the kill switch is
+        bit-identical, not merely equivalent).
+    (B) Exactness, 100k filters x one bulk batch straight through
+        ``StandingFilterSet.dispatch``: per-filter hit rows id-exact
+        vs the per-filter ``filters.evaluate`` oracle, residual
+        filters included (GEOMESA_TPU_BENCH_GEOFENCE_ORACLE=0 checks
+        every filter; the default samples 2048, residual-stratified).
+    (C) Incrementality: register/unregister churn within the padded
+        cap triggers zero kernel recompiles (plan-cache counters).
+    """
+    from geomesa_tpu.features import parse_spec
+    from geomesa_tpu.filters import evaluate, parse_ecql
+    from geomesa_tpu.scan.standing import StandingFilterSet
+    from geomesa_tpu.store import LiveDataStore
+    from geomesa_tpu.store.continuous import (CQ_DEVICE,
+                                              ContinuousQueryPublisher)
+
+    env = os.environ.get
+    nf = int(n_filters if n_filters is not None
+             else env("GEOMESA_TPU_BENCH_GEOFENCE_FILTERS", 10_000))
+    nf_big = int(n_filters_big if n_filters_big is not None
+                 else env("GEOMESA_TPU_BENCH_GEOFENCE_FILTERS_BIG",
+                          100_000))
+    rows = int(ingest_rows if ingest_rows is not None
+               else env("GEOMESA_TPU_BENCH_GEOFENCE_INGEST_ROWS", 8192))
+    batches = int(n_batches if n_batches is not None
+                  else env("GEOMESA_TPU_BENCH_GEOFENCE_BATCHES", 4))
+    nbig = int(big_rows if big_rows is not None
+               else env("GEOMESA_TPU_BENCH_GEOFENCE_ROWS", 1_000_000))
+    oracle_sample = int(env("GEOMESA_TPU_BENCH_GEOFENCE_ORACLE", 2048))
+    spec = "name:String,speed:Double,dtg:Date,*geom:Point:srid=4326"
+    out = {"filters": nf, "filters_big": nf_big, "ingest_rows": rows,
+           "batches": batches, "bulk_rows": nbig}
+
+    ecqls = [_geofence_ecql(rng, i) for i in range(max(nf, nf_big))]
+    feed = [_geofence_batch(rng, parse_spec("g15", spec), rows, f"b{b}")
+            for b in range(batches)]
+    warm = _geofence_batch(rng, parse_spec("g15", spec), rows, "warm")
+
+    # -- (A) publisher throughput: device kernel vs host loop -------------
+    def run(device: bool):
+        sft = parse_spec("g15", spec)
+        store = LiveDataStore()
+        store.create_schema(sft)
+        pub = ContinuousQueryPublisher(store)
+        t0 = time.perf_counter()
+        for i in range(nf):
+            pub.register(f"q{i}", "g15", ecqls[i])
+        reg_s = time.perf_counter() - t0
+        CQ_DEVICE.set("true" if device else "false")
+        try:
+            # one unprobed warmup write: the device run's jit compile
+            # happens here, so the timed window is steady-state
+            store.write("g15", warm)
+            probe = {}
+            sample = list(range(0, nf, max(nf // 64, 1)))
+            for i in sample:
+                got: list = []
+                store.bus.subscribe(
+                    f"cq.q{i}",
+                    (lambda g: lambda m: g.extend(
+                        list(m.batch.ids)))(got))
+                probe[f"q{i}"] = got
+            t0 = time.perf_counter()
+            for b in feed:
+                store.write("g15", b)
+            elapsed = time.perf_counter() - t0
+        finally:
+            CQ_DEVICE.set(None)
+        pub.close()
+        return elapsed, reg_s, probe
+
+    host_s, host_reg_s, host_probe = run(device=False)
+    dev_s, dev_reg_s, dev_probe = run(device=True)
+    total = rows * batches
+    identical = all(host_probe[k] == dev_probe[k] for k in host_probe)
+    out["publisher"] = {
+        "register_per_s": round(nf / max(dev_reg_s, 1e-9)),
+        "host_s": round(host_s, 3),
+        "device_s": round(dev_s, 3),
+        "host_rows_per_s": round(total / max(host_s, 1e-9)),
+        "device_rows_per_s": round(total / max(dev_s, 1e-9)),
+        "device_speedup": round(host_s / max(dev_s, 1e-9), 2),
+        "topics_probed": len(host_probe),
+        "kill_switch_bit_identical": bool(identical)}
+
+    # -- (B) 100k-filter bulk exactness vs the evaluate oracle ------------
+    sft = parse_spec("g15b", spec)
+    fset = StandingFilterSet(sft)
+    parsed = [parse_ecql(e) for e in ecqls[:nf_big]]
+    t0 = time.perf_counter()
+    for i, f in enumerate(parsed):
+        fset.register(f"q{i}", f)
+    big_reg_s = time.perf_counter() - t0
+    bulk = _geofence_batch(rng, sft, nbig, "bulk")
+    t0 = time.perf_counter()
+    hits = fset.dispatch(bulk)
+    bulk_s = time.perf_counter() - t0
+    st = fset.stats()
+    if oracle_sample and oracle_sample < nf_big:
+        # residual-stratified sample: every 10th index is the LIKE
+        # variant, so a stride over the population keeps them in
+        check = list(range(0, nf_big,
+                           max(nf_big // oracle_sample, 1)))
+    else:
+        check = list(range(nf_big))
+    t0 = time.perf_counter()
+    mism = sum(
+        not np.array_equal(np.asarray(hits[f"q{i}"], dtype=np.int64),
+                           np.flatnonzero(evaluate(parsed[i], bulk)))
+        for i in check)
+    oracle_s = time.perf_counter() - t0
+    out["bulk"] = {
+        "register_per_s": round(nf_big / max(big_reg_s, 1e-9)),
+        "dispatch_s": round(bulk_s, 3),
+        "rows_per_s": round(nbig / max(bulk_s, 1e-9)),
+        "padded_cap": st["padded_cap"],
+        "residual_fraction": st["residual_fraction"],
+        "oracle_filters_checked": len(check),
+        "oracle_s": round(oracle_s, 2),
+        "id_exact": bool(mism == 0)}
+
+    # -- (C) churn within the padded cap never recompiles -----------------
+    miss0 = fset.cache_misses
+    for i in range(0, min(nf_big, 256)):
+        fset.unregister(f"q{i}")
+        fset.register(f"q{i}r", parsed[i])
+    # same row count as the bulk batch -> same jit shape class
+    fset.dispatch(_geofence_batch(rng, sft, nbig, "churn"))
+    out["churn"] = {"replaced": min(nf_big, 256),
+                    "recompiles": fset.cache_misses - miss0,
+                    "zero_recompile": bool(fset.cache_misses == miss0)}
+
+    out["gates_pass"] = bool(
+        out["publisher"]["device_speedup"] >= 20.0
+        and out["publisher"]["kill_switch_bit_identical"]
+        and out["bulk"]["id_exact"]
+        and out["churn"]["zero_recompile"])
     return out
 
 
@@ -2247,6 +2432,8 @@ def main(argv=None):
         out["configs"]["13_tail_latency"] = bench_config13(rng)
     if "14" in CONFIGS:
         out["configs"]["14_streaming"] = bench_config14(rng)
+    if "15" in CONFIGS:
+        out["configs"]["15_geofence"] = bench_config15(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

@@ -25,7 +25,8 @@ pyarrow's IPC **stream** format:
   ``merge_deltas`` concat-everything path).
 
 Knobs: ``geomesa.stream.batch.rows`` (rows per wire batch, default
-8096 — SimpleFeatureVector.scala:98) and
+8192 — pow2 so downstream padded shape classes land exactly, unlike
+the reference's 8096 vector capacity) and
 ``geomesa.stream.max.inflight.batches`` (producer->consumer queue
 depth for streamed scatter legs, cluster/coordinator.py).
 """
@@ -39,7 +40,7 @@ import numpy as np
 from ..features.batch import FeatureBatch
 from ..features.sft import SimpleFeatureType
 from ..utils.properties import SystemProperty
-from .io import DEFAULT_BATCH_SIZE, _empty_col, _schema_meta
+from .io import _empty_col, _schema_meta
 from .vector import ArrowDictionary
 
 __all__ = ["DeltaWriter", "STREAM_BATCH_ROWS", "STREAM_MAX_INFLIGHT",
@@ -47,9 +48,9 @@ __all__ = ["DeltaWriter", "STREAM_BATCH_ROWS", "STREAM_MAX_INFLIGHT",
            "slice_batches", "merge_sorted_streams", "reassemble_ipc",
            "empty_batch"]
 
-# rows per streamed record batch (the fixed vector capacity of the wire)
-STREAM_BATCH_ROWS = SystemProperty("geomesa.stream.batch.rows",
-                                   str(DEFAULT_BATCH_SIZE))
+# rows per streamed record batch (the fixed vector capacity of the
+# wire); 8192 not the reference's 8096 so pow2 shape classes fit
+STREAM_BATCH_ROWS = SystemProperty("geomesa.stream.batch.rows", "8192")
 # bounded producer->consumer depth for streamed scatter legs: a slow
 # consumer backpressures the legs instead of buffering them
 STREAM_MAX_INFLIGHT = SystemProperty("geomesa.stream.max.inflight.batches",
@@ -61,7 +62,7 @@ ARROW_STREAM_MIME = "application/vnd.apache.arrow.stream"
 def _rows(batch_rows: int | None) -> int:
     if batch_rows is not None:
         return max(int(batch_rows), 1)
-    return max(STREAM_BATCH_ROWS.as_int() or DEFAULT_BATCH_SIZE, 1)
+    return max(STREAM_BATCH_ROWS.as_int() or 8192, 1)
 
 
 def empty_batch(sft: SimpleFeatureType) -> FeatureBatch:

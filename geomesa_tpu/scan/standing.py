@@ -1,0 +1,528 @@
+"""Standing-filter set: 100k registered geofences as ONE fused kernel.
+
+The inverse of scan/zscan.py's batched ad-hoc scan: there the data is
+device-resident and queries arrive; here the FILTERS are
+device-resident (compiled to stacked bound arrays by
+filters/compile.py) and data arrives as ingest batches. Every create
+batch is evaluated against the whole filter population in a single
+``rows x filters`` launch — a vmap of the rectangle predicate over the
+filter axis — followed by the zscan count-then-compact transfer, so the
+host sees per-filter hit lists sized by actual matches, not
+rows*filters.
+
+Incrementality is the whole point of a STANDING set:
+
+- per-filter columns are capacity-padded to a power of two; ``register``
+  appends in place with ``dynamic_update_slice`` (amortized-doubling
+  rebuild only when the cap or per-filter box width grows) and
+  ``unregister`` tombstones the slot via the alive mask — neither
+  changes any device shape, so filter churn within the cap NEVER
+  recompiles (asserted via the plan-cache counters, the
+  scan/batcher.py observability pattern);
+- ingest rows are padded to the next power of two, so the jit shape
+  class is (filter cap, box width, attr count, padded rows) — a handful
+  of traces over a workload's whole life.
+
+Exactness mirrors zscan's conservative-mask + exact-patch split: the
+kernel compares two-float pairs against slightly WIDENED bounds (a
+guaranteed superset of the f64 predicate), and each filter's surviving
+candidates take a host patch — the cheap vectorized f64 recheck for
+compiled-exact filters, the full ``filters.evaluate`` oracle for
+residual ones (LIKE, polygons, OR trees). Either way the final hit set
+is id-exact against the oracle.
+
+Metrics (``cq.device.*``): dispatch timer, padded cap / live / residual
+fraction gauges, candidate+hit row counters, plan-cache hit/miss.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..filters.compile import (CompiledFilter, compile_filter, exact_hits,
+                               numeric_attrs)
+from ..metrics import metrics
+from ..utils.properties import SystemProperty
+from .zscan import (MILLIS_PER_DAY, _ge_two_float, _le_two_float,
+                    next_pow2, split_two_float)
+
+__all__ = ["StandingFilterSet", "STANDING_MIN_CAP", "CQ_DEVICE_MAX_CELLS"]
+
+# starting filter capacity (pow2); the set doubles from here on demand
+STANDING_MIN_CAP = 64
+
+# mask-cell budget per kernel launch: dispatch chunks ingest rows so
+# the fused mask never exceeds cap*chunk = this many bools (128M cells
+# ~= 128MB). 100k filters x a 1M-row bulk write would otherwise
+# materialize a 131GB mask; chunking bounds it and every full chunk
+# shares ONE jit shape class (the last chunk pads up to the same size)
+CQ_DEVICE_MAX_CELLS = SystemProperty("geomesa.cq.device.max.cells",
+                                     str(1 << 27))
+
+# values are clamped into +/-_F32_SAFE before the two-float split so
+# overflow-to-inf can never poison the lo residual with NaN; the clamp
+# is monotone, so superset-ness survives (host recheck restores f64)
+_F32_SAFE = 1.0e38
+
+# widened-bound slack: relative 1e-11 dominates the ~2^-47 relative
+# error of a two-float pair by three orders of magnitude, guaranteeing
+# the device compare never drops a true f64 match; the slack's false
+# positives die in the host recheck
+_WIDEN_REL = 1e-11
+
+# catch-all day range: filters with no time constraint carry an
+# interval spanning all representable days (zscan._CATCH_ALL_INTERVAL),
+# so the kernel needs no per-filter static time_any argument
+_TIME_ALL = (-(2 ** 30), 0, 2 ** 30, MILLIS_PER_DAY)
+
+
+def _clamp(a: np.ndarray) -> np.ndarray:
+    a = np.asarray(a, dtype=np.float64)
+    return np.clip(a, -_F32_SAFE, _F32_SAFE)
+
+
+def _widen_lo(v: float) -> float:
+    return v - (abs(v) + 1.0) * _WIDEN_REL
+
+
+def _widen_hi(v: float) -> float:
+    return v + (abs(v) + 1.0) * _WIDEN_REL
+
+
+def _split_bound(v: float) -> tuple[np.float32, np.float32]:
+    hi, lo = split_two_float(np.float64(v))
+    return np.float32(hi), np.float32(lo)
+
+
+def _split_time(millis: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    millis = np.asarray(millis, dtype=np.int64)
+    tday = (millis // MILLIS_PER_DAY).astype(np.int32)
+    tms = (millis - tday.astype(np.int64) * MILLIS_PER_DAY).astype(np.int32)
+    return tday, tms
+
+
+# -- in-place device row updates (one trace per array rank/dtype) ----------
+
+@jax.jit
+def _upd1(a, u, i):
+    return jax.lax.dynamic_update_slice(a, u, (i,))
+
+
+@jax.jit
+def _upd2(a, u, i):
+    return jax.lax.dynamic_update_slice(a, u[None], (i, 0))
+
+
+@jax.jit
+def _upd3(a, u, i):
+    return jax.lax.dynamic_update_slice(a, u[None], (i, 0, 0))
+
+
+# -- the fused rows x filters kernel ---------------------------------------
+
+@jax.jit
+def _standing_mask(xhi, xlo, yhi, ylo, tday, tms, avhi, avlo,
+                   boxes, box_valid, box_any, times,
+                   attrs, attr_any, alive, n_valid):
+    """bool[F_cap, rows_padded]: which rows each filter matches
+    (conservatively — widened bounds, see module docstring).
+
+    Row arrays: (Np,) two-float coords + day/ms times; (Np, A) two-float
+    attribute values. Filter arrays: (F, K, 8) boxes, (F, K) box_valid,
+    (F,) box_any (no spatial constraint: pass), (F, 4) inclusive time
+    envelopes, (F, A, 4) attribute bound pairs, (F, A) attr_any, (F,)
+    alive. ``n_valid`` masks the row padding (traced, not static)."""
+
+    def one(bx, bv, bany, tx, ab, aany):
+        sx = (_ge_two_float(xhi[:, None], xlo[:, None],
+                            bx[None, :, 0], bx[None, :, 1])
+              & _le_two_float(xhi[:, None], xlo[:, None],
+                              bx[None, :, 2], bx[None, :, 3])
+              & _ge_two_float(yhi[:, None], ylo[:, None],
+                              bx[None, :, 4], bx[None, :, 5])
+              & _le_two_float(yhi[:, None], ylo[:, None],
+                              bx[None, :, 6], bx[None, :, 7]))
+        spatial = bany | jnp.any(sx & bv[None, :], axis=1)
+        after = (tday > tx[0]) | ((tday == tx[0]) & (tms >= tx[1]))
+        before = (tday < tx[2]) | ((tday == tx[2]) & (tms <= tx[3]))
+        a_ge = _ge_two_float(avhi, avlo, ab[None, :, 0], ab[None, :, 1])
+        a_le = _le_two_float(avhi, avlo, ab[None, :, 2], ab[None, :, 3])
+        attr_ok = jnp.all(aany[None, :] | (a_ge & a_le), axis=1)
+        return spatial & after & before & attr_ok
+
+    m = jax.vmap(one)(boxes, box_valid, box_any, times, attrs, attr_any)
+    row_ok = jnp.arange(xhi.shape[0], dtype=jnp.int32) < n_valid
+    return m & alive[:, None] & row_ok[None, :]
+
+
+@jax.jit
+def _mask_total(mask):
+    return jnp.sum(mask, dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def _flat_nonzero(mask, size: int):
+    """Candidate (filter, row) cells as FLAT ascending indices into the
+    raveled mask — one nonzero over the whole mask beats a per-filter
+    vmapped nonzero by an order of magnitude, and ascending flat order
+    IS (filter-major, row-ascending) grouping for free."""
+    return jnp.nonzero(mask.ravel(), size=size, fill_value=mask.size)[0]
+
+
+@functools.lru_cache(maxsize=1)
+def _host_compact() -> bool:
+    """On the CPU backend the mask already lives in host memory, and
+    ``np.flatnonzero`` is ~200x faster than XLA:CPU's sized nonzero;
+    on an accelerator the device compaction keeps the transfer at
+    actual-candidate size instead of shipping the raw mask."""
+    return jax.default_backend() == "cpu"
+
+
+class StandingFilterSet:
+    """The registered filter population for one feature type, compiled
+    to capacity-padded device columns, plus the dispatch that matches
+    an ingest batch against all of it in one launch."""
+
+    def __init__(self, sft, registry=metrics, min_cap: int = STANDING_MIN_CAP):
+        self.sft = sft
+        self.geom_attr = sft.geom_field if sft.is_points else None
+        self.dtg_attr = sft.dtg_field
+        self.attr_names = numeric_attrs(sft)
+        self._registry = registry
+        self._lock = threading.RLock()
+        self._cap = max(next_pow2(max(min_cap, 1)), 1)
+        self._k = 1                       # boxes per filter (pow2)
+        self._slots: dict[str, int] = {}  # name -> slot
+        self._free: list[int] = []        # tombstoned slots, reusable
+        self._high = 0                    # high-water slot count
+        self._filters: list = []          # slot -> (name, ast, compiled)
+        self._alloc_host()
+        self._dev = None                  # lazy device mirrors
+        # jit shape-class observability (scan/batcher.py pattern): a
+        # probed key already seen means the dispatch reuses a trace
+        self._plan_keys: set[tuple] = set()
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    # -- storage ----------------------------------------------------------
+
+    def _alloc_host(self):
+        f, k, a = self._cap, self._k, len(self.attr_names)
+        self._boxes = np.zeros((f, k, 8), dtype=np.float32)
+        self._box_valid = np.zeros((f, k), dtype=bool)
+        self._box_any = np.zeros(f, dtype=bool)
+        self._times = np.zeros((f, 4), dtype=np.int32)
+        self._attrs = np.zeros((f, a, 4), dtype=np.float32)
+        self._attr_any = np.zeros((f, a), dtype=bool)
+        self._alive = np.zeros(f, dtype=bool)
+
+    def _device(self):
+        if self._dev is None:
+            self._dev = [jnp.asarray(a) for a in (
+                self._boxes, self._box_valid, self._box_any, self._times,
+                self._attrs, self._attr_any, self._alive)]
+        return self._dev
+
+    def _encode(self, cf: CompiledFilter):
+        """CompiledFilter -> one row of each per-filter array (widened
+        two-float bounds, catch-all defaults)."""
+        k, a = self._k, len(self.attr_names)
+        boxes = np.zeros((k, 8), dtype=np.float32)
+        box_valid = np.zeros(k, dtype=bool)
+        box_any = cf.spatial_any and not cf.never
+        for i, (xmin, ymin, xmax, ymax) in enumerate(cf.boxes):
+            xminh, xminl = _split_bound(_widen_lo(_clamp(xmin)))
+            xmaxh, xmaxl = _split_bound(_widen_hi(_clamp(xmax)))
+            yminh, yminl = _split_bound(_widen_lo(_clamp(ymin)))
+            ymaxh, ymaxl = _split_bound(_widen_hi(_clamp(ymax)))
+            boxes[i] = (xminh, xminl, xmaxh, xmaxl,
+                        yminh, yminl, ymaxh, ymaxl)
+            box_valid[i] = True
+        times = np.asarray(_TIME_ALL, dtype=np.int32)
+        if cf.interval is not None:
+            lo, hi = cf.interval
+            lod, lom = (_TIME_ALL[0], _TIME_ALL[1]) if lo is None \
+                else (lo // MILLIS_PER_DAY, lo % MILLIS_PER_DAY)
+            hid, him = (_TIME_ALL[2], _TIME_ALL[3]) if hi is None \
+                else (hi // MILLIS_PER_DAY, hi % MILLIS_PER_DAY)
+            times = np.asarray((lod, lom, hid, him), dtype=np.int32)
+        attrs = np.zeros((a, 4), dtype=np.float32)
+        attr_any = np.ones(a, dtype=bool)
+        for j, name in enumerate(self.attr_names):
+            ab = cf.attr_bounds.get(name)
+            if ab is None:
+                continue
+            attr_any[j] = False
+            lo = -_F32_SAFE if ab.lo is None \
+                else _widen_lo(float(_clamp(ab.lo)))
+            hi = _F32_SAFE if ab.hi is None \
+                else _widen_hi(float(_clamp(ab.hi)))
+            loh, lol = _split_bound(lo)
+            hih, hil = _split_bound(hi)
+            attrs[j] = (loh, lol, hih, hil)
+        if cf.never:
+            # dead on arrival: no box, no box_any -> spatial never passes
+            box_valid[:] = False
+            box_any = False
+        return boxes, box_valid, box_any, times, attrs, attr_any
+
+    def _write_slot(self, slot: int, row, alive: bool):
+        boxes, box_valid, box_any, times, attrs, attr_any = row
+        self._boxes[slot] = boxes
+        self._box_valid[slot] = box_valid
+        self._box_any[slot] = box_any
+        self._times[slot] = times
+        self._attrs[slot] = attrs
+        self._attr_any[slot] = attr_any
+        self._alive[slot] = alive
+        if self._dev is not None:
+            d = self._dev
+            i = slot  # python int traces as a dynamic scalar: no retrace
+            d[0] = _upd3(d[0], jnp.asarray(boxes), i)
+            d[1] = _upd2(d[1], jnp.asarray(box_valid), i)
+            d[2] = _upd1(d[2], jnp.asarray([box_any]), i)
+            d[3] = _upd2(d[3], jnp.asarray(times), i)
+            d[4] = _upd3(d[4], jnp.asarray(attrs), i)
+            d[5] = _upd2(d[5], jnp.asarray(attr_any), i)
+            d[6] = _upd1(d[6], jnp.asarray([alive]), i)
+
+    def _grow(self, cap: int | None = None, k: int | None = None):
+        """Amortized-doubling rebuild: re-encode every live filter into
+        fresh host arrays (device mirrors re-upload lazily)."""
+        self._cap = max(self._cap, next_pow2(max(cap or 0, 1)))
+        self._k = max(self._k, next_pow2(max(k or 0, 1)))
+        live = [(name, f, cf) for (name, f, cf) in self._filters
+                if name is not None]
+        self._alloc_host()
+        self._dev = None
+        self._slots = {}
+        self._free = []
+        self._filters = []
+        self._high = 0
+        for name, f, cf in live:
+            self._append(name, f, cf)
+
+    def _append(self, name: str, f, cf: CompiledFilter):
+        if self._free:
+            slot = self._free.pop()
+            self._filters[slot] = (name, f, cf)
+        else:
+            slot = self._high
+            if slot >= self._cap:
+                self._grow(cap=self._cap * 2)
+                self._append(name, f, cf)
+                return
+            self._high += 1
+            self._filters.append((name, f, cf))
+        self._slots[name] = slot
+        self._write_slot(slot, self._encode(cf), alive=not cf.never)
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, name: str, f, compiled: CompiledFilter | None = None):
+        """Compile + append one standing filter. Within the padded cap
+        this is a pure in-place row write — no shape changes."""
+        with self._lock:
+            if name in self._slots:
+                raise ValueError(f"standing filter {name!r} exists")
+            cf = compiled if compiled is not None \
+                else compile_filter(f, self.sft)
+            if cf.n_boxes > self._k:
+                self._grow(k=cf.n_boxes)
+            self._append(name, f, cf)
+            self._gauges()
+            return cf
+
+    def unregister(self, name: str) -> bool:
+        """Tombstone a filter: alive goes False in place, the slot is
+        reused by the next register. Never reshapes, never recompiles."""
+        with self._lock:
+            slot = self._slots.pop(name, None)
+            if slot is None:
+                return False
+            self._filters[slot] = (None, None, None)
+            self._free.append(slot)
+            self._alive[slot] = False
+            if self._dev is not None:
+                self._dev[6] = _upd1(self._dev[6],
+                                     jnp.asarray([False]), slot)
+            self._gauges()
+            return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._slots
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _rows_host(self, batch):
+        """Ingest batch -> host row arrays (two-float coords/attrs,
+        split times), unchunked and unpadded. Null coords/attrs are NaN
+        and simply never match constrained filters (they are never true
+        matches for those), while unconstrained dimensions pass via
+        box_any/attr_any/catch-all."""
+        n = batch.n
+        if self.geom_attr is not None:
+            col = batch.col(self.geom_attr)
+            xhi, xlo = split_two_float(_clamp(col.x))
+            yhi, ylo = split_two_float(_clamp(col.y))
+        else:
+            xhi = xlo = yhi = ylo = np.zeros(n, dtype=np.float32)
+        if self.dtg_attr is not None:
+            col = batch.col(self.dtg_attr)
+            tday, tms = _split_time(np.where(col.valid, col.millis, 0))
+        else:
+            tday = tms = np.zeros(n, dtype=np.int32)
+        a = len(self.attr_names)
+        avhi = np.zeros((n, a), dtype=np.float32)
+        avlo = np.zeros((n, a), dtype=np.float32)
+        for j, name in enumerate(self.attr_names):
+            col = batch.col(name)
+            vals = _clamp(np.where(col.valid,
+                                   col.values.astype(np.float64), np.nan))
+            vhi, vlo = split_two_float(vals)
+            avhi[:, j] = vhi
+            avlo[:, j] = vlo
+        return (np.asarray(xhi, dtype=np.float32),
+                np.asarray(xlo, dtype=np.float32),
+                np.asarray(yhi, dtype=np.float32),
+                np.asarray(ylo, dtype=np.float32),
+                tday.astype(np.int32), tms.astype(np.int32),
+                avhi, avlo)
+
+    @staticmethod
+    def _chunk_device(rows, start: int, stop: int, chunk: int):
+        """Slice [start:stop) out of the host row arrays and pad to the
+        fixed chunk size (every chunk shares one device shape)."""
+        out = []
+        for a in rows:
+            buf = np.zeros((chunk,) + a.shape[1:], dtype=a.dtype)
+            buf[:stop - start] = a[start:stop]
+            out.append(jnp.asarray(buf))
+        return out
+
+    def _chunk_rows(self, n: int) -> int:
+        """Rows per kernel launch: the largest pow2 keeping the fused
+        mask under the cell budget, clamped to the padded batch size."""
+        cells = max(CQ_DEVICE_MAX_CELLS.as_int() or (1 << 27), 1)
+        q = max(cells // max(self._cap, 1), 1)
+        chunk = 1 << (q.bit_length() - 1)
+        return min(chunk, next_pow2(max(n, 1)))
+
+    def _probe_plan_cache(self, key: tuple):
+        hit = key in self._plan_keys
+        if hit:
+            self.cache_hits += 1
+        else:
+            self._plan_keys.add(key)
+            self.cache_misses += 1
+        reg = self._registry
+        reg.counter("cq.device.plan_cache.hit" if hit
+                    else "cq.device.plan_cache.miss")
+
+    def dispatch(self, batch) -> dict[str, np.ndarray]:
+        """Match one ingest batch against every registered filter:
+        {filter name: sorted hit row indices}, id-exact vs the
+        ``filters.evaluate`` oracle. Rows stream through the kernel in
+        fixed-size chunks (CQ_DEVICE_MAX_CELLS bounds cap*chunk), so a
+        1M-row bulk write at 100k filters runs in constant device
+        memory."""
+        with self._lock:
+            if not self._slots:
+                return {}
+            entries = [(name, slot, self._filters[slot][1],
+                        self._filters[slot][2])
+                       for name, slot in self._slots.items()]
+            reg = self._registry
+            n = batch.n
+            with reg.time("cq.device.dispatch"):
+                rows = self._rows_host(batch)
+                chunk = self._chunk_rows(n)
+                key = (self._cap, self._k, len(self.attr_names), chunk)
+                self._probe_plan_cache(key)
+                fids_parts: list[np.ndarray] = []
+                rows_parts: list[np.ndarray] = []
+                for start in range(0, n, chunk):
+                    stop = min(start + chunk, n)
+                    dev = self._chunk_device(rows, start, stop, chunk)
+                    mask = _standing_mask(*dev, *self._device(),
+                                          jnp.int32(stop - start))
+                    if _host_compact():
+                        flat = np.flatnonzero(np.asarray(mask))
+                        if not len(flat):
+                            continue
+                    else:
+                        total = int(_mask_total(mask))
+                        if not total:
+                            continue
+                        size = next_pow2(total)
+                        flat = np.asarray(_flat_nonzero(
+                            mask, size))[:total].astype(np.int64)
+                    fids_parts.append(flat // chunk)
+                    rows_parts.append(flat % chunk + start)
+                if fids_parts:
+                    fids = np.concatenate(fids_parts)
+                    rws = np.concatenate(rows_parts)
+                    # stable by filter id: per-filter rows stay
+                    # ascending because chunks were visited in order
+                    order = np.argsort(fids, kind="stable")
+                    fids = fids[order]
+                    rws = rws[order]
+                    lo = np.searchsorted(fids, np.arange(self._cap))
+                    hi = np.searchsorted(fids, np.arange(self._cap),
+                                         side="right")
+                else:
+                    rws = np.empty(0, dtype=np.int64)
+                    lo = hi = np.zeros(self._cap + 1, dtype=np.int64)
+            out: dict[str, np.ndarray] = {}
+            cand_rows = 0
+            for name, slot, f, cf in entries:
+                cand = rws[lo[slot]:hi[slot]]
+                cand_rows += len(cand)
+                out[name] = exact_hits(cf, f, batch, cand)
+            n_res = sum(1 for _, _, _, cf in entries if cf.residual)
+            reg.counter("cq.device.rows", n)
+            reg.counter("cq.device.candidates", cand_rows)
+            reg.counter("cq.device.hits",
+                        int(sum(len(h) for h in out.values())))
+            self._gauges(residual=n_res / max(len(entries), 1))
+            return out
+
+    # -- observability -----------------------------------------------------
+
+    def _gauges(self, residual: float | None = None):
+        reg = self._registry
+        reg.gauge("cq.device.padded_cap", self._cap)
+        reg.gauge("cq.device.live", len(self._slots))
+        if residual is not None:
+            reg.gauge("cq.device.residual.fraction", round(residual, 4))
+        probes = self.cache_hits + self.cache_misses
+        if probes:
+            reg.gauge("cq.device.plan_cache.hit_rate",
+                      round(self.cache_hits / probes, 4))
+
+    def stats(self) -> dict:
+        with self._lock:
+            n_res = sum(1 for e in self._filters
+                        if e[0] is not None and e[2].residual)
+            live = len(self._slots)
+            return {
+                "type_name": self.sft.type_name,
+                "live": live,
+                "padded_cap": self._cap,
+                "boxes_per_filter": self._k,
+                "tracked_attrs": list(self.attr_names),
+                "residual": n_res,
+                "residual_fraction": round(n_res / max(live, 1), 4),
+                "plan_cache_hits": self.cache_hits,
+                "plan_cache_misses": self.cache_misses,
+            }

@@ -18,9 +18,23 @@ and duplicate-free from the last committed offset (the
 ZookeeperOffsetManager analog). The in-process ``MessageBus`` works
 too for single-process pipelines (push delivery, no offsets).
 
-Knob: ``geomesa.cq.publish.batch.rows`` caps rows per published delta
-message — a bulk write matching 1M rows streams to subscribers as
-fixed-size messages, not one giant frame.
+Matching is DEVICE-RESIDENT by default: every registered filter is
+compiled into a per-type ``StandingFilterSet`` (scan/standing.py), so
+one ingest batch against 100k standing geofences is a single fused
+``rows x filters`` kernel launch plus per-filter host patches — not
+100k interpreted ``evaluate`` passes. The ``geomesa.cq.device`` kill
+switch falls back to the original host loop, which is also the forced
+path for stores whose schemas the publisher cannot read (the two paths
+publish bit-identical deltas; tests assert it).
+
+Knobs:
+
+- ``geomesa.cq.publish.batch.rows`` caps rows per published delta
+  message — a bulk write matching 1M rows streams to subscribers as
+  fixed-size messages, not one giant frame.
+- ``geomesa.cq.device`` (default true) — fuse standing-filter matching
+  into one device dispatch per ingest batch; ``false`` restores the
+  per-filter host loop.
 """
 
 from __future__ import annotations
@@ -36,12 +50,17 @@ from ..utils.properties import SystemProperty
 from .live import GeoMessage
 
 __all__ = ["ContinuousQuery", "ContinuousQueryPublisher",
-           "ContinuousQuerySubscriber", "CQ_PUBLISH_BATCH_ROWS"]
+           "ContinuousQuerySubscriber", "CQ_PUBLISH_BATCH_ROWS",
+           "CQ_DEVICE"]
 
 # rows per published continuous-query delta message: bounds subscriber
 # (and broker frame) memory when a bulk write matches many rows
 CQ_PUBLISH_BATCH_ROWS = SystemProperty("geomesa.cq.publish.batch.rows",
-                                       "8096")
+                                       "8192")
+
+# device-resident standing-filter matching (scan/standing.py); false
+# falls back to the per-filter host evaluate loop
+CQ_DEVICE = SystemProperty("geomesa.cq.device", "true")
 
 
 def cq_topic(name: str) -> str:
@@ -83,6 +102,11 @@ class ContinuousQueryPublisher:
         self._queries: dict[str, ContinuousQuery] = {}
         self._attached: set[str] = set()
         self._lock = threading.Lock()
+        # one StandingFilterSet per type; types whose schema the
+        # publisher cannot read stay host-only FOREVER (a set created
+        # late would miss earlier registrations)
+        self._sets: dict = {}
+        self._host_only: set[str] = set()
 
     @staticmethod
     def _store_bus(store):
@@ -100,33 +124,103 @@ class ContinuousQueryPublisher:
         with self._lock:
             if name in self._queries:
                 raise ValueError(f"continuous query {name!r} exists")
+            fset = self._set_for(type_name)
+            if fset is not None:
+                fset.register(name, cq.filter)
             self._queries[name] = cq
             attach = type_name not in self._attached
             if attach:
                 self._attached.add(type_name)
+            n = len(self._queries)
         if attach:
             self._attach(type_name)
-        self._registry.gauge("cq.registered", len(self._queries))
+        self._registry.gauge("cq.registered", n)
         return cq
 
     def unregister(self, name: str):
+        """Drop a standing query; detaches the store listener when the
+        last query for its type goes (a publisher must not keep
+        evaluating types nobody watches)."""
         with self._lock:
-            self._queries.pop(name, None)
-        self._registry.gauge("cq.registered", len(self._queries))
+            cq = self._queries.pop(name, None)
+            detach = None
+            if cq is not None:
+                fset = self._sets.get(cq.type_name)
+                if fset is not None:
+                    fset.unregister(name)
+                if cq.type_name in self._attached and not any(
+                        q.type_name == cq.type_name
+                        for q in self._queries.values()):
+                    self._attached.discard(cq.type_name)
+                    detach = cq.type_name
+            n = len(self._queries)
+        if detach is not None:
+            self._detach(detach)
+        self._registry.gauge("cq.registered", n)
+
+    def close(self):
+        """Detach every store listener and drop all queries; the
+        publisher stops evaluating entirely."""
+        with self._lock:
+            attached = list(self._attached)
+            self._attached.clear()
+            self._queries.clear()
+            self._sets.clear()
+            self._host_only.clear()
+        for type_name in attached:
+            self._detach(type_name)
+        self._registry.gauge("cq.registered", 0)
 
     def queries(self) -> list[ContinuousQuery]:
         with self._lock:
             return list(self._queries.values())
 
-    def _attach(self, type_name: str):
+    def device_stats(self) -> list[dict]:
+        """Per-type StandingFilterSet stats (empty when every type is
+        on the host path)."""
+        with self._lock:
+            return [s.stats() for s in self._sets.values()]
+
+    def _set_for(self, type_name: str):
+        """The type's StandingFilterSet, created on first registration;
+        None (host-only, sticky) when the schema is unreadable —
+        e.g. a bus-fed store that has not seen the type yet."""
+        if type_name in self._host_only:
+            return None
+        fset = self._sets.get(type_name)
+        if fset is None:
+            from ..scan.standing import StandingFilterSet
+            try:
+                sft = self.store.get_schema(type_name)
+                fset = StandingFilterSet(sft, registry=self._registry)
+            except Exception:
+                self._host_only.add(type_name)
+                return None
+            self._sets[type_name] = fset
+        return fset
+
+    @staticmethod
+    def _takes_type(fn) -> bool:
         # LiveDataStore.add_listener(type_name, fn);
         # StreamDataStore.add_listener(fn) — bound to its one type
-        add = self.store.add_listener
         import inspect
-        if len(inspect.signature(add).parameters) >= 2:
+        return len(inspect.signature(fn).parameters) >= 2
+
+    def _attach(self, type_name: str):
+        add = self.store.add_listener
+        if self._takes_type(add):
             add(type_name, self._on_message)
         else:
             add(self._on_message)
+
+    def _detach(self, type_name: str):
+        remove = getattr(self.store, "remove_listener", None)
+        if remove is None:
+            return
+        if self._takes_type(remove):
+            remove(type_name, self._on_message)
+        else:
+            remove(self._on_message)
 
     # -- the push path -------------------------------------------------------
 
@@ -134,13 +228,28 @@ class ContinuousQueryPublisher:
         with self._lock:
             cqs = [cq for cq in self._queries.values()
                    if cq.type_name == msg.type_name]
+            fset = self._sets.get(msg.type_name)
         if not cqs:
             return
         if msg.kind == "create" and msg.batch is not None and msg.batch.n:
-            rows = max(CQ_PUBLISH_BATCH_ROWS.as_int() or 8096, 1)
+            rows = max(CQ_PUBLISH_BATCH_ROWS.as_int() or 8192, 1)
+            # one fused rows x filters device dispatch for the whole
+            # standing population; any failure falls back to the host
+            # loop for this message (both paths emit identical hits)
+            device_hits = None
+            if fset is not None and len(fset) and CQ_DEVICE.as_bool():
+                try:
+                    device_hits = fset.dispatch(msg.batch)
+                except Exception:
+                    self._registry.counter("cq.device.errors")
+                    device_hits = None
             for cq in cqs:
-                mask = evaluate(cq.filter, msg.batch)
-                hits = np.flatnonzero(mask)
+                if device_hits is not None:
+                    hits = device_hits.get(
+                        cq.name, np.empty(0, dtype=np.int64))
+                else:
+                    mask = evaluate(cq.filter, msg.batch)
+                    hits = np.flatnonzero(mask)
                 if not len(hits):
                     continue
                 cq.matched += len(hits)

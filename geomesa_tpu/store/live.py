@@ -196,6 +196,12 @@ class LiveDataStore(DataStore):
     def add_listener(self, type_name: str, fn: Callable[[GeoMessage], None]):
         self._listeners.setdefault(type_name, []).append(fn)
 
+    def remove_listener(self, type_name: str,
+                        fn: Callable[[GeoMessage], None]):
+        fns = self._listeners.get(type_name, [])
+        if fn in fns:
+            fns.remove(fn)
+
     # -- maintenance -------------------------------------------------------
 
     def expire(self, type_name: str, now_ms: int | None = None) -> int:

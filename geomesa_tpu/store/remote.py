@@ -593,3 +593,20 @@ class RemoteDataStore(DataStore):
         params = {"type": type_name} if type_name else None
         out = self._json("POST", "/rest/cache/invalidate", params)
         return int(out.get("invalidated", 0))
+
+    def cq_status(self) -> dict:
+        """GET /rest/cq: registered continuous queries plus per-type
+        device filter-set stats."""
+        return self._json("GET", "/rest/cq")
+
+    def cq_register(self, name: str, type_name: str,
+                    ecql: str = "INCLUDE") -> dict:
+        """POST /rest/cq/register (bearer-gated); the ECQL travels in a
+        JSON body, not the query string."""
+        body = json.dumps({"name": name, "type": type_name,
+                           "ecql": ecql}).encode()
+        return self._json("POST", "/rest/cq/register", body=body)
+
+    def cq_unregister(self, name: str) -> dict:
+        """POST /rest/cq/unregister?name= (bearer-gated)."""
+        return self._json("POST", "/rest/cq/unregister", {"name": name})

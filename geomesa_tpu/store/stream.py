@@ -159,6 +159,9 @@ class StreamDataStore(DataStore):
     def add_listener(self, fn):
         self._live.add_listener(self.sft.type_name, fn)
 
+    def remove_listener(self, fn):
+        self._live.remove_listener(self.sft.type_name, fn)
+
     # -- DataStore surface -------------------------------------------------
 
     def create_schema(self, sft, spec=None):

@@ -510,6 +510,44 @@ def cmd_cache(args) -> int:
     return 2
 
 
+def cmd_cq(args) -> int:
+    """Continuous-query administration against a serving node:
+    ``list`` dumps registered standing queries + device filter-set
+    stats; ``register``/``unregister`` mutate the standing population
+    (bearer-gated on remote nodes)."""
+    path = args.path
+    if not path.startswith("remote://"):
+        print("cq commands need --path remote://host:port",
+              file=sys.stderr)
+        return 2
+    from ..store import RemoteDataStore
+    from ..store.remote import RemoteError
+    host, _, port = path[len("remote://"):].partition(":")
+    ds = RemoteDataStore(host or "127.0.0.1", int(port) if port else 8080,
+                         auth_token=getattr(args, "token", None))
+    try:
+        if args.cq_command == "list":
+            json.dump(ds.cq_status(), sys.stdout, indent=2)
+        elif args.cq_command == "register":
+            json.dump(ds.cq_register(args.name, getattr(args, "type"),
+                                     args.cql or "INCLUDE"),
+                      sys.stdout, indent=2)
+        elif args.cq_command == "unregister":
+            json.dump(ds.cq_unregister(args.name), sys.stdout, indent=2)
+        else:
+            print(f"unknown cq command {args.cq_command!r}",
+                  file=sys.stderr)
+            return 2
+    except RemoteError as e:
+        if e.status == 403:
+            print("cq mutation is gated: pass --token matching "
+                  "geomesa.web.auth.token", file=sys.stderr)
+            return 3
+        raise
+    print()
+    return 0
+
+
 def cmd_version(args) -> int:
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -664,6 +702,32 @@ def main(argv=None) -> int:
             ap.add_argument("--type", default=None,
                             help="schema to invalidate (default: all)")
         ap.set_defaults(fn=cmd_cache)
+
+    cqp = sub.add_parser("cq",
+                         help="continuous-query (standing geofence) "
+                              "administration")
+    cqsub = cqp.add_subparsers(dest="cq_command", required=True)
+    for qname, qhelp in (("list", "registered queries + device "
+                                  "filter-set stats"),
+                         ("register", "add a standing query "
+                                      "(token-gated)"),
+                         ("unregister", "drop a standing query "
+                                        "(token-gated)")):
+        qp = cqsub.add_parser(qname, help=qhelp)
+        qp.add_argument("--path", required=True,
+                        help="serving node, remote://host:port")
+        qp.add_argument("--token", default=None,
+                        help="admin bearer token "
+                             "(geomesa.web.auth.token)")
+        if qname in ("register", "unregister"):
+            qp.add_argument("--name", required=True,
+                            help="continuous query name")
+        if qname == "register":
+            qp.add_argument("--type", required=True,
+                            help="schema the query watches")
+            qp.add_argument("--cql", default=None,
+                            help="ECQL filter (default INCLUDE)")
+        qp.set_defaults(fn=cmd_cq)
 
     add("version", cmd_version, needs_store=False)
     add("env", cmd_env, needs_store=False)

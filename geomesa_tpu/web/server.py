@@ -82,7 +82,7 @@ WEB_AUTH_TOKEN = SystemProperty("geomesa.web.auth.token", None)
 # GET /rest/wal stays open (read-only stats)
 _GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas"),
           ("POST", "wal"), ("POST", "replication"), ("POST", "integrity"),
-          ("POST", "cluster"), ("POST", "cache")}
+          ("POST", "cluster"), ("POST", "cache"), ("POST", "cq")}
 
 # load-shedding gate: max concurrent in-flight requests (unset ->
 # unlimited). Requests over the cap get 503 + Retry-After BEFORE any
@@ -103,9 +103,15 @@ class GeoMesaWebServer:
 
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
                  audit=None, auth_token: str | None = None,
-                 batcher=None, max_inflight: int | None = None):
+                 batcher=None, max_inflight: int | None = None,
+                 cq=None):
         from ..scan.registry import shared_batcher
         self.store = store
+        # continuous-query publisher behind /rest/cq: pass one in, or
+        # the first POST /rest/cq/register creates it lazily (needs a
+        # store with a mutation bus)
+        self.cq = cq
+        self._owns_cq = False
         self.audit = audit if audit is not None \
             else getattr(store, "audit", None)
         self.auth_token = (auth_token if auth_token is not None
@@ -151,6 +157,8 @@ class GeoMesaWebServer:
     def stop(self):
         if self.refresher is not None:
             self.refresher.stop()
+        if self._owns_cq and self.cq is not None:
+            self.cq.close()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -398,6 +406,8 @@ class GeoMesaWebServer:
             return 200, "application/json", _j(metrics.snapshot())
         if parts and parts[0] == "cache":
             return self._cache(method, parts[1:], params)
+        if parts and parts[0] == "cq":
+            return self._cq(method, parts[1:], params, body)
         if parts == ["sql"]:
             # POST body or ?q= : a SELECT with ST_* predicates/joins
             stmt = (body.decode() if method == "POST" and body
@@ -737,6 +747,72 @@ class GeoMesaWebServer:
         if etag is not None and getattr(data, "complete", True) is not False:
             hdrs["ETag"] = etag
         return 200, "application/octet-stream", bytes(data), hdrs
+
+    def _cq_publisher(self):
+        if self.cq is None:
+            from ..store.continuous import ContinuousQueryPublisher
+            try:
+                self.cq = ContinuousQueryPublisher(self.store)
+            except ValueError:
+                return None
+            self._owns_cq = True
+        return self.cq
+
+    def _cq(self, method, parts, params, body):
+        """Continuous-query admin: GET /rest/cq (registered queries +
+        per-type device filter-set stats, open); POST
+        /rest/cq/register?name=&type=&ecql= and POST
+        /rest/cq/unregister?name= (mutating, bearer-gated via _GATED).
+        Register args also accepted as a JSON body — long ECQL reads
+        better there than in a query string."""
+        if method == "GET" and not parts:
+            out = {"queries": [], "device": []}
+            if self.cq is not None:
+                out["queries"] = [
+                    {"name": q.name, "type": q.type_name, "ecql": q.ecql,
+                     "topic": q.topic, "matched": q.matched,
+                     "published": q.published}
+                    for q in self.cq.queries()]
+                out["device"] = self.cq.device_stats()
+            return 200, "application/json", _j(out)
+        if method == "POST" and parts in (["register"], ["unregister"]):
+            args = {k: v[0] for k, v in params.items()}
+            if body:
+                try:
+                    parsed = json.loads(body)
+                    if not isinstance(parsed, dict):
+                        raise ValueError("body must be a JSON object")
+                    args.update(parsed)
+                except ValueError as e:
+                    return 400, "application/json", _j(
+                        {"error": f"bad JSON body: {e}"})
+            pub = self._cq_publisher()
+            if pub is None:
+                return 404, "application/json", _j(
+                    {"error": "store has no mutation bus for "
+                              "continuous queries"})
+            name = args.get("name")
+            if not name:
+                return 400, "application/json", _j(
+                    {"error": "name required"})
+            if parts == ["register"]:
+                type_name = args.get("type")
+                if not type_name:
+                    return 400, "application/json", _j(
+                        {"error": "type required"})
+                ecql = args.get("ecql") or "INCLUDE"
+                try:
+                    cq = pub.register(name, type_name, ecql)
+                except ValueError as e:
+                    status = 409 if "exists" in str(e) else 400
+                    return status, "application/json", _j(
+                        {"error": str(e)})
+                return 200, "application/json", _j(
+                    {"registered": cq.name, "type": cq.type_name,
+                     "topic": cq.topic})
+            pub.unregister(name)
+            return 200, "application/json", _j({"unregistered": name})
+        return 404, "application/json", _j({"error": "not found"})
 
     def _cache(self, method, parts, params):
         """Materialized-cache admin: GET /rest/cache (status, open),

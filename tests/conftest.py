@@ -52,3 +52,7 @@ def pytest_configure(config):
         "markers", "streaming: streaming result-plane suites (Arrow "
         "delta batches, chunked wire endpoints, k-way stream merge, "
         "continuous queries; select with -m streaming)")
+    config.addinivalue_line(
+        "markers", "geofence: device-resident standing-filter suites "
+        "(filter compiler, fused rows x filters kernel, publisher "
+        "device path, /rest/cq surfaces; select with -m geofence)")

@@ -129,6 +129,30 @@ def test_load_gate_reports_without_exiting(monkeypatch, capsys):
 
 
 @pytest.mark.bench_smoke
+@pytest.mark.geofence
+def test_config15_geofence_smoke():
+    rng = np.random.default_rng(48)
+    c = bench.bench_config15(rng, n_filters=150, n_filters_big=300,
+                             ingest_rows=1024, n_batches=2,
+                             big_rows=2048)
+    p = c["publisher"]
+    # the kill switch must be bit-identical at any size; the >=20x
+    # speedup gate only means something on the real accelerator
+    assert p["kill_switch_bit_identical"] is True
+    assert p["topics_probed"] > 0
+    assert p["host_rows_per_s"] > 0 and p["device_rows_per_s"] > 0
+    assert "device_speedup" in p  # the full-size run gates on it
+    b = c["bulk"]
+    assert b["id_exact"] is True
+    assert b["oracle_filters_checked"] == 300  # residual ones included
+    assert 0.05 < b["residual_fraction"] < 0.2
+    assert b["padded_cap"] >= 300
+    ch = c["churn"]
+    assert ch["zero_recompile"] is True and ch["recompiles"] == 0
+    assert "gates_pass" in c
+
+
+@pytest.mark.bench_smoke
 def test_config14_streaming_smoke():
     rng = np.random.default_rng(47)
     c = bench.bench_config14(rng, n=30_000, batch_rows=2048)

@@ -1,0 +1,549 @@
+"""Device-resident geofencing: the standing-filter compiler, the fused
+rows x filters kernel, and the publisher/web/CLI surfaces around it.
+
+The load-bearing contract is id-exactness: for every registered filter
+— compiled-exact, residual (LIKE / OR trees / fid filters), or
+provably-never — the fused device dispatch must return EXACTLY the
+rows the per-filter ``filters.evaluate`` oracle returns, including on
+batches with NaN coordinates, null dates, and null numeric attributes,
+and regardless of how many row chunks the dispatch splits into. On top
+of that: filter churn within the padded capacity never recompiles
+(plan-cache counters), the ``geomesa.cq.device`` kill switch restores
+bit-identical host-loop publishes, and visibilities stay row-aligned
+through chunked deltas when a strict subset of rows match."""
+
+import io
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.filters import evaluate, parse_ecql
+from geomesa_tpu.filters.compile import (compile_filter, exact_hits,
+                                         exact_match, numeric_attrs)
+from geomesa_tpu.scan.standing import (CQ_DEVICE_MAX_CELLS,
+                                       StandingFilterSet)
+from geomesa_tpu.store.continuous import (CQ_DEVICE,
+                                          CQ_PUBLISH_BATCH_ROWS,
+                                          ContinuousQueryPublisher,
+                                          ContinuousQuerySubscriber)
+
+pytestmark = pytest.mark.geofence
+
+SPEC = "name:String,age:Integer,speed:Double,dtg:Date,*geom:Point:srid=4326"
+
+# one of each compiler class: conjunctive bbox/time/numeric filters the
+# summary captures exactly, residual shapes (LIKE, =, OR trees, NOT,
+# fid IN, out-of-world bbox), and provably-empty conjunctions
+EXACT_ECQL = [
+    "INCLUDE",
+    "BBOX(geom, -50, -20, 10, 30)",
+    "BBOX(geom, -10, -10, 10, 10) AND "
+    "dtg DURING 2021-03-01T00:00:00Z/2021-06-01T00:00:00Z",
+    "dtg AFTER 2021-06-01T00:00:00Z",
+    "dtg BEFORE 2021-04-01T00:00:00Z",
+    "speed > 100.5",
+    "speed >= 100.5",
+    "age BETWEEN 10 AND 60",
+    "age < 25 AND BBOX(geom, -120, 0, 0, 60)",
+    "dtg DURING 2021-02-01T00:00:00Z/2021-02-10T00:00:00Z AND speed < 40",
+]
+RESIDUAL_ECQL = [
+    "name LIKE 'n1%'",
+    "name = 'n3'",
+    "BBOX(geom, 0, 0, 40, 40) OR BBOX(geom, -40, -40, 0, 0)",
+    "NOT (age < 50)",
+    "speed BETWEEN 50 AND 60 OR speed BETWEEN 200 AND 220",
+    "IN ('d7', 'd11')",
+    "BBOX(geom, -190, -90, -170, 90)",
+]
+NEVER_ECQL = [
+    "EXCLUDE",
+    "BBOX(geom, 10, 10, 20, 20) AND BBOX(geom, 30, 30, 40, 40)",
+    "age > 10 AND age < 5",
+]
+ALL_ECQL = EXACT_ECQL + RESIDUAL_ECQL + NEVER_ECQL
+
+
+def messy_batch(sft, n, seed=7, id_prefix="d"):
+    """n rows with NaN coordinates, null dates, and null numeric
+    attributes sprinkled in — the dispatch must treat every one of
+    them exactly like the evaluator does."""
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    x[rng.random(n) < 0.05] = np.nan
+    age = np.array([None if i % 29 == 0 else i % 100 for i in range(n)],
+                   dtype=object)
+    speed = rng.uniform(0, 300, n)
+    speed[rng.random(n) < 0.05] = np.nan
+    t0 = np.int64(1609459200000)  # 2021-01-01
+    millis = t0 + rng.integers(0, 300 * 86400000, n).astype(np.int64)
+    dtg = np.array([None if i % 31 == 0
+                    else np.datetime64(int(millis[i]), "ms")
+                    for i in range(n)], dtype=object)
+    ids = np.array([f"{id_prefix}{i}" for i in range(n)], dtype=object)
+    return FeatureBatch.from_dict(sft, ids, {
+        "name": np.array([f"n{i % 17}" for i in range(n)], dtype=object),
+        "age": age, "speed": speed, "dtg": dtg, "geom": (x, y)})
+
+
+def oracle(ecql, batch):
+    return np.flatnonzero(evaluate(parse_ecql(ecql), batch))
+
+
+# -- the compiler ------------------------------------------------------------
+
+class TestCompiler:
+    def _sft(self):
+        return parse_spec("pts", SPEC)
+
+    def test_numeric_attrs_schema_order(self):
+        assert numeric_attrs(self._sft()) == ["age", "speed"]
+
+    def test_classification(self):
+        sft = self._sft()
+        for e in EXACT_ECQL:
+            cf = compile_filter(parse_ecql(e), sft)
+            assert not cf.residual and not cf.never, e
+        for e in RESIDUAL_ECQL:
+            cf = compile_filter(parse_ecql(e), sft)
+            assert cf.residual and not cf.never, e
+        for e in NEVER_ECQL:
+            cf = compile_filter(parse_ecql(e), sft)
+            assert cf.never, e
+
+    def test_bbox_and_interval_bounds(self):
+        sft = self._sft()
+        cf = compile_filter(parse_ecql(
+            "BBOX(geom, -10, -5, 10, 5) AND "
+            "dtg DURING 2021-03-01T00:00:00Z/2021-06-01T00:00:00Z"), sft)
+        assert cf.boxes == ((-10.0, -5.0, 10.0, 5.0),)
+        # DURING is exclusive on both ends; the inclusive envelope
+        # shifts by exactly 1 ms (exact at millisecond resolution)
+        lo, hi = cf.interval
+        assert lo == int(np.datetime64("2021-03-01T00:00:00", "ms")
+                         .astype(np.int64)) + 1
+        assert hi == int(np.datetime64("2021-06-01T00:00:00", "ms")
+                         .astype(np.int64)) - 1
+
+    def test_attr_bound_inclusivity(self):
+        sft = self._sft()
+        gt = compile_filter(parse_ecql("speed > 100.5"), sft)
+        ge = compile_filter(parse_ecql("speed >= 100.5"), sft)
+        assert gt.attr_bounds["speed"].lo == 100.5
+        assert gt.attr_bounds["speed"].lo_inc is False
+        assert ge.attr_bounds["speed"].lo_inc is True
+        bt = compile_filter(parse_ecql("age BETWEEN 10 AND 60"), sft)
+        ab = bt.attr_bounds["age"]
+        assert (ab.lo, ab.hi, ab.lo_inc, ab.hi_inc) == (10.0, 60.0,
+                                                        True, True)
+
+    def test_or_of_bboxes_keeps_both_envelopes(self):
+        cf = compile_filter(parse_ecql(
+            "BBOX(geom, 0, 0, 40, 40) OR BBOX(geom, -40, -40, 0, 0)"),
+            self._sft())
+        assert cf.residual and cf.n_boxes == 2
+
+    def test_exact_match_equals_oracle_for_compiled_exact(self):
+        sft = self._sft()
+        batch = messy_batch(sft, 700)
+        rows = np.arange(batch.n)
+        for e in EXACT_ECQL:
+            f = parse_ecql(e)
+            cf = compile_filter(f, sft)
+            got = rows[exact_match(cf, batch, rows)]
+            np.testing.assert_array_equal(got, oracle(e, batch), err_msg=e)
+
+    def test_exact_hits_patches_any_candidate_superset(self):
+        sft = self._sft()
+        batch = messy_batch(sft, 500)
+        for e in ALL_ECQL:
+            f = parse_ecql(e)
+            cf = compile_filter(f, sft)
+            got = exact_hits(cf, f, batch, np.arange(batch.n))
+            np.testing.assert_array_equal(got, oracle(e, batch), err_msg=e)
+
+
+# -- the fused kernel --------------------------------------------------------
+
+class TestStandingFilterSet:
+    def _set(self, sft=None, **kw):
+        sft = sft or parse_spec("pts", SPEC)
+        return sft, StandingFilterSet(sft, **kw)
+
+    def _register_all(self, fset):
+        for i, e in enumerate(ALL_ECQL):
+            fset.register(f"q{i}", parse_ecql(e))
+
+    def test_dispatch_id_exact_vs_oracle(self):
+        sft, fset = self._set()
+        self._register_all(fset)
+        batch = messy_batch(sft, 3000)
+        out = fset.dispatch(batch)
+        assert sorted(out) == sorted(f"q{i}" for i in range(len(ALL_ECQL)))
+        for i, e in enumerate(ALL_ECQL):
+            np.testing.assert_array_equal(out[f"q{i}"], oracle(e, batch),
+                                          err_msg=e)
+
+    def test_multi_chunk_dispatch_matches_single_chunk(self):
+        sft, fset = self._set()
+        self._register_all(fset)
+        batch = messy_batch(sft, 1500)
+        old = CQ_DEVICE_MAX_CELLS.get()
+        try:
+            # cap is 64 -> 64-row chunks -> 24 launches for 1500 rows
+            CQ_DEVICE_MAX_CELLS.set(str(64 * 64))
+            out = fset.dispatch(batch)
+        finally:
+            CQ_DEVICE_MAX_CELLS.set(old)
+        for i, e in enumerate(ALL_ECQL):
+            np.testing.assert_array_equal(out[f"q{i}"], oracle(e, batch),
+                                          err_msg=e)
+
+    def test_churn_within_cap_never_recompiles(self):
+        sft, fset = self._set()
+        for i in range(40):
+            fset.register(f"q{i}", parse_ecql(
+                f"BBOX(geom, {-50 + i}, -20, {10 + i}, 30)"))
+        batch = messy_batch(sft, 512)
+        fset.dispatch(batch)
+        assert (fset.cache_misses, fset.cache_hits) == (1, 0)
+        # tombstone + re-register churn: same shapes, zero new traces
+        for i in range(20):
+            fset.unregister(f"q{i}")
+        for i in range(20):
+            fset.register(f"r{i}", parse_ecql(f"age < {i + 1}"))
+        out = fset.dispatch(messy_batch(sft, 512, seed=9))
+        assert fset.cache_misses == 1 and fset.cache_hits == 1
+        assert "q0" not in out and "r0" in out
+        assert len(fset) == 40 and "r5" in fset and "q5" not in fset
+        # growth past the padded cap is the ONE allowed recompile
+        for i in range(40, 70):
+            fset.register(f"q{i}", parse_ecql(f"speed > {i}"))
+        assert fset.stats()["padded_cap"] == 128
+        fset.dispatch(batch)
+        assert fset.cache_misses == 2
+
+    def test_unregister_tombstones_and_duplicate_raises(self):
+        sft, fset = self._set()
+        fset.register("a", parse_ecql("age < 10"))
+        with pytest.raises(ValueError, match="exists"):
+            fset.register("a", parse_ecql("age < 20"))
+        assert fset.unregister("a") is True
+        assert fset.unregister("a") is False
+        assert fset.dispatch(messy_batch(sft, 32)) == {}
+
+    def test_stats_surface(self):
+        _, fset = self._set()
+        self._register_all(fset)
+        st = fset.stats()
+        assert st["live"] == len(ALL_ECQL)
+        assert st["padded_cap"] >= len(ALL_ECQL)
+        assert st["tracked_attrs"] == ["age", "speed"]
+        assert st["residual"] == len(RESIDUAL_ECQL)
+
+
+# -- the publisher device path -----------------------------------------------
+
+class TestPublisherDevicePath:
+    def _live(self, type_name="pts"):
+        from geomesa_tpu.store.live import LiveDataStore
+        sft = parse_spec(type_name, SPEC)
+        store = LiveDataStore()
+        store.create_schema(sft)
+        return store, sft
+
+    def _run_publishes(self, device: bool, n_writes=2, rows=200):
+        """One fresh store + publisher + per-topic subscriber capture,
+        with the kill switch pinned for the duration of the writes."""
+        store, sft = self._live()
+        pub = ContinuousQueryPublisher(store)
+        topics = {}
+        for i, e in enumerate(ALL_ECQL):
+            pub.register(f"q{i}", "pts", e)
+            got = topics[f"q{i}"] = []
+            sub = ContinuousQuerySubscriber(f"q{i}", bus=store.bus)
+            sub.on_message(lambda m, g=got: g.append(
+                tuple(str(x) for x in m.batch.ids)))
+        old = CQ_DEVICE.get()
+        try:
+            CQ_DEVICE.set("true" if device else "false")
+            for w in range(n_writes):
+                store.write("pts", messy_batch(sft, rows, seed=w,
+                                               id_prefix=f"w{w}_"))
+        finally:
+            CQ_DEVICE.set(old)
+        return pub, topics
+
+    def test_kill_switch_publishes_bit_identical(self):
+        old = CQ_PUBLISH_BATCH_ROWS.get()
+        try:
+            CQ_PUBLISH_BATCH_ROWS.set("32")  # force chunked deltas too
+            pub_h, host = self._run_publishes(device=False)
+            pub_d, dev = self._run_publishes(device=True)
+        finally:
+            CQ_PUBLISH_BATCH_ROWS.set(old)
+        assert dev == host  # same messages, same chunking, same order
+        for qh, qd in zip(pub_h.queries(), pub_d.queries()):
+            assert (qh.name, qh.matched, qh.published) == \
+                   (qd.name, qd.matched, qd.published)
+        # registration compiles sets either way; with the switch off
+        # the dispatch never runs (no plan-cache probes)
+        assert all(s["plan_cache_misses"] + s["plan_cache_hits"] == 0
+                   for s in pub_h.device_stats())
+        assert any(s["plan_cache_misses"] >= 1
+                   for s in pub_d.device_stats())
+
+    def test_device_path_matches_oracle_per_query(self):
+        store, sft = self._live()
+        pub = ContinuousQueryPublisher(store)
+        for i, e in enumerate(ALL_ECQL):
+            pub.register(f"q{i}", "pts", e)
+        batch = messy_batch(sft, 400)
+        store.write("pts", batch)
+        for i, e in enumerate(ALL_ECQL):
+            q = next(q for q in pub.queries() if q.name == f"q{i}")
+            assert q.matched == len(oracle(e, batch)), e
+
+    def test_unreadable_schema_stays_host_only(self):
+        from geomesa_tpu.store.live import LiveDataStore
+        store = LiveDataStore()
+        pub = ContinuousQueryPublisher(store)
+        # registered BEFORE the schema exists: the publisher cannot
+        # compile it, and the type must stay on the host loop forever
+        cq = pub.register("early", "pts", "age < 10")
+        sft = parse_spec("pts", SPEC)
+        store.create_schema(sft)
+        store.write("pts", messy_batch(sft, 100))
+        assert cq.matched == len(oracle("age < 10",
+                                        messy_batch(sft, 100)))
+        assert pub.device_stats() == []
+        # a late registration joins the same sticky host-only type
+        pub.register("late", "pts", "age < 5")
+        assert pub.device_stats() == []
+
+    def test_unregister_detaches_listener_on_last_query(self):
+        store, _ = self._live()
+        pub = ContinuousQueryPublisher(store)
+        pub.register("a", "pts", "age < 10")
+        pub.register("b", "pts", "age < 20")
+        assert len(store._listeners["pts"]) == 1
+        pub.unregister("a")
+        assert len(store._listeners["pts"]) == 1
+        pub.unregister("b")
+        assert store._listeners["pts"] == []
+
+    def test_close_detaches_everything(self):
+        store, sft = self._live()
+        pub = ContinuousQueryPublisher(store)
+        cq = pub.register("a", "pts", "INCLUDE")
+        pub.close()
+        assert store._listeners["pts"] == []
+        assert pub.queries() == [] and pub.device_stats() == []
+        store.write("pts", messy_batch(sft, 10))
+        assert cq.matched == 0
+
+    def test_reregister_after_unregister_zero_recompile(self):
+        store, sft = self._live()
+        pub = ContinuousQueryPublisher(store)
+        pub.register("a", "pts", "age < 10")
+        store.write("pts", messy_batch(sft, 256))
+        [st] = pub.device_stats()
+        misses = st["plan_cache_misses"]
+        pub.unregister("a")
+        pub.register("a", "pts", "age < 30")  # filter sets survive churn
+        store.write("pts", messy_batch(sft, 256, seed=9))
+        [st] = pub.device_stats()
+        assert st["plan_cache_misses"] == misses
+        assert st["plan_cache_hits"] >= 1
+
+    def test_visibilities_stay_row_aligned_through_chunks(self):
+        """Strict-subset match + chunked publish: every delta's
+        visibilities must line up row-for-row with its ids."""
+        store, sft = self._live()
+        old = CQ_PUBLISH_BATCH_ROWS.get()
+        try:
+            CQ_PUBLISH_BATCH_ROWS.set("32")
+            pub = ContinuousQueryPublisher(store)
+            pub.register("vis", "pts", "age BETWEEN 3 AND 80")
+            sub = ContinuousQuerySubscriber("vis", bus=store.bus)
+            msgs = []
+            sub.on_message(msgs.append)
+            n = 120
+            ids = np.array([f"f{i}" for i in range(n)], dtype=object)
+            batch = FeatureBatch.from_dict(sft, ids, {
+                "name": np.array(["n"] * n, dtype=object),
+                "age": np.arange(n), "speed": np.zeros(n),
+                "dtg": np.full(n, 1609459200000, dtype=np.int64),
+                "geom": (np.zeros(n), np.zeros(n))})
+            store.write("pts", batch,
+                        visibilities=tuple(f"v{i}" for i in range(n)))
+            hits = [i for i in range(n) if 3 <= i <= 80]
+            assert [m.batch.n for m in msgs] == [32, 32, 14]
+            flat_ids, flat_vis = [], []
+            for m in msgs:
+                assert len(m.visibilities) == m.batch.n
+                flat_ids.extend(str(x) for x in m.batch.ids)
+                flat_vis.extend(m.visibilities)
+            assert flat_ids == [f"f{i}" for i in hits]
+            assert flat_vis == [f"v{i}" for i in hits]
+        finally:
+            CQ_PUBLISH_BATCH_ROWS.set(old)
+
+
+# -- knob defaults (satellite: 8096 -> 8192 alignment) -----------------------
+
+class TestKnobDefaults:
+    def test_publish_and_stream_batch_defaults_are_8192(self):
+        from geomesa_tpu.arrow.delta import STREAM_BATCH_ROWS
+        assert CQ_PUBLISH_BATCH_ROWS.default == "8192"
+        assert STREAM_BATCH_ROWS.default == "8192"
+
+    def test_device_knob_defaults(self):
+        assert CQ_DEVICE.default == "true"
+        assert CQ_DEVICE_MAX_CELLS.default == str(1 << 27)
+
+
+# -- REST surface ------------------------------------------------------------
+
+class TestCqRest:
+    def _request(self, srv, method, path, token=None, body=None):
+        data = (json.dumps(body).encode() if body is not None
+                else (b"" if method == "POST" else None))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}", method=method, data=data)
+        if token is not None:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read() or b"null")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"null")
+
+    def _server(self):
+        from geomesa_tpu.store.live import LiveDataStore
+        from geomesa_tpu.web import GeoMesaWebServer
+        store = LiveDataStore()
+        sft = parse_spec("pts", SPEC)
+        store.create_schema(sft)
+        srv = GeoMesaWebServer(store, auth_token="tok").start()
+        return srv, store, sft
+
+    def test_routes_gating_and_device_stats(self):
+        srv, store, sft = self._server()
+        try:
+            st, body = self._request(srv, "GET", "/rest/cq")
+            assert st == 200 and body == {"queries": [], "device": []}
+
+            q = urllib.parse.urlencode(
+                {"name": "young", "type": "pts", "ecql": "age < 10"})
+            st, _ = self._request(srv, "POST", f"/rest/cq/register?{q}")
+            assert st == 403  # mutating: bearer required
+            st, body = self._request(srv, "POST",
+                                     f"/rest/cq/register?{q}", token="tok")
+            assert st == 200 and body == {
+                "registered": "young", "type": "pts", "topic": "cq.young"}
+            st, _ = self._request(srv, "POST", f"/rest/cq/register?{q}",
+                                  token="tok")
+            assert st == 409  # duplicate name
+
+            # register via JSON body (long ECQL goes there)
+            st, body = self._request(
+                srv, "POST", "/rest/cq/register", token="tok",
+                body={"name": "box", "type": "pts",
+                      "ecql": "BBOX(geom, -10, -10, 10, 10)"})
+            assert st == 200 and body["topic"] == "cq.box"
+
+            store.write("pts", messy_batch(sft, 100))
+            st, body = self._request(srv, "GET", "/rest/cq")
+            assert st == 200
+            young = next(q for q in body["queries"]
+                         if q["name"] == "young")
+            assert young["matched"] == len(
+                oracle("age < 10", messy_batch(sft, 100)))
+            [dev] = body["device"]
+            assert dev["type_name"] == "pts" and dev["live"] == 2
+
+            st, body = self._request(
+                srv, "POST", "/rest/cq/unregister?name=young", token="tok")
+            assert st == 200 and body == {"unregistered": "young"}
+            st, body = self._request(srv, "GET", "/rest/cq")
+            assert [q["name"] for q in body["queries"]] == ["box"]
+        finally:
+            srv.stop()
+
+    def test_bad_requests(self):
+        srv, _, _ = self._server()
+        try:
+            st, body = self._request(
+                srv, "POST", "/rest/cq/register?name=x&type=pts"
+                             "&ecql=age+%3C%3C+3", token="tok")
+            assert st == 400 and "error" in body
+            st, _ = self._request(srv, "POST", "/rest/cq/register?type=pts",
+                                  token="tok")
+            assert st == 400  # name required
+            st, _ = self._request(srv, "POST", "/rest/cq/register?name=x",
+                                  token="tok")
+            assert st == 400  # type required
+            st, _ = self._request(srv, "GET", "/rest/cq/nope")
+            assert st == 404
+        finally:
+            srv.stop()
+
+    def test_busless_store_404s_on_mutation(self):
+        from geomesa_tpu.store import InMemoryDataStore
+        from geomesa_tpu.web import GeoMesaWebServer
+        srv = GeoMesaWebServer(InMemoryDataStore(),
+                               auth_token="tok").start()
+        try:
+            st, body = self._request(
+                srv, "POST", "/rest/cq/register?name=x&type=t",
+                token="tok")
+            assert st == 404 and "bus" in body["error"]
+            st, body = self._request(srv, "GET", "/rest/cq")
+            assert st == 200 and body == {"queries": [], "device": []}
+        finally:
+            srv.stop()
+
+
+# -- CLI surface -------------------------------------------------------------
+
+class TestCqCli:
+    def test_rc_contract_and_roundtrip(self, capsys):
+        from geomesa_tpu.store.live import LiveDataStore
+        from geomesa_tpu.tools.cli import main as cli_main
+        from geomesa_tpu.web import GeoMesaWebServer
+        store = LiveDataStore()
+        store.create_schema(parse_spec("pts", SPEC))
+        srv = GeoMesaWebServer(store, auth_token="tok").start()
+        path = f"remote://127.0.0.1:{srv.port}"
+        try:
+            assert cli_main(["cq", "register", "--path", path,
+                             "--name", "a", "--type", "pts",
+                             "--cql", "age < 10"]) == 3  # gated: no token
+            assert "gated" in capsys.readouterr().err
+            assert cli_main(["cq", "register", "--path", path,
+                             "--token", "tok", "--name", "a",
+                             "--type", "pts", "--cql", "age < 10"]) == 0
+            capsys.readouterr()
+            assert cli_main(["cq", "list", "--path", path]) == 0
+            body = json.loads(capsys.readouterr().out)
+            assert [q["name"] for q in body["queries"]] == ["a"]
+            assert body["device"][0]["live"] == 1
+            assert cli_main(["cq", "unregister", "--path", path,
+                             "--token", "tok", "--name", "a"]) == 0
+            capsys.readouterr()
+            assert cli_main(["cq", "list", "--path", path]) == 0
+            assert json.loads(capsys.readouterr().out)["queries"] == []
+        finally:
+            srv.stop()
+
+    def test_non_remote_path_rejected(self, capsys):
+        from geomesa_tpu.tools.cli import main as cli_main
+        assert cli_main(["cq", "list", "--path", "/tmp/nope"]) == 2
+        assert "remote://" in capsys.readouterr().err
