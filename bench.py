@@ -144,7 +144,7 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,"
+                             "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,"
                              "northstar")
               .split(","))
 MS_DAY = 86_400_000
@@ -2164,6 +2164,266 @@ def bench_config15(rng, n_filters=None, n_filters_big=None,
     return out
 
 
+# -- config 16: ingest firehose — vectorized convert + group commit -------
+
+def bench_config16(rng, n=None, c_read=None, read_rounds=None,
+                   kill_rows=None):
+    """The ingest firehose, end to end. (A) the same AIS-shaped CSV
+    stream is converted and committed to a durable store two ways —
+    the scalar per-write baseline (record-at-a-time transforms, one
+    store.write per chunk) and the firehose path (columnar converter +
+    group-commit pipeline) — gated at >= 5x sustained rows/s. (B) a
+    c=32 BBOX read battery runs idle and again against a live ingest,
+    so admission control's promise (bulk writes don't starve reads)
+    shows up as a bounded p99 ratio. (C) a mid-ingest copy of the
+    durable dir (the kill image, taken while the writer thread is
+    live) must recover every row acked before the copy began — the
+    zero-acked-loss contract."""
+    import shutil
+    import tempfile
+    import threading
+
+    from geomesa_tpu.convert.converter import converter_for
+    from geomesa_tpu.convert.dsl import EvaluationContext
+    from geomesa_tpu.convert.vectorized import INGEST_VECTORIZED
+    from geomesa_tpu.features import parse_spec
+    from geomesa_tpu.index.api import Query
+    from geomesa_tpu.ingest import IngestPipeline
+    from geomesa_tpu.metrics import metrics
+    from geomesa_tpu.store import InMemoryDataStore
+
+    n = n if n is not None else int(
+        os.environ.get("GEOMESA_TPU_BENCH_INGEST_ROWS", 1_000_000))
+    c_read = c_read if c_read is not None else int(
+        os.environ.get("GEOMESA_TPU_BENCH_INGEST_READERS", 32))
+    read_rounds = read_rounds if read_rounds is not None else 4
+    kill_rows = kill_rows if kill_rows is not None else min(n, 100_000)
+    baseline_chunk = 4096   # a client POST's worth per scalar write
+    fast_chunk = 65536      # geomesa.ingest.batch.rows default
+
+    spec = ("name:String,mmsi:Integer,dtg:Date,speed:Double,"
+            "course:Double,heading:Double,*geom:Point:srid=4326")
+    cfg = {"type": "delimited-text", "format": "CSV",
+           "id-field": "concat('v', $2)",
+           "fields": [
+               {"name": "name", "transform": "$1"},
+               {"name": "mmsi", "transform": "$2::int"},
+               {"name": "dtg", "transform": "isoDate($3)"},
+               {"name": "geom",
+                "transform": "point($4::double, $5::double)"},
+               {"name": "speed", "transform": "$6::double"},
+               {"name": "course", "transform": "$7::double"},
+               {"name": "heading", "transform": "$8::double"}]}
+
+    def make_csv(rows, start=0):
+        x = rng.uniform(-180, 180, rows)
+        y = rng.uniform(-90, 90, rows)
+        day = rng.integers(1, 28, rows)
+        hh = rng.integers(0, 24, rows)
+        spd = rng.uniform(0, 30, rows)
+        crs = rng.uniform(0, 360, rows)
+        return "".join(
+            f"vessel{(start + i) % 5000},{start + i},"
+            f"2017-03-{day[i]:02d}T{hh[i]:02d}:15:00Z,"
+            f"{x[i]:.5f},{y[i]:.5f},{spd[i]:.2f},{crs[i]:.2f},"
+            f"{crs[i]:.1f}\n"
+            for i in range(rows))
+
+    text = make_csv(n)
+    sft = parse_spec("ais16", spec)
+    conv = converter_for(sft, cfg)
+
+    def fsyncs():
+        return metrics.snapshot()["counters"].get("wal.fsyncs", 0)
+
+    def groups():
+        return metrics.snapshot()["counters"].get("ingest.groups", 0)
+
+    # -- (A) sustained throughput: scalar per-write vs firehose -----------
+    import gc
+
+    d1 = tempfile.mkdtemp(prefix="geomesa-ingest16-scalar-")
+    try:
+        ds = InMemoryDataStore(durable_dir=d1, wal_fsync="interval")
+        ds.create_schema(parse_spec("ais16", spec))
+        # both timed legs run GC-quiesced: a threshold collection over
+        # the other leg's surviving heap would bill one side for the
+        # other's garbage (observed: a 2x swing on the second leg)
+        gc.collect()
+        gc.disable()
+        INGEST_VECTORIZED.thread_local_set("false")
+        try:
+            ctx = EvaluationContext()
+            fs0, t0 = fsyncs(), time.perf_counter()
+            writes = 0
+            for batch, _ in conv.iter_batches(text, ctx,
+                                              batch_rows=baseline_chunk):
+                ds.write("ais16", batch)
+                writes += 1
+            scalar_s = time.perf_counter() - t0
+            scalar_fsyncs = fsyncs() - fs0
+        finally:
+            INGEST_VECTORIZED.thread_local_set(None)
+            gc.enable()
+        ok_scalar = ds.count("ais16") == ctx.success
+        ds.close()
+    finally:
+        shutil.rmtree(d1, ignore_errors=True)
+
+    d2 = tempfile.mkdtemp(prefix="geomesa-ingest16-vec-")
+    read_ds = None
+    try:
+        ds = InMemoryDataStore(durable_dir=d2, wal_fsync="interval")
+        ds.create_schema(parse_spec("ais16", spec))
+        ctx = EvaluationContext()
+        gc.collect()
+        gc.disable()
+        try:
+            fs0, g0, t0 = fsyncs(), groups(), time.perf_counter()
+            staged = 0
+            with IngestPipeline(ds) as pipe:
+                for batch, _ in conv.iter_batches(text, ctx,
+                                                  batch_rows=fast_chunk):
+                    pipe.write("ais16", batch)
+                    staged += 1
+                pipe.flush()
+                vec_s = time.perf_counter() - t0
+                vec_fsyncs, vec_groups = fsyncs() - fs0, groups() - g0
+        finally:
+            gc.enable()
+        ok_vec = ds.count("ais16") == ctx.success
+        read_ds = ds  # part B reads the freshly ingested store
+    finally:
+        pass  # d2 cleaned after part B
+
+    speedup = scalar_s / vec_s
+    out = {
+        "rows": n,
+        "scalar_per_write": {
+            "chunk_rows": baseline_chunk, "ingest_s": round(scalar_s, 3),
+            "rows_per_s": round(n / scalar_s, 1), "writes": writes,
+            "fsyncs": scalar_fsyncs},
+        "vectorized_group_commit": {
+            "chunk_rows": fast_chunk, "ingest_s": round(vec_s, 3),
+            "rows_per_s": round(n / vec_s, 1), "staged_batches": staged,
+            "groups": vec_groups, "fsyncs": vec_fsyncs},
+        "speedup": round(speedup, 2),
+        "rows_exact": bool(ok_scalar and ok_vec),
+    }
+
+    # -- (B) c=32 reads, idle vs against a live ingest --------------------
+    def mk_queries(m, seed):
+        q_rng = np.random.default_rng(seed)
+        qs = []
+        for _ in range(m):
+            x0 = float(q_rng.uniform(-150, 110))
+            y0 = float(q_rng.uniform(-70, 45))
+            qs.append(Query("ais16",
+                            f"BBOX(geom, {x0:.4f}, {y0:.4f}, "
+                            f"{x0 + 40:.4f}, {y0 + 25:.4f})"))
+        return qs
+
+    def read_battery(seed):
+        lat: list[float] = []
+        lock = threading.Lock()
+
+        def worker(wid):
+            qs = mk_queries(read_rounds, seed + wid)
+            mine = []
+            for q in qs:
+                t0 = time.perf_counter()
+                read_ds.query(q)
+                mine.append(time.perf_counter() - t0)
+            with lock:
+                lat.extend(mine)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(c_read)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return _pcts(lat)
+
+    read_ds.query(mk_queries(1, 5)[0])  # warm the plan path
+    idle = read_battery(seed=1000)
+
+    stop = threading.Event()
+    ingest_text = make_csv(min(n, 200_000), start=n)
+
+    def pump():
+        with IngestPipeline(read_ds) as pipe:
+            while not stop.is_set():
+                c2 = EvaluationContext()
+                for batch, _ in conv.iter_batches(ingest_text, c2,
+                                                  batch_rows=fast_chunk):
+                    if stop.is_set():
+                        break
+                    pipe.write("ais16", batch)
+                pipe.flush()
+
+    pumper = threading.Thread(target=pump, daemon=True)
+    pumper.start()
+    try:
+        loaded = read_battery(seed=2000)
+    finally:
+        stop.set()
+        pumper.join(timeout=30)
+    read_ds.close()
+    shutil.rmtree(d2, ignore_errors=True)
+
+    ratio = loaded["p99"] / max(idle["p99"], 1e-9)
+    out["reads_under_ingest"] = {
+        "concurrency": c_read,
+        "idle_p99_ms": round(idle["p99"] * 1e3, 3),
+        "loaded_p99_ms": round(loaded["p99"] * 1e3, 3),
+        "idle_p50_ms": round(idle["p50"] * 1e3, 3),
+        "loaded_p50_ms": round(loaded["p50"] * 1e3, 3),
+        "p99_ratio": round(ratio, 2),
+        "bounded": bool(ratio < 10.0),
+    }
+
+    # -- (C) kill mid-ingest: the copy must hold every acked row ----------
+    d3 = tempfile.mkdtemp(prefix="geomesa-ingest16-kill-")
+    img = tempfile.mkdtemp(prefix="geomesa-ingest16-img-")
+    try:
+        ds = InMemoryDataStore(durable_dir=d3, wal_fsync="always")
+        ds.create_schema(parse_spec("ais16", spec))
+        kill_text = make_csv(kill_rows)
+        acked_rows = 0
+        acks = []
+        with IngestPipeline(ds, group_rows=8192) as pipe:
+            ctx = EvaluationContext()
+            for batch, _ in conv.iter_batches(kill_text, ctx,
+                                              batch_rows=1024):
+                acks.append((pipe.write("ais16", batch), batch.n))
+                if len(acks) >= (kill_rows // 1024) // 2:
+                    break
+            # the kill image: copy the live dir with the writer thread
+            # still running; only rows acked BEFORE the copy may be
+            # claimed (an acked row is journaled + fsynced)
+            acked_rows = sum(b for a, b in acks if a is not None and a.done)
+            shutil.copytree(d3, img, dirs_exist_ok=True)
+        ds.close()
+        ds2 = InMemoryDataStore(durable_dir=img, wal_fsync="always")
+        recovered = ds2.count("ais16")
+        ds2.close()
+        out["kill_recovery"] = {
+            "acked_rows_at_kill": int(acked_rows),
+            "recovered_rows": int(recovered),
+            "zero_acked_loss": bool(recovered >= acked_rows),
+        }
+    finally:
+        shutil.rmtree(d3, ignore_errors=True)
+        shutil.rmtree(img, ignore_errors=True)
+
+    out["gates_pass"] = bool(
+        out["speedup"] >= 5.0 and out["rows_exact"]
+        and out["reads_under_ingest"]["bounded"]
+        and out["kill_recovery"]["zero_acked_loss"])
+    return out
+
+
 # -- config 10: storage integrity — scrub overhead + corrupt recovery -----
 
 def bench_config10(rng):
@@ -2434,6 +2694,8 @@ def main(argv=None):
         out["configs"]["14_streaming"] = bench_config14(rng)
     if "15" in CONFIGS:
         out["configs"]["15_geofence"] = bench_config15(rng)
+    if "16" in CONFIGS:
+        out["configs"]["16_ingest"] = bench_config16(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

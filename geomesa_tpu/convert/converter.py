@@ -18,11 +18,14 @@ from __future__ import annotations
 import csv
 import io
 import json
+from itertools import chain, islice
 from typing import Any, Iterable
+
+import numpy as np
 
 from ..features.batch import FeatureBatch
 from ..features.sft import SimpleFeatureType
-from .dsl import EvaluationContext, compile_expression
+from .dsl import EvaluationContext, compile_expression, parse_expression
 
 __all__ = ["SimpleFeatureConverter", "DelimitedTextConverter",
            "JsonConverter", "converter_for"]
@@ -38,13 +41,17 @@ class SimpleFeatureConverter:
     def __init__(self, sft: SimpleFeatureType, config: dict):
         self.sft = sft
         self.config = config
+        self.id_ast = parse_expression(config.get("id-field", "uuid()"))
         self.id_expr = compile_expression(config.get("id-field", "uuid()"))
         # every named field compiles IN DECLARATION ORDER — later
         # transforms (and the id expression) may reference earlier ones
         # as $fieldName (Transformers' fieldLookup). Intermediate fields
         # not in the SFT are building blocks only. Nameless entries are
         # column bindings (e.g. a bare JSON path referenced by number).
+        # The AST is kept next to each compiled closure so the columnar
+        # backend (convert/vectorized.py) can evaluate the same program.
         self.ordered_exprs: list[tuple[str, Any]] = []
+        self.ordered_asts: list[tuple[str, tuple]] = []
         declared = {}
         for f in config.get("fields", []):
             if "name" not in f or f.get("transform") is None:
@@ -52,12 +59,15 @@ class SimpleFeatureConverter:
             declared[f["name"]] = True
             self.ordered_exprs.append(
                 (f["name"], compile_expression(f["transform"])))
+            self.ordered_asts.append(
+                (f["name"], parse_expression(f["transform"])))
         for attr in sft.attributes:
             if attr.name not in declared:
                 raise ValueError(f"no transform for attribute {attr.name!r}")
         from .validators import build_validators
-        self.validators = build_validators(
-            config.get("options", {}).get("validators", []), sft)
+        self.validator_names = list(
+            config.get("options", {}).get("validators", []))
+        self.validators = build_validators(self.validator_names, sft)
 
     def _records(self, source) -> Iterable[list]:
         """Yield column lists; cols[0] is the raw record."""
@@ -66,9 +76,14 @@ class SimpleFeatureConverter:
     def process(self, source, ctx: EvaluationContext | None = None
                 ) -> tuple[FeatureBatch, EvaluationContext]:
         ctx = ctx or EvaluationContext()
+        return self._process_scalar(self._records(source), ctx), ctx
+
+    def _process_scalar(self, records: Iterable[list],
+                        ctx: EvaluationContext) -> FeatureBatch:
+        """The record-at-a-time oracle; ``iter_batches`` is the fast path."""
         ids: list[str] = []
         data: dict[str, list] = {a.name: [] for a in self.sft.attributes}
-        for cols in self._records(source):
+        for cols in records:
             ctx.line += 1
             if cols is _BAD_RECORD:
                 ctx.failure += 1
@@ -93,8 +108,72 @@ class SimpleFeatureConverter:
                 data[name].append(v)
             ctx.success += 1
         # point columns arrive as Point objects; from_dict handles them
-        batch = FeatureBatch.from_dict(self.sft, ids, data)
-        return batch, ctx
+        return FeatureBatch.from_dict(self.sft, ids, data)
+
+    def iter_batches(self, source, ctx: EvaluationContext | None = None,
+                     batch_rows: int | None = None):
+        """Stream ``FeatureBatch``es of ``geomesa.ingest.batch.rows``
+        records — the firehose entry point. Columnar evaluation by
+        default (see convert/vectorized.py); ``geomesa.ingest.
+        vectorized=false`` kills it back to the scalar oracle, and
+        ``geomesa.ingest.verify=true`` runs both per chunk and asserts
+        id-for-id equivalence.
+
+        Yields (batch, ctx) per chunk; ctx is cumulative (pass one in to
+        aggregate across sources).
+        """
+        from .vectorized import (INGEST_BATCH_ROWS, INGEST_VECTORIZED,
+                                 INGEST_VERIFY, process_columnar,
+                                 process_columns)
+        ctx = ctx or EvaluationContext()
+        rows = batch_rows or INGEST_BATCH_ROWS.as_int()
+        vectorized = INGEST_VECTORIZED.as_bool()
+        verify = INGEST_VERIFY.as_bool()
+
+        # formats with a columnar source (CSV cell-splitting) skip the
+        # per-record generator entirely; verify mode needs the record
+        # stream for the scalar oracle, so it takes the row path
+        col_chunks = getattr(self, "iter_column_chunks", None)
+        if vectorized and not verify and col_chunks is not None:
+            for cols, n, ragged, n_bad in col_chunks(source, rows):
+                yield process_columns(self, cols, n, ragged, n_bad, ctx), ctx
+            return
+
+        def emit(chunk: list[list]) -> FeatureBatch:
+            if not vectorized:
+                return self._process_scalar(chunk, ctx)
+            batch = process_columnar(self, chunk, ctx)
+            if verify:
+                oracle = self._process_scalar(chunk, EvaluationContext())
+                if list(batch.ids) != list(oracle.ids):
+                    raise AssertionError(
+                        "vectorized/scalar id divergence: "
+                        f"{len(batch.ids)} vs {len(oracle.ids)} rows")
+            return batch
+
+        chunk: list[list] = []
+        for rec in self._records(source):
+            chunk.append(rec)
+            if len(chunk) >= rows:
+                yield emit(chunk), ctx
+                chunk = []
+        if chunk:
+            yield emit(chunk), ctx
+
+
+def _uses_col0(node: tuple) -> bool:
+    kind = node[0]
+    if kind == "col":
+        return node[1] == 0
+    if kind in ("lit", "relit", "field"):
+        return False
+    if kind == "recast":
+        return _uses_col0(node[1])
+    if kind == "cast":
+        return _uses_col0(node[2])
+    if kind in ("try", "withdefault"):
+        return _uses_col0(node[1]) or _uses_col0(node[2])
+    return any(_uses_col0(a) for a in node[2])
 
 
 class DelimitedTextConverter(SimpleFeatureConverter):
@@ -105,15 +184,132 @@ class DelimitedTextConverter(SimpleFeatureConverter):
         fmt = config.get("format", "CSV").upper()
         self.delimiter = {"CSV": ",", "TSV": "\t"}.get(fmt, ",")
         self.skip_lines = int(config.get("options", {}).get("skip-lines", 0))
+        # re-joining every parsed row into the $0 raw record costs more
+        # than the parse itself on wide rows — skip it when no transform
+        # (and not the id expression) ever reads $0
+        self._needs_raw = (_uses_col0(self.id_ast)
+                           or any(_uses_col0(a)
+                                  for _, a in self.ordered_asts))
 
     def _records(self, source):
         if isinstance(source, str):
             source = io.StringIO(source)
         reader = csv.reader(source, delimiter=self.delimiter)
-        for i, row in enumerate(reader):
-            if i < self.skip_lines or not row:
-                continue
-            yield [self.delimiter.join(row)] + row
+        if self._needs_raw:
+            for i, row in enumerate(reader):
+                if i < self.skip_lines or not row:
+                    continue
+                yield [self.delimiter.join(row)] + row
+        else:
+            for i, row in enumerate(reader):
+                if i < self.skip_lines or not row:
+                    continue
+                yield [""] + row
+
+    def iter_column_chunks(self, source, rows: int):
+        """Columnar CSV parse: split whole chunks of text into cell
+        arrays instead of iterating records — the per-row work drops to
+        two C string splits plus one numpy reshape. Yields
+        ``(cols, n, ragged, n_bad)`` tuples for ``process_columns``.
+
+        The split is only CSV-correct while no quote character appears;
+        the first chunk containing ``"`` degrades the REST of the stream
+        to the csv.reader row path (a quoted newline may span chunk
+        boundaries, so per-chunk fallback would tear records). Ragged
+        chunks (uneven delimiter counts) re-parse row-wise so column
+        references err exactly the rows the scalar path would.
+        """
+        from .vectorized import _transpose, parse_csv_arrow
+        if isinstance(source, str):
+            source = io.StringIO(source)
+        for _ in range(self.skip_lines):
+            if not source.readline():
+                return
+        d = self.delimiter
+        # Arrow's reader drops the raw line, so $0 users stay on the
+        # python split (they need the unsplit text in column 0)
+        use_arrow = not self._needs_raw
+
+        def row_chunks(line_iter):
+            reader = csv.reader(line_iter, delimiter=d)
+            chunk: list[list] = []
+            for row in reader:
+                if not row:
+                    continue
+                chunk.append(([d.join(row)] + row) if self._needs_raw
+                             else ([""] + row))
+                if len(chunk) >= rows:
+                    cols, ragged = _transpose(chunk)
+                    yield cols, len(chunk), ragged, 0
+                    chunk = []
+            if chunk:
+                cols, ragged = _transpose(chunk)
+                yield cols, len(chunk), ragged, 0
+
+        est = 0  # learned bytes/line; first chunk iterates to calibrate
+        carry = ""
+        while True:
+            if est:
+                # block read: one syscall-ish slab instead of `rows`
+                # readline calls, cut at the last complete line
+                block = source.read(rows * est)
+                joined = carry + block
+                if not joined:
+                    return
+                if block:
+                    cut = joined.rfind("\n")
+                    if cut < 0:  # line longer than the slab: keep growing
+                        carry = joined
+                        continue
+                    carry, joined = joined[cut + 1:], joined[:cut + 1]
+                else:
+                    carry = ""  # EOF: flush the unterminated tail line
+            else:
+                raw = list(islice(source, rows))
+                if not raw:
+                    return
+                joined = "".join(raw)
+                est = max(16, len(joined) // len(raw))
+            if '"' in joined:
+                if carry:  # finish the cut-off line before re-splitting
+                    carry += source.readline()
+                yield from row_chunks(chain(
+                    io.StringIO(joined), [carry] if carry else [], source))
+                return
+            got = parse_csv_arrow(joined, d) if use_arrow else None
+            if got is None:
+                got = self._split_chunk(joined, d)
+            if got is not None:
+                yield got
+
+    def _split_chunk(self, joined: str, d: str):
+        body = joined[:-1] if joined.endswith("\n") else joined
+        if "\r" in body:  # str sources; text-mode files normalize already
+            body = body.replace("\r\n", "\n").replace("\r", "\n")
+        ls = body.split("\n")
+        if "" in ls:  # blank lines are skipped, not counted
+            ls = [line for line in ls if line]
+            body = "\n".join(ls)
+        n = len(ls)
+        if n == 0:
+            return None
+        w1 = ls[0].count(d)
+        flat = body.replace("\n", d).split(d)
+        if (len(flat) == n * (w1 + 1)
+                and all(line.count(d) == w1 for line in ls)):
+            arr = np.array(flat, dtype=object).reshape(n, w1 + 1)
+            raw_col = (np.array(ls, dtype=object) if self._needs_raw
+                       else np.full(n, "", dtype=object))
+            cols = [raw_col] + [arr[:, i] for i in range(w1 + 1)]
+            return cols, n, False, 0
+        # ragged: row-wise parse isolates exactly the short/long rows
+        from .vectorized import _transpose
+        recs = [(([d.join(r)] + r) if self._needs_raw else ([""] + r))
+                for r in csv.reader(io.StringIO(joined), delimiter=d) if r]
+        if not recs:
+            return None
+        cols, ragged = _transpose(recs)
+        return cols, len(recs), ragged, 0
 
 
 class JsonConverter(SimpleFeatureConverter):

@@ -34,6 +34,7 @@ import base64 as _b64
 import hashlib
 import re
 import struct
+import threading
 import uuid as _uuid
 from typing import Any, Callable
 
@@ -41,21 +42,40 @@ import numpy as np
 
 from ..geometry import Point, parse_wkt
 
-__all__ = ["compile_expression", "EvaluationContext",
+__all__ = ["compile_expression", "parse_expression", "EvaluationContext",
            "murmur3_32", "murmur3_128"]
 
 
 class EvaluationContext:
-    """Per-ingest counters + caches (convert/EvaluationContext analog)."""
+    """Per-ingest counters + caches (convert/EvaluationContext analog).
+
+    Counter bumps are NOT atomic in CPython across `+=` on attributes,
+    so the ingest pipeline's worker threads each get their own context
+    and `merge()` them into the caller's at flush — the per-worker-
+    context strategy of the reference's EvaluationContext.copy. A lock
+    still guards `merge`/`counters` so a live metrics scrape racing a
+    flush reads a consistent triple."""
 
     def __init__(self):
         self.success = 0
         self.failure = 0
         self.line = 0
+        self._lock = threading.Lock()
+
+    def merge(self, other: "EvaluationContext") -> "EvaluationContext":
+        """Fold another context's counts into this one (thread-safe)."""
+        with other._lock:
+            s, f, ln = other.success, other.failure, other.line
+        with self._lock:
+            self.success += s
+            self.failure += f
+            self.line += ln
+        return self
 
     def counters(self) -> dict[str, int]:
-        return {"success": self.success, "failure": self.failure,
-                "line": self.line}
+        with self._lock:
+            return {"success": self.success, "failure": self.failure,
+                    "line": self.line}
 
 
 # -- murmur3 (x86_32 and x64_128) — pure-python, test-vector checked ------
@@ -384,24 +404,42 @@ def _parse_bool(v):
     raise ValueError(f"not a boolean: {v!r}")
 
 
+def parse_expression(text: str) -> tuple:
+    """Parse an expression into its AST — tagged tuples shared by the
+    scalar compiler below and the columnar compiler in ``vectorized``:
+
+        ("col", i)               column reference $i
+        ("field", name)          $fieldName cross-reference
+        ("lit", value)           literal (str only from quoted literals)
+        ("relit", pattern)       '...'::r constant-folded at parse time
+        ("recast", node)         dynamic ::r over a non-literal
+        ("cast", name, node)     ::int / ::double / ...
+        ("fn", name, [nodes])    registry function call
+        ("try", expr, fallback)
+        ("withdefault", expr, default)
+    """
+    p = _P(text)
+    node = _parse_expr(p)
+    p.ws()
+    if p.i != len(p.s):
+        raise ValueError(f"trailing input in expression: {text[p.i:]!r}")
+    return node
+
+
 def compile_expression(text: str) -> Callable[..., Any]:
     """Compile an expression to ``fn(columns, fields=None)``.
     ``columns[0]`` is the whole record; ``columns[1:]`` are input
     fields; ``fields`` maps previously-computed field names to values
     (the reference's `$fieldName` cross-references, evaluated in
     declaration order)."""
-    p = _P(text)
-    expr = _parse_expr(p)
-    p.ws()
-    if p.i != len(p.s):
-        raise ValueError(f"trailing input in expression: {text[p.i:]!r}")
+    expr = _compile_node(parse_expression(text))
 
     def run(cols, fields=None):
         return expr((cols, fields or {}))
     return run
 
 
-def _parse_expr(p: _P):
+def _parse_expr(p: _P) -> tuple:
     e = _parse_primary(p)
     # postfix casts, possibly chained; '...'::r compiles a regex literal
     while True:
@@ -410,56 +448,37 @@ def _parse_expr(p: _P):
             return e
         name = m.group(1).lower()
         if name == "r":
-            lit = getattr(e, "lit", None)
-            if lit is not None:
+            if e[0] == "lit" and isinstance(e[1], str):
                 # constant-fold: string literals compile ONCE at
                 # expression-compile time, not per record
-                pat = re.compile(str(lit))
-                e = lambda ctx, pat=pat: pat
+                e = ("relit", re.compile(e[1]))
             else:
-                inner = e
-                e = (lambda inner: lambda ctx: re.compile(
-                    str(inner(ctx))))(inner)
+                e = ("recast", e)
             continue
-        cast = _CASTS.get(name)
-        if cast is None:
+        if name not in _CASTS:
             raise ValueError(f"unknown cast ::{m.group(1)}")
-        inner = e
-        e = (lambda inner, cast: lambda ctx: cast(inner(ctx)))(inner, cast)
+        e = ("cast", name, e)
 
 
-def _parse_primary(p: _P):
+def _parse_primary(p: _P) -> tuple:
     m = p.match_re(r"\$(\d+)")
     if m:
-        idx = int(m.group(1))
-        return lambda ctx: ctx[0][idx]
+        return ("col", int(m.group(1)))
     m = p.match_re(r"\$([A-Za-z_]\w*)")
     if m:
-        name = m.group(1)
-
-        def _field(ctx, name=name):
-            if name not in ctx[1]:
-                raise ValueError(f"unknown field reference ${name} "
-                                 "(fields evaluate in declaration order)")
-            return ctx[1][name]
-        return _field
+        return ("field", m.group(1))
     m = p.match_re(r"'((?:[^']|'')*)'")
     if m:
-        lit = m.group(1).replace("''", "'")
-        fn = lambda ctx, lit=lit: lit
-        fn.lit = lit  # marks a compile-time constant (see ::r folding)
-        return fn
+        return ("lit", m.group(1).replace("''", "'"))
     m = p.match_re(r"[-+]?\d+\.\d+(?:[eE][-+]?\d+)?")
     if m:
-        lit = float(m.group(0))
-        return lambda ctx: lit
+        return ("lit", float(m.group(0)))
     m = p.match_re(r"[-+]?\d+(?![\w.])")
     if m:
-        lit = int(m.group(0))
-        return lambda ctx: lit
+        return ("lit", int(m.group(0)))
     m = p.match_re(r"null\b")
     if m:
-        return lambda ctx: None
+        return ("lit", None)
     m = p.match_re(r"(\w+)\s*\(")
     if m:
         name = m.group(1)
@@ -473,23 +492,63 @@ def _parse_primary(p: _P):
         if name == "try":
             if len(args) != 2:
                 raise ValueError("try(expr, fallback) takes 2 args")
-            expr, fallback = args
-
-            def _try(ctx, expr=expr, fallback=fallback):
-                try:
-                    return expr(ctx)
-                except Exception:
-                    return fallback(ctx)
-            return _try
+            return ("try", args[0], args[1])
         if name == "withDefault":
-            expr, default = args
-
-            def _wd(ctx, expr=expr, default=default):
-                v = expr(ctx)
-                return default(ctx) if v in (None, "") else v
-            return _wd
-        fn = _FUNCTIONS.get(name)
-        if fn is None:
+            if len(args) != 2:
+                raise ValueError("withDefault(expr, default) takes 2 args")
+            return ("withdefault", args[0], args[1])
+        if name not in _FUNCTIONS:
             raise ValueError(f"unknown function {name!r}")
-        return (lambda fn, args: lambda ctx: fn(*(a(ctx) for a in args)))(fn, args)
+        return ("fn", name, args)
     raise ValueError(f"cannot parse expression at {p.i} in {p.s!r}")
+
+
+def _compile_node(node: tuple) -> Callable[[tuple], Any]:
+    """Scalar backend: AST -> closure over ctx=(cols, fields)."""
+    kind = node[0]
+    if kind == "col":
+        idx = node[1]
+        return lambda ctx: ctx[0][idx]
+    if kind == "field":
+        name = node[1]
+
+        def _field(ctx, name=name):
+            if name not in ctx[1]:
+                raise ValueError(f"unknown field reference ${name} "
+                                 "(fields evaluate in declaration order)")
+            return ctx[1][name]
+        return _field
+    if kind == "lit":
+        lit = node[1]
+        return lambda ctx: lit
+    if kind == "relit":
+        pat = node[1]
+        return lambda ctx: pat
+    if kind == "recast":
+        inner = _compile_node(node[1])
+        return lambda ctx: re.compile(str(inner(ctx)))
+    if kind == "cast":
+        cast = _CASTS[node[1]]
+        inner = _compile_node(node[2])
+        return lambda ctx: cast(inner(ctx))
+    if kind == "try":
+        expr = _compile_node(node[1])
+        fallback = _compile_node(node[2])
+
+        def _try(ctx, expr=expr, fallback=fallback):
+            try:
+                return expr(ctx)
+            except Exception:
+                return fallback(ctx)
+        return _try
+    if kind == "withdefault":
+        expr = _compile_node(node[1])
+        default = _compile_node(node[2])
+
+        def _wd(ctx, expr=expr, default=default):
+            v = expr(ctx)
+            return default(ctx) if v in (None, "") else v
+        return _wd
+    fn = _FUNCTIONS[node[1]]
+    args = [_compile_node(a) for a in node[2]]
+    return lambda ctx: fn(*(a(ctx) for a in args))

@@ -19,6 +19,11 @@ from typing import Any, Iterable
 
 import numpy as np
 
+try:  # ingest hands string columns over as Arrow arrays when it can
+    import pyarrow as pa
+except Exception:  # pragma: no cover — arrow-less fallback stays live
+    pa = None
+
 from ..geometry import Geometry, Point, parse_wkt
 from .sft import SimpleFeatureType
 
@@ -139,6 +144,25 @@ class StringColumn(Column):
         codes[~mask] = -1
         return cls(name, codes, vocab.astype(object))
 
+    @classmethod
+    def from_arrow(cls, name: str, arr) -> "StringColumn":
+        """Dictionary-encode in C, then remap codes to the sorted-vocab
+        order ``code_of``'s searchsorted contract requires. Sorting the
+        (small) vocab beats argsorting every row."""
+        if arr.null_count:
+            return cls.from_strings(
+                name, np.asarray(arr.to_numpy(zero_copy_only=False),
+                                 dtype=object))
+        d = arr.dictionary_encode()
+        codes = np.asarray(d.indices.to_numpy(zero_copy_only=False),
+                           dtype=np.int32)
+        vocab = np.asarray(d.dictionary.to_numpy(zero_copy_only=False),
+                           dtype=object)
+        order = np.argsort(vocab)
+        inv = np.empty(len(order), dtype=np.int32)
+        inv[order] = np.arange(len(order), dtype=np.int32)
+        return cls(name, inv[codes], vocab[order])
+
 
 @dataclasses.dataclass
 class PointColumn(Column):
@@ -224,6 +248,10 @@ def _column_for(spec_type: str, name: str, data) -> Column:
                      "MultiPolygon", "GeometryCollection", "Geometry"):
         return GeometryColumn.from_geoms(name, data)
     if spec_type == "String" or spec_type == "UUID":
+        if pa is not None and isinstance(data, (pa.Array, pa.ChunkedArray)):
+            if isinstance(data, pa.ChunkedArray):
+                data = data.combine_chunks()
+            return StringColumn.from_arrow(name, data)
         return StringColumn.from_strings(name, data)
     if spec_type == "Date":
         arr = np.asarray(data)

@@ -1,0 +1,19 @@
+"""Ingest firehose: group-commit batched writes + admission control.
+
+``IngestPipeline`` stages converter output (or any caller's batches)
+through a bounded in-flight-rows queue into coalesced ``write_many``
+store calls — one WAL append / fsync decision and one state append per
+fused group. ``IngestGovernor`` is the admission-control half: a
+token bucket over in-flight rows (blocking put for embedded callers,
+429 + Retry-After on the web tier) plus a shed signal derived from the
+read batchers' queue depth so bulk ingest cannot starve query
+dispatches.
+"""
+
+from .pipeline import (INGEST_GROUP_ROWS, INGEST_LATENCY_BUDGET_MS,
+                       INGEST_MAX_INFLIGHT_ROWS, INGEST_SHED_QUEUE_DEPTH,
+                       IngestAck, IngestGovernor, IngestPipeline)
+
+__all__ = ["IngestPipeline", "IngestGovernor", "IngestAck",
+           "INGEST_MAX_INFLIGHT_ROWS", "INGEST_GROUP_ROWS",
+           "INGEST_LATENCY_BUDGET_MS", "INGEST_SHED_QUEUE_DEPTH"]

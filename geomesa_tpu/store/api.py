@@ -66,6 +66,31 @@ class DataStore(abc.ABC):
                    FeatureBatch.from_dict(self.get_schema(type_name),
                                           ids, data), **kwargs)
 
+    def write_many(self, type_name: str,
+                   pairs: list[tuple[FeatureBatch, Any]]):
+        """Group-commit: coalesce staged (batch, visibilities) pairs
+        into ONE backend write. The fused batch pays a single journal
+        append / fsync decision and a single state append on durable
+        stores, and is sliced once across partition groups on the
+        cluster store — per-caller writes would pay all of that per
+        batch. Returns the backend write's return value (e.g. an LSN
+        vector)."""
+        batches = [b for b, _ in pairs]
+        if not batches:
+            return None
+        if len(batches) == 1:
+            return self.write(type_name, batches[0],
+                              visibilities=pairs[0][1])
+        fused = FeatureBatch.concat_all(batches)
+        if all(v is None for _, v in pairs):
+            vis = None
+        else:
+            import numpy as np
+            parts = [np.full(b.n, None, dtype=object) if v is None
+                     else np.asarray(v, dtype=object) for b, v in pairs]
+            vis = np.concatenate(parts) if parts else None
+        return self.write(type_name, fused, visibilities=vis)
+
     # -- queries -----------------------------------------------------------
 
     @abc.abstractmethod

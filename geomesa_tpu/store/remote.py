@@ -198,13 +198,16 @@ class RemoteDataStore(DataStore):
                     msg = json.loads(data.decode()).get("error", "")
                 except Exception:
                     msg = data[:200].decode(errors="replace")
-                if resp.status == 503:
-                    # load shed: the server refused BEFORE executing,
-                    # so a retry is duplicate-safe for any method;
-                    # honor its explicit backpressure hint
+                if resp.status in (503, 429):
+                    # load shed (503) or ingest admission refusal (429):
+                    # the server refused BEFORE executing, so a retry is
+                    # duplicate-safe for any method; honor its explicit
+                    # backpressure hint — this is how a remote writer
+                    # experiences the ingest governor's blocking put
                     ra = resp.getheader("Retry-After")
                     raise RemoteError(
-                        f"503 {path}: {msg}", status=503, retryable=True,
+                        f"{resp.status} {path}: {msg}",
+                        status=resp.status, retryable=True,
                         retry_after_s=float(ra) if ra else None)
                 raise RemoteError(f"{resp.status} {path}: {msg}",
                                   status=resp.status,

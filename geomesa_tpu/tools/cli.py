@@ -97,23 +97,56 @@ def cmd_list_schemas(args) -> int:
 
 
 def cmd_ingest(args) -> int:
-    from ..convert import converter_for
+    """Streaming ingest: converter batches of ``geomesa.ingest.batch.
+    rows`` flow through the group-commit pipeline as they parse —
+    constant memory over any file size, columnar conversion unless
+    ``geomesa.ingest.vectorized=false``, coalesced journal/store writes
+    (ingest/pipeline.py). ``--scalar`` forces the record-at-a-time
+    oracle; ``--no-pipeline`` writes each chunk directly."""
+    from ..convert import EvaluationContext, converter_for
+    from ..convert.vectorized import INGEST_VECTORIZED
     ds = _store(args)
     sft = ds.get_schema(args.name)
     with open(args.converter) as fh:
         conf = json.load(fh)
     conv = converter_for(sft, conf)
-    total_ok = total_bad = 0
-    for path in args.files:
-        with open(path) as fh:
-            batch, ctx = conv.process(fh)
-        if batch.n:
-            ds.write(args.name, batch)
-        total_ok += ctx.success
-        total_bad += ctx.failure
-        print(f"{path}: ingested {ctx.success}, failed {ctx.failure}")
-    print(f"total: {total_ok} ingested, {total_bad} failed")
-    return 0 if total_bad == 0 else 1
+    if getattr(args, "scalar", False):
+        INGEST_VECTORIZED.thread_local_set("false")
+    pipe = None
+    if not getattr(args, "no_pipeline", False):
+        from ..ingest import IngestPipeline
+        pipe = IngestPipeline(ds)
+    total = EvaluationContext()
+    try:
+        for path in args.files:
+            # per-source context, merged at flush: per-file reporting
+            # stays exact even when a future caller converts sources on
+            # parallel workers
+            ctx = EvaluationContext()
+            with open(path) as fh:
+                for batch, _ in conv.iter_batches(fh, ctx):
+                    if not batch.n:
+                        continue
+                    if pipe is not None:
+                        pipe.write(args.name, batch)  # blocking put
+                    else:
+                        ds.write(args.name, batch)
+            if pipe is not None:
+                pipe.flush()
+            total.merge(ctx)
+            c = ctx.counters()
+            print(f"{path}: ingested {c['success']}, "
+                  f"failed {c['failure']}")
+    finally:
+        if pipe is not None:
+            pipe.observe_context(total)
+            pipe.close()
+        if getattr(args, "scalar", False):
+            INGEST_VECTORIZED.thread_local_set(None)
+    counts = total.counters()
+    print(f"total: {counts['success']} ingested, "
+          f"{counts['failure']} failed")
+    return 0 if counts["failure"] == 0 else 1
 
 
 def _query(args):
@@ -589,6 +622,14 @@ def main(argv=None) -> int:
     add("list-schemas", cmd_list_schemas)
     add("ingest", cmd_ingest, name_arg,
         (["--converter"], {"required": True}),
+        (["--scalar"], {"action": "store_true",
+                        "help": "force the record-at-a-time converter "
+                                "oracle (kill switch for the columnar "
+                                "path)"}),
+        (["--no-pipeline"], {"action": "store_true",
+                             "help": "write each chunk directly instead "
+                                     "of through the group-commit "
+                                     "pipeline"}),
         (["files"], {"nargs": "+"}))
     add("export", cmd_export, name_arg, cql_arg,
         (["--format"], {"default": "csv",

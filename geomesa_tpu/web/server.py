@@ -91,6 +91,14 @@ WEB_MAX_INFLIGHT = SystemProperty("geomesa.web.max.inflight", None)
 # the Retry-After hint (seconds) a shed response carries
 WEB_RETRY_AFTER = SystemProperty("geomesa.web.retry.after.s", "1")
 
+# route POST /rest/write through the process ingest pipeline: writes
+# from concurrent clients coalesce into group commits (one WAL append /
+# fsync decision per fused group), and admission control applies — a
+# full in-flight-rows bucket or a deep read-batcher backlog answers
+# 429 + Retry-After BEFORE the batch is staged, so a retry is
+# duplicate-safe (ingest/pipeline.py)
+WEB_INGEST_PIPELINE = SystemProperty("geomesa.ingest.web.pipeline", "true")
+
 
 class GeoMesaWebServer:
     """Bind a datastore to an HTTP port. ``start()`` serves on a daemon
@@ -127,6 +135,10 @@ class GeoMesaWebServer:
                              else WEB_MAX_INFLIGHT.as_int())
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # lazy group-commit write plane (first POST /rest/write when
+        # geomesa.ingest.web.pipeline is on)
+        self._ingest_pipeline = None
+        self._ingest_lock = threading.Lock()
         self._started_at = time.monotonic()
         # background hot-tile refresher: opt-in via the interval knob,
         # and only for stores that actually own a result cache (the
@@ -157,6 +169,8 @@ class GeoMesaWebServer:
     def stop(self):
         if self.refresher is not None:
             self.refresher.stop()
+        if self._ingest_pipeline is not None:
+            self._ingest_pipeline.close()
         if self._owns_cq and self.cq is not None:
             self.cq.close()
         self._httpd.shutdown()
@@ -179,7 +193,8 @@ class GeoMesaWebServer:
                  "uptime_s": round(time.monotonic() - self._started_at, 3),
                  "resilience": self._resilience_detail(),
                  "batcher": self._batcher_detail(),
-                 "durability": self._durability_detail()})
+                 "durability": self._durability_detail(),
+                 "ingest": self._ingest_detail()})
         if method == "GET" and parts == ["ready"]:
             return self._ready()
         if not self._acquire_slot():
@@ -271,6 +286,19 @@ class GeoMesaWebServer:
             if cause is not None:
                 out["cause"] = repr(cause)
         return out
+
+    def _ingest_detail(self) -> dict | None:
+        """Ingest-plane health: in-flight staged rows against the
+        admission bucket and whether new writes would currently shed.
+        None until the first pipelined write creates the plane."""
+        pipe = self._ingest_pipeline
+        if pipe is None:
+            return None
+        gov = pipe.governor
+        return {"inflight_rows": gov.inflight_rows,
+                "max_inflight_rows": gov.max_inflight_rows,
+                "group_cap_rows": pipe.effective_group_rows(),
+                "shedding": gov.should_shed()}
 
     def _batcher_detail(self) -> dict | None:
         """Serving-tier batcher health: per-type pending-queue depth
@@ -375,9 +403,14 @@ class GeoMesaWebServer:
             batches = [FeatureBatch.from_arrow(sft, rb)
                        for rb in table.to_batches() if rb.num_rows]
             if batches:
-                self.store.write(parts[1],
-                                 FeatureBatch.concat_all(batches),
-                                 visibilities=vis)
+                fused = FeatureBatch.concat_all(batches)
+                if str(WEB_INGEST_PIPELINE.get()).lower() in (
+                        "true", "1", "yes"):
+                    refused = self._pipeline_write(parts[1], fused, vis)
+                    if refused is not None:
+                        return refused
+                else:
+                    self.store.write(parts[1], fused, visibilities=vis)
             n = sum(b.n for b in batches)
             out = {"written": n, "lsn": self._tail_lsn()}
             vec = getattr(self.store, "lsn_vector", None)
@@ -437,6 +470,39 @@ class GeoMesaWebServer:
             return 200, "application/json", _j(
                 [json.loads(e.to_json()) for e in evs])
         return 404, "application/json", _j({"error": "not found"})
+
+    def _ingest_pipe(self):
+        if self._ingest_pipeline is None:
+            with self._ingest_lock:
+                if self._ingest_pipeline is None:
+                    from ..ingest import IngestPipeline
+                    self._ingest_pipeline = IngestPipeline(self.store)
+        return self._ingest_pipeline
+
+    def _pipeline_write(self, type_name: str, batch, vis):
+        """Stage through the group-commit pipeline. Returns None once
+        the write has committed, or a ready 429 response when admission
+        control refuses — the bucket of in-flight rows is full, or the
+        read batchers are backed up and ingest must yield."""
+        pipe = self._ingest_pipe()
+        retry_after = WEB_RETRY_AFTER.get() or "1"
+        if pipe.governor.should_shed():
+            metrics.counter("ingest.web.sheds")
+            return (429, "application/json",
+                    _j({"error": "ingest shed: read queues saturated",
+                        "retryable": True}),
+                    {"Retry-After": retry_after})
+        ack = pipe.write(type_name, batch, visibilities=vis, block=False)
+        if ack is None:
+            metrics.counter("ingest.web.backpressure")
+            return (429, "application/json",
+                    _j({"error": "ingest backpressure: in-flight row "
+                                 "bucket full", "retryable": True}),
+                    {"Retry-After": retry_after})
+        # block this request thread until the fused group commits: the
+        # response's lsn must cover this write (read-your-writes)
+        ack.wait()
+        return None
 
     def _tail_lsn(self) -> int | None:
         """The WAL position after a mutation (None for non-durable

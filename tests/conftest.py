@@ -56,3 +56,7 @@ def pytest_configure(config):
         "markers", "geofence: device-resident standing-filter suites "
         "(filter compiler, fused rows x filters kernel, publisher "
         "device path, /rest/cq surfaces; select with -m geofence)")
+    config.addinivalue_line(
+        "markers", "ingest: ingest-firehose suites (vectorized "
+        "converter parity vs the scalar oracle, group-commit pipeline, "
+        "admission control / 429 backpressure; select with -m ingest)")

@@ -170,3 +170,24 @@ def test_config14_streaming_smoke():
     assert r["byte_exact"] is True
     assert r["materialized_bytes"] == r["rebuilt_bytes"] > 0
     assert "gates_pass" in c
+
+
+@pytest.mark.bench_smoke
+@pytest.mark.ingest
+def test_config16_ingest_smoke():
+    rng = np.random.default_rng(49)
+    c = bench.bench_config16(rng, n=20_000, c_read=4, read_rounds=2,
+                             kill_rows=4096)
+    # conversion equivalence is exact at any size; the >=5x rows/s gate
+    # only means something at the full 1M-row run
+    assert c["rows_exact"] is True
+    assert c["scalar_per_write"]["rows_per_s"] > 0
+    assert c["vectorized_group_commit"]["rows_per_s"] > 0
+    v = c["vectorized_group_commit"]
+    # group commit must coalesce: fewer store commits than staged batches
+    assert v["groups"] <= v["staged_batches"]
+    r = c["reads_under_ingest"]
+    assert r["idle_p99_ms"] > 0 and r["loaded_p99_ms"] > 0
+    # the acked-durability contract holds at toy sizes too
+    assert c["kill_recovery"]["zero_acked_loss"] is True
+    assert "gates_pass" in c
