@@ -144,7 +144,7 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,"
+                             "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,"
                              "northstar")
               .split(","))
 MS_DAY = 86_400_000
@@ -2424,6 +2424,212 @@ def bench_config16(rng, n=None, c_read=None, read_rounds=None,
     return out
 
 
+# -- config 17: observability — tracing overhead + audit completeness -----
+
+def bench_config17(rng, n=None, c=None, nq=None, slow_s=None):
+    """What the observability plane costs and proves, in three gates.
+
+    (A) Overhead: ``c`` concurrent web clients stream a mixed read
+        workload (bbox query / count alternating) twice — tracing
+        fully off (sample=0, slow=0) then fully on (sample=1.0, every
+        trace kept, audit enriched) — p50/p99 must regress under 5%.
+    (B) Slow-query always-capture: with sampling OFF and the slow
+        threshold low, a deliberately stalled request must land in the
+        ring anyway, its trace showing >= 4 distinct span kinds (web,
+        batcher-wait, dispatch, store-scan).
+    (C) Audit completeness: the store recorded exactly one enriched
+        event per query across both phases; every traced-phase event's
+        trace id resolves in the ring; the Prometheus exposition
+        parses line-by-line.
+    """
+    import threading
+
+    from geomesa_tpu.audit import AuditLogger
+    from geomesa_tpu.features import parse_spec
+    from geomesa_tpu.index.api import Query
+    from geomesa_tpu.obs import tracer
+    from geomesa_tpu.obs.trace import TRACE_SAMPLE, TRACE_SLOW_MS
+    from geomesa_tpu.scan.registry import batcher_registry
+    from geomesa_tpu.store import InMemoryDataStore
+    from geomesa_tpu.store.remote import RemoteDataStore
+    from geomesa_tpu.web.server import GeoMesaWebServer
+
+    n = int(n if n is not None
+            else os.environ.get("GEOMESA_TPU_BENCH_OBS_N", 200_000))
+    c = int(c if c is not None else 32)
+    nq = int(nq if nq is not None else 25)
+    slow = float(slow_s if slow_s is not None else 0.25)
+    out = {"n": n, "clients": c, "queries_per_client": nq}
+
+    # only whitelisted hints cross the REST wire, so the straggler is
+    # marked by a sentinel bbox coordinate no workload rect ever uses
+    stall_mark = "-179.25"
+
+    class StallStore(InMemoryDataStore):
+        """Sleeps on a marked query so the slow-capture phase has a
+        deterministic straggler."""
+
+        def query(self, q, *args, **kwargs):
+            if stall_mark in str(getattr(q, "filter", "")):
+                time.sleep(slow)
+            return super().query(q, *args, **kwargs)
+
+    audit = AuditLogger()
+    ds = StallStore(audit=audit)
+    ds.create_schema(parse_spec("obs17",
+                                "dtg:Date,*geom:Point:srid=4326"))
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    ms = rng.integers(T0_DAY * MS_DAY, T1_DAY * MS_DAY, n).astype(np.int64)
+    ds.write_dict("obs17", np.arange(n).astype(str).astype(object),
+                  {"dtg": ms, "geom": (x, y)})
+    del x, y, ms
+
+    def bbox_q(i, w=4.0, h=4.0):
+        x0 = -170.0 + (i * 37) % 330
+        y0 = -80.0 + (i * 23) % 150
+        return Query("obs17",
+                     f"BBOX(geom, {x0}, {y0}, {x0 + w}, {y0 + h})")
+
+    def run_phase(server):
+        """c clients, nq mixed reads each; returns latency samples."""
+        lat: list = [None] * (c * nq)
+        barrier = threading.Barrier(c)
+
+        def worker(ci):
+            client = RemoteDataStore("127.0.0.1", server.port,
+                                     hedge=False)
+            barrier.wait()
+            for j in range(nq):
+                k = ci * nq + j
+                t0 = time.perf_counter()
+                if j % 2:
+                    client.query_count(bbox_q(k))
+                else:
+                    client.query(bbox_q(k))
+                lat[k] = time.perf_counter() - t0
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True) for i in range(c)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120.0)
+        assert not any(v is None for v in lat), "config 17 phase stuck"
+        return lat
+
+    batcher_registry.clear()
+    tracer.clear()
+    server = GeoMesaWebServer(ds).start()
+    try:
+        # warmup compiles the scan kernels AND materializes every rect
+        # both phases will ask for: off/on then compare like against
+        # like (cache-hit serving, the tier's steady state) instead of
+        # charging phase off the cold misses
+        warm = RemoteDataStore("127.0.0.1", server.port, hedge=False)
+        for k in range(c * nq):
+            if k % 2:
+                warm.query_count(bbox_q(k))
+            else:
+                warm.query(bbox_q(k))
+
+        # -- phase A: instrumentation off vs fully on ---------------------
+        TRACE_SAMPLE.set("0")
+        TRACE_SLOW_MS.set("0")
+        ev0 = len(audit.query())
+        try:
+            lat_off = run_phase(server)
+        finally:
+            TRACE_SAMPLE.set(None)
+            TRACE_SLOW_MS.set(None)
+        ev_off = len(audit.query()) - ev0
+
+        TRACE_SAMPLE.set("1.0")
+        ev1 = len(audit.query())
+        try:
+            lat_on = run_phase(server)
+        finally:
+            TRACE_SAMPLE.set(None)
+        ev_on = len(audit.query()) - ev1
+        traced_events = list(audit.query())[ev1:]
+
+        po, pn = _pcts(lat_off), _pcts(lat_on)
+        out["instrumentation_off"] = {
+            "p50_ms": round(po["p50"] * 1e3, 2),
+            "p99_ms": round(po["p99"] * 1e3, 2)}
+        out["instrumentation_on"] = {
+            "p50_ms": round(pn["p50"] * 1e3, 2),
+            "p99_ms": round(pn["p99"] * 1e3, 2)}
+        out["overhead"] = {
+            "p50_pct": round((pn["p50"] / max(po["p50"], 1e-9) - 1)
+                             * 100, 2),
+            "p99_pct": round((pn["p99"] / max(po["p99"], 1e-9) - 1)
+                             * 100, 2)}
+        out["overhead_under_5pct"] = bool(
+            pn["p50"] <= po["p50"] * 1.05
+            and pn["p99"] <= po["p99"] * 1.05)
+
+        # resolve traced-phase audit ids against the ring BEFORE phase
+        # B clears it
+        resolvable = 0
+        for e in traced_events:
+            if e.trace_id and tracer.get(e.trace_id) is not None:
+                resolvable += 1
+
+        # -- phase B: slow-query always-capture (sampling off) ------------
+        tracer.clear()
+        TRACE_SAMPLE.set("0")
+        TRACE_SLOW_MS.set(str(int(slow * 1e3 / 2)))
+        try:
+            # a rect no phase-A client asked for: the stall must reach
+            # the store, not the materialized result cache
+            sq = Query("obs17", f"BBOX(geom, {stall_mark}, -80.25, "
+                                "-175.25, -76.25)")
+            client = RemoteDataStore("127.0.0.1", server.port,
+                                     hedge=False)
+            client.query(sq)
+            # the server-side web trace is the one the ring must hold
+            caught = [t for t in tracer.traces()
+                      if t["root_kind"] in ("web", "batcher-wait")]
+            kinds = set()
+            for t in caught:
+                kinds.update(t["kinds"])
+            out["slow_capture"] = {
+                "captured": bool(caught),
+                "span_kinds": sorted(kinds),
+                "four_kinds": bool(len(kinds) >= 4)}
+        finally:
+            TRACE_SAMPLE.set(None)
+            TRACE_SLOW_MS.set(None)
+
+        # -- phase C: audit completeness + prometheus parse ---------------
+        prom = server.handle("GET", "/rest/metrics",
+                             {"format": ["prometheus"]}, None)[2]
+        prom_ok = all(
+            ln.startswith("#") or (" " in ln and not ln[0].isspace())
+            for ln in prom.splitlines() if ln)
+        out["audit"] = {
+            "queries": c * nq,
+            "events_off": ev_off, "events_on": ev_on,
+            "one_event_per_query": bool(
+                ev_off == c * nq and ev_on == c * nq),
+            "traced_ids_resolvable": resolvable,
+            "all_resolvable": bool(resolvable == len(traced_events)),
+            "prometheus_parses": prom_ok}
+    finally:
+        server.stop()
+        batcher_registry.clear()
+        tracer.clear()
+
+    out["gates_pass"] = bool(
+        out["overhead_under_5pct"]
+        and out["slow_capture"]["four_kinds"]
+        and out["audit"]["one_event_per_query"]
+        and out["audit"]["all_resolvable"]
+        and out["audit"]["prometheus_parses"])
+    return out
+
+
 # -- config 10: storage integrity — scrub overhead + corrupt recovery -----
 
 def bench_config10(rng):
@@ -2696,6 +2902,8 @@ def main(argv=None):
         out["configs"]["15_geofence"] = bench_config15(rng)
     if "16" in CONFIGS:
         out["configs"]["16_ingest"] = bench_config16(rng)
+    if "17" in CONFIGS:
+        out["configs"]["17_observability"] = bench_config17(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

@@ -129,13 +129,17 @@ class ResultCache:
                 else:
                     leader = False
                     self.singleflight_waits += 1
+        from ..obs import annotate, set_flag
         if leader is None:
             self._registry.counter("cache.hits")
+            annotate("cache.hit", type=type_name)
+            set_flag("cache_hit")
             return decode(stored) if decode is not None else stored
         if leader is False:
             # follower: park on the leader's flight, decode a private
             # copy of its payload — no store lock, no device dispatch
             self._registry.counter("cache.singleflight.waits")
+            annotate("cache.singleflight.follower", type=type_name)
             fl.event.wait(_FLIGHT_WAIT_S)
             if fl.error is not None or not fl.event.is_set() \
                     or fl.stored is None:
@@ -144,6 +148,7 @@ class ResultCache:
         # leader: compute (the store's own synchronization applies),
         # publish to followers, install the entry
         self._registry.counter("cache.misses")
+        annotate("cache.miss", type=type_name)
         with self._lock:
             self.misses += 1
         try:

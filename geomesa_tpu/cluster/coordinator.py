@@ -44,6 +44,7 @@ drops a shard's rows is worse than a single store):
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 
@@ -172,9 +173,11 @@ class ClusterDataStore(DataStore):
     """
 
     def __init__(self, groups, names=None, leg_deadline_s=None,
-                 hedge_ms=None, allow_partial=None, registry=metrics):
+                 hedge_ms=None, allow_partial=None, registry=metrics,
+                 audit=None):
         if not groups:
             raise ValueError("at least one shard group required")
+        self.audit = audit  # AuditLogger or None (global fallback)
         self._groups = list(groups)
         self._names = (list(names) if names is not None
                        else [f"shard{i}" for i in range(len(groups))])
@@ -251,39 +254,46 @@ class ClusterDataStore(DataStore):
         fast group hedges sooner, a slow one stops hedging on every
         call — and hedges are charged to the cluster's retry budget so
         a cluster-wide brownout can't double its own load."""
-        breaker = self._breakers.get(name)
-        try:
-            breaker.acquire()
-        except CircuitOpenError as e:
-            self._registry.counter("cluster.leg.fastfails")
-            failures[name] = e
-            return
-        t0 = time.perf_counter()
-        delay = self._hedge.delay_s(self._breakers.latency_p99_s(name))
-        if delay is None:
-            delay = hedge_s  # no estimate yet: the static knob
-        if self._hedge.budget is not None:
-            self._hedge.budget.deposit()  # first attempts earn tokens
-        try:
-            v = self._hedge.call(
-                fn, delay, deadline_s=deadline, name=f"cluster.{name}",
-                on_hedge=lambda: self._registry.counter(
-                    "cluster.leg.hedges"))
-        except TimeoutError:
-            breaker.failure()
-            self._registry.counter("cluster.leg.failures")
-            self._registry.counter("cluster.leg.timeouts")
-            failures[name] = TimeoutError(
-                f"shard leg {name!r} exceeded its {deadline:g}s "
-                "deadline")
-        except Exception as e:  # noqa: BLE001 — leg boundary
-            breaker.failure()
-            self._registry.counter("cluster.leg.failures")
-            failures[name] = e
-        else:
-            breaker.success()
-            self._breakers.observe(name, time.perf_counter() - t0)
-            results[name] = v
+        from ..obs import tracer
+        with tracer.span("scatter-leg", name) as sp:
+            breaker = self._breakers.get(name)
+            try:
+                breaker.acquire()
+            except CircuitOpenError as e:
+                self._registry.counter("cluster.leg.fastfails")
+                sp.annotate("breaker.fastfail")
+                failures[name] = e
+                return
+            t0 = time.perf_counter()
+            delay = self._hedge.delay_s(
+                self._breakers.latency_p99_s(name))
+            if delay is None:
+                delay = hedge_s  # no estimate yet: the static knob
+            if self._hedge.budget is not None:
+                self._hedge.budget.deposit()  # first attempts earn
+            try:
+                v = self._hedge.call(
+                    fn, delay, deadline_s=deadline,
+                    name=f"cluster.{name}",
+                    on_hedge=lambda: self._registry.counter(
+                        "cluster.leg.hedges"))
+            except TimeoutError:
+                breaker.failure()
+                self._registry.counter("cluster.leg.failures")
+                self._registry.counter("cluster.leg.timeouts")
+                sp.annotate("leg.timeout", deadline_s=deadline)
+                failures[name] = TimeoutError(
+                    f"shard leg {name!r} exceeded its {deadline:g}s "
+                    "deadline")
+            except Exception as e:  # noqa: BLE001 — leg boundary
+                breaker.failure()
+                self._registry.counter("cluster.leg.failures")
+                sp.annotate("leg.failed", error=type(e).__name__)
+                failures[name] = e
+            else:
+                breaker.success()
+                self._breakers.observe(name, time.perf_counter() - t0)
+                results[name] = v
 
     def _scatter(self, make_fn) -> tuple[dict, dict]:
         """Fan one read out to every group. ``make_fn(name, group)``
@@ -295,10 +305,14 @@ class ClusterDataStore(DataStore):
         failures: dict = {}
         threads = []
         for name, group in zip(self._names, self._groups):
+            # each leg thread runs under a copy of the caller's
+            # context: trace spans parent correctly and the audit
+            # hook's delegation suppression reaches the inner stores
+            ctx = contextvars.copy_context()
             t = threading.Thread(
-                target=self._leg,
-                args=(name, make_fn(name, group), deadline, hedge_s,
-                      results, failures),
+                target=ctx.run,
+                args=(self._leg, name, make_fn(name, group), deadline,
+                      hedge_s, results, failures),
                 daemon=True, name=f"cluster-scatter-{name}")
             threads.append(t)
             t.start()
@@ -470,7 +484,10 @@ class ClusterDataStore(DataStore):
                 return res
             return leg
 
-        results, failures = self._scatter(make_fn)
+        from ..audit import audit_query, delegated_scope
+        t0 = time.perf_counter()
+        with delegated_scope():
+            results, failures = self._scatter(make_fn)
         missing = self._missing(failures)
         ids_parts, batch_parts = [], []
         for name in self._names:
@@ -505,17 +522,27 @@ class ClusterDataStore(DataStore):
             out.complete = False
             out.missing_groups = missing["groups"]
             out.missing_z_ranges = missing["z_ranges"]
+        audit_query(self.audit, "cluster", q.type_name, str(q.filter),
+                    q.hints, 0.0, (time.perf_counter() - t0) * 1000,
+                    len(ids), index="cluster")
         return out
 
     def query_count(self, q, type_name=None) -> int:
         q = self._as_query(q, type_name)
-        results, failures = self._scatter(
-            lambda name, group:
-            lambda: group.query_count(q, **self._ryw_kwargs(name, group)))
+        from ..audit import audit_query, delegated_scope
+        t0 = time.perf_counter()
+        with delegated_scope():
+            results, failures = self._scatter(
+                lambda name, group:
+                lambda: group.query_count(q, **self._ryw_kwargs(name,
+                                                                group)))
         missing = self._missing(failures)
         total = int(sum(results.values()))
         if q.max_features is not None:
             total = min(total, q.max_features)
+        audit_query(self.audit, "cluster", q.type_name, str(q.filter),
+                    q.hints, 0.0, (time.perf_counter() - t0) * 1000,
+                    total, index="cluster")
         if missing:
             out = PartialCount(total)
             out.missing_groups = missing["groups"]

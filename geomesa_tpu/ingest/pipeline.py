@@ -198,7 +198,9 @@ class IngestPipeline:
             if self._closed:
                 self.governor.release(batch.n)
                 raise RuntimeError("ingest pipeline is closed")
-            self._q.append((type_name, batch, visibilities, ack))
+            from ..obs import tracer
+            self._q.append((type_name, batch, visibilities, ack,
+                            tracer.current()))
             self._cv.notify()
         return ack
 
@@ -296,9 +298,24 @@ class IngestPipeline:
             rows = sum(e[1].n for e in group)
             self._shed_pause()
             t0 = time.perf_counter()
+            from ..obs import tracer
+            gsp = tracer.span("group-commit", type_name, root=True)
+            if gsp.span_id is not None:
+                # link the commit span to every staged caller's trace so
+                # a write's trace resolves to the fsync that durably
+                # committed it (and vice versa)
+                for e in group:
+                    ctx = e[4]
+                    if ctx is None:
+                        continue
+                    state, wsp = ctx
+                    gsp.link(state.trace_id, wsp.span_id)
+                    wsp.link(gsp.trace_id, gsp.span_id)
             try:
-                result = self.store.write_many(
-                    type_name, [(e[1], e[2]) for e in group])
+                with gsp:
+                    gsp.set_attr(rows=rows, staged=len(group))
+                    result = self.store.write_many(
+                        type_name, [(e[1], e[2]) for e in group])
             except BaseException as exc:  # noqa: BLE001 — acks carry it
                 metrics.counter("ingest.errors")
                 for e in group:

@@ -1,6 +1,8 @@
 """Metrics registry (geomesa-metrics / Dropwizard analog): counters,
 timers and gauges with pluggable reporters."""
 
-from .registry import MetricsRegistry, metrics, sanitize_key
+from .registry import (MetricsRegistry, labeled_key, metrics,
+                       prometheus_text, sanitize_key, split_key)
 
-__all__ = ["MetricsRegistry", "metrics", "sanitize_key"]
+__all__ = ["MetricsRegistry", "metrics", "sanitize_key", "labeled_key",
+           "split_key", "prometheus_text"]

@@ -1,14 +1,19 @@
 """Lightweight metrics registry (MetricsConfig analog,
-metrics/config/MetricsConfig.scala:26): counters/timers/gauges with a
-snapshot API and delimited-file reporting."""
+metrics/config/MetricsConfig.scala:26): counters/gauges with optional
+labels, timers backed by fixed-log-bucket histograms (p50/p95/p99 in
+``snapshot()``), a delimited-file reporter hook, and Prometheus text
+exposition for ``GET /rest/metrics?format=prometheus``."""
 
 from __future__ import annotations
 
+import math
 import re
 import threading
 import time
+from bisect import bisect_left
 
-__all__ = ["MetricsRegistry", "metrics", "sanitize_key"]
+__all__ = ["MetricsRegistry", "metrics", "sanitize_key",
+           "labeled_key", "split_key", "prometheus_text"]
 
 # metric-key material derived from user-controlled strings (type names,
 # endpoint routes) must not corrupt the registry dump: no whitespace or
@@ -29,22 +34,79 @@ def sanitize_key(raw: str) -> str:
     return s or "_"
 
 
+def _esc_label(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def labeled_key(name: str, labels: dict | None) -> str:
+    """Registry key for a labeled metric: ``name{k="v",...}`` with
+    sorted keys, Prometheus-style escaping. Label *names* are
+    sanitized like key segments; values only escaped (they end up
+    inside quotes)."""
+    if not labels:
+        return name
+    inner = ",".join(f'{sanitize_key(k)}="{_esc_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+_LABELED = re.compile(r"^([^{]+)\{(.*)\}$")
+
+
+def split_key(key: str) -> tuple[str, str]:
+    """Inverse of labeled_key at the exposition layer: returns
+    (base name, label body or '')."""
+    m = _LABELED.match(key)
+    return (m.group(1), m.group(2)) if m else (key, "")
+
+
+# Fixed log-spaced histogram bounds: sqrt(2) steps from 1µs to ~46000s
+# (64 buckets + overflow). Quantiles interpolate inside the matched
+# bucket, so the relative error is bounded by the step (~±20%) at a
+# fixed 65-slot cost per timer — cheap enough to leave on everywhere.
+_BOUNDS = tuple(1e-6 * 2 ** (i / 2) for i in range(64))
+
+
 class _Timer:
-    __slots__ = ("count", "total_s", "max_s")
+    """Timer = count/sum/max + a fixed-log-bucket histogram."""
+
+    __slots__ = ("count", "total_s", "max_s", "buckets")
 
     def __init__(self):
         self.count = 0
         self.total_s = 0.0
         self.max_s = 0.0
+        self.buckets = [0] * (len(_BOUNDS) + 1)
 
     def update(self, seconds: float):
         self.count += 1
         self.total_s += seconds
         self.max_s = max(self.max_s, seconds)
+        self.buckets[bisect_left(_BOUNDS, seconds)] += 1
 
     @property
     def mean_ms(self) -> float:
         return (self.total_s / self.count * 1000) if self.count else 0.0
+
+    def quantile_s(self, q: float) -> float:
+        """Histogram quantile estimate in seconds: find the bucket the
+        rank lands in, interpolate linearly within it."""
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        seen = 0
+        for i, c in enumerate(self.buckets):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                lo = _BOUNDS[i - 1] if i > 0 else 0.0
+                hi = _BOUNDS[i] if i < len(_BOUNDS) else self.max_s
+                hi = min(max(hi, lo), self.max_s) if self.max_s else hi
+                frac = (rank - seen) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            seen += c
+        return self.max_s
 
 
 class MetricsRegistry:
@@ -54,16 +116,28 @@ class MetricsRegistry:
         self._timers: dict[str, _Timer] = {}
         self._gauges: dict[str, float] = {}
 
-    def counter(self, name: str, inc: int = 1):
+    def counter(self, name: str, inc: int = 1,
+                labels: dict | None = None):
+        key = labeled_key(name, labels)
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + inc
+            self._counters[key] = self._counters.get(key, 0) + inc
 
-    def gauge(self, name: str, value: float):
+    def gauge(self, name: str, value: float,
+              labels: dict | None = None):
         with self._lock:
-            self._gauges[name] = value
+            self._gauges[labeled_key(name, labels)] = value
 
-    def time(self, name: str):
+    def observe(self, name: str, seconds: float,
+                labels: dict | None = None):
+        """Record one duration directly (for callers that measured it
+        themselves)."""
+        key = labeled_key(name, labels)
+        with self._lock:
+            self._timers.setdefault(key, _Timer()).update(seconds)
+
+    def time(self, name: str, labels: dict | None = None):
         reg = self
+        key = labeled_key(name, labels)
 
         class _Ctx:
             def __enter__(self):
@@ -72,18 +146,27 @@ class MetricsRegistry:
             def __exit__(self, *exc):
                 dt = time.perf_counter() - self.t0
                 with reg._lock:
-                    reg._timers.setdefault(name, _Timer()).update(dt)
+                    reg._timers.setdefault(key, _Timer()).update(dt)
 
         return _Ctx()
 
     def snapshot(self) -> dict:
+        """JSON-safe snapshot. Non-finite gauge values (an EWMA can
+        divide to inf/nan before warm-up) map to None — json.dumps
+        would otherwise emit bare ``Infinity``/``NaN``, which is not
+        JSON and breaks ``/rest/metrics`` consumers."""
         with self._lock:
             return {
                 "counters": dict(self._counters),
-                "gauges": dict(self._gauges),
+                "gauges": {k: (v if isinstance(v, (int, float))
+                               and math.isfinite(v) else None)
+                           for k, v in self._gauges.items()},
                 "timers": {k: {"count": t.count,
                                "mean_ms": round(t.mean_ms, 3),
-                               "max_ms": round(t.max_s * 1000, 3)}
+                               "max_ms": round(t.max_s * 1000, 3),
+                               "p50_ms": round(t.quantile_s(0.50) * 1000, 3),
+                               "p95_ms": round(t.quantile_s(0.95) * 1000, 3),
+                               "p99_ms": round(t.quantile_s(0.99) * 1000, 3)}
                            for k, t in self._timers.items()},
             }
 
@@ -92,6 +175,77 @@ class MetricsRegistry:
         format owner; see reporters.py)."""
         from .reporters import DelimitedFileReporter
         DelimitedFileReporter(path, delimiter).report(self.snapshot())
+
+    def prometheus_text(self) -> str:
+        return prometheus_text(self.snapshot())
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    n = _PROM_BAD.sub("_", name)
+    if not n or not (n[0].isalpha() or n[0] in "_:"):
+        n = "_" + n
+    return "geomesa_" + n
+
+
+def _prom_line(name: str, label_body: str, extra: str, value) -> str:
+    body = ",".join(x for x in (label_body, extra) if x)
+    return (f"{name}{{{body}}} {value!r}" if body
+            else f"{name} {value!r}")
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Prometheus text exposition (format version 0.0.4) of a
+    snapshot: counters as ``_total`` counters, gauges as gauges
+    (non-finite samples dropped), timers as summaries with
+    p50/p95/p99 quantiles. Labeled registry keys split back into
+    name + label body; ``# TYPE`` emitted once per metric family."""
+    families: dict[str, tuple[str, list[str]]] = {}
+
+    def fam(prom: str, mtype: str) -> list[str]:
+        if prom not in families:
+            families[prom] = (mtype, [])
+        return families[prom][1]
+
+    for key, v in sorted(snapshot.get("counters", {}).items()):
+        base, lbl = split_key(key)
+        prom = _prom_name(base) + "_total"
+        fam(prom, "counter").append(_prom_line(prom, lbl, "", float(v)))
+    for key, v in sorted(snapshot.get("gauges", {}).items()):
+        if v is None or not math.isfinite(float(v)):
+            continue
+        base, lbl = split_key(key)
+        prom = _prom_name(base)
+        fam(prom, "gauge").append(_prom_line(prom, lbl, "", float(v)))
+    for key, t in sorted(snapshot.get("timers", {}).items()):
+        base, lbl = split_key(key)
+        prom = _prom_name(base) + "_seconds"
+        lines = fam(prom, "summary")
+        for q, field in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                         ("0.99", "p99_ms")):
+            val = t.get(field)
+            if val is None:
+                continue
+            lines.append(_prom_line(prom, lbl, f'quantile="{q}"',
+                                    float(val) / 1000.0))
+        cnt = fam(prom + "_count", "")
+        cnt.append(_prom_line(prom + "_count", lbl, "",
+                              float(t.get("count", 0))))
+        mean = t.get("mean_ms")
+        if mean is not None:
+            s = fam(prom + "_sum", "")
+            s.append(_prom_line(
+                prom + "_sum", lbl, "",
+                float(mean) / 1000.0 * float(t.get("count", 0))))
+
+    out: list[str] = []
+    for prom, (mtype, lines) in families.items():
+        if mtype:
+            out.append(f"# TYPE {prom} {mtype}")
+        out.extend(lines)
+    return "\n".join(out) + ("\n" if out else "")
 
 
 metrics = MetricsRegistry()

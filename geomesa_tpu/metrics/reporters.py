@@ -19,9 +19,15 @@ def _flatten(snapshot: dict) -> list[tuple[str, float]]:
     for name, v in sorted(snapshot.get("counters", {}).items()):
         out.append((f"counters.{name}", float(v)))
     for name, v in sorted(snapshot.get("gauges", {}).items()):
+        # snapshot() maps non-finite gauges to None; a delimited report
+        # has no null, so those samples are simply dropped
+        if v is None:
+            continue
         out.append((f"gauges.{name}", float(v)))
     for name, t in sorted(snapshot.get("timers", {}).items()):
         for field, val in t.items():
+            if val is None:
+                continue
             out.append((f"timers.{name}.{field}", float(val)))
     return out
 

@@ -1,0 +1,12 @@
+"""Observability: Dapper-style request tracing (spans, wire
+propagation, bounded ring + JSONL export) — see trace.py for the
+model. The metrics histograms live in ``geomesa_tpu.metrics``; the
+audit plane in ``geomesa_tpu.audit``."""
+
+from .trace import (TRACE_HEADER, TRACE_MAX_SPANS, TRACE_PATH,
+                    TRACE_SAMPLE, TRACE_SLOW_MS, Span, Tracer, annotate,
+                    current_trace_id, get_flag, set_flag, tracer)
+
+__all__ = ["TRACE_HEADER", "TRACE_SAMPLE", "TRACE_SLOW_MS",
+           "TRACE_MAX_SPANS", "TRACE_PATH", "Span", "Tracer", "tracer",
+           "annotate", "set_flag", "get_flag", "current_trace_id"]

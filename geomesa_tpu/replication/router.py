@@ -99,7 +99,8 @@ class ReplicatedDataStore(DataStore):
                  auto_promote: bool | None = None,
                  probe_ms: float | None = None,
                  probe_failures: int | None = None,
-                 registry=metrics):
+                 registry=metrics, audit=None):
+        self.audit = audit  # AuditLogger or None (global fallback)
         self.primary = primary
         self._replicas: list[Replica] = list(replicas)
         self._registry = registry
@@ -292,8 +293,20 @@ class ReplicatedDataStore(DataStore):
 
     def query(self, q, type_name=None, explain_out=None,
               max_lag_lsn=None, max_lag_s=None):
-        return self._read("query", q, type_name, explain_out=explain_out,
-                          max_lag_lsn=max_lag_lsn, max_lag_s=max_lag_s)
+        from ..audit import audit_query, delegated_scope
+        t0 = time.perf_counter()
+        with delegated_scope():
+            out = self._read("query", q, type_name,
+                             explain_out=explain_out,
+                             max_lag_lsn=max_lag_lsn,
+                             max_lag_s=max_lag_s)
+        audit_query(self.audit, "replicated",
+                    getattr(q, "type_name", None) or type_name or "",
+                    str(getattr(q, "filter", q)),
+                    getattr(q, "hints", {}) or {}, 0.0,
+                    (time.perf_counter() - t0) * 1000,
+                    int(getattr(out, "n", 0)), index="replicated")
+        return out
 
     def query_stream(self, q, type_name=None, batch_rows=None,
                      max_lag_lsn=None, max_lag_s=None):
@@ -308,8 +321,18 @@ class ReplicatedDataStore(DataStore):
 
     def query_count(self, q, type_name=None,
                     max_lag_lsn=None, max_lag_s=None) -> int:
-        return self._read("query_count", q, type_name,
-                          max_lag_lsn=max_lag_lsn, max_lag_s=max_lag_s)
+        from ..audit import audit_query, delegated_scope
+        t0 = time.perf_counter()
+        with delegated_scope():
+            n = self._read("query_count", q, type_name,
+                           max_lag_lsn=max_lag_lsn, max_lag_s=max_lag_s)
+        audit_query(self.audit, "replicated",
+                    getattr(q, "type_name", None) or type_name or "",
+                    str(getattr(q, "filter", q)),
+                    getattr(q, "hints", {}) or {}, 0.0,
+                    (time.perf_counter() - t0) * 1000, int(n),
+                    index="replicated")
+        return n
 
     def count(self, type_name: str,
               max_lag_lsn=None, max_lag_s=None) -> int:

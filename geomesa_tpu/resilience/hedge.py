@@ -39,6 +39,7 @@ attempts/wins for the serving tier's dashboards).
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 
@@ -128,8 +129,15 @@ class HedgePolicy:
                 cond.notify_all()
 
         def launch(idx: int):
+            # each attempt runs under a copy of the caller's context so
+            # trace spans parent into the live trace (and the audit
+            # hook's delegation scope reaches hedged attempts too);
+            # copies are independent, so concurrent attempts never
+            # re-enter one Context
             state["running"] += 1
-            threading.Thread(target=attempt, args=(idx,), daemon=True,
+            ctx = contextvars.copy_context()
+            threading.Thread(target=ctx.run, args=(attempt, idx),
+                             daemon=True,
                              name=f"hedge-{name or 'call'}-{idx}").start()
 
         t0 = self._clock()
@@ -162,6 +170,10 @@ class HedgePolicy:
                     if key:
                         self._registry.counter(
                             f"resilience.hedge.attempts.{key}")
+                    from ..obs import annotate, set_flag
+                    annotate("hedge.launched", name=name,
+                             delay_ms=round((now - t0) * 1000, 3))
+                    set_flag("hedged")
                     if on_hedge is not None:
                         on_hedge()
                     launch(1)

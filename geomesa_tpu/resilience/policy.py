@@ -142,6 +142,10 @@ class RetryPolicy:
                 self._registry.counter("resilience.retries")
                 if name:
                     self._registry.counter(f"resilience.retries.{name}")
+                from ..obs import annotate
+                annotate("retry", name=name, attempt=attempt,
+                         error=type(e).__name__,
+                         delay_ms=round(delay * 1000, 3))
                 if on_retry is not None:
                     on_retry(e, attempt, delay)
                 self._sleep(delay)

@@ -88,13 +88,17 @@ _EWMA_ALPHA = 0.2
 
 
 class _Pending:
-    __slots__ = ("q", "ev", "result", "error")
+    __slots__ = ("q", "ev", "result", "error", "span_ctx")
 
     def __init__(self, q):
         self.q = q
         self.ev = threading.Event()
         self.result = None
         self.error = None
+        # caller's (trace state, span) — the leader links its fused
+        # dispatch span to every waiter and grafts the dispatch
+        # subtree back into their traces (obs/trace.py)
+        self.span_ctx = None
 
     def resolve(self, result=None, error=None):
         self.result, self.error = result, error
@@ -182,28 +186,33 @@ class QueryBatcher:
             if type_name is None:
                 raise ValueError("type_name required with a filter string")
             q = Query(type_name, q)
+        from ..obs import tracer
         if self.max_batch <= 1:
             self._note(1)
-            return self.store.query(q)
+            with tracer.span("batcher-wait", q.type_name, root=True):
+                return self.store.query(q)
         p = _Pending(q)
-        with self._cond:
-            tq = self._queues.setdefault(q.type_name, _TypeQueue())
-            tq.observe_arrival(time.monotonic())
-            tq.items.append(p)
-            depth = len(tq.items)
-            if not tq.has_leader:
-                tq.has_leader = True
-                leader = True
-            else:
-                leader = False
-                if depth >= self.effective_max_batch(q.type_name):
-                    self._cond.notify_all()
-        self.registry.gauge(
-            f"batcher.queue_depth.{sanitize_key(q.type_name)}", depth)
-        if not leader:
+        with tracer.span("batcher-wait", q.type_name, root=True) as wsp:
+            p.span_ctx = tracer.current()
+            with self._cond:
+                tq = self._queues.setdefault(q.type_name, _TypeQueue())
+                tq.observe_arrival(time.monotonic())
+                tq.items.append(p)
+                depth = len(tq.items)
+                if not tq.has_leader:
+                    tq.has_leader = True
+                    leader = True
+                else:
+                    leader = False
+                    if depth >= self.effective_max_batch(q.type_name):
+                        self._cond.notify_all()
+            self.registry.gauge(
+                f"batcher.queue_depth.{sanitize_key(q.type_name)}", depth)
+            wsp.set_attr(leader=leader, depth=depth)
+            if not leader:
+                return p.get()
+            self._lead(q.type_name, tq)
             return p.get()
-        self._lead(q.type_name, tq)
-        return p.get()
 
     def knn(self, type_name: str, qx: float, qy: float, k: int):
         """Submit one KNN query; blocks until (ids, distances) is
@@ -216,28 +225,32 @@ class QueryBatcher:
             self._note(1)
             return knn_process(self.store, type_name, float(qx),
                                float(qy), k)
+        from ..obs import tracer
         p = _Pending((float(qx), float(qy)))
         key = f"{type_name}\x00knn\x00{int(k)}"
-        with self._cond:
-            tq = self._queues.setdefault(key, _TypeQueue())
-            tq.observe_arrival(time.monotonic())
-            tq.items.append(p)
-            depth = len(tq.items)
-            if not tq.has_leader:
-                tq.has_leader = True
-                leader = True
-            else:
-                leader = False
-                if depth >= self.max_batch:
-                    self._cond.notify_all()
-        self.registry.gauge(
-            f"batcher.queue_depth.{sanitize_key(key)}", depth)
-        if not leader:
+        with tracer.span("batcher-wait", f"knn:{type_name}",
+                         root=True):
+            p.span_ctx = tracer.current()
+            with self._cond:
+                tq = self._queues.setdefault(key, _TypeQueue())
+                tq.observe_arrival(time.monotonic())
+                tq.items.append(p)
+                depth = len(tq.items)
+                if not tq.has_leader:
+                    tq.has_leader = True
+                    leader = True
+                else:
+                    leader = False
+                    if depth >= self.max_batch:
+                        self._cond.notify_all()
+            self.registry.gauge(
+                f"batcher.queue_depth.{sanitize_key(key)}", depth)
+            if not leader:
+                return p.get()
+            self._lead(key, tq,
+                       dispatch=lambda _key, chunk:
+                       self._dispatch_knn(type_name, int(k), chunk))
             return p.get()
-        self._lead(key, tq,
-                   dispatch=lambda _key, chunk:
-                   self._dispatch_knn(type_name, int(k), chunk))
-        return p.get()
 
     def stats(self) -> dict:
         """Batching counters (also mirrored into the metrics registry)."""
@@ -327,34 +340,63 @@ class QueryBatcher:
         ctx.__exit__(None, None, None)
 
     def _dispatch(self, type_name: str, chunk: list[_Pending]):
+        from ..obs import tracer
         occupancy = len(chunk)
         self._note(occupancy)
         shape = self._shape_key(type_name, occupancy)
-        try:
-            if occupancy == 1:
-                results = [self.store.query(chunk[0].q)]
-            else:
-                self._probe_plan_cache(shape)
-                t0 = time.perf_counter()
-                results = self.store.query_batched(
-                    [p.q for p in chunk])
-                # only FUSED dispatches feed the cost EWMA: the cap
-                # decision is about how many queries one fused launch
-                # can carry inside the budget, and the scalar fast
-                # path has a different cost profile entirely
-                self._observe_cost(type_name, shape,
-                                   (time.perf_counter() - t0) / occupancy)
+        dsp = self._open_dispatch_span(tracer, type_name, chunk)
+        err = None
+        results: list = []
+        with dsp:
+            dsp.set_attr(occupancy=occupancy)
+            try:
+                if occupancy == 1:
+                    results = [self.store.query(chunk[0].q)]
+                else:
+                    self._probe_plan_cache(shape)
+                    t0 = time.perf_counter()
+                    results = self.store.query_batched(
+                        [p.q for p in chunk])
+                    # only FUSED dispatches feed the cost EWMA: the cap
+                    # decision is about how many queries one fused
+                    # launch can carry inside the budget, and the
+                    # scalar fast path has a different cost profile
+                    # entirely
+                    self._observe_cost(
+                        type_name, shape,
+                        (time.perf_counter() - t0) / occupancy)
+            except Exception as e:  # noqa: BLE001
+                dsp.annotate("dispatch.failed", error=str(e))
+                err = e
+        # graft BEFORE resolving: the dispatch subtree lands in every
+        # follower's trace while their roots are still open
+        tracer.graft(dsp, [p.span_ctx for p in chunk])
+        if err is None:
             for p, r in zip(chunk, results):
                 p.resolve(result=r)
-        except Exception:
-            # semantics fallback: a batch-level failure must not take
-            # down every caller — replay each query individually so
-            # errors land on exactly the caller that owns them
+            return
+        # semantics fallback: a batch-level failure must not take
+        # down every caller — replay each query individually so
+        # errors land on exactly the caller that owns them
+        for p in chunk:
+            try:
+                p.resolve(result=self.store.query(p.q))
+            except Exception as e:  # noqa: BLE001
+                p.resolve(error=e)
+
+    def _open_dispatch_span(self, tracer, name: str,
+                            chunk: list[_Pending]):
+        """A fused dispatch serves N waiting callers: the span links
+        to each waiter and each waiter's span links back, so the
+        N-queries -> 1-dispatch fan-in is navigable from both ends."""
+        dsp = tracer.span("dispatch", name)
+        if dsp.span_id is not None:
             for p in chunk:
-                try:
-                    p.resolve(result=self.store.query(p.q))
-                except Exception as e:  # noqa: BLE001
-                    p.resolve(error=e)
+                if p.span_ctx:
+                    state, wsp = p.span_ctx
+                    dsp.link(state.trace_id, wsp.span_id)
+                    wsp.link(dsp.trace_id, dsp.span_id)
+        return dsp
 
     def _dispatch_knn(self, type_name: str, k: int,
                       chunk: list[_Pending]):
@@ -364,26 +406,38 @@ class QueryBatcher:
         caller. Failures replay per caller, same contract as
         ``_dispatch``."""
         from ..analytics.processes import knn_batch_process, knn_process
+        from ..obs import tracer
         occupancy = len(chunk)
         self._note(occupancy)
-        try:
-            if occupancy == 1:
-                qx, qy = chunk[0].q
-                chunk[0].resolve(result=knn_process(
-                    self.store, type_name, qx, qy, k))
-                return
-            qx = np.array([p.q[0] for p in chunk])
-            qy = np.array([p.q[1] for p in chunk])
-            results = knn_batch_process(self.store, type_name, qx, qy, k)
+        dsp = self._open_dispatch_span(tracer, f"knn:{type_name}", chunk)
+        err = None
+        results: list = []
+        with dsp:
+            dsp.set_attr(occupancy=occupancy, k=int(k))
+            try:
+                if occupancy == 1:
+                    qx, qy = chunk[0].q
+                    results = [knn_process(self.store, type_name,
+                                           qx, qy, k)]
+                else:
+                    qx = np.array([p.q[0] for p in chunk])
+                    qy = np.array([p.q[1] for p in chunk])
+                    results = knn_batch_process(self.store, type_name,
+                                                qx, qy, k)
+            except Exception as e:  # noqa: BLE001
+                dsp.annotate("dispatch.failed", error=str(e))
+                err = e
+        tracer.graft(dsp, [p.span_ctx for p in chunk])
+        if err is None:
             for p, r in zip(chunk, results):
                 p.resolve(result=r)
-        except Exception:
-            for p in chunk:
-                try:
-                    p.resolve(result=knn_process(
-                        self.store, type_name, p.q[0], p.q[1], k))
-                except Exception as e:  # noqa: BLE001
-                    p.resolve(error=e)
+            return
+        for p in chunk:
+            try:
+                p.resolve(result=knn_process(
+                    self.store, type_name, p.q[0], p.q[1], k))
+            except Exception as e:  # noqa: BLE001
+                p.resolve(error=e)
 
     # -- accounting --------------------------------------------------------
 

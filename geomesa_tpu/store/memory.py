@@ -1154,15 +1154,19 @@ class InMemoryDataStore(DataStore):
             explain("Store is empty").pop()
             return QueryResult(np.empty(0, dtype=object), None, explain,
                                FilterStrategy("empty", None, None))
-        idx, strategy, t_plan, t_scan0, attr_mask = \
-            self._matching_rows(q, st, explain)
-        return self._finish_query(q, st, idx, attr_mask, strategy,
-                                  explain, t_plan, t_scan0)
+        from ..obs import tracer
+        with tracer.span("store-scan", q.type_name) as sp:
+            idx, strategy, t_plan, t_scan0, attr_mask = \
+                self._matching_rows(q, st, explain)
+            sp.set_attr(index=strategy.index, rows=int(st.n),
+                        hits=int(len(idx)))
+            return self._finish_query(q, st, idx, attr_mask, strategy,
+                                      explain, t_plan, t_scan0)
 
     def _finish_query(self, q: Query, st: _TypeState, idx: np.ndarray,
                       attr_mask, strategy: FilterStrategy,
                       explain: Explainer, t_plan: float,
-                      t_scan0: float) -> QueryResult:
+                      t_scan0: float, batched: bool = False) -> QueryResult:
         """Result-assembly stages shared by the scalar and batched
         pipelines: sort, max_features, projection validation, lazy
         batch + attribute-cell redaction, id gather, audit."""
@@ -1235,11 +1239,16 @@ class InMemoryDataStore(DataStore):
             src = st.batch
             ids = (lambda: src.ids[idx])
         explain(f"Hits: {len(idx)}").pop()
-        if self.audit is not None:
-            self.audit.record(q.type_name, str(q.filter), q.hints,
-                              round(t_plan * 1000, 3),
-                              round((_time.perf_counter() - t_scan0) * 1000, 3),
-                              len(idx))
+        scan_s = _time.perf_counter() - t_scan0
+        from ..metrics import metrics as _metrics
+        _metrics.observe("store.scan", scan_s,
+                         labels={"type": q.type_name,
+                                 "index": strategy.index or "none"})
+        from ..audit import audit_query
+        audit_query(self.audit, "memory", q.type_name, str(q.filter),
+                    q.hints, t_plan * 1000, scan_s * 1000, len(idx),
+                    index=strategy.index, rows_scanned=int(st.n),
+                    batched=batched)
         return QueryResult(ids, batch, explain, strategy, n=len(idx))
 
     @_synchronized
@@ -1260,15 +1269,19 @@ class InMemoryDataStore(DataStore):
         explain = Explainer()
         explain.push(lambda: f"Counting '{q.type_name}' "
                              f"filter={q.filter}")
-        idx, _, t_plan, t_scan0, _m = self._matching_rows(q, st, explain)
-        n = len(idx)
-        if q.max_features is not None:
-            n = min(n, q.max_features)
-        if self.audit is not None:
-            self.audit.record(q.type_name, str(q.filter), q.hints,
-                              round(t_plan * 1000, 3),
-                              round((_time.perf_counter() - t_scan0)
-                                    * 1000, 3), n)
+        from ..obs import tracer
+        with tracer.span("store-scan", q.type_name) as sp:
+            idx, strategy, t_plan, t_scan0, _m = \
+                self._matching_rows(q, st, explain)
+            n = len(idx)
+            if q.max_features is not None:
+                n = min(n, q.max_features)
+            sp.set_attr(index=strategy.index, rows=int(st.n), hits=n)
+            from ..audit import audit_query
+            audit_query(self.audit, "memory", q.type_name,
+                        str(q.filter), q.hints, t_plan * 1000,
+                        (_time.perf_counter() - t_scan0) * 1000, n,
+                        index=strategy.index, rows_scanned=int(st.n))
         return n
 
     @_synchronized
@@ -1328,17 +1341,21 @@ class InMemoryDataStore(DataStore):
             if not fused:
                 continue
             t_scan0 = _time.perf_counter()
-            rows_per_q = self._batched_scan_rows(
-                st, [(queries[i],) + plans[i] for i in fused])
-            for i, rows in zip(fused, rows_per_q):
-                q = queries[i]
-                explain = Explainer(explain_out)
-                explain.push(lambda q=q: f"Batched '{q.type_name}' "
-                                         f"filter={q.filter}")
-                idx, attr_mask = self._post_scan(q, st, rows, explain)
-                results[i] = self._finish_query(
-                    q, st, idx, attr_mask, plans[i][0], explain,
-                    0.0, t_scan0)
+            from ..obs import tracer
+            with tracer.span("store-scan", tn) as sp:
+                sp.set_attr(fused=len(fused), rows=int(st.n))
+                rows_per_q = self._batched_scan_rows(
+                    st, [(queries[i],) + plans[i] for i in fused])
+                for i, rows in zip(fused, rows_per_q):
+                    q = queries[i]
+                    explain = Explainer(explain_out)
+                    explain.push(lambda q=q: f"Batched '{q.type_name}' "
+                                             f"filter={q.filter}")
+                    idx, attr_mask = self._post_scan(q, st, rows,
+                                                     explain)
+                    results[i] = self._finish_query(
+                        q, st, idx, attr_mask, plans[i][0], explain,
+                        0.0, t_scan0, batched=True)
         return results  # type: ignore[return-value]
 
     def _batched_scan_rows(self, st: _TypeState, items) -> list[np.ndarray]:

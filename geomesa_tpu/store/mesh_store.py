@@ -248,10 +248,10 @@ class DistributedDataStore(InMemoryDataStore):
         else:
             sq = zscan.make_query(boxes, intervals)
             n = sum(distributed_count(seg, sq) for seg in st.segments)
-        if self.audit is not None:
-            self.audit.record(q.type_name, str(q.filter), q.hints, 0.0,
-                              round((_time.perf_counter() - t0) * 1000, 3),
-                              n)
+        from ..audit import audit_query
+        audit_query(self.audit, "mesh", q.type_name, str(q.filter),
+                    q.hints, 0.0, (_time.perf_counter() - t0) * 1000, n,
+                    index=strategy.index, rows_scanned=int(st.n))
         return n
 
     def _density_uncached(self, type_name: str, ecql, bbox, width: int,

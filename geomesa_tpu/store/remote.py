@@ -88,11 +88,13 @@ class RemoteDataStore(DataStore):
                  auth_token: str | None = None,
                  retry_policy: RetryPolicy | None = None,
                  breakers: BreakerBoard | None = None,
-                 hedge: HedgePolicy | bool | None = None):
+                 hedge: HedgePolicy | bool | None = None,
+                 audit=None):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
         self.auth_token = auth_token  # bearer token for gated endpoints
+        self.audit = audit  # AuditLogger or None (global fallback)
         self._schemas: dict[str, SimpleFeatureType] = {}
         self._retry = retry_policy if retry_policy is not None \
             else RetryPolicy(budget=RetryBudget())
@@ -135,9 +137,16 @@ class RemoteDataStore(DataStore):
             self._breakers.observe(endpoint, time.perf_counter() - t0)
             return out
 
-        return self._retry.call(self._maybe_hedged(attempt, breaker,
-                                                   endpoint, idempotent),
-                                name=f"remote.{endpoint}")
+        from ..obs import tracer
+        with tracer.span("remote", f"{method} {path}") as sp:
+            try:
+                return self._retry.call(
+                    self._maybe_hedged(attempt, breaker, endpoint,
+                                       idempotent),
+                    name=f"remote.{endpoint}")
+            except Exception as e:
+                sp.annotate("remote.failed", error=type(e).__name__)
+                raise
 
     def _maybe_hedged(self, attempt, breaker, endpoint: str,
                       idempotent: bool, streaming: bool = False):
@@ -167,6 +176,12 @@ class RemoteDataStore(DataStore):
         headers = {}
         if self.auth_token:
             headers["Authorization"] = f"Bearer {self.auth_token}"
+        from ..obs import TRACE_HEADER, tracer
+        wire = tracer.inject()
+        if wire is not None:
+            # the server continues this trace: its web/store spans land
+            # under our current span's trace id
+            headers[TRACE_HEADER] = wire
         try:
             try:
                 conn.connect()
@@ -316,8 +331,10 @@ class RemoteDataStore(DataStore):
               explain_out=None):
         q = self._as_query(q, type_name)
         params = self._query_params(q, "arrow")
+        t0 = time.perf_counter()
         _, data = self._request("GET", f"/rest/query/{quote(q.type_name)}",
                                 params)
+        t_fetch_ms = (time.perf_counter() - t0) * 1000
         sft = self._result_sft(q)
         import pyarrow as pa
         with pa.ipc.open_file(io.BytesIO(data)) as rd:
@@ -332,6 +349,9 @@ class RemoteDataStore(DataStore):
                       for a in sft.attributes}))
         from .memory import QueryResult
         from ..index.api import Explainer
+        from ..audit import audit_query
+        audit_query(self.audit, "remote", q.type_name, str(q.filter),
+                    q.hints, 0.0, t_fetch_ms, batch.n, index="remote")
         return QueryResult(batch.ids, batch, Explainer(),
                            FilterStrategy("remote", q.filter, None),
                            n=batch.n)
@@ -350,6 +370,10 @@ class RemoteDataStore(DataStore):
         headers = {}
         if self.auth_token:
             headers["Authorization"] = f"Bearer {self.auth_token}"
+        from ..obs import TRACE_HEADER, tracer
+        wire = tracer.inject()
+        if wire is not None:
+            headers[TRACE_HEADER] = wire
 
         def attempt():
             breaker.acquire()
@@ -527,8 +551,34 @@ class RemoteDataStore(DataStore):
             params["sampleBy"] = q.hints[QueryHints.SAMPLE_BY]
         if QueryHints.QUERY_INDEX in q.hints:
             params["index"] = q.hints[QueryHints.QUERY_INDEX]
-        return int(self._json(
+        t0 = time.perf_counter()
+        n = int(self._json(
             "GET", f"/rest/count/{quote(q.type_name)}", params)["count"])
+        from ..audit import audit_query
+        audit_query(self.audit, "remote", q.type_name, str(q.filter),
+                    q.hints, 0.0, (time.perf_counter() - t0) * 1000, n,
+                    index="remote")
+        return n
+
+    # -- observability surfaces --------------------------------------------
+
+    def traces(self, limit: int = 50) -> list[dict]:
+        """Trace summaries from the server's ring (GET /rest/trace)."""
+        return self._json("GET", "/rest/trace", {"limit": limit})
+
+    def trace(self, trace_id: str) -> list[dict]:
+        """Full span list for one trace (KeyError if unknown)."""
+        return self._json("GET", f"/rest/trace/{quote(trace_id)}")
+
+    def audit_events(self, type_name: str | None = None,
+                     since_ms: int | None = None) -> list[dict]:
+        """Server-side audit events (GET /rest/audit)."""
+        params: dict[str, Any] = {}
+        if type_name is not None:
+            params["type"] = type_name
+        if since_ms is not None:
+            params["since"] = since_ms
+        return self._json("GET", "/rest/audit", params or None)
 
     # -- server-side analytics ---------------------------------------------
 

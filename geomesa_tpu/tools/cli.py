@@ -581,6 +581,40 @@ def cmd_cq(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Distributed-trace inspection against a serving node: ``list``
+    dumps recent trace summaries (id, root, duration, span kinds);
+    ``get`` dumps one trace's full span tree by id."""
+    path = args.path
+    if not path.startswith("remote://"):
+        print("trace commands need --path remote://host:port",
+              file=sys.stderr)
+        return 2
+    from ..store import RemoteDataStore
+    host, _, port = path[len("remote://"):].partition(":")
+    ds = RemoteDataStore(host or "127.0.0.1", int(port) if port else 8080,
+                         auth_token=getattr(args, "token", None))
+    if args.trace_command == "list":
+        json.dump(ds.traces(limit=args.limit), sys.stdout, indent=2)
+        print()
+        return 0
+    if args.trace_command == "get":
+        try:
+            out = ds.trace(args.id)
+        except KeyError:
+            # the wire client maps the server's 404 to KeyError
+            print(f"no such trace {args.id!r} (evicted or never "
+                  "sampled — raise geomesa.trace.sample or "
+                  "geomesa.trace.max.spans)", file=sys.stderr)
+            return 2
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return 0
+    print(f"unknown trace command {args.trace_command!r}",
+          file=sys.stderr)
+    return 2
+
+
 def cmd_version(args) -> int:
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -769,6 +803,24 @@ def main(argv=None) -> int:
             qp.add_argument("--cql", default=None,
                             help="ECQL filter (default INCLUDE)")
         qp.set_defaults(fn=cmd_cq)
+
+    trp = sub.add_parser("trace",
+                         help="distributed request-trace inspection")
+    trsub = trp.add_subparsers(dest="trace_command", required=True)
+    for tname, thelp in (("list", "recent trace summaries"),
+                         ("get", "one trace's full span tree")):
+        tp = trsub.add_parser(tname, help=thelp)
+        tp.add_argument("--path", required=True,
+                        help="serving node, remote://host:port")
+        tp.add_argument("--token", default=None,
+                        help="admin bearer token "
+                             "(geomesa.web.auth.token)")
+        if tname == "list":
+            tp.add_argument("--limit", type=int, default=50,
+                            help="max summaries (newest first)")
+        if tname == "get":
+            tp.add_argument("--id", required=True, help="trace id")
+        tp.set_defaults(fn=cmd_trace)
 
     add("version", cmd_version, needs_store=False)
     add("env", cmd_env, needs_store=False)

@@ -429,6 +429,7 @@ class WriteAheadLog:
             self._sync_in_progress = True
         pending: int | None = None
         err: OSError | None = None
+        t0 = time.perf_counter()
         try:
             with self._lock:
                 fd, path = self._fd, self._seg_path
@@ -448,6 +449,9 @@ class WriteAheadLog:
         if err is not None:
             self._poison(err)
         self.registry.counter("wal.fsyncs")
+        from ..obs import annotate
+        annotate("wal.fsync", lsn=lsn, batch=batch,
+                 ms=round((time.perf_counter() - t0) * 1000, 3))
         if batch > 0:
             self.registry.gauge("wal.group_commit.batch", batch)
 

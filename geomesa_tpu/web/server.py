@@ -206,43 +206,75 @@ class GeoMesaWebServer:
                     {"Retry-After": retry_after})
         slot_owned = True
         try:
-            if parts and (method, parts[0]) in _GATED \
-                    and not self._authorized(headers):
-                return 403, "application/json", _j({"error": "forbidden"})
-            try:
-                out = self._route(method, parts, params, body, headers)
+            from ..audit import principal_scope
+            from ..obs import TRACE_HEADER, tracer
+            hdr = headers.get(TRACE_HEADER) if headers is not None \
+                else None
+            name = f"{method} /rest/{parts[0] if parts else ''}"
+            # the web span is the local trace root; an incoming
+            # X-GeoMesa-Trace header continues the caller's trace
+            # (RemoteDataStore client leg, upstream coordinator)
+            route = parts[0] if parts else ""
+            with tracer.span("web", name, root=True, remote=hdr) as wsp, \
+                    metrics.time("web.request", labels={"route": route,
+                                                        "method": method}):
+                with principal_scope(self._principal(headers)):
+                    out = self._handle_routed(method, parts, params,
+                                              body, headers)
+                wsp.set_attr(status=int(out[0]))
                 if len(out) >= 3 and not isinstance(
                         out[2], (bytes, bytearray, str)):
                     # streaming payload: the generator outlives this
                     # frame, so the in-flight slot travels with it and
-                    # releases when the stream finishes (or dies)
+                    # releases when the stream finishes (or dies).
+                    # (The web span closes at handoff — streamed
+                    # byte time is not in the trace.)
+                    wsp.annotate("streaming")
                     out = (*out[:2], self._slot_guard(out[2]), *out[3:])
                     slot_owned = False
                 return out
-            except KeyError as e:
-                return 404, "application/json", _j({"error": str(e)})
-            except DurabilityError as e:
-                # the WAL poisoned itself (failed fsync/write): the
-                # store is read-only degraded. 503 tells clients the
-                # SERVER can't take writes — reads still work — and
-                # retrying here is pointless until an operator recycles
-                # the process
-                metrics.counter("integrity.web.write_rejects")
-                return (503, "application/json",
-                        _j({"error": repr(e), "retryable": False,
-                            "degraded": "read-only"}))
-            except ValueError as e:
-                # parse/plan errors (CQL/filter parse is a ValueError
-                # subclass): the request is malformed, do NOT retry
-                return 400, "application/json", _j({"error": repr(e)})
-            except Exception as e:
-                # unexpected server fault: 500 so clients know the
-                # request (not the server's health) might still be fine
-                metrics.counter("resilience.web.errors")
-                return 500, "application/json", _j({"error": repr(e)})
         finally:
             if slot_owned:
                 self._release_slot()
+
+    @staticmethod
+    def _principal(headers) -> str | None:
+        """Audit principal from the Authorization header: a stable
+        token digest, never the bearer token itself."""
+        got = (headers or {}).get("Authorization", "") or ""
+        if got.startswith("Bearer ") and got[7:]:
+            import hashlib
+            return "bearer:" + hashlib.sha1(
+                got[7:].encode()).hexdigest()[:8]
+        return None
+
+    def _handle_routed(self, method, parts, params, body, headers):
+        if parts and (method, parts[0]) in _GATED \
+                and not self._authorized(headers):
+            return 403, "application/json", _j({"error": "forbidden"})
+        try:
+            return self._route(method, parts, params, body, headers)
+        except KeyError as e:
+            return 404, "application/json", _j({"error": str(e)})
+        except DurabilityError as e:
+            # the WAL poisoned itself (failed fsync/write): the
+            # store is read-only degraded. 503 tells clients the
+            # SERVER can't take writes — reads still work — and
+            # retrying here is pointless until an operator recycles
+            # the process
+            metrics.counter("integrity.web.write_rejects")
+            return (503, "application/json",
+                    _j({"error": repr(e), "retryable": False,
+                        "degraded": "read-only"}))
+        except ValueError as e:
+            # parse/plan errors (CQL/filter parse is a ValueError
+            # subclass): the request is malformed, do NOT retry
+            return 400, "application/json", _j({"error": repr(e)})
+        except Exception as e:
+            # unexpected server fault: 500 so clients know the
+            # request (not the server's health) might still be fine
+            metrics.counter("resilience.web.errors")
+            return 500, "application/json", _j({"error": repr(e)})
 
     def _slot_guard(self, gen):
         """Hold the shed slot for a streaming response's lifetime."""
@@ -320,7 +352,7 @@ class GeoMesaWebServer:
         prefix = "resilience.latency.p99."
         latency = {k[len(prefix):]: round(v, 3)
                    for k, v in snap.get("gauges", {}).items()
-                   if k.startswith(prefix)}
+                   if k.startswith(prefix) and v is not None}
         return {"latency_p99_ms": latency}
 
     def _acquire_slot(self) -> bool:
@@ -436,7 +468,20 @@ class GeoMesaWebServer:
         if len(parts) == 2 and parts[0] == "bin":
             return self._bin(parts[1], params, headers)
         if method == "GET" and parts == ["metrics"]:
+            if params.get("format", [""])[0] == "prometheus":
+                return (200, "text/plain; version=0.0.4",
+                        metrics.prometheus_text())
             return 200, "application/json", _j(metrics.snapshot())
+        if method == "GET" and parts and parts[0] == "trace":
+            from ..obs import tracer
+            if len(parts) == 1:
+                limit = int(params.get("limit", ["50"])[0])
+                return 200, "application/json", _j(tracer.traces(limit))
+            spans = tracer.get(parts[1])
+            if spans is None:
+                raise KeyError(f"unknown trace: {parts[1]}")
+            return 200, "application/json", _j(
+                {"trace_id": parts[1], "spans": spans})
         if parts and parts[0] == "cache":
             return self._cache(method, parts[1:], params)
         if parts and parts[0] == "cq":
@@ -462,9 +507,12 @@ class GeoMesaWebServer:
         if parts and parts[0] == "cluster":
             return self._cluster(method, parts[1:], params)
         if parts == ["audit"]:
-            if self.audit is None:
-                return 200, "application/json", _j([])
-            evs = self.audit.query(
+            # a server fronting a store without its own logger still
+            # answers: surfaces without one record into the process
+            # global ring (audit/hook.py)
+            from ..audit import global_audit
+            log = self.audit if self.audit is not None else global_audit()
+            evs = log.query(
                 params.get("type", [None])[0],
                 int(params["since"][0]) if "since" in params else None)
             return 200, "application/json", _j(

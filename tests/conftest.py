@@ -60,3 +60,7 @@ def pytest_configure(config):
         "markers", "ingest: ingest-firehose suites (vectorized "
         "converter parity vs the scalar oracle, group-commit pipeline, "
         "admission control / 429 backpressure; select with -m ingest)")
+    config.addinivalue_line(
+        "markers", "obs: observability suites (trace spans and wire "
+        "propagation, histogram quantiles, Prometheus exposition, "
+        "unified query audit; select with -m obs)")

@@ -191,3 +191,28 @@ def test_config16_ingest_smoke():
     # the acked-durability contract holds at toy sizes too
     assert c["kill_recovery"]["zero_acked_loss"] is True
     assert "gates_pass" in c
+
+
+@pytest.mark.bench_smoke
+@pytest.mark.obs
+def test_config17_observability_smoke():
+    rng = np.random.default_rng(50)
+    c = bench.bench_config17(rng, n=3000, c=4, nq=6, slow_s=0.12)
+    # the <5% overhead gate only means something at the full-size run;
+    # at toy sizes assert the structural contracts instead
+    assert "overhead_under_5pct" in c
+    assert c["instrumentation_off"]["p50_ms"] > 0
+    assert c["instrumentation_on"]["p50_ms"] > 0
+    # slow-query always-capture: sampling was OFF, the stalled request
+    # must land in the ring with the full four-kind span tree
+    s = c["slow_capture"]
+    assert s["captured"] is True
+    assert s["four_kinds"] is True
+    for kind in ("web", "batcher-wait", "dispatch", "store-scan"):
+        assert kind in s["span_kinds"]
+    # audit completeness is exact at any size
+    a = c["audit"]
+    assert a["one_event_per_query"] is True
+    assert a["all_resolvable"] is True
+    assert a["prometheus_parses"] is True
+    assert "gates_pass" in c
