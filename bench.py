@@ -144,7 +144,7 @@ N = int(os.environ.get("GEOMESA_TPU_BENCH_N", 10_000_000))
 REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                             "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,"
+                             "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,"
                              "northstar")
               .split(","))
 MS_DAY = 86_400_000
@@ -1891,6 +1891,9 @@ def bench_config14(rng, n=None, batch_rows=None):
     from geomesa_tpu.arrow.delta import iter_ipc, reassemble_ipc
     from geomesa_tpu.features import parse_spec
     from geomesa_tpu.index.api import Query
+    from geomesa_tpu.obs.prof import PROF_HZ
+    from geomesa_tpu.obs.runtime import RUNTIME_ENABLED
+    from geomesa_tpu.obs.slo import SLO_ENABLED
     from geomesa_tpu.store import InMemoryDataStore
     from geomesa_tpu.store.remote import RemoteDataStore
     from geomesa_tpu.web.server import GeoMesaWebServer
@@ -1899,6 +1902,17 @@ def bench_config14(rng, n=None, batch_rows=None):
             else os.environ.get("GEOMESA_TPU_BENCH_STREAM_N", 1_000_000))
     rows = int(batch_rows if batch_rows is not None else 8192)
     out = {"n": n, "batch_rows": rows}
+
+    # server and client share this process, so the tracemalloc windows
+    # below would otherwise count the health plane's background
+    # allocations (profiler trie, SLO ring buckets, runtime samples)
+    # against the CLIENT-memory contract. The health-plane tax has its
+    # own config (18_health); keep it out of this measurement.
+    _health_saved = {p: p.get_override()
+                     for p in (PROF_HZ, SLO_ENABLED, RUNTIME_ENABLED)}
+    PROF_HZ.set("0")
+    SLO_ENABLED.set("false")
+    RUNTIME_ENABLED.set("false")
 
     ds = InMemoryDataStore()
     ds.create_schema(parse_spec("s14", "dtg:Date,*geom:Point:srid=4326"))
@@ -1977,6 +1991,8 @@ def bench_config14(rng, n=None, batch_rows=None):
             and streamed == n and drained == n)
     finally:
         server.stop()
+        for p, v in _health_saved.items():
+            p.set(v)
     return out
 
 
@@ -2630,6 +2646,305 @@ def bench_config17(rng, n=None, c=None, nq=None, slow_s=None):
     return out
 
 
+# -- config 18: runtime health plane — overhead, stalls, burn reaction ----
+
+def bench_config18(rng, n=None, c=None, nq=None, stall_s=None):
+    """What the runtime health plane costs and proves, in three gates.
+
+    (A) Overhead: ``c`` concurrent web clients stream a mixed read
+        workload twice — health plane fully OFF (profiler hz 0, SLO
+        engine disabled, runtime collector disabled, watchdog factor
+        0) then fully ON (19Hz sampler, SLO recording + evaluation,
+        runtime telemetry, watchdog armed) — p50/p99 must regress
+        under 5%, and the ON phase must leave real data on all three
+        surfaces (profiler samples, runtime dispatch rows, SLO routes).
+    (B) Stall capture: a two-group cluster scatters to a healthy
+        in-memory shard and a remote shard behind a ChaosProxy whose
+        every connection stalls; the watchdog must capture the stuck
+        scatter leg mid-flight with a non-empty live Python stack.
+    (C) Burn reaction: a 503 storm against a ``max_inflight=1`` server
+        trips the availability fast-burn on shortened windows; with
+        ``geomesa.slo.react`` on the shared retry/hedge budget capacity
+        measurably drops, and once the burn clears every touched knob
+        override is restored EXACTLY (including not-set).
+    """
+    import threading
+
+    from geomesa_tpu.cluster import ClusterDataStore
+    from geomesa_tpu.features import FeatureBatch, parse_spec
+    from geomesa_tpu.index.api import Query
+    from geomesa_tpu.obs.prof import (PROF_HZ, WATCHDOG_FACTOR,
+                                      WATCHDOG_MIN_MS, profiler, watchdog)
+    from geomesa_tpu.obs.runtime import RUNTIME_ENABLED, runtime
+    from geomesa_tpu.obs.slo import (SLO_ENABLED, SLO_REACT,
+                                     SLO_WINDOWS_FAST, slo_engine)
+    from geomesa_tpu.resilience import ChaosProxy
+    from geomesa_tpu.resilience.policy import (RETRY_BUDGET_SCALE,
+                                               RetryBudget)
+    from geomesa_tpu.scan.batcher import BATCH_LINGER_MICROS
+    from geomesa_tpu.scan.registry import batcher_registry
+    from geomesa_tpu.store import InMemoryDataStore
+    from geomesa_tpu.store.remote import RemoteDataStore
+    from geomesa_tpu.web.server import GeoMesaWebServer
+
+    n = int(n if n is not None
+            else os.environ.get("GEOMESA_TPU_BENCH_HEALTH_N", 200_000))
+    c = int(c if c is not None else 32)
+    nq = int(nq if nq is not None else 25)
+    stall = float(stall_s if stall_s is not None else 0.6)
+    out = {"n": n, "clients": c, "queries_per_client": nq}
+
+    hold_mark = "-178.125"   # sentinel bbox coord: phase C's held query
+
+    class HoldStore(InMemoryDataStore):
+        """Parks a marked query on an event so phase C can pin the
+        server's single inflight slot for the storm's duration."""
+
+        def __init__(self):
+            super().__init__()
+            self.hold = threading.Event()
+
+        def query(self, q, *args, **kwargs):
+            if hold_mark in str(getattr(q, "filter", "")):
+                assert self.hold.wait(60.0), "config 18 hold leaked"
+            return super().query(q, *args, **kwargs)
+
+    sft = parse_spec("health18", "dtg:Date,*geom:Point:srid=4326")
+    ds = HoldStore()
+    ds.create_schema(sft)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    ms = rng.integers(T0_DAY * MS_DAY, T1_DAY * MS_DAY, n).astype(np.int64)
+    ds.write_dict("health18", np.arange(n).astype(str).astype(object),
+                  {"dtg": ms, "geom": (x, y)})
+
+    def bbox_q(i, w=4.0, h=4.0):
+        x0 = -170.0 + (i * 37) % 330
+        y0 = -80.0 + (i * 23) % 150
+        return Query("health18",
+                     f"BBOX(geom, {x0}, {y0}, {x0 + w}, {y0 + h})")
+
+    def run_phase(server):
+        lat: list = [None] * (c * nq)
+        barrier = threading.Barrier(c)
+
+        def worker(ci):
+            client = RemoteDataStore("127.0.0.1", server.port,
+                                     hedge=False)
+            barrier.wait()
+            for j in range(nq):
+                k = ci * nq + j
+                t0 = time.perf_counter()
+                if j % 2:
+                    client.query_count(bbox_q(k))
+                else:
+                    client.query(bbox_q(k))
+                lat[k] = time.perf_counter() - t0
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True) for i in range(c)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120.0)
+        assert not any(v is None for v in lat), "config 18 phase stuck"
+        return lat
+
+    def plane(on: bool):
+        """Flip the whole health plane: profiler, SLO, runtime
+        telemetry, watchdog. ``None`` restores the (on) defaults."""
+        PROF_HZ.set(None if on else "0")
+        SLO_ENABLED.set(None if on else "false")
+        RUNTIME_ENABLED.set(None if on else "false")
+        WATCHDOG_FACTOR.set(None if on else "0")
+
+    # -- phase A: health plane off vs fully on ----------------------------
+    batcher_registry.clear()
+    slo_engine.clear()
+    watchdog.clear()
+    runtime.clear()
+    profiler.clear()
+    server = GeoMesaWebServer(ds).start()
+    try:
+        # warmup compiles the scan kernels and materializes every rect
+        # both phases ask for: compare steady state against steady state
+        warm = RemoteDataStore("127.0.0.1", server.port, hedge=False)
+        for k in range(c * nq):
+            if k % 2:
+                warm.query_count(bbox_q(k))
+            else:
+                warm.query(bbox_q(k))
+
+        plane(on=False)
+        try:
+            lat_off = run_phase(server)
+        finally:
+            plane(on=True)
+        lat_on = run_phase(server)
+
+        po, pn = _pcts(lat_off), _pcts(lat_on)
+        out["health_off"] = {"p50_ms": round(po["p50"] * 1e3, 2),
+                             "p99_ms": round(po["p99"] * 1e3, 2)}
+        out["health_on"] = {"p50_ms": round(pn["p50"] * 1e3, 2),
+                            "p99_ms": round(pn["p99"] * 1e3, 2)}
+        out["overhead"] = {
+            "p50_pct": round((pn["p50"] / max(po["p50"], 1e-9) - 1)
+                             * 100, 2),
+            "p99_pct": round((pn["p99"] / max(po["p99"], 1e-9) - 1)
+                             * 100, 2)}
+        out["overhead_under_5pct"] = bool(
+            pn["p50"] <= po["p50"] * 1.05
+            and pn["p99"] <= po["p99"] * 1.05)
+
+        snap = runtime.snapshot()
+        slo_routes = slo_engine.status().get("routes", {})
+        out["surfaces"] = {
+            "profiler_samples": profiler.stats()["samples"],
+            # fused-dispatch rows need real coalescing pressure; at
+            # full c=32 they populate, at toy sizes they may not —
+            # reported, not gated
+            "runtime_dispatch_domains": sorted(snap["dispatch"]),
+            "runtime_compile_domains": sorted(snap["compile"]),
+            "slo_routes": sorted(slo_routes),
+            "all_live": bool(profiler.stats()["samples"] > 0
+                             and slo_routes)}
+    finally:
+        server.stop()
+        batcher_registry.clear()
+
+    # -- phase B: ChaosProxy-stalled scatter leg hits the watchdog --------
+    slo_engine.clear()
+    watchdog.clear()
+    backend = InMemoryDataStore()
+    srv2 = GeoMesaWebServer(backend).start()
+    proxy = ChaosProxy("127.0.0.1", srv2.port, seed=18,
+                       slow_rate=0.0, slow_s=stall).start()
+    WATCHDOG_MIN_MS.set("50")
+    try:
+        cluster = ClusterDataStore(
+            [InMemoryDataStore(),
+             RemoteDataStore(proxy.host, proxy.port, hedge=False)],
+            names=["mem", "proxied"], leg_deadline_s=60)
+        cluster.create_schema(sft)
+        nb = min(n, 10_000)
+        cluster.write("health18", FeatureBatch.from_dict(
+            sft, np.arange(nb).astype(str).astype(object),
+            {"dtg": ms[:nb], "geom": (x[:nb], y[:nb])}))
+        # healthy warmup teaches the watchdog each leg's p99
+        for i in range(8):
+            cluster.query_count(bbox_q(i), "health18")
+
+        proxy.slow_rate = 1.0
+        hit: list = []
+        done = threading.Event()
+
+        def stalled_query():
+            try:
+                cluster.query_count(bbox_q(99), "health18")
+            finally:
+                done.set()
+
+        t = threading.Thread(target=stalled_query, daemon=True)
+        t.start()
+        deadline = time.perf_counter() + max(stall * 10, 5.0)
+        while time.perf_counter() < deadline:
+            watchdog.check()
+            hit = [s for s in watchdog.stalls()
+                   if s["key"] == "scatter-leg.proxied"]
+            if hit:
+                break
+            time.sleep(0.005)
+        done.wait(max(stall * 10, 5.0))
+        t.join(5.0)
+        proxy.slow_rate = 0.0
+        out["stall_capture"] = {
+            "captured": bool(hit),
+            "key": hit[0]["key"] if hit else None,
+            "stack_depth": len(hit[0]["stack"]) if hit else 0,
+            "threshold_ms": round(hit[0]["threshold_s"] * 1e3, 1)
+            if hit else None,
+            "non_empty_stack": bool(hit and hit[0]["stack"])}
+    finally:
+        WATCHDOG_MIN_MS.set(None)
+        proxy.stop()
+        srv2.stop()
+        watchdog.clear()
+
+    # -- phase C: 503 storm -> fast-burn -> react tightens, then restores -
+    slo_engine.clear()
+    SLO_WINDOWS_FAST.set("1:10:14.4")   # real-time-friendly windows
+    SLO_REACT.set("true")
+    rb = RetryBudget(capacity=10.0)
+    scale_before = RETRY_BUDGET_SCALE.get_override()
+    linger_before = BATCH_LINGER_MICROS.get_override()
+    cap_before = rb.effective_capacity()
+    srv3 = GeoMesaWebServer(ds, max_inflight=1).start()
+    try:
+        holder = threading.Thread(
+            target=lambda: RemoteDataStore(
+                "127.0.0.1", srv3.port, hedge=False).query(
+                    Query("health18", f"BBOX(geom, {hold_mark}, -80.125,"
+                                      " -174.125, -76.125)")),
+            daemon=True)
+        holder.start()
+        deadline = time.perf_counter() + 10.0
+        while srv3._inflight < 1 and time.perf_counter() < deadline:
+            time.sleep(0.002)
+
+        import http.client as _hc
+        sheds = 0
+        for _ in range(24):
+            conn = _hc.HTTPConnection("127.0.0.1", srv3.port, timeout=10)
+            try:
+                conn.request("GET", "/rest/schemas")
+                sheds += int(conn.getresponse().status == 503)
+            finally:
+                conn.close()
+        states = slo_engine.evaluate()
+        fired = any(s["fast_firing"] for s in states.values())
+        cap_during = rb.effective_capacity()
+        scale_during = RETRY_BUDGET_SCALE.get_override()
+        linger_during = BATCH_LINGER_MICROS.get_override()
+
+        ds.hold.set()
+        holder.join(10.0)
+        time.sleep(1.3)   # the 1s short window drains
+        states = slo_engine.evaluate()
+        cleared = not any(s["fast_firing"] for s in states.values())
+        cap_after = rb.effective_capacity()
+        restored = (RETRY_BUDGET_SCALE.get_override() == scale_before
+                    and BATCH_LINGER_MICROS.get_override() == linger_before)
+        out["burn_react"] = {
+            "sheds": sheds,
+            "fast_burn_fired": bool(fired),
+            "budget_capacity": {"before": cap_before,
+                                "during": cap_during,
+                                "after": cap_after},
+            "scale_override_during": scale_during,
+            "linger_override_during": linger_during,
+            "budget_tightened": bool(cap_during < cap_before),
+            "cleared": bool(cleared),
+            "restored_exactly": bool(restored
+                                     and cap_after == cap_before)}
+    finally:
+        ds.hold.set()
+        SLO_WINDOWS_FAST.set(None)
+        SLO_REACT.set(None)
+        srv3.stop()
+        slo_engine.clear()
+        batcher_registry.clear()
+        runtime.clear()
+
+    out["gates_pass"] = bool(
+        out["overhead_under_5pct"]
+        and out["surfaces"]["all_live"]
+        and out["stall_capture"]["non_empty_stack"]
+        and out["burn_react"]["fast_burn_fired"]
+        and out["burn_react"]["budget_tightened"]
+        and out["burn_react"]["restored_exactly"])
+    return out
+
+
 # -- config 10: storage integrity — scrub overhead + corrupt recovery -----
 
 def bench_config10(rng):
@@ -2904,6 +3219,8 @@ def main(argv=None):
         out["configs"]["16_ingest"] = bench_config16(rng)
     if "17" in CONFIGS:
         out["configs"]["17_observability"] = bench_config17(rng)
+    if "18" in CONFIGS:
+        out["configs"]["18_health"] = bench_config18(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

@@ -753,11 +753,18 @@ def prewarm_join_kernels(px64, py64, device_xy=None,
     n = len(px64)
     if n == 0:
         return
+    from ..obs.runtime import runtime
+    cap = 1 << max(int(n - 1).bit_length(), 0)
     for nq in query_counts:
         qx = np.linspace(-170.0, 170.0, nq)
         qy = np.zeros(nq)
+        # a prewarm IS the compile for its shape class: report it as a
+        # miss so the runtime plane sees where traces come from
+        runtime.note_plan_probe("join", ("dwithin", cap, int(nq)),
+                                hit=False)
         dwithin_join(px64, py64, qx, qy, radius_deg, counts_only=True,
                      device_xy=device_xy)
     for q in knn_batches:
+        runtime.note_plan_probe("join", ("knn", cap, int(q)), hit=False)
         knn_batched(px64, py64, np.zeros(q), np.zeros(q),
                     min(knn_k, n), device_xy=device_xy)

@@ -272,11 +272,13 @@ class ClusterDataStore(DataStore):
             if self._hedge.budget is not None:
                 self._hedge.budget.deposit()  # first attempts earn
             try:
-                v = self._hedge.call(
-                    fn, delay, deadline_s=deadline,
-                    name=f"cluster.{name}",
-                    on_hedge=lambda: self._registry.counter(
-                        "cluster.leg.hedges"))
+                from ..obs.prof import watchdog
+                with watchdog.watch(f"scatter-leg.{name}", span=sp):
+                    v = self._hedge.call(
+                        fn, delay, deadline_s=deadline,
+                        name=f"cluster.{name}",
+                        on_hedge=lambda: self._registry.counter(
+                            "cluster.leg.hedges"))
             except TimeoutError:
                 breaker.failure()
                 self._registry.counter("cluster.leg.failures")

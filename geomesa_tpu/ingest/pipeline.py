@@ -314,8 +314,11 @@ class IngestPipeline:
             try:
                 with gsp:
                     gsp.set_attr(rows=rows, staged=len(group))
-                    result = self.store.write_many(
-                        type_name, [(e[1], e[2]) for e in group])
+                    from ..obs.prof import watchdog
+                    with watchdog.watch(f"ingest.commit.{type_name}",
+                                        span=gsp):
+                        result = self.store.write_many(
+                            type_name, [(e[1], e[2]) for e in group])
             except BaseException as exc:  # noqa: BLE001 — acks carry it
                 metrics.counter("ingest.errors")
                 for e in group:

@@ -12,8 +12,17 @@ import threading
 import time
 from bisect import bisect_left
 
+from ..utils.properties import SystemProperty
+
 __all__ = ["MetricsRegistry", "metrics", "sanitize_key",
-           "labeled_key", "split_key", "prometheus_text"]
+           "labeled_key", "split_key", "prometheus_text",
+           "METRICS_MAX_SERIES"]
+
+# per-family labeled-series ceiling: a hostile or runaway label value
+# stream (type names, principals) must not grow the registry without
+# bound — past the cap new label combinations collapse into one
+# all-``other`` series and ``metrics.series.dropped`` counts the loss
+METRICS_MAX_SERIES = SystemProperty("geomesa.metrics.max.series", "256")
 
 # metric-key material derived from user-controlled strings (type names,
 # endpoint routes) must not corrupt the registry dump: no whitespace or
@@ -108,6 +117,19 @@ class _Timer:
             seen += c
         return self.max_s
 
+    def cumulative(self) -> list:
+        """Sparse cumulative bucket pairs ``[upper_bound_s, count<=bound]``
+        for occupied buckets only, terminated by ``[None, total]`` (the
+        ``+Inf`` bucket) — the shape Prometheus ``_bucket`` lines need."""
+        out, running = [], 0
+        for i, c in enumerate(self.buckets[:-1]):
+            if c == 0:
+                continue
+            running += c
+            out.append([round(_BOUNDS[i], 9), running])
+        out.append([None, self.count])
+        return out
+
 
 class MetricsRegistry:
     def __init__(self):
@@ -115,29 +137,56 @@ class MetricsRegistry:
         self._counters: dict[str, int] = {}
         self._timers: dict[str, _Timer] = {}
         self._gauges: dict[str, float] = {}
+        # family name -> label bodies seen, for the cardinality guard
+        self._series: dict[str, set[str]] = {}
+
+    def _series_key(self, name: str, labels: dict | None) -> str:
+        """Registry key with the per-family cardinality guard applied
+        (caller holds ``self._lock``): past ``geomesa.metrics.max.series``
+        distinct label bodies, a NEW combination collapses into the
+        family's all-``other`` series (admitted once, so the family
+        tops out at cap+1) and ``metrics.series.dropped`` counts it."""
+        if not labels:
+            return name
+        key = labeled_key(name, labels)
+        body = key[len(name) + 1:-1]
+        seen = self._series.setdefault(name, set())
+        if body in seen:
+            return key
+        try:
+            cap = int(METRICS_MAX_SERIES.get() or 256)
+        except (TypeError, ValueError):
+            cap = 256
+        if len(seen) < cap:
+            seen.add(body)
+            return key
+        self._counters["metrics.series.dropped"] = \
+            self._counters.get("metrics.series.dropped", 0) + 1
+        over = labeled_key(name, {k: "other" for k in labels})
+        seen.add(over[len(name) + 1:-1])
+        return over
 
     def counter(self, name: str, inc: int = 1,
                 labels: dict | None = None):
-        key = labeled_key(name, labels)
         with self._lock:
+            key = self._series_key(name, labels)
             self._counters[key] = self._counters.get(key, 0) + inc
 
     def gauge(self, name: str, value: float,
               labels: dict | None = None):
         with self._lock:
-            self._gauges[labeled_key(name, labels)] = value
+            self._gauges[self._series_key(name, labels)] = value
 
     def observe(self, name: str, seconds: float,
                 labels: dict | None = None):
         """Record one duration directly (for callers that measured it
         themselves)."""
-        key = labeled_key(name, labels)
         with self._lock:
+            key = self._series_key(name, labels)
             self._timers.setdefault(key, _Timer()).update(seconds)
 
     def time(self, name: str, labels: dict | None = None):
         reg = self
-        key = labeled_key(name, labels)
 
         class _Ctx:
             def __enter__(self):
@@ -146,6 +195,7 @@ class MetricsRegistry:
             def __exit__(self, *exc):
                 dt = time.perf_counter() - self.t0
                 with reg._lock:
+                    key = reg._series_key(name, labels)
                     reg._timers.setdefault(key, _Timer()).update(dt)
 
         return _Ctx()
@@ -166,7 +216,10 @@ class MetricsRegistry:
                                "max_ms": round(t.max_s * 1000, 3),
                                "p50_ms": round(t.quantile_s(0.50) * 1000, 3),
                                "p95_ms": round(t.quantile_s(0.95) * 1000, 3),
-                               "p99_ms": round(t.quantile_s(0.99) * 1000, 3)}
+                               "p99_ms": round(t.quantile_s(0.99) * 1000, 3),
+                               # sparse cumulative histogram: [le_s, n]
+                               # pairs for occupied buckets, None = +Inf
+                               "buckets": t.cumulative()}
                            for k, t in self._timers.items()},
             }
 
@@ -239,6 +292,24 @@ def prometheus_text(snapshot: dict) -> str:
             s.append(_prom_line(
                 prom + "_sum", lbl, "",
                 float(mean) / 1000.0 * float(t.get("count", 0))))
+        # native histogram family alongside the summary (Grafana
+        # heatmaps need cumulative ``le`` buckets, which a summary
+        # cannot express). A distinct ``_hist`` family name keeps the
+        # 0.0.4 one-``# TYPE``-per-family rule intact.
+        bks = t.get("buckets")
+        if bks:
+            hprom = _prom_name(base) + "_seconds_hist"
+            hl = fam(hprom, "histogram")
+            for le, cum in bks:
+                le_txt = "+Inf" if le is None else f"{float(le):g}"
+                hl.append(_prom_line(hprom + "_bucket", lbl,
+                                     f'le="{le_txt}"', float(cum)))
+            hl.append(_prom_line(hprom + "_count", lbl, "",
+                                 float(t.get("count", 0))))
+            if mean is not None:
+                hl.append(_prom_line(
+                    hprom + "_sum", lbl, "",
+                    float(mean) / 1000.0 * float(t.get("count", 0))))
 
     out: list[str] = []
     for prom, (mtype, lines) in families.items():

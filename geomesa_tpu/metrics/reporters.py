@@ -26,7 +26,9 @@ def _flatten(snapshot: dict) -> list[tuple[str, float]]:
         out.append((f"gauges.{name}", float(v)))
     for name, t in sorted(snapshot.get("timers", {}).items()):
         for field, val in t.items():
-            if val is None:
+            # histogram bucket lists are structured, not scalar — they
+            # belong to the Prometheus exposition, not delimited rows
+            if val is None or not isinstance(val, (int, float)):
                 continue
             out.append((f"timers.{name}.{field}", float(val)))
     return out

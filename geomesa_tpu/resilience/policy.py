@@ -40,6 +40,10 @@ RETRY_ATTEMPTS = SystemProperty("geomesa.retry.attempts", "5")
 RETRY_BASE_MS = SystemProperty("geomesa.retry.base.ms", "50")
 RETRY_CAP_MS = SystemProperty("geomesa.retry.cap.ms", "2000")
 RETRY_DEADLINE = SystemProperty("geomesa.retry.deadline", "30s")
+# live multiplier on every budget's capacity (0..1]: the SLO reaction
+# loop shrinks it during a fast burn so retries/hedges stop amplifying
+# an outage, and restores it when the burn clears
+RETRY_BUDGET_SCALE = SystemProperty("geomesa.retry.budget.scale", "1")
 
 
 def default_retryable(exc: BaseException) -> bool:
@@ -63,12 +67,28 @@ class RetryBudget:
         self._tokens = float(capacity)
         self._lock = threading.Lock()
 
+    def effective_capacity(self) -> float:
+        """Capacity after the live ``geomesa.retry.budget.scale``
+        multiplier — re-read per call so the SLO reaction (or an
+        operator) can throttle every budget in the process at once."""
+        try:
+            scale = float(RETRY_BUDGET_SCALE.get() or 1.0)
+        except (TypeError, ValueError):
+            scale = 1.0
+        return self.capacity * min(max(scale, 0.0), 1.0)
+
     def deposit(self):
         with self._lock:
-            self._tokens = min(self.capacity, self._tokens + self.ratio)
+            self._tokens = min(self.effective_capacity(),
+                               self._tokens + self.ratio)
 
     def try_withdraw(self) -> bool:
         with self._lock:
+            cap = self.effective_capacity()
+            if self._tokens > cap:
+                # the scale was tightened while tokens were banked:
+                # clamp down so the stored surplus cannot fund a storm
+                self._tokens = cap
             if self._tokens >= 1.0:
                 self._tokens -= 1.0
                 return True

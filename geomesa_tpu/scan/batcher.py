@@ -146,8 +146,8 @@ class QueryBatcher:
         self.store = store
         self.max_batch = int(max_batch if max_batch is not None
                              else BATCH_MAX_SIZE.get())
-        self.linger_us = float(linger_us if linger_us is not None
-                               else BATCH_LINGER_MICROS.get())
+        self._linger_override = (None if linger_us is None
+                                 else float(linger_us))
         self.adaptive = (adaptive if adaptive is not None
                          else str(BATCH_LINGER_ADAPTIVE.get()).lower()
                          in ("true", "1", "yes"))
@@ -174,6 +174,23 @@ class QueryBatcher:
         self.batches = 0
         self.cache_hits = 0
         self.cache_misses = 0
+
+    @property
+    def linger_us(self) -> float:
+        """The linger ceiling in force: an explicit constructor value
+        wins; otherwise the knob is re-read LIVE per dispatch, so the
+        SLO reaction loop (and operators) can lower the ceiling on a
+        running tier without rebuilding batchers."""
+        if self._linger_override is not None:
+            return self._linger_override
+        try:
+            return float(BATCH_LINGER_MICROS.get())
+        except (TypeError, ValueError):
+            return 2000.0
+
+    @linger_us.setter
+    def linger_us(self, value: float):
+        self._linger_override = float(value)
 
     # -- public surface ----------------------------------------------------
 
@@ -354,17 +371,22 @@ class QueryBatcher:
                     results = [self.store.query(chunk[0].q)]
                 else:
                     self._probe_plan_cache(shape)
+                    from ..obs.prof import watchdog
+                    from ..obs.runtime import runtime
                     t0 = time.perf_counter()
-                    results = self.store.query_batched(
-                        [p.q for p in chunk])
+                    with watchdog.watch(
+                            f"dispatch.{sanitize_key(type_name)}",
+                            span=dsp):
+                        results = self.store.query_batched(
+                            [p.q for p in chunk])
+                    dt = time.perf_counter() - t0
                     # only FUSED dispatches feed the cost EWMA: the cap
                     # decision is about how many queries one fused
                     # launch can carry inside the budget, and the
                     # scalar fast path has a different cost profile
                     # entirely
-                    self._observe_cost(
-                        type_name, shape,
-                        (time.perf_counter() - t0) / occupancy)
+                    self._observe_cost(type_name, shape, dt / occupancy)
+                    runtime.note_dispatch("batcher", shape, dt)
             except Exception as e:  # noqa: BLE001
                 dsp.annotate("dispatch.failed", error=str(e))
                 err = e
@@ -420,10 +442,20 @@ class QueryBatcher:
                     results = [knn_process(self.store, type_name,
                                            qx, qy, k)]
                 else:
+                    from ..obs.prof import watchdog
+                    from ..obs.runtime import runtime
                     qx = np.array([p.q[0] for p in chunk])
                     qy = np.array([p.q[1] for p in chunk])
-                    results = knn_batch_process(self.store, type_name,
-                                                qx, qy, k)
+                    t0 = time.perf_counter()
+                    with watchdog.watch(
+                            f"dispatch.knn.{sanitize_key(type_name)}",
+                            span=dsp):
+                        results = knn_batch_process(self.store, type_name,
+                                                    qx, qy, k)
+                    runtime.note_dispatch(
+                        "knn", (type_name, int(k), next_pow2(occupancy)),
+                        time.perf_counter() - t0,
+                        h2d_bytes=int(qx.nbytes + qy.nbytes))
             except Exception as e:  # noqa: BLE001
                 dsp.annotate("dispatch.failed", error=str(e))
                 err = e
@@ -470,6 +502,8 @@ class QueryBatcher:
                     else "batcher.plan_cache.miss")
         reg.gauge("batcher.plan_cache.hit_rate",
                   hits / (hits + misses) if hits + misses else 0.0)
+        from ..obs.runtime import runtime
+        runtime.note_plan_probe("batcher", key, hit)
 
     # -- latency-derived batch caps ----------------------------------------
 

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -428,6 +429,8 @@ class StandingFilterSet:
         reg = self._registry
         reg.counter("cq.device.plan_cache.hit" if hit
                     else "cq.device.plan_cache.miss")
+        from ..obs.runtime import runtime
+        runtime.note_plan_probe("standing", key, hit)
 
     def dispatch(self, batch) -> dict[str, np.ndarray]:
         """Match one ingest batch against every registered filter:
@@ -444,7 +447,12 @@ class StandingFilterSet:
                        for name, slot in self._slots.items()]
             reg = self._registry
             n = batch.n
-            with reg.time("cq.device.dispatch"):
+            from ..obs.prof import watchdog
+            from ..obs.runtime import runtime
+            t_disp = time.perf_counter()
+            h2d = d2h = 0
+            with reg.time("cq.device.dispatch"), \
+                    watchdog.watch("dispatch.cq"):
                 rows = self._rows_host(batch)
                 chunk = self._chunk_rows(n)
                 key = (self._cap, self._k, len(self.attr_names), chunk)
@@ -454,10 +462,13 @@ class StandingFilterSet:
                 for start in range(0, n, chunk):
                     stop = min(start + chunk, n)
                     dev = self._chunk_device(rows, start, stop, chunk)
+                    h2d += sum(int(getattr(b, "nbytes", 0)) for b in dev)
                     mask = _standing_mask(*dev, *self._device(),
                                           jnp.int32(stop - start))
                     if _host_compact():
-                        flat = np.flatnonzero(np.asarray(mask))
+                        host_mask = np.asarray(mask)
+                        d2h += int(host_mask.nbytes)
+                        flat = np.flatnonzero(host_mask)
                         if not len(flat):
                             continue
                     else:
@@ -465,8 +476,9 @@ class StandingFilterSet:
                         if not total:
                             continue
                         size = next_pow2(total)
-                        flat = np.asarray(_flat_nonzero(
-                            mask, size))[:total].astype(np.int64)
+                        host_flat = np.asarray(_flat_nonzero(mask, size))
+                        d2h += int(host_flat.nbytes)
+                        flat = host_flat[:total].astype(np.int64)
                     fids_parts.append(flat // chunk)
                     rows_parts.append(flat % chunk + start)
                 if fids_parts:
@@ -483,6 +495,9 @@ class StandingFilterSet:
                 else:
                     rws = np.empty(0, dtype=np.int64)
                     lo = hi = np.zeros(self._cap + 1, dtype=np.int64)
+            runtime.note_dispatch("standing", key,
+                                  time.perf_counter() - t_disp,
+                                  h2d_bytes=h2d, d2h_bytes=d2h)
             out: dict[str, np.ndarray] = {}
             cand_rows = 0
             for name, slot, f, cf in entries:

@@ -1244,6 +1244,8 @@ class InMemoryDataStore(DataStore):
         _metrics.observe("store.scan", scan_s,
                          labels={"type": q.type_name,
                                  "index": strategy.index or "none"})
+        from ..obs.slo import slo_engine
+        slo_engine.record("store.scan", ok=True, latency_s=scan_s)
         from ..audit import audit_query
         audit_query(self.audit, "memory", q.type_name, str(q.filter),
                     q.hints, t_plan * 1000, scan_s * 1000, len(idx),

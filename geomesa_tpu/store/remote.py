@@ -570,6 +570,20 @@ class RemoteDataStore(DataStore):
         """Full span list for one trace (KeyError if unknown)."""
         return self._json("GET", f"/rest/trace/{quote(trace_id)}")
 
+    def runtime_snapshot(self) -> dict:
+        """Runtime telemetry: compile churn, device memory, transfer
+        bytes (GET /rest/runtime)."""
+        return self._json("GET", "/rest/runtime")
+
+    def slo_status(self) -> dict:
+        """SLO burn-rate/alert state (GET /rest/slo)."""
+        return self._json("GET", "/rest/slo")
+
+    def profile_collapsed(self) -> str:
+        """Collapsed-stack profile text (GET /rest/profile)."""
+        _, data = self._request("GET", "/rest/profile")
+        return data.decode("utf-8", "replace")
+
     def audit_events(self, type_name: str | None = None,
                      since_ms: int | None = None) -> list[dict]:
         """Server-side audit events (GET /rest/audit)."""

@@ -615,6 +615,35 @@ def cmd_trace(args) -> int:
     return 2
 
 
+def cmd_health(args) -> int:
+    """Runtime-health surfaces of a serving node: ``slo`` dumps the
+    burn-rate/alert state, ``runtime`` the compile/device/transfer
+    telemetry, ``profile`` the collapsed-stack profile text."""
+    path = args.path
+    if not path.startswith("remote://"):
+        print("health commands need --path remote://host:port",
+              file=sys.stderr)
+        return 2
+    from ..store import RemoteDataStore
+    host, _, port = path[len("remote://"):].partition(":")
+    ds = RemoteDataStore(host or "127.0.0.1", int(port) if port else 8080,
+                         auth_token=getattr(args, "token", None))
+    if args.health_command == "slo":
+        json.dump(ds.slo_status(), sys.stdout, indent=2)
+        print()
+        return 0
+    if args.health_command == "runtime":
+        json.dump(ds.runtime_snapshot(), sys.stdout, indent=2)
+        print()
+        return 0
+    if args.health_command == "profile":
+        sys.stdout.write(ds.profile_collapsed())
+        return 0
+    print(f"unknown health command {args.health_command!r}",
+          file=sys.stderr)
+    return 2
+
+
 def cmd_version(args) -> int:
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -821,6 +850,22 @@ def main(argv=None) -> int:
         if tname == "get":
             tp.add_argument("--id", required=True, help="trace id")
         tp.set_defaults(fn=cmd_trace)
+
+    hp = sub.add_parser("health",
+                        help="runtime health plane: SLO burn rates, "
+                             "runtime telemetry, profiler")
+    hsub = hp.add_subparsers(dest="health_command", required=True)
+    for hname, hhelp in (("slo", "burn-rate/alert state per route"),
+                         ("runtime", "compile churn, device memory, "
+                                     "transfer bytes"),
+                         ("profile", "collapsed-stack profile text")):
+        hcp = hsub.add_parser(hname, help=hhelp)
+        hcp.add_argument("--path", required=True,
+                         help="serving node, remote://host:port")
+        hcp.add_argument("--token", default=None,
+                         help="admin bearer token "
+                              "(geomesa.web.auth.token)")
+        hcp.set_defaults(fn=cmd_health)
 
     add("version", cmd_version, needs_store=False)
     add("env", cmd_env, needs_store=False)

@@ -44,6 +44,14 @@ class SystemProperty:
         else:
             _overrides[self.name] = str(value)
 
+    def get_override(self) -> str | None:
+        """The process-wide override layer ONLY (None = unset),
+        ignoring thread-local/env/default resolution — lets a caller
+        (the SLO reaction loop) save the exact override state it found
+        and later restore it with ``set``, without baking a resolved
+        env/default value into the override map."""
+        return _overrides.get(self.name)
+
     def thread_local_set(self, value: str | None):
         tl = getattr(_tls, "values", None)
         if tl is None:

@@ -431,7 +431,12 @@ class WriteAheadLog:
         err: OSError | None = None
         t0 = time.perf_counter()
         try:
-            with self._lock:
+            from ..obs import tracer
+            from ..obs.prof import watchdog
+            cur = tracer.current()
+            with self._lock, \
+                    watchdog.watch("wal.fsync",
+                                   span=cur[1] if cur else None):
                 fd, path = self._fd, self._seg_path
                 fd.flush()
                 faultfs.fsync(fd.fileno(), path)

@@ -18,6 +18,11 @@ stdlib http server — no framework dependency:
     GET  /rest/density/{type}?bbox=x0,y0,x1,y1&width=&height=&cql=
     GET  /rest/bin/{type}?cql=&track=&label=&sort=   -> BIN bytes
     GET  /rest/metrics                      -> metrics registry snapshot
+    GET  /rest/runtime                      -> compile/device/transfer
+                                               telemetry snapshot
+    GET  /rest/slo                          -> SLO burn-rate/alert state
+    GET  /rest/profile                      -> collapsed-stack profile
+                                               (?format=json for stats)
     GET  /rest/cache                        -> materialized-cache status
     POST /rest/cache/invalidate?type=       (bearer-gated)
     GET  /rest/sql?q=SELECT...  (or POST /rest/sql, body = statement)
@@ -88,6 +93,12 @@ _GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas"),
 # unlimited). Requests over the cap get 503 + Retry-After BEFORE any
 # handler state changes, so clients may retry them safely.
 WEB_MAX_INFLIGHT = SystemProperty("geomesa.web.max.inflight", None)
+# label web.request series with the caller's principal digest (the
+# first step toward per-tenant QoS accounting). Default off: it
+# multiplies series cardinality by the tenant count — the registry's
+# geomesa.metrics.max.series guard bounds the blast radius when on
+WEB_METRICS_PRINCIPAL = SystemProperty("geomesa.metrics.principal",
+                                       "false")
 # the Retry-After hint (seconds) a shed response carries
 WEB_RETRY_AFTER = SystemProperty("geomesa.web.retry.after.s", "1")
 
@@ -152,6 +163,12 @@ class GeoMesaWebServer:
         handler = _make_handler(self)
         self._httpd = _Httpd((host, port), handler)
         self._thread: threading.Thread | None = None
+        # the serving tier owns the health plane's sampler: refcounted,
+        # so N servers in one process share ONE profiler thread
+        # (geomesa.prof.hz=0 parks it). Released in stop().
+        from ..obs.prof import profiler
+        profiler.start()
+        self._owns_prof = True
 
     @property
     def port(self) -> int:
@@ -173,6 +190,10 @@ class GeoMesaWebServer:
             self._ingest_pipeline.close()
         if self._owns_cq and self.cq is not None:
             self.cq.close()
+        if self._owns_prof:
+            self._owns_prof = False
+            from ..obs.prof import profiler
+            profiler.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -199,6 +220,11 @@ class GeoMesaWebServer:
             return self._ready()
         if not self._acquire_slot():
             metrics.counter("resilience.web.sheds")
+            # a shed IS an availability event on the route's SLO: the
+            # caller got a 503, whatever the reason
+            from ..obs.slo import slo_engine
+            slo_engine.record(parts[0] if parts else "", ok=False,
+                              latency_s=0.0)
             retry_after = WEB_RETRY_AFTER.get() or "1"
             return (503, "application/json",
                     _j({"error": "overloaded: in-flight request cap "
@@ -208,6 +234,7 @@ class GeoMesaWebServer:
         try:
             from ..audit import principal_scope
             from ..obs import TRACE_HEADER, tracer
+            from ..obs.slo import slo_engine
             hdr = headers.get(TRACE_HEADER) if headers is not None \
                 else None
             name = f"{method} /rest/{parts[0] if parts else ''}"
@@ -215,13 +242,19 @@ class GeoMesaWebServer:
             # X-GeoMesa-Trace header continues the caller's trace
             # (RemoteDataStore client leg, upstream coordinator)
             route = parts[0] if parts else ""
+            labels = {"route": route, "method": method}
+            if str(WEB_METRICS_PRINCIPAL.get()).lower() in \
+                    ("true", "1", "yes"):
+                labels["principal"] = self._principal(headers) or "anon"
+            t_req = time.perf_counter()
             with tracer.span("web", name, root=True, remote=hdr) as wsp, \
-                    metrics.time("web.request", labels={"route": route,
-                                                        "method": method}):
+                    metrics.time("web.request", labels=labels):
                 with principal_scope(self._principal(headers)):
                     out = self._handle_routed(method, parts, params,
                                               body, headers)
                 wsp.set_attr(status=int(out[0]))
+                slo_engine.record(route, ok=int(out[0]) < 500,
+                                  latency_s=time.perf_counter() - t_req)
                 if len(out) >= 3 and not isinstance(
                         out[2], (bytes, bytearray, str)):
                     # streaming payload: the generator outlives this
@@ -472,6 +505,21 @@ class GeoMesaWebServer:
                 return (200, "text/plain; version=0.0.4",
                         metrics.prometheus_text())
             return 200, "application/json", _j(metrics.snapshot())
+        if method == "GET" and parts == ["runtime"]:
+            from ..obs.runtime import runtime
+            return 200, "application/json", _j(runtime.snapshot())
+        if method == "GET" and parts == ["slo"]:
+            from ..obs.slo import slo_engine
+            return 200, "application/json", _j(slo_engine.status())
+        if method == "GET" and parts == ["profile"]:
+            from ..obs.prof import profiler, watchdog
+            if params.get("format", [""])[0] == "json":
+                return 200, "application/json", _j(
+                    {"profiler": profiler.stats(),
+                     "watchdog": watchdog.stats()})
+            # default: collapsed-stack text (flamegraph.pl/speedscope
+            # input — "frame;frame;frame N" per line)
+            return 200, "text/plain", profiler.collapsed()
         if method == "GET" and parts and parts[0] == "trace":
             from ..obs import tracer
             if len(parts) == 1:
@@ -1020,6 +1068,11 @@ def _make_handler(server: GeoMesaWebServer):
             extra = out[3] if len(out) > 3 else {}
             if not isinstance(payload, (bytes, bytearray, str)):
                 return self._respond_chunked(status, ctype, payload, extra)
+            if isinstance(payload, str):
+                # text routes (prometheus exposition, collapsed-stack
+                # profiles) hand back str; the socket needs bytes, and
+                # Content-Length must count bytes, not characters
+                payload = payload.encode("utf-8")
             try:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)

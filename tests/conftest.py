@@ -64,3 +64,8 @@ def pytest_configure(config):
         "markers", "obs: observability suites (trace spans and wire "
         "propagation, histogram quantiles, Prometheus exposition, "
         "unified query audit; select with -m obs)")
+    config.addinivalue_line(
+        "markers", "health: runtime health plane suites (SLO burn-rate "
+        "engine + react loop, stall watchdog, continuous profiler, "
+        "runtime telemetry, metrics cardinality guard; select with "
+        "-m health)")

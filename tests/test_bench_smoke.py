@@ -216,3 +216,32 @@ def test_config17_observability_smoke():
     assert a["all_resolvable"] is True
     assert a["prometheus_parses"] is True
     assert "gates_pass" in c
+
+
+@pytest.mark.bench_smoke
+@pytest.mark.health
+def test_config18_health_smoke():
+    rng = np.random.default_rng(51)
+    c = bench.bench_config18(rng, n=3000, c=4, nq=6, stall_s=0.4)
+    # the <5% overhead gate only means something at the full c=32 run;
+    # at toy sizes assert the structural contracts instead
+    assert "overhead_under_5pct" in c
+    assert c["health_off"]["p50_ms"] > 0
+    assert c["health_on"]["p50_ms"] > 0
+    # the ON phase left live data on the profiler + SLO surfaces
+    assert c["surfaces"]["all_live"] is True
+    # the ChaosProxy-stalled scatter leg was caught mid-flight with a
+    # real Python stack
+    s = c["stall_capture"]
+    assert s["captured"] is True
+    assert s["key"] == "scatter-leg.proxied"
+    assert s["non_empty_stack"] is True
+    # the 503 storm tripped the fast burn; react tightened the shared
+    # retry/hedge budget and restored it exactly on clear
+    r = c["burn_react"]
+    assert r["fast_burn_fired"] is True
+    assert r["budget_tightened"] is True
+    assert r["budget_capacity"]["during"] < r["budget_capacity"]["before"]
+    assert r["cleared"] is True
+    assert r["restored_exactly"] is True
+    assert "gates_pass" in c

@@ -1,0 +1,755 @@
+"""Runtime health plane: SLO burn-rate engine (multi-window
+multi-burn-rate math against a fake clock — zero sleeps), the
+admission-tightening react loop with exact knob restore, the
+stall-capturing watchdog, the continuous profiler's bounded trie,
+the metrics cardinality guard, native Prometheus histogram buckets,
+and the /rest/runtime, /rest/slo, /rest/profile surfaces."""
+
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.metrics import MetricsRegistry
+from geomesa_tpu.metrics.registry import METRICS_MAX_SERIES
+from geomesa_tpu.obs import tracer
+from geomesa_tpu.obs.prof import (PROF_MAX_NODES, WATCHDOG_FACTOR,
+                                  WATCHDOG_MIN_MS, ContinuousProfiler,
+                                  StallWatchdog, profiler, watchdog)
+from geomesa_tpu.obs.runtime import (RUNTIME_ENABLED, RuntimeCollector,
+                                     runtime)
+from geomesa_tpu.obs.slo import (SLO_MIN_EVENTS, SLO_REACT,
+                                 SLO_REACT_FACTOR, SLO_WINDOWS_FAST,
+                                 SloEngine, slo_engine)
+from geomesa_tpu.obs.trace import TRACE_SAMPLE, TRACE_SLOW_MS
+from geomesa_tpu.resilience.policy import RETRY_BUDGET_SCALE, RetryBudget
+from geomesa_tpu.scan.batcher import BATCH_LINGER_MICROS
+from geomesa_tpu.store import InMemoryDataStore
+from geomesa_tpu.web.server import WEB_METRICS_PRINCIPAL, GeoMesaWebServer
+
+pytestmark = [pytest.mark.obs, pytest.mark.health]
+
+# exposition-format 0.0.4 validator (same grammar test_obs.py checks)
+_PROM_TYPE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+    r"(counter|gauge|summary|histogram|untyped)$")
+_PROM_SAMPLE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\\\|\\"|\\n|[^"\\])*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\\\|\\"|\\n|[^"\\])*")*\})?'
+    r" [-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|Inf|NaN)$")
+
+
+def assert_prometheus_parses(text: str):
+    assert text.endswith("\n") or text == ""
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        assert _PROM_TYPE.match(ln) or _PROM_SAMPLE.match(ln), (
+            f"unparseable exposition line: {ln!r}")
+
+SPEC = "*geom:Point:srid=4326,dtg:Date"
+
+T0 = 1_000_000.0   # fake-clock epoch: far from zero, far from now
+
+
+class FakeClock:
+    def __init__(self, t=T0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += float(s)
+
+
+class SpyReaction:
+    """Reaction stub: records every apply() so burn tests don't touch
+    real knobs."""
+
+    engaged = False
+
+    def __init__(self):
+        self.calls = []
+
+    def apply(self, firing):
+        self.calls.append(bool(firing))
+
+
+def engine(clk, registry=None, reaction=None):
+    return SloEngine(clock=clk, registry=registry or MetricsRegistry(),
+                     reaction=reaction or SpyReaction())
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.perf_counter() + timeout
+    while not pred():
+        if time.perf_counter() > deadline:
+            raise AssertionError("staging timed out")
+        time.sleep(0.001)
+
+
+# -- burn-rate math (fake clock, zero sleeps) ------------------------------
+
+class TestBurnRateMath:
+    def test_fast_burn_fires_at_workbook_threshold(self):
+        """2% errors against a 99.9% availability target is burn 20 —
+        over the 14.4 page threshold on both fast windows."""
+        clk = FakeClock()
+        e = engine(clk)
+        for i in range(50):
+            e.record("query", ok=(i != 0), latency_s=0.01, now=clk())
+        st = e.evaluate(clk())["query"]
+        assert st["fast_firing"] is True
+        assert st["alert"] == "fast-burn"
+        assert st["burn"]["availability"]["300s"] == pytest.approx(20.0)
+        assert st["burn"]["availability"]["3600s"] == pytest.approx(20.0)
+
+    def test_below_threshold_does_not_fire(self):
+        """1% errors is burn 10 < 14.4: no page — but a sustained burn
+        10 IS ticket-worthy, so the slow rule catches it instead."""
+        clk = FakeClock()
+        e = engine(clk)
+        for i in range(100):
+            e.record("query", ok=(i != 0), latency_s=0.01, now=clk())
+        st = e.evaluate(clk())["query"]
+        assert st["burn"]["availability"]["300s"] == pytest.approx(10.0)
+        assert st["fast_firing"] is False
+        assert st["slow_firing"] is True
+        assert st["alert"] == "slow-burn"
+
+    def test_min_events_guard_blocks_tiny_samples(self):
+        """One failure out of six must not page anybody, however
+        enormous the fraction-based burn looks."""
+        clk = FakeClock()
+        e = engine(clk)
+        for _ in range(6):
+            e.record("query", ok=False, latency_s=0.01, now=clk())
+        st = e.evaluate(clk())["query"]
+        assert st["burn"]["availability"]["300s"] >= 14.4
+        assert st["fast_firing"] is False
+
+    def test_fast_burn_clears_when_short_window_drains(self):
+        """Clear needs only the SHORT window under threshold — the 1h
+        window still carries the incident, the 5m window says the
+        bleeding stopped."""
+        clk = FakeClock()
+        reg = MetricsRegistry()
+        e = engine(clk, registry=reg)
+        for _ in range(20):
+            e.record("query", ok=False, latency_s=0.01, now=clk())
+        assert e.evaluate(clk())["query"]["fast_firing"] is True
+        # 400s later: errors aged out of the 5m window, still in 1h
+        clk.advance(400)
+        for _ in range(20):
+            e.record("query", ok=True, latency_s=0.01, now=clk())
+        st = e.evaluate(clk())["query"]
+        assert st["fast_firing"] is False
+        assert st["burn"]["availability"]["3600s"] >= 14.4
+        counters = reg.snapshot()["counters"]
+        fired = [k for k in counters if k.startswith("slo.alerts.fired")]
+        cleared = [k for k in counters
+                   if k.startswith("slo.alerts.cleared")]
+        assert fired and cleared
+
+    def test_latency_objective_is_its_own_burn(self):
+        """Every request succeeding slowly burns the latency SLO while
+        availability stays clean."""
+        clk = FakeClock()
+        e = engine(clk)
+        for _ in range(50):
+            e.record("query", ok=True, latency_s=0.9, now=clk())
+        st = e.evaluate(clk())["query"]
+        assert st["burn"]["availability"]["300s"] == 0.0
+        assert st["burn"]["latency"]["300s"] >= 14.4
+        assert st["fast_firing"] is True
+
+    def test_slow_burn_fires_on_sustained_trickle(self):
+        """5% errors two hours ago: invisible to the fast windows,
+        burn 50 on the 6h/3d pair."""
+        clk = FakeClock()
+        e = engine(clk)
+        past = clk() - 7200
+        for i in range(200):
+            e.record("query", ok=(i % 20 != 0), latency_s=0.01, now=past)
+        st = e.evaluate(clk())["query"]
+        assert st["slow_firing"] is True
+        assert st["fast_firing"] is False
+        assert st["alert"] == "slow-burn"
+
+    def test_slow_burn_ignores_transient_spike(self):
+        """A short error blip against hours of good background traffic
+        neither pages (min-events) nor tickets (diluted fraction)."""
+        clk = FakeClock()
+        e = engine(clk)
+        past = clk() - 7200
+        for _ in range(6000):
+            e.record("query", ok=True, latency_s=0.01, now=past)
+        for _ in range(5):
+            e.record("query", ok=False, latency_s=0.01, now=clk())
+        st = e.evaluate(clk())["query"]
+        assert st["slow_firing"] is False
+        assert st["fast_firing"] is False
+        assert st["alert"] == "ok"
+
+    def test_route_cap_collapses_overflow_to_other(self):
+        clk = FakeClock()
+        e = engine(clk)
+        from geomesa_tpu.obs.slo import SLO_MAX_ROUTES
+        SLO_MAX_ROUTES.set("3")
+        try:
+            for i in range(10):
+                e.record(f"route{i}", ok=True, latency_s=0.01, now=clk())
+        finally:
+            SLO_MAX_ROUTES.set(None)
+        routes = set(e.evaluate(clk()))
+        assert "other" in routes
+        assert len(routes) <= 4
+
+    def test_window_knob_reconfigures_engine(self):
+        """Shortened windows via the knob: the same stream fires under
+        1s/10s windows without waiting five minutes of fake time."""
+        clk = FakeClock()
+        e = engine(clk)
+        SLO_WINDOWS_FAST.set("1:10:14.4")
+        try:
+            for _ in range(20):
+                e.record("query", ok=False, latency_s=0.01, now=clk())
+            st = e.evaluate(clk())["query"]
+            assert st["fast_firing"] is True
+            assert "1s" in st["burn"]["availability"]
+        finally:
+            SLO_WINDOWS_FAST.set(None)
+
+
+# -- the react loop: tighten on fire, restore exactly on clear -------------
+
+class TestSloReact:
+    def _fire(self, e, clk):
+        for _ in range(20):
+            e.record("query", ok=False, latency_s=0.01, now=clk())
+        return e.evaluate(clk())
+
+    def _clear(self, e, clk):
+        clk.advance(400)
+        for _ in range(20):
+            e.record("query", ok=True, latency_s=0.01, now=clk())
+        return e.evaluate(clk())
+
+    def test_react_off_by_default_never_touches_knobs(self):
+        clk = FakeClock()
+        e = SloEngine(clock=clk, registry=MetricsRegistry())
+        assert self._fire(e, clk)["query"]["fast_firing"] is True
+        assert RETRY_BUDGET_SCALE.get_override() is None
+        assert BATCH_LINGER_MICROS.get_override() is None
+
+    def test_react_tightens_then_restores_exactly(self):
+        """Engage saves the override LAYER of every knob it touches and
+        puts it back verbatim on clear — including the not-set state."""
+        clk = FakeClock()
+        SLO_REACT.set("true")
+        BATCH_LINGER_MICROS.set("7777")   # pre-existing operator override
+        try:
+            e = SloEngine(clock=clk, registry=MetricsRegistry())
+            rb = RetryBudget(capacity=10.0)
+            assert rb.effective_capacity() == pytest.approx(10.0)
+
+            self._fire(e, clk)
+            # factor 4: scale 0.25, linger quartered, budget quartered
+            assert RETRY_BUDGET_SCALE.get_override() == "0.25"
+            assert float(BATCH_LINGER_MICROS.get_override()) == \
+                pytest.approx(7777 / 4)
+            assert rb.effective_capacity() == pytest.approx(2.5)
+
+            self._clear(e, clk)
+            assert RETRY_BUDGET_SCALE.get_override() is None
+            assert BATCH_LINGER_MICROS.get_override() == "7777"
+            assert rb.effective_capacity() == pytest.approx(10.0)
+        finally:
+            SLO_REACT.set(None)
+            BATCH_LINGER_MICROS.set(None)
+
+    def test_react_factor_knob(self):
+        clk = FakeClock()
+        SLO_REACT.set("true")
+        SLO_REACT_FACTOR.set("10")
+        try:
+            e = SloEngine(clock=clk, registry=MetricsRegistry())
+            self._fire(e, clk)
+            assert RETRY_BUDGET_SCALE.get_override() == "0.1"
+            self._clear(e, clk)
+            assert RETRY_BUDGET_SCALE.get_override() is None
+        finally:
+            SLO_REACT_FACTOR.set(None)
+            SLO_REACT.set(None)
+
+    def test_disabling_react_mid_fire_restores(self):
+        """Flipping the kill switch off while the burn still fires must
+        release the knobs immediately — the operator always wins."""
+        clk = FakeClock()
+        SLO_REACT.set("true")
+        try:
+            e = SloEngine(clock=clk, registry=MetricsRegistry())
+            self._fire(e, clk)
+            assert RETRY_BUDGET_SCALE.get_override() == "0.25"
+            SLO_REACT.set("false")
+            st = e.evaluate(clk())
+            assert st["query"]["fast_firing"] is True   # still burning
+            assert RETRY_BUDGET_SCALE.get_override() is None
+        finally:
+            SLO_REACT.set(None)
+
+    def test_retry_budget_scale_clamps_banked_tokens(self):
+        """Tightening the scale mid-flight must also shrink tokens
+        already banked — the stored surplus cannot fund a storm."""
+        rb = RetryBudget(capacity=10.0)
+        assert rb.try_withdraw() is True    # full bucket
+        RETRY_BUDGET_SCALE.set("0.05")      # capacity 0.5 < 1 token
+        try:
+            assert rb.effective_capacity() == pytest.approx(0.5)
+            assert rb.try_withdraw() is False
+        finally:
+            RETRY_BUDGET_SCALE.set(None)
+        # the clamp is permanent until deposits refill the pool: scale
+        # coming back does NOT resurrect the confiscated tokens
+        assert rb.try_withdraw() is False
+        for _ in range(5):
+            rb.deposit()                    # 5 x 0.2 ratio = 1 token
+        assert rb.try_withdraw() is True
+
+
+# -- stall watchdog --------------------------------------------------------
+
+class TestStallWatchdog:
+    def test_learned_threshold_from_history(self):
+        clk = FakeClock()
+        wd = StallWatchdog(registry=MetricsRegistry(), clock=clk)
+        WATCHDOG_MIN_MS.set("1")
+        try:
+            for _ in range(10):
+                with wd.watch("op"):
+                    clk.advance(0.010)
+            # ~8 x the 10ms p99 (log-bucket quantiles are ~±20%)
+            assert 0.05 <= wd.threshold_s("op") <= 0.15
+        finally:
+            WATCHDOG_MIN_MS.set(None)
+
+    def test_cold_key_uses_floored_threshold(self):
+        wd = StallWatchdog(registry=MetricsRegistry())
+        # no history: floor(100ms) x factor(8)
+        assert wd.threshold_s("never-seen") == pytest.approx(0.8)
+
+    def test_factor_zero_disables(self):
+        clk = FakeClock()
+        wd = StallWatchdog(registry=MetricsRegistry(), clock=clk)
+        WATCHDOG_FACTOR.set("0")
+        try:
+            with wd.watch("op"):
+                clk.advance(100)
+                assert wd.check(now=clk()) == []
+        finally:
+            WATCHDOG_FACTOR.set(None)
+
+    def test_stall_captured_with_live_stack_and_span_kept(self):
+        """The acceptance gate: a dispatch parked past its threshold is
+        captured with the owning thread's live Python stack, the span
+        is annotated + force-kept even at sample rate 0."""
+        clk = FakeClock()
+        reg = MetricsRegistry()
+        wd = StallWatchdog(registry=reg, clock=clk)
+        # sample 0 + an unreachable slow threshold: neither policy
+        # would keep this trace — only the watchdog's force-keep can
+        TRACE_SAMPLE.set("0")
+        TRACE_SLOW_MS.set("60000")
+        tracer.clear()
+        evt = threading.Event()
+
+        def worker():
+            with tracer.span("dispatch", "stalled-dispatch",
+                             root=True) as sp:
+                with wd.watch("dispatch.stalltest", span=sp):
+                    evt.wait(30.0)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            _wait(lambda: wd.stats()["active"] >= 1)
+            clk.advance(10)          # way past the 0.8s cold threshold
+            recs = wd.check(now=clk())
+            assert len(recs) == 1
+            rec = recs[0]
+            assert rec["key"] == "dispatch.stalltest"
+            assert rec["stack"], "captured stack must be non-empty"
+            assert any("threading" in f for f in rec["stack"])
+            assert rec["elapsed_s"] > rec["threshold_s"]
+            # capture is once per op
+            assert wd.check(now=clk()) == []
+            counters = reg.snapshot()["counters"]
+            assert any(k.startswith("prof.watchdog.stalls")
+                       for k in counters)
+        finally:
+            evt.set()
+            t.join(10.0)
+            TRACE_SAMPLE.set(None)
+            TRACE_SLOW_MS.set(None)
+        # sampling was OFF, yet the stalled trace landed in the ring
+        traces = tracer.traces()
+        assert any(tr["root_kind"] == "dispatch" for tr in traces)
+        tid = next(tr["trace_id"] for tr in traces
+                   if tr["root_kind"] == "dispatch")
+        spans = tracer.get(tid)
+        stalled = [s for s in spans if s.get("attrs", {}).get("stalled")]
+        assert stalled
+        notes = [a for s in spans for a in s.get("annotations", [])
+                 if a.get("text") == "watchdog.stall"]
+        assert notes and notes[0]["stack"]
+        tracer.clear()
+
+    def test_finished_op_is_not_captured(self):
+        clk = FakeClock()
+        wd = StallWatchdog(registry=MetricsRegistry(), clock=clk)
+        with wd.watch("op"):
+            clk.advance(0.001)
+        clk.advance(100)
+        assert wd.check(now=clk()) == []
+        assert wd.stalls() == []
+
+
+# -- continuous profiler ---------------------------------------------------
+
+class TestContinuousProfiler:
+    def test_sample_once_and_collapsed_format(self):
+        p = ContinuousProfiler(registry=MetricsRegistry())
+        evt = threading.Event()
+        t = threading.Thread(target=lambda: evt.wait(30.0), daemon=True)
+        t.start()
+        try:
+            _wait(lambda: t.is_alive())
+            p.sample_once()
+        finally:
+            evt.set()
+            t.join(10.0)
+        text = p.collapsed()
+        assert text.endswith("\n")
+        line_re = re.compile(r"^\S+(;\S+)* \d+$")
+        for ln in text.splitlines():
+            assert line_re.match(ln), f"bad collapsed line: {ln!r}"
+        assert "threading.py:" in text   # the parked worker's frames
+        st = p.stats()
+        assert st["samples"] == 1
+        assert st["nodes"] > 1
+
+    def test_trie_cap_truncates_not_grows(self):
+        p = ContinuousProfiler(registry=MetricsRegistry())
+        PROF_MAX_NODES.set("3")
+        try:
+            p._insert(["a", "b", "c", "d", "e"])
+            p._insert(["x", "y", "z"])
+        finally:
+            PROF_MAX_NODES.set(None)
+        st = p.stats()
+        assert st["nodes"] <= 5          # cap + root + <trunc>
+        assert st["truncated"] >= 1
+        assert "<trunc>" in p.collapsed()
+
+    def test_start_stop_refcounted(self):
+        from geomesa_tpu.obs.prof import PROF_HZ
+        p = ContinuousProfiler(registry=MetricsRegistry())
+        PROF_HZ.set("0")     # parked thread: lifecycle without sampling
+        try:
+            p.start()
+            p.start()
+            assert p.running is True
+            p.stop()
+            assert p.running is True     # one ref still held
+            p.stop()
+            assert p.running is False
+        finally:
+            PROF_HZ.set(None)
+
+
+# -- runtime telemetry collector -------------------------------------------
+
+class TestRuntimeCollector:
+    def test_compile_and_dispatch_accounting(self):
+        rc = RuntimeCollector(registry=MetricsRegistry())
+        rc.note_plan_probe("batcher", ("pts", 8), hit=False)
+        rc.note_plan_probe("batcher", ("pts", 8), hit=True)
+        rc.note_plan_probe("batcher", ("pts", 8), hit=True)
+        rc.note_dispatch("batcher", ("pts", 8), 0.004, h2d_bytes=1024,
+                         d2h_bytes=256)
+        rc.note_dispatch("batcher", ("pts", 8), 0.006)
+        snap = rc.snapshot()
+        cls = snap["compile"]["batcher"]["pts/8"]
+        assert cls == {"hits": 2, "misses": 1}
+        d = snap["dispatch"]["batcher"]["pts/8"]
+        assert d["count"] == 2
+        assert d["max_ms"] == pytest.approx(6.0)
+        assert snap["transfer"] == {"h2d_bytes": 1024, "d2h_bytes": 256}
+
+    def test_kill_switch(self):
+        rc = RuntimeCollector(registry=MetricsRegistry())
+        RUNTIME_ENABLED.set("false")
+        try:
+            rc.note_plan_probe("batcher", ("pts", 8), hit=False)
+            rc.note_dispatch("batcher", ("pts", 8), 0.004)
+        finally:
+            RUNTIME_ENABLED.set(None)
+        snap = rc.snapshot()
+        assert snap["compile"] == {} and snap["dispatch"] == {}
+
+    def test_device_memory_sample_is_safe_and_counted(self):
+        """jax is loaded by conftest: sampling must not raise and must
+        count a sample (CPU backends may expose no memory_stats — the
+        live-buffer fallback still runs)."""
+        rc = RuntimeCollector(registry=MetricsRegistry())
+        rc.sample_device_memory()
+        mem = rc.snapshot()["device_memory"]
+        assert mem["samples"] == 1
+        assert mem["live_buffers"] >= 0
+
+
+# -- metrics: cardinality guard + native histogram buckets -----------------
+
+class TestCardinalityGuard:
+    def test_overflow_collapses_to_other(self):
+        reg = MetricsRegistry()
+        METRICS_MAX_SERIES.set("4")
+        try:
+            for i in range(20):
+                reg.counter("cg.hits", labels={"route": f"r{i}"})
+        finally:
+            METRICS_MAX_SERIES.set(None)
+        counters = reg.snapshot()["counters"]
+        fam = [k for k in counters if k.startswith("cg.hits")]
+        assert len(fam) == 5             # cap + the one `other` series
+        other = [k for k in fam if 'route="other"' in k]
+        assert len(other) == 1
+        assert counters[other[0]] == 16
+        assert counters["metrics.series.dropped"] == 16
+
+    def test_known_series_keep_counting_past_cap(self):
+        reg = MetricsRegistry()
+        METRICS_MAX_SERIES.set("2")
+        try:
+            for _ in range(3):
+                reg.counter("cg.ok", labels={"r": "a"})
+            reg.counter("cg.ok", labels={"r": "b"})
+            reg.counter("cg.ok", labels={"r": "c"})   # over: -> other
+            reg.counter("cg.ok", labels={"r": "a"})   # still admitted
+        finally:
+            METRICS_MAX_SERIES.set(None)
+        counters = reg.snapshot()["counters"]
+        assert counters['cg.ok{r="a"}'] == 4
+
+    def test_guard_applies_to_gauges_and_timers(self):
+        reg = MetricsRegistry()
+        METRICS_MAX_SERIES.set("1")
+        try:
+            reg.gauge("cg.g", 1.0, labels={"r": "a"})
+            reg.gauge("cg.g", 2.0, labels={"r": "b"})
+            reg.observe("cg.t", 0.01, labels={"r": "a"})
+            reg.observe("cg.t", 0.02, labels={"r": "b"})
+        finally:
+            METRICS_MAX_SERIES.set(None)
+        snap = reg.snapshot()
+        assert snap["gauges"]['cg.g{r="other"}'] == 2.0
+        assert snap["timers"]['cg.t{r="other"}']["count"] == 1
+
+
+class TestPrometheusHistograms:
+    def test_bucket_lines_cumulative_and_valid(self):
+        reg = MetricsRegistry()
+        for _ in range(90):
+            reg.observe("lat", 0.001)
+        for _ in range(10):
+            reg.observe("lat", 0.100)
+        text = reg.prometheus_text()
+        assert_prometheus_parses(text)
+        bucket_re = re.compile(
+            r'^geomesa_lat_seconds_hist_bucket\{le="([^"]+)"\} (\S+)$',
+            re.M)
+        found = bucket_re.findall(text)
+        assert found, "histogram _bucket lines missing"
+        # cumulative: counts never decrease, +Inf carries the total
+        counts = [float(c) for _, c in found]
+        assert counts == sorted(counts)
+        assert found[-1][0] == "+Inf"
+        assert counts[-1] == 100.0
+        assert "geomesa_lat_seconds_hist_count 100.0" in text
+        assert "# TYPE geomesa_lat_seconds_hist histogram" in text
+
+    def test_one_type_line_per_family(self):
+        reg = MetricsRegistry()
+        reg.observe("lat", 0.001, labels={"r": "a"})
+        reg.observe("lat", 0.002, labels={"r": "b"})
+        reg.counter("hits", labels={"r": "a"})
+        reg.counter("hits", labels={"r": "b"})
+        text = reg.prometheus_text()
+        assert_prometheus_parses(text)
+        types = [ln for ln in text.splitlines()
+                 if ln.startswith("# TYPE ")]
+        assert len(types) == len({ln.split()[2] for ln in types})
+
+    def test_summary_and_histogram_families_coexist(self):
+        reg = MetricsRegistry()
+        reg.observe("lat", 0.005)
+        text = reg.prometheus_text()
+        assert 'geomesa_lat_seconds{quantile="0.5"}' in text
+        assert "geomesa_lat_seconds_hist_bucket" in text
+
+
+# -- web surfaces ----------------------------------------------------------
+
+def seeded_store(n=50):
+    rng = np.random.default_rng(7)
+    sft = parse_spec("hpts", SPEC)
+    ds = InMemoryDataStore()
+    ds.create_schema(sft)
+    ds.write("hpts", FeatureBatch.from_dict(
+        sft, np.array([f"f{i}" for i in range(n)], dtype=object),
+        {"geom": (rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)),
+         "dtg": rng.integers(0, 10**12, n).astype(np.int64)}))
+    return ds
+
+
+class TestHealthEndpoints:
+    @pytest.fixture
+    def server(self):
+        slo_engine.clear()
+        srv = GeoMesaWebServer(seeded_store()).start()
+        try:
+            yield srv
+        finally:
+            srv.stop()
+            slo_engine.clear()
+
+    def test_rest_runtime(self, server):
+        status, ctype, body = server.handle("GET", "/rest/runtime",
+                                            {}, None)[:3]
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        for key in ("enabled", "compile", "dispatch", "transfer",
+                    "device_memory"):
+            assert key in doc
+
+    def test_rest_slo_reflects_traffic(self, server):
+        server.handle("GET", "/rest/schemas", {}, None)
+        status, _, body = server.handle("GET", "/rest/slo", {}, None)[:3]
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["enabled"] is True
+        assert doc["objectives"]["availability_target"] == 0.999
+        assert doc["windows"]["fast"] == [300.0, 3600.0, 14.4]
+        assert "schemas" in doc["routes"]
+        assert doc["routes"]["schemas"]["alert"] == "ok"
+
+    def test_rest_profile_text_and_json(self, server):
+        status, ctype, body = server.handle("GET", "/rest/profile",
+                                            {}, None)[:3]
+        assert status == 200 and ctype == "text/plain"
+        assert isinstance(body, str)
+        status, ctype, body = server.handle(
+            "GET", "/rest/profile", {"format": ["json"]}, None)[:3]
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert "profiler" in doc and "watchdog" in doc
+        assert doc["profiler"]["running"] is True   # server owns a ref
+
+    def test_server_lifecycle_owns_profiler_ref(self):
+        before = profiler._refs
+        srv = GeoMesaWebServer(seeded_store()).start()
+        assert profiler._refs == before + 1
+        srv.stop()
+        assert profiler._refs == before
+
+    def test_remote_client_health_methods(self, server):
+        from geomesa_tpu.store.remote import RemoteDataStore
+        client = RemoteDataStore("127.0.0.1", server.port, hedge=False)
+        assert "transfer" in client.runtime_snapshot()
+        assert client.slo_status()["enabled"] is True
+        assert isinstance(client.profile_collapsed(), str)
+
+    def test_shed_503_counts_against_route_slo(self):
+        slo_engine.clear()
+        hold = threading.Event()
+
+        class Holder(InMemoryDataStore):
+            def get_type_names(self):
+                assert hold.wait(30.0)
+                return super().get_type_names()
+
+        srv = GeoMesaWebServer(Holder(), max_inflight=1).start()
+        try:
+            t = threading.Thread(
+                target=lambda: srv.handle("GET", "/rest/schemas",
+                                          {}, None),
+                daemon=True)
+            t.start()
+            _wait(lambda: srv._inflight >= 1)
+            status = srv.handle("GET", "/rest/schemas", {}, None)[0]
+            assert status == 503
+        finally:
+            hold.set()
+            t.join(10.0)
+            srv.stop()
+        st = slo_engine.evaluate()
+        assert "schemas" in st
+        slo_engine.clear()
+
+
+class TestPrincipalLabel:
+    def test_off_by_default_and_digest_when_on(self):
+        from geomesa_tpu.metrics import metrics as global_metrics
+        srv = GeoMesaWebServer(seeded_store()).start()
+        try:
+            srv.handle("GET", "/rest/metrics", {}, None)
+            keys = global_metrics.snapshot()["timers"]
+            off = [k for k in keys if k.startswith("web.request")
+                   and 'route="metrics"' in k]
+            assert off and all("principal=" not in k for k in off)
+
+            WEB_METRICS_PRINCIPAL.set("true")
+            try:
+                srv.handle("GET", "/rest/metrics", {}, None)
+                srv.handle("GET", "/rest/metrics", {}, None,
+                           {"Authorization": "Bearer sekret"})
+            finally:
+                WEB_METRICS_PRINCIPAL.set(None)
+            keys = global_metrics.snapshot()["timers"]
+            on = [k for k in keys if k.startswith("web.request")
+                  and "principal=" in k]
+            assert any('principal="anon"' in k for k in on)
+            digested = [k for k in on if 'principal="bearer:' in k]
+            assert digested
+            # never the raw token — only its digest
+            assert all("sekret" not in k for k in digested)
+        finally:
+            srv.stop()
+
+
+# -- global singleton hygiene ----------------------------------------------
+
+class TestSingletonHygiene:
+    def test_singletons_exported_from_obs(self):
+        from geomesa_tpu import obs
+        assert obs.slo_engine is slo_engine
+        assert obs.runtime is runtime
+        assert obs.watchdog is watchdog
+        assert obs.profiler is profiler
+
+    def test_min_events_knob_is_live(self):
+        clk = FakeClock()
+        e = engine(clk)
+        SLO_MIN_EVENTS.set("2")
+        try:
+            for _ in range(3):
+                e.record("query", ok=False, latency_s=0.01, now=clk())
+            assert e.evaluate(clk())["query"]["fast_firing"] is True
+        finally:
+            SLO_MIN_EVENTS.set(None)
