@@ -145,7 +145,7 @@ REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
                              "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,"
-                             "northstar")
+                             "19,northstar")
               .split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
@@ -2945,6 +2945,183 @@ def bench_config18(rng, n=None, c=None, nq=None, stall_s=None):
     return out
 
 
+# -- config 19: distributed SQL — partial-aggregate pushdown ---------------
+
+def bench_config19(rng, n=None, reps=None):
+    """What partial-aggregate pushdown buys over coordinator
+    materialization through ONE SQL frontend.
+
+    Phase 1 — grouped/ungrouped aggregates on a 4-group cluster, three
+    ways: `single` (one store holding all rows — the reference),
+    `cluster_pull` (kill switch off: every leg ships its ROWS and the
+    coordinator concatenates + aggregates — the pre-pushdown path),
+    and `distributed` (each leg reduces locally, the coordinator
+    merges per-group partials). Every statement is checked row-exact
+    against the single-store oracle; the 2x gate is pushdown vs the
+    pull path it replaces.
+
+    Phase 2 — broadcast spatial join (small polygon side shipped to
+    each leg, fused kernels per shard, psum/by-key merge) vs the same
+    join over pulled rows, count- and group-exact.
+
+    Phase 3 — leg-kill probe: one group hard down; every statement
+    must yield a typed ShardUnavailableError (knob off) or a flagged
+    `complete=False` merge (knob on). Never a silent wrong answer."""
+    from geomesa_tpu.cluster import ClusterDataStore, ShardUnavailableError
+    from geomesa_tpu.features import FeatureBatch, parse_spec
+    from geomesa_tpu.geometry import Polygon
+    from geomesa_tpu.sql import SqlEngine
+    from geomesa_tpu.sql.distributed import SQL_DISTRIBUTED
+    from geomesa_tpu.store import InMemoryDataStore
+
+    n = n if n is not None else int(
+        os.environ.get("GEOMESA_TPU_BENCH_SQL_N", 2_000_000))
+    reps = reps if reps is not None else max(TRIALS, 3)
+    sft = parse_spec("pts19", "*geom:Point:srid=4326,name:String,"
+                              "val:Integer")
+    ids = np.arange(n).astype(str).astype(object)
+    names = np.array([f"grp{i}" for i in range(32)], dtype=object)
+    batch = FeatureBatch.from_dict(sft, ids, {
+        "geom": (rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)),
+        "name": names[rng.integers(0, len(names), n)],
+        "val": rng.permutation(n).astype(np.int64),
+    })
+    zsft = parse_spec("zones19", "*geom:Polygon:srid=4326,zname:String")
+
+    def _box(x0, y0, w, h):
+        return Polygon(np.array([[x0, y0], [x0 + w, y0],
+                                 [x0 + w, y0 + h], [x0, y0 + h],
+                                 [x0, y0]], float))
+
+    zb = FeatureBatch.from_dict(
+        zsft, np.array([f"z{i}" for i in range(16)], dtype=object),
+        {"geom": np.array([_box(-160 + 20 * (i % 16), -60 + 30 * (i // 8),
+                                15, 25) for i in range(16)], dtype=object),
+         "zname": np.array([f"zone{i}" for i in range(16)], dtype=object)})
+
+    oracle = InMemoryDataStore()
+    groups = [InMemoryDataStore() for _ in range(4)]
+    cluster = ClusterDataStore(groups, leg_deadline_s=120)
+    for st in (oracle, cluster):
+        st.create_schema(sft)
+        st.write("pts19", batch)
+        st.create_schema(zsft)
+        st.write("zones19", zb)
+    oe, ce = SqlEngine(oracle), SqlEngine(cluster)
+
+    AGG = [
+        "SELECT name, COUNT(*), SUM(val), MIN(val), MAX(val), AVG(val) "
+        "FROM pts19 GROUP BY name",
+        "SELECT name, COUNT(*) AS cnt FROM pts19 GROUP BY name "
+        "ORDER BY cnt DESC LIMIT 5",
+        "SELECT COUNT(*), SUM(val), AVG(val) FROM pts19",
+        "SELECT name, ST_Extent(geom) FROM pts19 GROUP BY name",
+    ]
+    JOIN = [
+        "SELECT COUNT(*) FROM pts19 p "
+        "JOIN zones19 z ON ST_Contains(z.geom, p.geom)",
+        "SELECT z.zname, COUNT(*) FROM pts19 p "
+        "JOIN zones19 z ON ST_Contains(z.geom, p.geom) GROUP BY z.zname",
+    ]
+
+    def _canon(res):
+        return sorted(tuple(map(str, r)) for r in res.rows())
+
+    def _run(engine, stmts):
+        t0 = time.perf_counter()
+        out = [engine.query(s) for s in stmts]
+        return time.perf_counter() - t0, out
+
+    def _phase(stmts):
+        want = [_canon(oe.query(s)) for s in stmts]
+        # warm both paths once, then time
+        timings = {}
+        exact = True
+        modes = []
+        for label, knob in (("single", None), ("cluster_pull", "false"),
+                            ("distributed", None)):
+            eng = oe if label == "single" else ce
+            if knob is not None:
+                SQL_DISTRIBUTED.set(knob)
+            try:
+                _run(eng, stmts)  # warm
+                samples = []
+                for _ in range(reps):
+                    dt, res = _run(eng, stmts)
+                    samples.append(dt)
+                exact = exact and all(
+                    _canon(r) == w for r, w in zip(res, want))
+                if label == "distributed":
+                    modes = [r.plan["mode"] for r in res]
+                timings[label] = _p50(samples)
+            finally:
+                if knob is not None:
+                    SQL_DISTRIBUTED.set(None)
+        return {
+            "single_s": round(timings["single"], 4),
+            "cluster_pull_s": round(timings["cluster_pull"], 4),
+            "distributed_s": round(timings["distributed"], 4),
+            "speedup_vs_pull": round(
+                timings["cluster_pull"] / timings["distributed"], 2),
+            "exact": bool(exact),
+            "plan_modes": sorted(set(modes)),
+            "statements": len(stmts),
+        }
+
+    out = {"n": n, "groups": 4, "reps": reps}
+    out["aggregate"] = _phase(AGG)
+    out["join"] = _phase(JOIN)
+    cluster.close()
+
+    # -- phase 3: leg-kill probe — typed-or-flagged only ------------------
+    class _Down:
+        def close(self):
+            pass
+
+        def __getattr__(self, key):
+            def boom(*a, **kw):
+                raise ConnectionError("bench: injected shard loss")
+            return boom
+
+    probe = AGG[:2] + JOIN[:1]
+    m = min(n, max(n // 100, 10_000))
+    sub = batch.take(np.arange(m))
+    typed = flagged = wrong = 0
+    for allow in (False, True):
+        wounded = ClusterDataStore(
+            [InMemoryDataStore() for _ in range(4)], allow_partial=allow)
+        wounded.create_schema(sft)
+        wounded.write("pts19", sub)
+        wounded.create_schema(zsft)
+        wounded.write("zones19", zb)
+        wounded._groups[2] = _Down()
+        we = SqlEngine(wounded)
+        for stmt in probe:
+            try:
+                res = we.query(stmt)
+                if res.complete is False and res.missing_groups:
+                    flagged += 1
+                else:
+                    wrong += 1
+            except ShardUnavailableError:
+                typed += 1
+        wounded.close()
+    out["partial"] = {
+        "queries": 2 * len(probe),
+        "typed_errors_knob_off": typed,
+        "partial_flagged_knob_on": flagged,
+        "silently_wrong": wrong,
+        "typed_or_flagged_only": bool(
+            wrong == 0 and typed == len(probe) and flagged == len(probe)),
+    }
+
+    out["gates_pass"] = bool(
+        out["aggregate"]["exact"] and out["join"]["exact"]
+        and out["aggregate"]["speedup_vs_pull"] >= 2.0
+        and out["partial"]["typed_or_flagged_only"])
+    return out
+
+
 # -- config 10: storage integrity — scrub overhead + corrupt recovery -----
 
 def bench_config10(rng):
@@ -3221,6 +3398,8 @@ def main(argv=None):
         out["configs"]["17_observability"] = bench_config17(rng)
     if "18" in CONFIGS:
         out["configs"]["18_health"] = bench_config18(rng)
+    if "19" in CONFIGS:
+        out["configs"]["19_distributed_sql"] = bench_config19(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

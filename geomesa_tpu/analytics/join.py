@@ -32,7 +32,16 @@ from ..scan.zscan import next_pow2, stack_points
 from ..utils.fp import f32_band as _f32_band
 
 __all__ = ["dwithin_join", "contains_join", "knn", "knn_batched",
-           "pack_polygon_batch", "prewarm_join_kernels"]
+           "pack_polygon_batch", "prewarm_join_kernels", "psum_counts"]
+
+
+def psum_counts(leg_counts) -> int:
+    """psum-style reduce of per-shard join match counts: the z-prefix
+    partition of the scattered side is disjoint and covering, so the
+    cluster-wide broadcast-join count is exactly the sum of leg
+    counts — the host-side analog of a ``jax.lax.psum`` over the
+    shard axis."""
+    return int(sum(int(c) for c in leg_counts))
 
 
 @jax.jit

@@ -18,7 +18,8 @@ __all__ = ["knn_process", "knn_batch_process", "contains_process",
            "sampling_process", "query_process", "join_process",
            "point2point_process", "track_label_process",
            "route_search_process", "hash_attribute_process",
-           "arrow_conversion_process", "bin_conversion_process"]
+           "arrow_conversion_process", "bin_conversion_process",
+           "length_spheroid_process"]
 
 
 def _point_cols(store, type_name):
@@ -462,3 +463,16 @@ def bin_conversion_process(store, type_name: str, ecql=None,
     query results as BIN records."""
     return store.bin_query(type_name, ecql or "INCLUDE", track=track,
                            label=label)
+
+
+def length_spheroid_process(store, type_name: str, attribute: str,
+                            ecql=None) -> np.ndarray:
+    """Per-feature WGS84 geodesic length of a geometry attribute
+    (process form of ST_LengthSpheroid); NaN for null geometries."""
+    from .st_functions import st_length_spheroid
+    res = store.query(Query(type_name, ecql or "INCLUDE"))
+    if res.batch is None or res.n == 0:
+        return np.empty(0, np.float64)
+    col = res.batch.col(attribute)
+    return np.array([st_length_spheroid(g) if (g := col.value(i)) is not None
+                     else np.nan for i in range(res.batch.n)], np.float64)

@@ -16,9 +16,10 @@ import math
 
 import numpy as np
 
-from ..geometry import (Envelope, Geometry, LineString, MultiPoint, Point,
-                        Polygon, parse_wkt, to_wkt)
-from ..geometry.base import _point_segments_dist2
+from ..geometry import (Envelope, Geometry, LineString, MultiLineString,
+                        MultiPoint, MultiPolygon, Point, Polygon, parse_wkt,
+                        to_wkt)
+from ..geometry.base import _Multi, _point_segments_dist2
 
 __all__ = [
     "st_contains", "st_covers", "st_crosses", "st_disjoint", "st_equals",
@@ -29,7 +30,8 @@ __all__ = [
     "st_closest_point", "st_translate", "st_point", "st_make_bbox",
     "st_geom_from_wkt", "st_as_text", "st_x", "st_y",
     "st_relate", "st_relate_bool", "st_buffer", "st_buffer_point",
-    "st_distance_spheroid", "st_cast_to_point", "st_cast_to_linestring",
+    "st_distance_spheroid", "st_length_spheroid",
+    "st_antimeridian_safe_geom", "st_cast_to_point", "st_cast_to_linestring",
     "st_cast_to_polygon", "st_cast_to_geometry", "st_as_binary",
     "st_geom_from_wkb", "st_as_geojson", "SQL_SCALARS",
     "contains_points", "distance_points",
@@ -309,6 +311,105 @@ def st_as_geojson(g: Geometry) -> str:
     return json.dumps(to_geojson(g))
 
 
+def st_length_spheroid(g: Geometry) -> float:
+    """Geodesic length of a line geometry on the WGS84 spheroid in
+    meters: Vincenty distance summed over consecutive vertices of every
+    part (the reference's ST_LengthSpheroid)."""
+    if isinstance(g, (Point, MultiPoint)):
+        return 0.0
+    total = 0.0
+    for coords in g.coords_list():
+        for i in range(len(coords) - 1):
+            total += st_distance_spheroid(Point(*coords[i]),
+                                          Point(*coords[i + 1]))
+    return float(total)
+
+
+def _clip_halfplane(ring: np.ndarray, east: bool) -> np.ndarray | None:
+    """Sutherland-Hodgman clip of a closed ring against the vertical
+    line x=180, keeping x>=180 (east=True) or x<=180 (east=False)."""
+    pts = ring[:-1] if len(ring) > 1 and np.array_equal(ring[0], ring[-1]) \
+        else ring
+
+    def inside(p):
+        return p[0] >= 180.0 if east else p[0] <= 180.0
+
+    out: list = []
+    for i in range(len(pts)):
+        a, b = pts[i - 1], pts[i]
+        ain, bin_ = inside(a), inside(b)
+        if bin_:
+            if not ain:
+                out.append(_cross_at_180(a, b))
+            out.append(b)
+        elif ain:
+            out.append(_cross_at_180(a, b))
+    if len(out) < 3:
+        return None
+    return np.asarray(out, np.float64)
+
+
+def _cross_at_180(a, b):
+    t = (180.0 - a[0]) / (b[0] - a[0])
+    return (180.0, a[1] + t * (b[1] - a[1]))
+
+
+def _split_line_at_180(coords: np.ndarray) -> list[np.ndarray]:
+    """Cut a linestring's coordinates wherever a segment crosses x=180,
+    duplicating the crossing point into both pieces."""
+    pieces: list[list] = [[coords[0]]]
+    for i in range(1, len(coords)):
+        a, b = coords[i - 1], coords[i]
+        if (a[0] - 180.0) * (b[0] - 180.0) < 0:
+            x = _cross_at_180(a, b)
+            pieces[-1].append(x)
+            pieces.append([x])
+        pieces[-1].append(b)
+    return [np.asarray(p, np.float64) for p in pieces if len(p) >= 2]
+
+
+def st_antimeridian_safe_geom(g: Geometry) -> Geometry:
+    """Split a geometry that extends past the antimeridian into parts
+    that each live inside [-180, 180] (the reference's
+    st_antimeridianSafeGeom). Input uses the continuous-longitude
+    convention (a bbox spanning the dateline runs e.g. 170..190); the
+    overflow east of x=180 is clipped off and translated by -360, so
+    area/length are preserved and point-in-polygon tests work in the
+    standard domain."""
+    env = g.envelope
+    if env.is_empty or env.xmax <= 180.0:
+        return g
+    if isinstance(g, Point):
+        return Point(g.x - 360.0, g.y) if g.x > 180.0 else g
+    if isinstance(g, _Multi):
+        parts: list[Geometry] = []
+        for p in g.parts:
+            safe = st_antimeridian_safe_geom(p)
+            parts.extend(safe.parts if isinstance(safe, _Multi) else [safe])
+        return parts[0] if len(parts) == 1 else type(g)(parts)
+    if isinstance(g, LineString):
+        pieces = _split_line_at_180(g.coords)
+        lines = [LineString(p - [360.0, 0.0] if p[:, 0].max() > 180.0 else p)
+                 for p in pieces]
+        return lines[0] if len(lines) == 1 else MultiLineString(lines)
+    if isinstance(g, Polygon):
+        polys: list[Polygon] = []
+        for east in (False, True):
+            shell = _clip_halfplane(g.shell, east)
+            if shell is None:
+                continue
+            holes = [h for h in (_clip_halfplane(h, east) for h in g.holes)
+                     if h is not None]
+            if east:
+                shell = shell - [360.0, 0.0]
+                holes = [h - [360.0, 0.0] for h in holes]
+            polys.append(Polygon(shell, holes))
+        if not polys:
+            return g
+        return polys[0] if len(polys) == 1 else MultiPolygon(polys)
+    return g
+
+
 # SQL scalar registry: SELECT-list ST_* calls resolve here (uppercased
 # SQL name -> python fn taking (geometry_value, *literal_args)); the
 # SQLSpatialAccessorFunctions / CastFunctions / OutputFunctions /
@@ -339,6 +440,8 @@ SQL_SCALARS = {
     "ST_CLOSESTPOINT": lambda g, o: st_closest_point(g, o),
     "ST_RELATE": lambda g, o: st_relate(g, o),
     "ST_RELATEBOOL": lambda g, o, p: st_relate_bool(g, o, str(p)),
+    "ST_LENGTHSPHEROID": st_length_spheroid,
+    "ST_ANTIMERIDIANSAFEGEOM": st_antimeridian_safe_geom,
 }
 
 

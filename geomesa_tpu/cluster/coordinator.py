@@ -455,6 +455,37 @@ class ClusterDataStore(DataStore):
         self._registry.counter("cluster.writes.routed", routed)
         return self.lsn_vector()
 
+    def write_many(self, type_name: str,
+                   pairs: list[tuple[FeatureBatch, list | None]]):
+        """Routed group commit: coalesce every staged batch's slices
+        per owning group BEFORE writing, so a fused ingest group costs
+        each group ONE ``write_many`` (one journal/fsync decision, one
+        admission pass) instead of one write per caller batch."""
+        pairs = [(b, v) for b, v in pairs if b is not None and b.n]
+        if not pairs:
+            return None
+        sft = self.get_schema(type_name)
+        per_group: list[list] = [[] for _ in self._groups]
+        routed = 0
+        for batch, vis in pairs:
+            owners = self._part.owners_for_batch(sft, batch)
+            vis_arr = (np.asarray(vis, dtype=object)
+                       if vis is not None else None)
+            for gi in np.unique(owners):
+                rows = np.flatnonzero(owners == gi)
+                sub = batch if len(rows) == batch.n else batch.take(rows)
+                sv = None if vis_arr is None else list(vis_arr[rows])
+                per_group[int(gi)].append((sub, sv))
+                routed += len(rows)
+        for gi, (name, group) in enumerate(zip(self._names,
+                                               self._groups)):
+            if not per_group[gi]:
+                continue
+            ret = group.write_many(type_name, per_group[gi])
+            self._bump_lsn(name, group, ret)
+        self._registry.counter("cluster.writes.routed", routed)
+        return self.lsn_vector()
+
     def delete(self, type_name: str, ids):
         """Broadcast: geometry-routed rows cannot be re-owned from ids
         alone, and deleting absent ids is a no-op everywhere."""
@@ -551,6 +582,68 @@ class ClusterDataStore(DataStore):
             out.missing_z_ranges = missing["z_ranges"]
             return out
         return total
+
+    # -- distributed SQL legs ----------------------------------------------
+
+    def sql_partial(self, stmt: str, type_name: str = "") \
+            -> tuple[dict, dict | None]:
+        """Scatter one partial-aggregate SQL leg per shard group (the
+        sql/distributed.py decomposition): remote groups evaluate via
+        their own ``sql_partial`` endpoint, in-process groups run the
+        leg directly. Returns ``(partials_by_group, missing)`` under
+        the standard partial-results contract."""
+        from ..audit import audit_query, delegated_scope
+        from ..sql.distributed import partial_aggregate
+        t0 = time.perf_counter()
+
+        def make_fn(name, group):
+            def leg():
+                fn = getattr(group, "sql_partial", None)
+                if callable(fn):
+                    return fn(stmt)
+                return partial_aggregate(
+                    group, stmt,
+                    query_kwargs=self._ryw_kwargs(name, group))
+            return leg
+
+        with delegated_scope():
+            results, failures = self._scatter(make_fn)
+        missing = self._missing(failures)
+        audit_query(self.audit, "cluster", type_name, stmt, None, 0.0,
+                    (time.perf_counter() - t0) * 1000,
+                    int(sum(r.get("n", 0) for r in results.values())),
+                    index="sql-partial")
+        return results, missing
+
+    def sql_join_partial(self, spec: dict, type_name: str = "") \
+            -> tuple[dict, dict | None]:
+        """Scatter one broadcast-join leg per shard group: each group
+        joins the shipped small side against its local slice of the
+        big side. Same contract as ``sql_partial``."""
+        from ..audit import audit_query, delegated_scope
+        from ..sql.distributed import join_partial_leg
+        t0 = time.perf_counter()
+
+        def make_fn(name, group):
+            def leg():
+                fn = getattr(group, "sql_join_partial", None)
+                if callable(fn):
+                    return fn(spec)
+                return join_partial_leg(
+                    group, spec,
+                    query_kwargs=self._ryw_kwargs(name, group))
+            return leg
+
+        with delegated_scope():
+            results, failures = self._scatter(make_fn)
+        missing = self._missing(failures)
+        audit_query(self.audit, "cluster", type_name,
+                    spec.get("sql", ""), None, 0.0,
+                    (time.perf_counter() - t0) * 1000,
+                    int(sum(r.get("n", r.get("count", 0))
+                            for r in results.values())),
+                    index="sql-join-partial")
+        return results, missing
 
     def count(self, type_name: str) -> int:
         results, failures = self._scatter(

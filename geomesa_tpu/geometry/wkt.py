@@ -170,6 +170,7 @@ def parse_wkt(s: str) -> Geometry:
 
 
 def _fmt(v: float) -> str:
+    v = float(v)
     if not np.isfinite(v):
         return repr(v)
     if v == int(v) and abs(v) < 1e15:

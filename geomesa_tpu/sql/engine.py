@@ -43,9 +43,18 @@ __all__ = ["SqlEngine", "SqlResult"]
 
 @dataclasses.dataclass
 class SqlResult:
-    """Columnar result table."""
+    """Columnar result table.
+
+    ``plan`` is the EXPLAIN surface: what was pushed down, which legs
+    ran, what merged where (or why execution stayed local). ``complete``
+    / ``missing_groups`` / ``missing_z_ranges`` carry the cluster
+    partial-results contract when the store allows partial answers."""
     names: list[str]
     columns: dict[str, np.ndarray]
+    plan: dict | None = None
+    complete: bool = True
+    missing_groups: list = dataclasses.field(default_factory=list)
+    missing_z_ranges: list = dataclasses.field(default_factory=list)
 
     @property
     def n(self) -> int:
@@ -236,6 +245,45 @@ def _group_hull(col, idx, ginv, ng):
     return out
 
 
+def _group_extent(col, idx, ginv, ng):
+    """Per-group bounding envelope (ST_Extent): vectorized min/max
+    folds over point coordinates or geometry bounds, one box polygon
+    per group, NULL for empty groups. An envelope fold is associative,
+    which is what lets the cluster tier merge per-shard extents
+    exactly."""
+    from ..geometry.base import Envelope
+    if idx is None:
+        idx = np.arange(col.n, dtype=np.int64)
+    safe = np.where(idx < 0, 0, idx)
+    valid = np.asarray(col.valid)[safe] & (idx >= 0)
+    if isinstance(col, PointColumn):
+        x, y = np.asarray(col.x, np.float64)[safe], \
+            np.asarray(col.y, np.float64)[safe]
+        bx = np.stack([x, y, x, y], axis=1)
+    else:
+        bx = np.asarray(col.bounds, np.float64)[safe]
+    out = np.empty(ng, dtype=object)
+    out[:] = None
+    if not valid.any():
+        return out
+    g = ginv[valid]
+    vb = bx[valid]
+    # segment reduce: one argsort (releases the GIL — shard legs fold
+    # their extents concurrently) + reduceat per bound, instead of the
+    # scalar-looped ufunc.at
+    order = np.argsort(g, kind="stable")
+    gsorted = g[order]
+    vb = vb[order]
+    starts = np.flatnonzero(np.diff(gsorted, prepend=gsorted[0] - 1))
+    present = gsorted[starts]
+    lo = np.minimum.reduceat(vb[:, :2], starts, axis=0)
+    hi = np.maximum.reduceat(vb[:, 2:], starts, axis=0)
+    for i, gi in enumerate(present):
+        out[gi] = Envelope(lo[i, 0], lo[i, 1],
+                           hi[i, 0], hi[i, 1]).to_polygon()
+    return out
+
+
 def _equi_pairs(acol, bcol) -> np.ndarray:
     """(a_row, b_row) match pairs of an equi-join ON a.col = b.col:
     unify both sides' value domains (dictionary codes for strings),
@@ -334,9 +382,32 @@ class SqlEngine:
 
     def query(self, text: str) -> SqlResult:
         sel = parse_sql(text)
-        if sel.joins:
-            return self._join_query(sel)
-        return self._single_table(sel)
+        reason = None
+        cluster = self._cluster_store()
+        if cluster is not None:
+            from .distributed import try_distributed
+            out, reason = try_distributed(self, cluster, sel, text)
+            if out is not None:
+                return out
+        res = self._join_query(sel) if sel.joins else \
+            self._single_table(sel)
+        if res.plan is None:
+            res.plan = {"mode": ("cluster-materialize"
+                                 if cluster is not None else "local"),
+                        "distributed": False}
+            if reason:
+                res.plan["fallback_reason"] = reason
+        return res
+
+    def _cluster_store(self):
+        """The store as a ClusterDataStore, or None — the gate for the
+        distributed planner."""
+        try:
+            from ..cluster.coordinator import ClusterDataStore
+        except ImportError:          # pragma: no cover
+            return None
+        return self.store if isinstance(self.store, ClusterDataStore) \
+            else None
 
     # -- single table ------------------------------------------------------
 
@@ -386,13 +457,15 @@ class SqlEngine:
         bincount / min.at / max.at; hull pooling for convex_hull). idx
         indirects into col (None = direct); -1 rows are NULL."""
         if it.agg not in ("count", "sum", "avg", "min", "max",
-                          "convex_hull"):
+                          "convex_hull", "extent"):
             raise ValueError(f"not an aggregate: {it.name} (HAVING "
                              f"terms must aggregate or be group keys)")
         if it.agg == "count" and it.expr == "*":
             return np.bincount(ginv, minlength=ng).astype(np.int64)
         if it.agg == "convex_hull":
             return _group_hull(col, idx, ginv, ng)
+        if it.agg == "extent":
+            return _group_extent(col, idx, ginv, ng)
         valid, vals, _, _ = _gather(col, idx)
         if it.agg == "count":
             return np.bincount(ginv, weights=valid.astype(np.float64),
@@ -410,7 +483,16 @@ class SqlEngine:
             fill = np.inf if it.agg == "min" else -np.inf
             out = np.full(ng, fill)
             op = np.minimum if it.agg == "min" else np.maximum
-            op.at(out, ginv[valid], vals[valid])
+            vr = np.flatnonzero(valid)
+            if len(vr):
+                # segment reduce via one argsort (releases the GIL, so
+                # concurrent shard legs overlap) + reduceat, instead of
+                # the scalar-looped ufunc.at
+                order = vr[np.argsort(ginv[vr], kind="stable")]
+                gs = ginv[order]
+                starts = np.flatnonzero(
+                    np.diff(gs, prepend=gs[0] - 1))
+                out[gs[starts]] = op.reduceat(vals[order], starts)
         # SQL semantics: a group with no non-null values yields NULL
         res = np.empty(ng, dtype=object)
         for g in range(ng):
@@ -456,14 +538,32 @@ class SqlEngine:
                                      for n in names})
         n = batch.n
         gid = np.zeros(n, dtype=np.int64)
+        bound = 1
         for k in keys:
             codes, _ = _factorize(batch.col(k))
-            gid = gid * (int(codes.max()) + 1) + codes
-            # re-compact so multi-key composites never overflow int64
-            _, gid = np.unique(gid, return_inverse=True)
-        uniq, rep, ginv = np.unique(gid, return_index=True,
-                                    return_inverse=True)
-        ng = len(uniq)
+            cmax = int(codes.max()) + 1
+            if bound > (1 << 60) // max(cmax, 1):
+                # re-compact so multi-key composites never overflow
+                _, gid = np.unique(gid, return_inverse=True)
+                bound = int(gid.max()) + 1
+            gid = gid * cmax + codes
+            bound *= cmax
+        if bound <= max(4 * n, 1 << 20):
+            # small code domain: O(n) bincount compaction instead of
+            # the O(n log n) argsort inside np.unique
+            counts = np.bincount(gid, minlength=bound)
+            present = np.flatnonzero(counts)
+            remap = np.empty(bound, np.int64)
+            remap[present] = np.arange(len(present), dtype=np.int64)
+            ginv = remap[gid]
+            member = np.empty(bound, np.int64)
+            member[gid] = np.arange(n, dtype=np.int64)
+            rep = member[present]   # any member row represents its group
+            ng = len(present)
+        else:
+            uniq, rep, ginv = np.unique(gid, return_index=True,
+                                        return_inverse=True)
+            ng = len(uniq)
 
         def col_of(it):
             return batch.col(it.expr.split(".")[-1]) \
@@ -498,11 +598,13 @@ class SqlEngine:
             if it.agg == "count" and it.expr == "*":
                 cols[name] = np.array([n], dtype=np.int64)
                 continue
-            if it.agg == "convex_hull":
+            if it.agg in ("convex_hull", "extent"):
                 if batch is None or n == 0:
                     cols[name] = np.array([None], dtype=object)
                 else:
-                    cols[name] = _group_hull(
+                    fn = _group_hull if it.agg == "convex_hull" \
+                        else _group_extent
+                    cols[name] = fn(
                         batch.col(it.expr.split(".")[-1]), None,
                         np.zeros(n, dtype=np.int64), 1)
                 continue

@@ -184,9 +184,11 @@ _RESERVED = {"FROM", "JOIN", "ON", "WHERE", "ORDER", "GROUP", "LIMIT",
              "INNER", "LEFT", "OUTER", "HAVING"}
 
 # geometry aggregates (the reference's ConvexHull UDAF,
-# geomesa-spark-sql/.../udaf/ConvexHull.scala)
+# geomesa-spark-sql/.../udaf/ConvexHull.scala, plus the ST_Extent-style
+# envelope fold)
 _GEOM_AGGS = {"ST_CONVEXHULL": "convex_hull", "CONVEXHULL": "convex_hull",
-              "CONVEX_HULL": "convex_hull"}
+              "CONVEX_HULL": "convex_hull",
+              "ST_EXTENT": "extent", "EXTENT": "extent"}
 
 
 class _Parser:
@@ -325,7 +327,7 @@ class _Parser:
             self.t.expect("lparen")
             col = self._name()
             self.t.expect("rparen")
-            return SelectItem(col, "convex_hull", self._opt_alias())
+            return SelectItem(col, _GEOM_AGGS[v.upper()], self._opt_alias())
         if k == "word" and v.upper() in _SQL_SCALARS \
                 and self.t.peek(1)[0] == "lparen":
             fn = self.t.next()[1].upper()

@@ -525,6 +525,26 @@ class RemoteDataStore(DataStore):
         return int(self._json("GET", f"/rest/count/{quote(type_name)}")
                    ["count"])
 
+    # -- distributed SQL legs ----------------------------------------------
+    # POST bodies, but read-only: idempotent=True keeps them eligible
+    # for the client's retry/hedge machinery
+
+    def sql_partial(self, stmt: str) -> dict:
+        """One shard group's partial-aggregate leg, evaluated server-
+        side next to the data (sql/distributed.py wire format)."""
+        _, data = self._request("POST", "/rest/sql",
+                                params={"mode": "partial"},
+                                body=stmt.encode(), idempotent=True)
+        return json.loads(data.decode())
+
+    def sql_join_partial(self, spec: dict) -> dict:
+        """One shard group's broadcast-join leg: the spec carries the
+        statement plus the encoded small side."""
+        _, data = self._request("POST", "/rest/sql/join-partial",
+                                body=json.dumps(spec).encode(),
+                                idempotent=True)
+        return json.loads(data.decode())
+
     def query_count(self, q: Query | str,
                     type_name: str | None = None) -> int:
         if isinstance(q, str):

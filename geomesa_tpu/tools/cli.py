@@ -281,9 +281,18 @@ def cmd_sql(args) -> int:
     """Run a SQL SELECT against the store (spark-sql surface analog)."""
     from ..sql import SqlEngine
     res = SqlEngine(_store(args)).query(args.query)
+    if getattr(args, "explain", False):
+        # EXPLAIN surface: what pushed down, which legs ran, what
+        # merged where (or why execution stayed local)
+        print(json.dumps(res.plan or {"mode": "local"}, indent=2,
+                         default=str))
+        return 0
     print("\t".join(res.names))
     for row in res.rows():
         print("\t".join("" if v is None else str(v) for v in row))
+    if not res.complete:
+        print(f"# PARTIAL result - missing groups: "
+              f"{','.join(res.missing_groups)}", file=sys.stderr)
     return 0
 
 
@@ -714,7 +723,10 @@ def main(argv=None) -> int:
     add("density", cmd_density, name_arg, cql_arg,
         (["--bbox"], {"required": True}),
         (["--size"], {"required": True}))
-    add("sql", cmd_sql, (["query"], {"help": "SELECT statement"}))
+    add("sql", cmd_sql, (["query"], {"help": "SELECT statement"}),
+        (["--explain"], {"action": "store_true",
+                         "help": "print the distributed plan instead "
+                                 "of rows"}))
     add("serve", cmd_serve,
         (["--host"], {"default": "127.0.0.1"}),
         (["--port"], {"type": int, "default": 8080}))

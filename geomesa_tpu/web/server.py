@@ -534,6 +534,14 @@ class GeoMesaWebServer:
             return self._cache(method, parts[1:], params)
         if parts and parts[0] == "cq":
             return self._cq(method, parts[1:], params, body)
+        if parts == ["sql", "join-partial"]:
+            # one shard-group leg of a distributed broadcast join:
+            # this server joins the shipped small side against its
+            # local slice of the big side
+            from ..sql.distributed import join_partial_leg
+            spec = json.loads(body.decode()) if body else {}
+            return 200, "application/json", _j(
+                join_partial_leg(self.store, spec))
         if parts == ["sql"]:
             # POST body or ?q= : a SELECT with ST_* predicates/joins
             stmt = (body.decode() if method == "POST" and body
@@ -541,11 +549,23 @@ class GeoMesaWebServer:
             if not stmt.strip():
                 return 400, "application/json", _j(
                     {"error": "missing SQL statement"})
+            if params.get("mode", [""])[0] == "partial":
+                # one shard-group leg of a distributed aggregate:
+                # mergeable partials computed next to the data
+                from ..sql.distributed import partial_aggregate
+                return 200, "application/json", _j(
+                    partial_aggregate(self.store, stmt))
             from ..sql import SqlEngine
             res = SqlEngine(self.store).query(stmt)
-            return 200, "application/json", _j(
-                {"columns": res.names,
-                 "rows": [list(r) for r in res.rows()]})
+            payload = {"columns": res.names,
+                       "rows": [list(r) for r in res.rows()]}
+            if res.plan is not None:
+                payload["plan"] = res.plan
+            if not res.complete:
+                payload["complete"] = False
+                payload["missing_groups"] = res.missing_groups
+                payload["missing_z_ranges"] = res.missing_z_ranges
+            return 200, "application/json", _j(payload)
         if parts and parts[0] == "wal":
             return self._wal(method, parts[1:], params)
         if parts and parts[0] == "integrity":

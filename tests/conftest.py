@@ -69,3 +69,7 @@ def pytest_configure(config):
         "engine + react loop, stall watchdog, continuous profiler, "
         "runtime telemetry, metrics cardinality guard; select with "
         "-m health)")
+    config.addinivalue_line(
+        "markers", "sql: distributed SQL suites (partial-aggregate "
+        "pushdown, broadcast spatial joins, plan surface, partial "
+        "contract over SQL legs; select with -m sql)")

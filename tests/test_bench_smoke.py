@@ -245,3 +245,27 @@ def test_config18_health_smoke():
     assert r["cleared"] is True
     assert r["restored_exactly"] is True
     assert "gates_pass" in c
+
+
+@pytest.mark.bench_smoke
+@pytest.mark.sql
+@pytest.mark.cluster
+def test_config19_distributed_sql_smoke():
+    rng = np.random.default_rng(52)
+    c = bench.bench_config19(rng, n=5000, reps=2)
+    # the >=2x speedup gate only means something at the full 2M-row
+    # run; at toy sizes assert exactness and the structural contracts
+    a = c["aggregate"]
+    assert a["exact"] is True
+    assert a["plan_modes"] == ["distributed-aggregate"]
+    assert a["single_s"] > 0 and a["cluster_pull_s"] > 0
+    assert a["distributed_s"] > 0
+    j = c["join"]
+    assert j["exact"] is True
+    assert j["plan_modes"] == ["broadcast-join"]
+    p = c["partial"]
+    assert p["typed_or_flagged_only"] is True
+    assert p["silently_wrong"] == 0
+    assert p["typed_errors_knob_off"] == p["queries"] // 2
+    assert p["partial_flagged_knob_on"] == p["queries"] // 2
+    assert "gates_pass" in c

@@ -329,6 +329,54 @@ class TestLsnVector:
             ship.stop()
 
 
+class TestRoutedWriteMany:
+    def test_staged_batches_coalesce_to_one_write_many_per_group(self):
+        """The routed group commit: N staged batches must cost each
+        owning group exactly ONE write_many call (one journal/fsync
+        decision), not one write per caller batch — with every row
+        landing on its z-prefix owner."""
+        class Spy(InMemoryDataStore):
+            def __init__(self):
+                super().__init__()
+                self.wm_calls = 0
+
+            def write_many(self, type_name, pairs):
+                self.wm_calls += 1
+                return super().write_many(type_name, pairs)
+
+        sft = parse_spec("pts", SPEC)
+        groups = [Spy() for _ in range(4)]
+        cluster = ClusterDataStore(groups)
+        cluster.create_schema(sft)
+        oracle = InMemoryDataStore()
+        oracle.create_schema(sft)
+        rng = np.random.default_rng(3)
+        pairs, total = [], 0
+        for k in range(8):
+            m = 50
+            ids = np.array([f"b{k}_{i}" for i in range(m)], dtype=object)
+            b = FeatureBatch.from_dict(sft, ids, {
+                "geom": (rng.uniform(-170, 170, m),
+                         rng.uniform(-80, 80, m)),
+                "dtg": np.full(m, 1_600_000_000_000, np.int64),
+                "name": np.array([f"n{i % 5}" for i in range(m)],
+                                 dtype=object)})
+            pairs.append((b, None))
+            oracle.write("pts", b)
+            total += m
+        cluster.write_many("pts", pairs)
+        # exactly one coalesced group commit per owning group
+        assert [g.wm_calls for g in groups] == [1, 1, 1, 1]
+        # no rows lost or duplicated, and routing matches plain write
+        assert cluster.query_count("INCLUDE", "pts") == total
+        got = set(cluster.query("INCLUDE", "pts").ids.astype(str))
+        want = set(oracle.query("INCLUDE", "pts").ids.astype(str))
+        assert got == want
+        per = [g.count("pts") for g in groups]
+        assert sum(per) == total and all(p > 0 for p in per)
+        cluster.close()
+
+
 # -- federation: two web servers, one cluster:// client ----------------------
 
 class TestFederation:

@@ -719,3 +719,141 @@ class TestPartitionedSpatialJoin:
         pp = partitioned_dwithin_join(ax, ay, bx, by, r,
                                       target_per_cell=500)
         assert set(map(tuple, pp.tolist())) == want
+
+
+class TestSpheroidAndAntimeridian:
+    """ST_* parity additions: WGS84 geodesic length and
+    antimeridian-safe splitting, via both the SQL function table and
+    the analytics process surface."""
+
+    def test_length_spheroid_oracle_values(self):
+        from geomesa_tpu.analytics import st_length_spheroid
+        from geomesa_tpu.geometry import LineString, Point
+        # one degree of longitude along the equator on WGS84
+        eq = st_length_spheroid(
+            LineString(np.array([[0.0, 0.0], [1.0, 0.0]])))
+        assert eq == pytest.approx(111_319.4908, rel=1e-6)
+        # one degree of latitude along a meridian (flattening shows up)
+        mer = st_length_spheroid(
+            LineString(np.array([[0.0, 0.0], [0.0, 1.0]])))
+        assert mer == pytest.approx(110_574.3886, rel=1e-6)
+        assert mer < eq  # oblate: N-S degree shorter at the equator
+        assert st_length_spheroid(Point(3.0, 4.0)) == 0.0
+        # additive over vertices
+        two = st_length_spheroid(LineString(
+            np.array([[0.0, 0.0], [1.0, 0.0], [2.0, 0.0]])))
+        assert two == pytest.approx(2 * eq, rel=1e-9)
+
+    def test_length_spheroid_sql_and_process(self):
+        from geomesa_tpu.analytics import length_spheroid_process
+        from geomesa_tpu.features import parse_spec
+        from geomesa_tpu.sql import SqlEngine
+        from geomesa_tpu.store import InMemoryDataStore
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("tracks", "*line:LineString:srid=4326"))
+        ds.write_dict("tracks", ["t0", "t1"], {
+            "line": ["LINESTRING (0 0, 1 0)", "LINESTRING (0 0, 0 1)"]})
+        r = SqlEngine(ds).query(
+            "SELECT ST_LengthSpheroid(line) AS km FROM tracks")
+        got = sorted(float(v) for v in r.column("km"))
+        assert got[0] == pytest.approx(110_574.3886, rel=1e-6)
+        assert got[1] == pytest.approx(111_319.4908, rel=1e-6)
+        proc = length_spheroid_process(ds, "tracks", "line")
+        assert sorted(proc.tolist()) == pytest.approx(got, rel=1e-12)
+
+    def test_antimeridian_polygon_split_preserves_area(self):
+        from geomesa_tpu.analytics import st_antimeridian_safe_geom
+        from geomesa_tpu.geometry import MultiPolygon, Polygon
+        from geomesa_tpu.geometry.wkt import parse_wkt
+        # a 20x20-degree box straddling the antimeridian (170..190)
+        g = parse_wkt("POLYGON ((170 -10, 190 -10, 190 10, 170 10, "
+                      "170 -10))")
+        safe = st_antimeridian_safe_geom(g)
+        assert isinstance(safe, MultiPolygon)
+        areas = sorted(p.area for p in safe.parts)
+        assert areas == pytest.approx([200.0, 200.0])
+        xs = np.concatenate([p.shell[:, 0] for p in safe.parts])
+        assert xs.min() >= -180.0 and xs.max() <= 180.0
+        # both halves land where they should
+        assert any(p.shell[:, 0].max() <= -170.0 for p in safe.parts)
+        assert any(p.shell[:, 0].min() >= 170.0 for p in safe.parts)
+
+    def test_antimeridian_line_point_and_noop(self):
+        from geomesa_tpu.analytics import st_antimeridian_safe_geom
+        from geomesa_tpu.geometry import MultiLineString, Point
+        from geomesa_tpu.geometry.wkt import parse_wkt
+        line = parse_wkt("LINESTRING (175 0, 185 0)")
+        safe = st_antimeridian_safe_geom(line)
+        assert isinstance(safe, MultiLineString)
+        assert len(safe.parts) == 2
+        for part in safe.parts:
+            assert np.abs(part.coords[:, 0]).max() <= 180.0
+        # an eastern-hemisphere point past 180 wraps to negative lons
+        p = st_antimeridian_safe_geom(Point(190.0, 5.0))
+        assert (p.x, p.y) == (-170.0, 5.0)
+        # geometries already in range come back unchanged (identity)
+        ok = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+        assert st_antimeridian_safe_geom(ok) is ok
+
+    def test_antimeridian_sql_surface(self):
+        from geomesa_tpu.features import parse_spec
+        from geomesa_tpu.sql import SqlEngine
+        from geomesa_tpu.store import InMemoryDataStore
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("zones", "*area:Polygon:srid=4326"))
+        ds.write_dict("zones", ["z0"], {
+            "area": ["POLYGON ((170 -10, 190 -10, 190 10, 170 10, "
+                     "170 -10))"]})
+        from geomesa_tpu.geometry import MultiPolygon
+        r = SqlEngine(ds).query(
+            "SELECT ST_AntimeridianSafeGeom(area) AS g FROM zones")
+        assert isinstance(r.column("g")[0], MultiPolygon)
+
+
+class TestExtentAggregate:
+    """ST_Extent: the bounding-envelope aggregate, grouped and
+    ungrouped, against a manually folded envelope oracle."""
+
+    @pytest.fixture()
+    def eng_pts(self):
+        from geomesa_tpu.features import parse_spec
+        from geomesa_tpu.sql import SqlEngine
+        from geomesa_tpu.store import InMemoryDataStore
+        rng = np.random.default_rng(17)
+        n = 500
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("pts", "name:String,"
+                                    "*geom:Point:srid=4326"))
+        x, y = rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)
+        names = [f"g{i % 4}" for i in range(n)]
+        ds.write_dict("pts", [f"f{i}" for i in range(n)],
+                      {"name": names, "geom": (x, y)})
+        return SqlEngine(ds), x, y, np.array(names)
+
+    def test_ungrouped_extent_is_global_bbox(self, eng_pts):
+        eng, x, y, _ = eng_pts
+        r = eng.query("SELECT ST_Extent(geom) AS e FROM pts")
+        assert r.n == 1
+        env = r.column("e")[0].envelope
+        assert (env.xmin, env.xmax) == (x.min(), x.max())
+        assert (env.ymin, env.ymax) == (y.min(), y.max())
+
+    def test_grouped_extent_matches_manual_fold(self, eng_pts):
+        eng, x, y, names = eng_pts
+        r = eng.query("SELECT name, ST_Extent(geom) AS e FROM pts "
+                      "GROUP BY name")
+        got = {r.column("name")[i]: r.column("e")[i].envelope
+               for i in range(r.n)}
+        assert set(got) == set(np.unique(names))
+        for g, env in got.items():
+            sel = names == g
+            assert env.xmin == x[sel].min() and env.xmax == x[sel].max()
+            assert env.ymin == y[sel].min() and env.ymax == y[sel].max()
+
+    def test_extent_in_having(self, eng_pts):
+        eng, _, _, _ = eng_pts
+        # parses and groups; HAVING uses a count alongside the extent
+        r = eng.query("SELECT name, ST_Extent(geom) AS e, COUNT(*) AS n "
+                      "FROM pts GROUP BY name HAVING COUNT(*) > 100")
+        assert r.n >= 1
+        assert all(c > 100 for c in r.column("n"))

@@ -1,0 +1,348 @@
+"""Distributed SQL suites: partial-aggregate pushdown and broadcast
+spatial joins over the cluster plane must be EXACTLY equivalent to the
+same statement against a single store holding all rows — same rows,
+same values, same order where ORDER BY applies — and the partial
+contract must hold over SQL legs (typed error by default, flagged
+``complete=False`` when partials are allowed). Never a silent wrong
+answer."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.cluster import ClusterDataStore, ShardUnavailableError
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.geometry import Polygon
+from geomesa_tpu.sql import SqlEngine
+from geomesa_tpu.sql.distributed import SQL_BROADCAST_ROWS, SQL_DISTRIBUTED
+from geomesa_tpu.store import InMemoryDataStore
+
+pytestmark = [pytest.mark.sql, pytest.mark.cluster]
+
+PTS_SPEC = "*geom:Point:srid=4326,name:String,val:Integer,dtg:Date"
+N = 3000
+
+
+def _box(x0, y0, x1, y1):
+    return Polygon(np.array(
+        [[x0, y0], [x1, y0], [x1, y1], [x0, y1], [x0, y0]], float))
+
+
+def _pts_batch(sft, n=N, seed=7):
+    rng = np.random.default_rng(seed)
+    ids = np.array([f"f{i:05d}" for i in range(n)], dtype=object)
+    names = np.array(["alpha", "bravo", "charlie", "delta", "echo"],
+                     dtype=object)
+    return FeatureBatch.from_dict(sft, ids, {
+        "geom": (rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)),
+        "name": names[rng.integers(0, 5, n)],
+        # unique integer values: deterministic ORDER BY ties, exact sums
+        "val": rng.permutation(n).astype(np.int64),
+        "dtg": np.int64(1_600_000_000_000)
+        + rng.integers(0, 10_000_000, n),
+    })
+
+
+def _zones_batch(sft):
+    boxes = [_box(-160 + 40 * i, -60, -130 + 40 * i, -20)
+             for i in range(8)]
+    return FeatureBatch.from_dict(
+        sft, np.array([f"z{i}" for i in range(8)], dtype=object),
+        {"geom": np.array(boxes, dtype=object),
+         "zname": np.array([f"zone{i}" for i in range(8)], dtype=object),
+         "zval": np.arange(8, dtype=np.int64)})
+
+
+def _hubs_batch(sft, seed=11):
+    rng = np.random.default_rng(seed)
+    return FeatureBatch.from_dict(
+        sft, np.array([f"h{i}" for i in range(6)], dtype=object),
+        {"geom": (rng.uniform(-150, 150, 6), rng.uniform(-60, 60, 6)),
+         "hname": np.array([f"hub{i}" for i in range(6)], dtype=object)})
+
+
+def _seed_stores(cluster, oracle):
+    psft = parse_spec("pts", PTS_SPEC)
+    zsft = parse_spec("zones", "*geom:Polygon:srid=4326,zname:String,"
+                               "zval:Integer")
+    hsft = parse_spec("hubs", "*geom:Point:srid=4326,hname:String")
+    pb, zb, hb = _pts_batch(psft), _zones_batch(zsft), _hubs_batch(hsft)
+    for st in (cluster, oracle):
+        for sft, batch in ((psft, pb), (zsft, zb), (hsft, hb)):
+            st.create_schema(sft)
+            st.write(sft.type_name, batch)
+
+
+@pytest.fixture(scope="module")
+def plane():
+    groups = [InMemoryDataStore() for _ in range(4)]
+    cluster = ClusterDataStore(groups)
+    oracle = InMemoryDataStore()
+    _seed_stores(cluster, oracle)
+    # rows actually land on every shard — otherwise the equivalence
+    # below would not exercise the merge at all
+    assert all(g.count("pts") > 0 for g in groups)
+    yield SqlEngine(cluster), SqlEngine(oracle)
+    cluster.close()
+
+
+def _rows(res):
+    return [tuple(map(str, r)) for r in res.rows()]
+
+
+def _cmp(ce, oe, stmt, ordered=False, mode=None):
+    a, b = ce.query(stmt), oe.query(stmt)
+    assert a.names == b.names
+    ra, rb = _rows(a), _rows(b)
+    if not ordered:
+        ra, rb = sorted(ra), sorted(rb)
+    assert ra == rb, (stmt, ra[:4], rb[:4])
+    assert a.complete is True
+    if mode is not None:
+        assert a.plan is not None and a.plan["mode"] == mode, a.plan
+    return a
+
+
+# -- partial-aggregate pushdown ----------------------------------------------
+
+AGG_SHAPES = [
+    "SELECT name, COUNT(*), SUM(val), MIN(val), MAX(val), AVG(val) "
+    "FROM pts GROUP BY name",
+    "SELECT name, COUNT(val) AS cv FROM pts GROUP BY name",
+    "SELECT name, COUNT(*) AS n FROM pts WHERE val < 1500 GROUP BY name",
+    "SELECT name, COUNT(*) AS n FROM pts GROUP BY name "
+    "HAVING COUNT(*) > 100",
+    # hidden HAVING aggregate (not in the select list)
+    "SELECT name, MIN(val) FROM pts GROUP BY name HAVING COUNT(*) > 550",
+    "SELECT name, ST_ConvexHull(geom) FROM pts GROUP BY name",
+    "SELECT name, ST_Extent(geom) FROM pts GROUP BY name",
+    "SELECT COUNT(*), COUNT(val), SUM(val), MIN(val), MAX(val), "
+    "AVG(val) FROM pts",
+    "SELECT ST_ConvexHull(geom), ST_Extent(geom) FROM pts",
+    "SELECT MIN(dtg), MAX(dtg) FROM pts",
+    # zero matching rows: one all-None/zero row, same as the oracle
+    "SELECT COUNT(*), SUM(val), MIN(val) FROM pts WHERE val < 0",
+]
+
+
+class TestPartialAggregates:
+    @pytest.mark.parametrize("stmt", AGG_SHAPES)
+    def test_equivalent_to_single_store(self, plane, stmt):
+        ce, oe = plane
+        res = _cmp(ce, oe, stmt, mode="distributed-aggregate")
+        assert res.plan["distributed"] is True
+        assert len(res.plan["legs"]) == 4
+
+    def test_order_by_limit_on_aggregate_output(self, plane):
+        ce, oe = plane
+        stmt = ("SELECT name, COUNT(*) AS cnt FROM pts GROUP BY name "
+                "ORDER BY cnt DESC LIMIT 2")
+        _cmp(ce, oe, stmt, ordered=True, mode="distributed-aggregate")
+
+    def test_plan_describes_merge(self, plane):
+        ce, _ = plane
+        res = ce.query("SELECT name, AVG(val) FROM pts GROUP BY name")
+        assert res.plan["merge"] == "by-key"
+        assert any("avg" in p for p in res.plan["partials"])
+
+    def test_kill_switch_falls_back_exactly(self, plane):
+        ce, oe = plane
+        stmt = "SELECT name, SUM(val) FROM pts GROUP BY name"
+        SQL_DISTRIBUTED.set("false")
+        try:
+            res = _cmp(ce, oe, stmt, mode="cluster-materialize")
+            assert res.plan["distributed"] is False
+        finally:
+            SQL_DISTRIBUTED.set(None)
+
+    def test_streamed_order_limit_exact(self, plane):
+        ce, oe = plane
+        stmt = "SELECT __fid__, name, val FROM pts ORDER BY val LIMIT 25"
+        res = _cmp(ce, oe, stmt, ordered=True, mode="distributed-stream")
+        assert res.plan["merge"] == "k-way-stream"
+
+    def test_invalid_statement_raises_like_single_node(self, plane):
+        ce, oe = plane
+        stmt = "SELECT name, SUM(nosuch) FROM pts GROUP BY name"
+        with pytest.raises(Exception) as ea:
+            ce.query(stmt)
+        with pytest.raises(Exception) as eb:
+            oe.query(stmt)
+        assert type(ea.value) is type(eb.value)
+
+
+# -- broadcast spatial joins -------------------------------------------------
+
+JOIN_SHAPES = [
+    ("SELECT COUNT(*) FROM pts p "
+     "JOIN zones z ON ST_Contains(z.geom, p.geom)", False),
+    ("SELECT z.zname, COUNT(*), SUM(p.val) FROM pts p "
+     "JOIN zones z ON ST_Contains(z.geom, p.geom) GROUP BY z.zname",
+     False),
+    ("SELECT p.name, COUNT(*), AVG(p.val) FROM pts p "
+     "JOIN zones z ON ST_Contains(z.geom, p.geom) GROUP BY p.name",
+     False),
+    ("SELECT COUNT(*), SUM(p.val), MIN(p.val), MAX(p.val) FROM pts p "
+     "JOIN zones z ON ST_Contains(z.geom, p.geom)", False),
+    ("SELECT p.__fid__, z.zname, p.val FROM pts p "
+     "JOIN zones z ON ST_Contains(z.geom, p.geom) "
+     "ORDER BY p.val LIMIT 30", True),
+    ("SELECT p.__fid__, z.zname FROM pts p "
+     "JOIN zones z ON ST_Contains(z.geom, p.geom) WHERE p.val < 200",
+     False),
+    ("SELECT h.hname, COUNT(*) FROM pts p "
+     "JOIN hubs h ON ST_DWithin(p.geom, h.geom, 10.0) GROUP BY h.hname",
+     False),
+    ("SELECT COUNT(*) FROM pts p JOIN zones z ON p.name = z.zname",
+     False),
+    ("SELECT p.__fid__, z.zname FROM pts p "
+     "LEFT JOIN zones z ON ST_Contains(z.geom, p.geom) "
+     "WHERE p.val < 100", False),
+    ("SELECT z.zname, COUNT(*) FROM pts p "
+     "LEFT JOIN zones z ON ST_Contains(z.geom, p.geom) "
+     "GROUP BY z.zname", False),
+]
+
+
+class TestBroadcastJoins:
+    @pytest.mark.parametrize("stmt,ordered", JOIN_SHAPES)
+    def test_equivalent_to_single_store(self, plane, stmt, ordered):
+        ce, oe = plane
+        res = _cmp(ce, oe, stmt, ordered=ordered, mode="broadcast-join")
+        assert res.plan["broadcast"]["rows"] <= SQL_BROADCAST_ROWS.as_int()
+
+    def test_small_side_is_the_broadcast_side(self, plane):
+        ce, _ = plane
+        res = ce.query("SELECT COUNT(*) FROM pts p "
+                       "JOIN zones z ON ST_Contains(z.geom, p.geom)")
+        assert res.plan["broadcast"]["table"] == "zones"
+        assert res.plan["broadcast"]["rows"] == 8
+
+    def test_left_join_inner_side_broadcasts(self, plane):
+        ce, oe = plane
+        # zones is the outer anchor; pts is the INNER (right) side, so
+        # broadcasting it is safe — anchor rows stay on their shards
+        stmt = ("SELECT z.zname, p.name FROM zones z "
+                "LEFT JOIN pts p ON ST_Contains(z.geom, p.geom) "
+                "WHERE p.val < 3")
+        res = _cmp(ce, oe, stmt, mode="broadcast-join")
+        assert res.plan["broadcast"]["side"] == "p"
+
+    def test_left_join_outer_anchor_cannot_broadcast(self, plane):
+        ce, oe = plane
+        # threshold admits only zones (8 rows) — but zones is the LEFT
+        # outer anchor, whose unmatched rows must survive per shard, so
+        # it cannot be shipped: exact cluster-materialize fallback
+        stmt = ("SELECT z.zname, p.name FROM zones z "
+                "LEFT JOIN pts p ON ST_Contains(z.geom, p.geom) "
+                "WHERE p.val < 3")
+        SQL_BROADCAST_ROWS.set("100")
+        try:
+            res = _cmp(ce, oe, stmt, mode="cluster-materialize")
+            assert "anchors cannot broadcast" in res.plan["fallback_reason"]
+        finally:
+            SQL_BROADCAST_ROWS.set(None)
+
+    def test_both_sides_large_falls_back(self, plane):
+        ce, oe = plane
+        stmt = ("SELECT COUNT(*) FROM pts p "
+                "JOIN zones z ON ST_Contains(z.geom, p.geom)")
+        SQL_BROADCAST_ROWS.set("1")
+        try:
+            res = _cmp(ce, oe, stmt, mode="cluster-materialize")
+            assert "no broadcastable side" in res.plan["fallback_reason"]
+        finally:
+            SQL_BROADCAST_ROWS.set(None)
+
+
+# -- partial-results contract over SQL legs ----------------------------------
+
+class _Down:
+    """Shard whose every call fails (hedges and retries included)."""
+
+    def close(self):
+        pass
+
+    def __getattr__(self, key):
+        def boom(*a, **kw):
+            raise ConnectionError("injected: shard down")
+        return boom
+
+
+def _wounded(allow_partial):
+    groups = [InMemoryDataStore() for _ in range(4)]
+    cluster = ClusterDataStore(groups, allow_partial=allow_partial)
+    oracle = InMemoryDataStore()
+    _seed_stores(cluster, oracle)
+    cluster._groups[2] = _Down()
+    return cluster, oracle
+
+
+class TestPartialContract:
+    @pytest.mark.parametrize("stmt", [
+        "SELECT name, COUNT(*) FROM pts GROUP BY name",
+        "SELECT COUNT(*) FROM pts p "
+        "JOIN zones z ON ST_Contains(z.geom, p.geom)",
+    ])
+    def test_dead_group_raises_typed_by_default(self, stmt):
+        cluster, _ = _wounded(allow_partial=False)
+        try:
+            with pytest.raises(ShardUnavailableError) as ei:
+                SqlEngine(cluster).query(stmt)
+            assert "shard2" in ei.value.groups
+            assert ei.value.z_ranges
+        finally:
+            cluster.close()
+
+    def test_dead_group_flagged_when_partials_allowed(self):
+        cluster, oracle = _wounded(allow_partial=True)
+        try:
+            res = SqlEngine(cluster).query(
+                "SELECT name, COUNT(*) FROM pts GROUP BY name")
+            assert res.complete is False
+            assert res.missing_groups == ["shard2"]
+            assert res.missing_z_ranges
+            # the surviving legs still merge: strictly fewer rows than
+            # the full answer, never more
+            full = SqlEngine(oracle).query(
+                "SELECT name, COUNT(*) FROM pts GROUP BY name")
+            got = dict(res.rows())
+            want = dict(full.rows())
+            assert set(got) <= set(want)
+            assert all(got[k] <= want[k] for k in got)
+        finally:
+            cluster.close()
+
+
+# -- federation: distributed SQL over REST legs ------------------------------
+
+class TestFederatedSql:
+    def test_rest_legs_match_single_store(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        backends = [InMemoryDataStore(), InMemoryDataStore()]
+        servers = [GeoMesaWebServer(b).start() for b in backends]
+        try:
+            uri = "cluster://" + ",".join(
+                f"127.0.0.1:{s.port}" for s in servers)
+            cluster = ClusterDataStore.from_uri(uri, leg_deadline_s=30)
+            oracle = InMemoryDataStore()
+            _seed_stores(cluster, oracle)
+            ce, oe = SqlEngine(cluster), SqlEngine(oracle)
+            _cmp(ce, oe,
+                 "SELECT name, COUNT(*), SUM(val), AVG(val) FROM pts "
+                 "GROUP BY name", mode="distributed-aggregate")
+            _cmp(ce, oe,
+                 "SELECT name, ST_Extent(geom) FROM pts GROUP BY name",
+                 mode="distributed-aggregate")
+            _cmp(ce, oe,
+                 "SELECT z.zname, COUNT(*) FROM pts p "
+                 "JOIN zones z ON ST_Contains(z.geom, p.geom) "
+                 "GROUP BY z.zname", mode="broadcast-join")
+            _cmp(ce, oe,
+                 "SELECT p.__fid__, z.zname, p.val FROM pts p "
+                 "JOIN zones z ON ST_Contains(z.geom, p.geom) "
+                 "ORDER BY p.val LIMIT 20", ordered=True,
+                 mode="broadcast-join")
+            cluster.close()
+        finally:
+            for s in servers:
+                s.stop()
