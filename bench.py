@@ -145,7 +145,7 @@ REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
                              "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,"
-                             "19,northstar")
+                             "19,20,northstar")
               .split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
@@ -1340,24 +1340,33 @@ def bench_config11(rng, n=None, nq=None):
                                                {"geom": (x, y)}))
     typed = partial = 0
     nq3 = max(nq // 10, 5)
-    for ecql in boxes(seed=114, count=nq3):
-        try:
-            half.query_count(ecql, "pts11")
-        except ShardUnavailableError:
-            typed += 1
-    half_p = ClusterDataStore([live, _Down()], names=["up", "down"],
-                              leg_deadline_s=2, hedge_ms=20,
-                              allow_partial=True)
-    half_p._sfts["pts11"] = sft
-    got_rows = want_rows = 0
-    missing_ranges = []
-    for ecql in boxes(seed=114, count=nq3):
-        c = half_p.query_count(ecql, "pts11")
-        if getattr(c, "complete", True) is False:
-            partial += 1
-            missing_ranges = c.missing_z_ranges
-        got_rows += int(c)
-        want_rows += oracle.query_count(ecql, "pts11")
+    # this phase measures the ALL-legs degraded contract: pin the Z-range
+    # planner off so every query contacts the dead group (with it on, a
+    # selective box legitimately skips "down" and returns the exact
+    # answer — config 20 covers that path)
+    from geomesa_tpu.cluster.coordinator import CLUSTER_PRUNE
+    CLUSTER_PRUNE.set("false")
+    try:
+        for ecql in boxes(seed=114, count=nq3):
+            try:
+                half.query_count(ecql, "pts11")
+            except ShardUnavailableError:
+                typed += 1
+        half_p = ClusterDataStore([live, _Down()], names=["up", "down"],
+                                  leg_deadline_s=2, hedge_ms=20,
+                                  allow_partial=True)
+        half_p._sfts["pts11"] = sft
+        got_rows = want_rows = 0
+        missing_ranges = []
+        for ecql in boxes(seed=114, count=nq3):
+            c = half_p.query_count(ecql, "pts11")
+            if getattr(c, "complete", True) is False:
+                partial += 1
+                missing_ranges = c.missing_z_ranges
+            got_rows += int(c)
+            want_rows += oracle.query_count(ecql, "pts11")
+    finally:
+        CLUSTER_PRUNE.set(None)
     out["degraded"] = {
         "queries": nq3,
         "typed_errors_knob_off": typed,
@@ -2820,6 +2829,10 @@ def bench_config18(rng, n=None, c=None, nq=None, stall_s=None):
     proxy = ChaosProxy("127.0.0.1", srv2.port, seed=18,
                        slow_rate=0.0, slow_s=stall).start()
     WATCHDOG_MIN_MS.set("50")
+    # the stall probe must REACH the proxied leg: pin the Z-range
+    # planner off so the selective probe box is not pruned away from it
+    from geomesa_tpu.cluster.coordinator import CLUSTER_PRUNE
+    CLUSTER_PRUNE.set("false")
     try:
         cluster = ClusterDataStore(
             [InMemoryDataStore(),
@@ -2865,6 +2878,7 @@ def bench_config18(rng, n=None, c=None, nq=None, stall_s=None):
             if hit else None,
             "non_empty_stack": bool(hit and hit[0]["stack"])}
     finally:
+        CLUSTER_PRUNE.set(None)
         WATCHDOG_MIN_MS.set(None)
         proxy.stop()
         srv2.stop()
@@ -3119,6 +3133,182 @@ def bench_config19(rng, n=None, reps=None):
         out["aggregate"]["exact"] and out["join"]["exact"]
         and out["aggregate"]["speedup_vs_pull"] >= 2.0
         and out["partial"]["typed_or_flagged_only"])
+    return out
+
+
+# -- config 20: cost-based planner — Z-pruning + strategy crossover -------
+
+def bench_config20(rng, n=None, reps=None):
+    """What the cost-based planner buys on cluster reads and SQL.
+
+    Phase 1 — Z-range leg pruning at 1/2/4 groups over a
+    selective-vs-broad bbox mix: qps with `geomesa.cluster.prune` on
+    vs off, per-query legs-contacted accounting from the coordinator
+    plan surface, and an id-exactness gate (every pruned answer must
+    match the unpruned one feature-for-feature). The 2x gate is the
+    selective mix at 4 groups — exactly the fan-out the pruner
+    removes.
+
+    Phase 2 — broadcast-vs-materialize crossover at the estimated
+    cardinality boundary: with the threshold above the small side's
+    estimate the planner must choose broadcast-join, below both
+    estimates it must fall back to exact cluster-materialize, and
+    both answers must match the single-store oracle."""
+    from geomesa_tpu.cluster import ClusterDataStore
+    from geomesa_tpu.cluster.coordinator import CLUSTER_PRUNE
+    from geomesa_tpu.cluster.partition import ZPrefixPartitioner
+    from geomesa_tpu.features import FeatureBatch, parse_spec
+    from geomesa_tpu.geometry import Polygon
+    from geomesa_tpu.index.api import Query
+    from geomesa_tpu.sql import SqlEngine
+    from geomesa_tpu.sql.distributed import SQL_BROADCAST_ROWS
+    from geomesa_tpu.store import InMemoryDataStore
+
+    n = n if n is not None else int(
+        os.environ.get("GEOMESA_TPU_BENCH_PLANNER_N", 500_000))
+    reps = reps if reps is not None else max(TRIALS, 3)
+    sft = parse_spec("pts20", "*geom:Point:srid=4326,name:String,"
+                              "val:Integer")
+    ids = np.arange(n).astype(str).astype(object)
+    x = rng.uniform(-170, 170, n)
+    y = rng.uniform(-80, 80, n)
+    names = np.array([f"grp{i}" for i in range(16)], dtype=object)
+    batch = FeatureBatch.from_dict(sft, ids, {
+        "geom": (x, y),
+        "name": names[rng.integers(0, len(names), n)],
+        "val": rng.permutation(n).astype(np.int64),
+    })
+
+    # selective boxes: small, centered on data points, and PROVABLY
+    # single-group at 4 groups (the analytic z-range intersection the
+    # pruner computes — the acceptance shape: 1 bbox -> 1 leg)
+    part4 = ZPrefixPartitioner(4)
+    selective = []
+    for i in rng.permutation(n)[:4000]:
+        box = (x[i] - 1.5, y[i] - 1.5, x[i] + 1.5, y[i] + 1.5)
+        if len(part4.groups_for_ranges(
+                part4.covering_ranges([box]))) == 1:
+            selective.append(box)
+            if len(selective) == 16:
+                break
+    broad = [(-120.0 + 10 * i, -60.0, 40.0 + 10 * i, 60.0)
+             for i in range(4)]
+
+    def _bbox_q(b):
+        return Query("pts20", f"BBOX(geom, {b[0]}, {b[1]}, {b[2]}, "
+                              f"{b[3]})")
+
+    def _mix(cluster, boxes):
+        """One pass over the mix: (elapsed_s, ids_per_box,
+        legs_contacted_total)."""
+        t0 = time.perf_counter()
+        got, legs = [], 0
+        for b in boxes:
+            res = cluster.query(_bbox_q(b))
+            got.append(sorted(res.ids))
+            legs += len(cluster.last_plan()["contacted"])
+        return time.perf_counter() - t0, got, legs
+
+    out = {"n": n, "reps": reps,
+           "selective_boxes": len(selective), "broad_boxes": len(broad)}
+    for n_groups in (1, 2, 4):
+        cluster = ClusterDataStore(
+            [InMemoryDataStore() for _ in range(n_groups)],
+            leg_deadline_s=120)
+        cluster.create_schema(sft)
+        cluster.write("pts20", batch)
+        row = {}
+        for label, boxes in (("selective", selective), ("broad", broad)):
+            per = {}
+            exact = True
+            for knob in ("off", "on"):
+                CLUSTER_PRUNE.set("false" if knob == "off" else None)
+                try:
+                    _mix(cluster, boxes)  # warm
+                    samples, legs = [], 0
+                    for _ in range(reps):
+                        dt, got, legs = _mix(cluster, boxes)
+                        samples.append(dt)
+                    per[knob] = {"qps": round(len(boxes)
+                                              / _p50(samples), 1),
+                                 "legs_contacted": legs}
+                    if knob == "off":
+                        want = got
+                    else:
+                        exact = exact and got == want
+                finally:
+                    CLUSTER_PRUNE.set(None)
+            row[label] = {
+                "qps_unpruned": per["off"]["qps"],
+                "qps_pruned": per["on"]["qps"],
+                "speedup": round(per["on"]["qps"]
+                                 / per["off"]["qps"], 2),
+                "legs_unpruned": per["off"]["legs_contacted"],
+                "legs_pruned": per["on"]["legs_contacted"],
+                "exact": bool(exact),
+            }
+        out[f"{n_groups}_groups"] = row
+        cluster.close()
+
+    # -- phase 2: strategy crossover at the estimate boundary -------------
+    zsft = parse_spec("zones20", "*geom:Polygon:srid=4326,zname:String")
+
+    def _box(x0, y0, w, h):
+        return Polygon(np.array([[x0, y0], [x0 + w, y0],
+                                 [x0 + w, y0 + h], [x0, y0 + h],
+                                 [x0, y0]], float))
+
+    zb = FeatureBatch.from_dict(
+        zsft, np.array([f"z{i}" for i in range(16)], dtype=object),
+        {"geom": np.array([_box(-160 + 20 * (i % 16),
+                                -60 + 30 * (i // 8), 15, 25)
+                           for i in range(16)], dtype=object),
+         "zname": np.array([f"zone{i}" for i in range(16)],
+                           dtype=object)})
+    m = min(n, 100_000)
+    sub = batch.take(np.arange(m))
+    oracle = InMemoryDataStore()
+    cluster = ClusterDataStore([InMemoryDataStore() for _ in range(4)],
+                               leg_deadline_s=120)
+    for st in (oracle, cluster):
+        st.create_schema(sft)
+        st.write("pts20", sub)
+        st.create_schema(zsft)
+        st.write("zones20", zb)
+    stmt = ("SELECT COUNT(*) FROM pts20 p "
+            "JOIN zones20 z ON ST_Contains(z.geom, p.geom)")
+    want = list(SqlEngine(oracle).query(stmt).rows())
+    ce = SqlEngine(cluster)
+    crossover = {}
+    ok = True
+    for label, threshold, mode in (("above_estimate", None,
+                                    "broadcast-join"),
+                                   ("below_estimate", "4",
+                                    "cluster-materialize")):
+        SQL_BROADCAST_ROWS.set(threshold)
+        try:
+            res = ce.query(stmt)
+        finally:
+            SQL_BROADCAST_ROWS.set(None)
+        cost = (res.plan or {}).get("cost", {})
+        crossover[label] = {
+            "mode": res.plan["mode"],
+            "estimated_rows": cost.get("estimated_rows"),
+            "strategy": cost.get("strategy"),
+        }
+        ok = (ok and res.plan["mode"] == mode
+              and cost.get("estimated_rows") is not None
+              and list(res.rows()) == want)
+    crossover["correct"] = bool(ok)
+    out["crossover"] = crossover
+    oracle.close()
+    cluster.close()
+
+    out["gates_pass"] = bool(
+        out["4_groups"]["selective"]["exact"]
+        and out["4_groups"]["broad"]["exact"]
+        and out["4_groups"]["selective"]["speedup"] >= 2.0
+        and out["crossover"]["correct"])
     return out
 
 
@@ -3400,6 +3590,8 @@ def main(argv=None):
         out["configs"]["18_health"] = bench_config18(rng)
     if "19" in CONFIGS:
         out["configs"]["19_distributed_sql"] = bench_config19(rng)
+    if "20" in CONFIGS:
+        out["configs"]["20_planner"] = bench_config20(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

@@ -19,7 +19,8 @@ __all__ = ["knn_process", "knn_batch_process", "contains_process",
            "point2point_process", "track_label_process",
            "route_search_process", "hash_attribute_process",
            "arrow_conversion_process", "bin_conversion_process",
-           "length_spheroid_process"]
+           "length_spheroid_process", "geohash_process",
+           "geohash_decode_process"]
 
 
 def _point_cols(store, type_name):
@@ -476,3 +477,25 @@ def length_spheroid_process(store, type_name: str, attribute: str,
     col = res.batch.col(attribute)
     return np.array([st_length_spheroid(g) if (g := col.value(i)) is not None
                      else np.nan for i in range(res.batch.n)], np.float64)
+
+
+def geohash_process(store, type_name: str, attribute: str,
+                    prec: int = 25, ecql=None) -> np.ndarray:
+    """Per-feature geohash of a geometry attribute at ``prec`` bits
+    (process form of ST_GeoHash); None for null geometries."""
+    from .st_functions import st_geohash
+    res = store.query(Query(type_name, ecql or "INCLUDE"))
+    if res.batch is None or res.n == 0:
+        return np.empty(0, object)
+    col = res.batch.col(attribute)
+    return np.array([st_geohash(g, prec) if (g := col.value(i)) is not None
+                     else None for i in range(res.batch.n)], object)
+
+
+def geohash_decode_process(hashes, prec: int | None = None) -> np.ndarray:
+    """Geohash strings back to cell-bbox polygons (process form of
+    ST_GeomFromGeoHash); None passes through."""
+    from .st_functions import st_geom_from_geohash
+    return np.array([st_geom_from_geohash(h, prec) if h is not None
+                     else None for h in np.asarray(hashes, object)],
+                    object)

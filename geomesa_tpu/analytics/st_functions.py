@@ -34,6 +34,7 @@ __all__ = [
     "st_antimeridian_safe_geom", "st_cast_to_point", "st_cast_to_linestring",
     "st_cast_to_polygon", "st_cast_to_geometry", "st_as_binary",
     "st_geom_from_wkb", "st_as_geojson", "SQL_SCALARS",
+    "st_geohash", "st_geom_from_geohash",
     "contains_points", "distance_points",
 ]
 
@@ -410,6 +411,26 @@ def st_antimeridian_safe_geom(g: Geometry) -> Geometry:
     return g
 
 
+def st_geohash(g: Geometry, prec: int = 25) -> str:
+    """Base-32 geohash of the geometry at ``prec`` BITS of precision
+    (the reference's st_geoHash; GeoHash.scala:25 takes bit precision).
+    Non-point geometries hash their centroid. The rendered string
+    carries ceil(prec/5) characters — the 5-bit base-32 granularity."""
+    from ..geohash import encode
+    c = g if isinstance(g, Point) else g.centroid
+    chars = max(1, -(-int(prec) // 5))
+    return encode(float(c.x), float(c.y), chars)
+
+
+def st_geom_from_geohash(gh: str, prec: int | None = None) -> Polygon:
+    """The geohash cell's bbox polygon (the reference's
+    st_geomFromGeoHash); ``prec`` (BITS) truncates to a coarser cell."""
+    from ..geohash import decode_bbox
+    xmin, ymin, xmax, ymax = decode_bbox(
+        str(gh), None if prec is None else int(prec))
+    return Envelope(xmin, ymin, xmax, ymax).to_polygon()
+
+
 # SQL scalar registry: SELECT-list ST_* calls resolve here (uppercased
 # SQL name -> python fn taking (geometry_value, *literal_args)); the
 # SQLSpatialAccessorFunctions / CastFunctions / OutputFunctions /
@@ -442,6 +463,9 @@ SQL_SCALARS = {
     "ST_RELATEBOOL": lambda g, o, p: st_relate_bool(g, o, str(p)),
     "ST_LENGTHSPHEROID": st_length_spheroid,
     "ST_ANTIMERIDIANSAFEGEOM": st_antimeridian_safe_geom,
+    "ST_GEOHASH": lambda g, prec=25: st_geohash(g, int(prec)),
+    "ST_GEOMFROMGEOHASH": lambda gh, prec=None: st_geom_from_geohash(
+        gh, None if prec is None else int(prec)),
 }
 
 

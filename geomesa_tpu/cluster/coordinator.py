@@ -65,7 +65,7 @@ from .partition import PREFIX_BITS, ZPrefixPartitioner
 __all__ = ["ClusterDataStore", "ClusterQueryResult",
            "ShardUnavailableError", "PartialCount",
            "CLUSTER_LEG_DEADLINE_S", "CLUSTER_HEDGE_MS",
-           "CLUSTER_ALLOW_PARTIAL"]
+           "CLUSTER_ALLOW_PARTIAL", "CLUSTER_PRUNE"]
 
 # per-scatter-leg deadline: a group that cannot answer inside this is
 # treated as down for THIS query (typed failure or flagged partial)
@@ -80,6 +80,9 @@ CLUSTER_HEDGE_MS = SystemProperty("geomesa.cluster.hedge.ms", "75")
 # complete=False with the missing z-ranges
 CLUSTER_ALLOW_PARTIAL = SystemProperty("geomesa.cluster.allow.partial",
                                        "false")
+# Z-range leg pruning kill switch: "false" scatters every read to
+# every group (today's pre-planner behavior, bit-identical)
+CLUSTER_PRUNE = SystemProperty("geomesa.cluster.prune", "true")
 
 
 class ShardUnavailableError(ConnectionError):
@@ -198,6 +201,16 @@ class ClusterDataStore(DataStore):
         self._lock = threading.Lock()
         self._lsn_vector: dict[str, int] = {}
         self._sfts: dict = {}
+        # scatter-plan surface: per-thread latest (concurrent queries
+        # must not clobber each other's plan reads) plus a global
+        # latest for the admin/status view
+        self._plan_tls = threading.local()
+        self._last_plan: dict | None = None
+        # (type, filter-text) -> prune decision: real query mixes
+        # repeat filter shapes, and the covering-range derivation is
+        # pure in (schema, filter, n_groups) — invalidated on schema
+        # change (see create_schema/remove_schema)
+        self._prune_cache: dict[tuple[str, str], tuple] = {}
         registry.gauge("cluster.groups", len(self._groups))
 
     # -- knobs -------------------------------------------------------------
@@ -297,16 +310,31 @@ class ClusterDataStore(DataStore):
                 self._breakers.observe(name, time.perf_counter() - t0)
                 results[name] = v
 
-    def _scatter(self, make_fn) -> tuple[dict, dict]:
-        """Fan one read out to every group. ``make_fn(name, group)``
-        returns the zero-arg leg callable. Returns
-        ``(results_by_name, failures_by_name)``."""
+    def _scatter(self, make_fn, legs=None) -> tuple[dict, dict]:
+        """Fan one read out to every group — or, with ``legs``, only
+        the named subset the planner proved can hold matching rows (a
+        Z-pruned leg is never contacted, so it can never fail and can
+        never be reported missing: pruned != unavailable).
+        ``make_fn(name, group)`` returns the zero-arg leg callable.
+        Returns ``(results_by_name, failures_by_name)``."""
         self._registry.counter("cluster.scatter.calls")
+        pairs = list(zip(self._names, self._groups))
+        if legs is not None:
+            want = set(legs)
+            pairs = [(n, g) for n, g in pairs if n in want]
         deadline, hedge_s = self._leg_deadline_s(), self._hedge_s()
         results: dict = {}
         failures: dict = {}
+        if len(pairs) == 1:
+            # single-leg scatter (a fully-pruned selective read): run
+            # inline — a thread buys no parallelism and its spawn/join
+            # cost dominates a selective leg
+            name, group = pairs[0]
+            self._leg(name, make_fn(name, group), deadline, hedge_s,
+                      results, failures)
+            return results, failures
         threads = []
-        for name, group in zip(self._names, self._groups):
+        for name, group in pairs:
             # each leg thread runs under a copy of the caller's
             # context: trace spans parent correctly and the audit
             # hook's delegation suppression reaches the inner stores
@@ -341,6 +369,97 @@ class ClusterDataStore(DataStore):
         self._registry.counter("cluster.scatter.partial")
         return {"groups": names, "z_ranges": z_ranges}
 
+    # -- cost-based planning: leg pruning + cardinality estimates ----------
+
+    def prune_for(self, type_name: str, flt) -> tuple[list[str] | None,
+                                                      dict | None]:
+        """Z-range leg pruning: the group names whose owned z range can
+        intersect the filter's covering Z2 ranges, or ``(None, info)``
+        when pruning does not apply (knob off, non-point schema, no
+        spatial bound — routing and filtering only provably coincide
+        for point schemas, where the routed coordinate IS the filtered
+        geometry). ``info`` is the plan fragment explaining the
+        decision; None exactly when the knob is off, so a disabled
+        cluster's plans stay bit-identical to the pre-planner ones."""
+        if not CLUSTER_PRUNE.as_bool():
+            return None, None
+        key = (type_name, str(flt))
+        hit = self._prune_cache.get(key)
+        if hit is not None:
+            return hit
+        out = self._prune_uncached(type_name, flt)
+        if len(self._prune_cache) >= 256:
+            self._prune_cache.pop(next(iter(self._prune_cache)))
+        self._prune_cache[key] = out
+        return out
+
+    def _prune_uncached(self, type_name: str, flt):
+        try:
+            from ..filters import parse_ecql
+            from ..filters.helper import extract_geometries
+            sft = self.get_schema(type_name)
+            if sft.geom_field is None or not sft.is_points:
+                return None, {"pruning": "non-point-schema"}
+            if flt is None:
+                return None, {"pruning": "no-spatial-bound"}
+            if isinstance(flt, str):
+                flt = parse_ecql(flt)
+            geoms = extract_geometries(flt, sft.geom_field)
+            if geoms.disjoint:
+                # provably-empty spatial constraint: contact no leg
+                return [], {"pruning": "empty", "covering_ranges": 0}
+            if geoms.is_empty:
+                return None, {"pruning": "no-spatial-bound"}
+            boxes = [(g.envelope.xmin, g.envelope.ymin,
+                      g.envelope.xmax, g.envelope.ymax) for g in geoms]
+            ranges = self._part.covering_ranges(boxes)
+            keep = self._part.groups_for_ranges(ranges)
+            names = [self._names[g] for g in keep]
+            return names, {"pruning": "z-range",
+                           "covering_ranges": int(len(ranges))}
+        except Exception as e:  # noqa: BLE001 — pruning is advisory
+            return None, {"pruning": f"error: {type(e).__name__}"}
+
+    def _account_legs(self, op: str, type_name: str, legs,
+                      info: dict | None = None) -> dict:
+        """Record which legs a scatter will contact vs pruned, on the
+        metrics plane and the cluster-level plan surface."""
+        contacted = (list(self._names) if legs is None
+                     else [n for n in self._names if n in set(legs)])
+        pruned = [n for n in self._names if n not in contacted]
+        plan = {"op": op, "type": type_name,
+                "contacted": contacted, "pruned": pruned}
+        if info:
+            plan.update(info)
+        self._registry.counter("cluster.legs.contacted", len(contacted))
+        if pruned:
+            self._registry.counter("cluster.legs.pruned", len(pruned))
+        self._plan_tls.plan = plan
+        self._last_plan = plan
+        return plan
+
+    def last_plan(self) -> dict | None:
+        """The most recent scatter plan (contacted/pruned legs): this
+        thread's if it has issued one, else the cluster-wide latest —
+        the plan surface tests and operators assert pruning against."""
+        return getattr(self._plan_tls, "plan", None) or self._last_plan
+
+    def estimate_count(self, type_name: str, flt) -> int | None:
+        """Cluster-merged cardinality estimate: each shard group
+        estimates its own slice (O(cells) sketch math, no scan) and
+        the coordinator sums — exact composition because the z-prefix
+        partition is disjoint. None as soon as any group cannot
+        estimate (cold type, cleared stats, unsupported filter): the
+        SQL planner then falls back to static thresholds."""
+        from ..sql.planner import estimate_for_store
+        total = 0
+        for group in self._groups:
+            est = estimate_for_store(group, type_name, flt)
+            if est is None:
+                return None
+            total += int(est)
+        return total
+
     def _ryw_kwargs(self, name: str, group) -> dict:
         """Cross-shard read-your-writes: translate 'this leg must see
         everything we have acked on this group' (min LSN) into the
@@ -368,6 +487,7 @@ class ClusterDataStore(DataStore):
             ret = group.create_schema(sft)
             self._bump_lsn(name, group, ret)
         self._sfts[sft.type_name] = sft
+        self._prune_cache.clear()
 
     def get_schema(self, type_name: str):
         sft = self._sfts.get(type_name)
@@ -400,6 +520,7 @@ class ClusterDataStore(DataStore):
             ret = group.remove_schema(type_name)
             self._bump_lsn(name, group, ret)
         self._sfts.pop(type_name, None)
+        self._prune_cache.clear()
 
     # -- write path --------------------------------------------------------
 
@@ -519,8 +640,10 @@ class ClusterDataStore(DataStore):
 
         from ..audit import audit_query, delegated_scope
         t0 = time.perf_counter()
+        legs, prune_info = self.prune_for(q.type_name, q.filter)
+        self._account_legs("query", q.type_name, legs, prune_info)
         with delegated_scope():
-            results, failures = self._scatter(make_fn)
+            results, failures = self._scatter(make_fn, legs=legs)
         missing = self._missing(failures)
         ids_parts, batch_parts = [], []
         for name in self._names:
@@ -564,11 +687,14 @@ class ClusterDataStore(DataStore):
         q = self._as_query(q, type_name)
         from ..audit import audit_query, delegated_scope
         t0 = time.perf_counter()
+        legs, prune_info = self.prune_for(q.type_name, q.filter)
+        self._account_legs("query_count", q.type_name, legs, prune_info)
         with delegated_scope():
             results, failures = self._scatter(
                 lambda name, group:
                 lambda: group.query_count(q, **self._ryw_kwargs(name,
-                                                                group)))
+                                                                group)),
+                legs=legs)
         missing = self._missing(failures)
         total = int(sum(results.values()))
         if q.max_features is not None:
@@ -585,16 +711,20 @@ class ClusterDataStore(DataStore):
 
     # -- distributed SQL legs ----------------------------------------------
 
-    def sql_partial(self, stmt: str, type_name: str = "") \
+    def sql_partial(self, stmt: str, type_name: str = "",
+                    legs: list[str] | None = None) \
             -> tuple[dict, dict | None]:
         """Scatter one partial-aggregate SQL leg per shard group (the
         sql/distributed.py decomposition): remote groups evaluate via
         their own ``sql_partial`` endpoint, in-process groups run the
-        leg directly. Returns ``(partials_by_group, missing)`` under
-        the standard partial-results contract."""
+        leg directly. ``legs`` (from the SQL planner's Z-range pruning
+        of the pushed WHERE) restricts the scatter to the named
+        groups. Returns ``(partials_by_group, missing)`` under the
+        standard partial-results contract."""
         from ..audit import audit_query, delegated_scope
         from ..sql.distributed import partial_aggregate
         t0 = time.perf_counter()
+        self._account_legs("sql_partial", type_name, legs)
 
         def make_fn(name, group):
             def leg():
@@ -607,7 +737,7 @@ class ClusterDataStore(DataStore):
             return leg
 
         with delegated_scope():
-            results, failures = self._scatter(make_fn)
+            results, failures = self._scatter(make_fn, legs=legs)
         missing = self._missing(failures)
         audit_query(self.audit, "cluster", type_name, stmt, None, 0.0,
                     (time.perf_counter() - t0) * 1000,
@@ -615,14 +745,18 @@ class ClusterDataStore(DataStore):
                     index="sql-partial")
         return results, missing
 
-    def sql_join_partial(self, spec: dict, type_name: str = "") \
+    def sql_join_partial(self, spec: dict, type_name: str = "",
+                         legs: list[str] | None = None) \
             -> tuple[dict, dict | None]:
         """Scatter one broadcast-join leg per shard group: each group
         joins the shipped small side against its local slice of the
-        big side. Same contract as ``sql_partial``."""
+        big side. ``legs`` restricts the scatter to the groups whose
+        owned z range can hold local-side matches. Same contract as
+        ``sql_partial``."""
         from ..audit import audit_query, delegated_scope
         from ..sql.distributed import join_partial_leg
         t0 = time.perf_counter()
+        self._account_legs("sql_join_partial", type_name, legs)
 
         def make_fn(name, group):
             def leg():
@@ -635,7 +769,7 @@ class ClusterDataStore(DataStore):
             return leg
 
         with delegated_scope():
-            results, failures = self._scatter(make_fn)
+            results, failures = self._scatter(make_fn, legs=legs)
         missing = self._missing(failures)
         audit_query(self.audit, "cluster", type_name,
                     spec.get("sql", ""), None, 0.0,
@@ -665,10 +799,13 @@ class ClusterDataStore(DataStore):
         """Scatter the sketch, merge exactly (Stat.merge — every
         sketch in stats/sketches.py is a commutative monoid over
         disjoint row sets, the StatsScan client reduce)."""
+        legs, prune_info = self.prune_for(type_name, ecql)
+        self._account_legs("stats_query", type_name, legs, prune_info)
         results, failures = self._scatter(
             lambda name, group:
             lambda: group.stats_query(type_name, stat_spec, ecql,
-                                      **self._ryw_kwargs(name, group)))
+                                      **self._ryw_kwargs(name, group)),
+            legs=legs)
         missing = self._missing(failures)
         merged = None
         for name in self._names:
@@ -695,11 +832,15 @@ class ClusterDataStore(DataStore):
         """Scatter the heatmap; grids over disjoint partitions sum
         exactly (the DensityScan client reduce)."""
         kwargs = {} if weight_attr is None else {"weight_attr": weight_attr}
+        legs, prune_info = self.prune_for(
+            type_name, self._density_filter(type_name, ecql, bbox))
+        self._account_legs("density", type_name, legs, prune_info)
         results, failures = self._scatter(
             lambda name, group:
             lambda: group.density(type_name, ecql, bbox, width, height,
                                   **kwargs,
-                                  **self._ryw_kwargs(name, group)))
+                                  **self._ryw_kwargs(name, group)),
+            legs=legs)
         missing = self._missing(failures)
         grid = np.zeros((height, width), dtype=np.float32)
         for g in results.values():
@@ -710,15 +851,37 @@ class ClusterDataStore(DataStore):
             grid.missing_z_ranges = missing["z_ranges"]
         return grid
 
+    def _density_filter(self, type_name: str, ecql, bbox):
+        """The effective spatial constraint of a density scan: the
+        ecql AND the grid bbox (rows outside the rendered extent
+        contribute no weight, so their legs can prune)."""
+        try:
+            from ..filters import ast as _ast
+            from ..filters import parse_ecql
+            sft = self.get_schema(type_name)
+            if sft.geom_field is None:
+                return ecql
+            box = _ast.BBox(sft.geom_field, float(bbox[0]), float(bbox[1]),
+                            float(bbox[2]), float(bbox[3]))
+            f = parse_ecql(ecql) if isinstance(ecql, str) else ecql
+            if f is None or isinstance(f, _ast.Include):
+                return box
+            return _ast.And([f, box])
+        except Exception:  # noqa: BLE001 — pruning input is advisory
+            return ecql
+
     def bin_query(self, type_name: str, ecql, track: str | None = None,
                   label: str | None = None, sort: bool = False) -> bytes:
         """Scatter BIN encoding; sorted chunks k-way merge via
         merge_sorted_bin_chunks (the BinSorter client reduce)."""
+        legs, prune_info = self.prune_for(type_name, ecql)
+        self._account_legs("bin_query", type_name, legs, prune_info)
         results, failures = self._scatter(
             lambda name, group:
             lambda: group.bin_query(type_name, ecql, track=track,
                                     label=label, sort=sort,
-                                    **self._ryw_kwargs(name, group)))
+                                    **self._ryw_kwargs(name, group)),
+            legs=legs)
         missing = self._missing(failures)
         chunks = [results[n] for n in self._names
                   if results.get(n)]
@@ -915,10 +1078,12 @@ class ClusterDataStore(DataStore):
                 "n_groups": len(self._groups),
                 "prefix_bits": PREFIX_BITS,
                 "allow_partial": self._allow_partial(),
+                "prune": bool(CLUSTER_PRUNE.as_bool()),
                 "leg_deadline_s": self._leg_deadline_s(),
                 "hedge_ms": self._hedge_s() * 1e3,
                 "lsn_vector": vec,
                 "groups": groups,
+                "last_plan": self.last_plan(),
                 "leg_latency": self._breakers.latencies()}
 
     def cache_status(self) -> dict:

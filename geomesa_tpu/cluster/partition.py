@@ -118,3 +118,34 @@ class ZPrefixPartitioner:
 
     def describe(self) -> list[dict]:
         return [self.z_range(g) for g in range(self.n_groups)]
+
+    # -- leg pruning ---------------------------------------------------------
+
+    def covering_ranges(self, boxes) -> np.ndarray:
+        """Inclusive ``[z_lo, z_hi]`` z2 ranges covering the bbox union
+        at prefix granularity (``precision=PREFIX_BITS`` stops the
+        covering BFS exactly at the ownership cell size — finer ranges
+        cannot change which groups intersect). Boxes clamp to world
+        bounds first: the normalizers treat out-of-range lows as caller
+        error, and an over-wide query box must still cover."""
+        clamped = []
+        for (xmin, ymin, xmax, ymax) in boxes:
+            clamped.append((max(float(xmin), -180.0),
+                            max(float(ymin), -90.0),
+                            min(float(xmax), 180.0),
+                            min(float(ymax), 90.0)))
+        return self._sfc.ranges(clamped, precision=PREFIX_BITS)
+
+    def groups_for_ranges(self, ranges) -> list[int]:
+        """Group indices whose owned ``[z_lo, z_hi)`` can intersect any
+        of the inclusive covering ranges — the legs a scatter must
+        contact; every other group provably holds no matching rows
+        (point schemas route by the same curve the ranges cover)."""
+        r = np.asarray(ranges, dtype=np.int64).reshape(-1, 2)
+        out = []
+        for g in range(self.n_groups):
+            zr = self.z_range(g)
+            if len(r) and bool(np.any((r[:, 0] < zr["z_hi"])
+                                      & (r[:, 1] >= zr["z_lo"]))):
+                out.append(g)
+        return out

@@ -83,9 +83,17 @@ def _deinterleave(v: int, nbits: int) -> tuple[int, int, int, int]:
     return lon, lat, n_lon, n_lat
 
 
-def decode_bbox(gh: str) -> tuple[float, float, float, float]:
-    """(xmin, ymin, xmax, ymax) of a geohash cell."""
+def decode_bbox(gh: str,
+                bits: int | None = None) -> tuple[float, float, float,
+                                                  float]:
+    """(xmin, ymin, xmax, ymax) of a geohash cell. ``bits`` truncates
+    to the leading bit precision (the reference's arbitrary-bit
+    GeoHash cells — base-32 rendering always carries a 5-bit multiple,
+    the cell itself need not)."""
     v, nbits = _to_bits(gh)
+    if bits is not None and 0 < bits < nbits:
+        v >>= nbits - bits
+        nbits = bits
     lon, lat, n_lon, n_lat = _deinterleave(v, nbits)
     wx = 360.0 / (1 << n_lon)
     wy = 180.0 / (1 << n_lat) if n_lat else 180.0

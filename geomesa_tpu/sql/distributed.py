@@ -46,6 +46,7 @@ from ..geometry import Geometry, parse_wkt, to_wkt
 from ..index.api import Query
 from ..utils.properties import SystemProperty
 from .parser import SelectItem, SqlSelect, parse_sql
+from .planner import SQL_PLANNER, CostModel, estimate_for_store
 
 __all__ = ["SQL_DISTRIBUTED", "SQL_BROADCAST_ROWS", "try_distributed",
            "partial_aggregate", "merge_partial_legs", "join_partial_leg"]
@@ -64,7 +65,19 @@ _MERGEABLE = ("count", "sum", "min", "max", "avg", "convex_hull", "extent")
 class _Unsupported(ValueError):
     """Statement shape the distributed planner does not cover — the
     caller records the reason and falls back to the single-node path
-    (which either answers or raises the proper user-facing error)."""
+    (which either answers or raises the proper user-facing error).
+    ``cost`` (optional) carries the cost model's terms so the fallback
+    plan can still explain the cardinality-driven decision."""
+
+    cost: dict | None = None
+
+
+class _FallbackReason(str):
+    """The fallback reason string, plus the planner's cost terms when
+    the decision was cost-based — the engine copies them onto the
+    cluster-materialize plan (``plan["cost"]``)."""
+
+    cost: dict | None = None
 
 
 # -- partial planning ------------------------------------------------------
@@ -542,7 +555,9 @@ def try_distributed(engine, cluster, sel: SqlSelect, text: str):
             return _broadcast_join(engine, cluster, sel, text), None
         return _single_table_distributed(engine, cluster, sel, text), None
     except _Unsupported as e:
-        return None, str(e)
+        reason = _FallbackReason(str(e))
+        reason.cost = getattr(e, "cost", None)
+        return None, reason
 
 
 def _flag(out, missing, *extra_partials):
@@ -600,7 +615,12 @@ def _single_table_distributed(engine, cluster, sel: SqlSelect, text: str):
     _, _, comps, keys = plan
     _check_columns(cluster, sel.table,
                    [i.expr for i in sel.items] + list(sel.group_by or []))
-    results, missing = cluster.sql_partial(text, type_name=sel.table)
+    from .engine import _strip_qualifier
+    where = (_strip_qualifier(sel.where, sel.alias)
+             if sel.where is not None else None)
+    legs_sel, prune_info = cluster.prune_for(sel.table, where)
+    results, missing = cluster.sql_partial(text, type_name=sel.table,
+                                           legs=legs_sel)
     legs = sorted(results)
     out = merge_partial_legs(sel, [results[n] for n in legs],
                              qualified=False)
@@ -616,6 +636,16 @@ def _single_table_distributed(engine, cluster, sel: SqlSelect, text: str):
                         and (sel.order_by or sel.limit is not None)
                         else None),
     }
+    if prune_info is not None:
+        out.plan["prune"] = dict(
+            prune_info, contacted=legs,
+            pruned=(sorted(set(cluster._names) - set(legs_sel))
+                    if legs_sel is not None else []))
+    if SQL_PLANNER.as_bool():
+        est = estimate_for_store(cluster, sel.table, where)
+        out.plan["cost"] = (
+            {"estimator": "stats", "estimated_rows": int(est)}
+            if est is not None else {"fallback": "no-stats"})
     if missing:
         out.plan["missing_groups"] = missing["groups"]
     return _flag(out, missing)
@@ -684,9 +714,34 @@ def _broadcast_join(engine, cluster, sel: SqlSelect, text: str):
         _check_columns(cluster, tables[a], refs[a])
 
     threshold = SQL_BROADCAST_ROWS.as_int() or 0
-    counts = {a: int(cluster.query_count(Query(tables[a],
-                                               _and(side_f[a]))))
-              for a in aliases}
+    # cardinality-driven side choice: estimated (filtered) rows from
+    # the per-shard stats sketches replace the exact query_count
+    # scatters — the planning cost drops from two cluster scans to
+    # O(cells) sketch math. Estimates only pick the side; the shipped
+    # batch's true size is re-checked against the threshold below.
+    cost: dict = {}
+    counts = None
+    if SQL_PLANNER.as_bool():
+        est = {a: estimate_for_store(cluster, tables[a], _and(side_f[a]))
+               for a in aliases}
+        if all(e is not None for e in est.values()):
+            counts = {a: int(est[a]) for a in aliases}
+            model = CostModel(len(cluster._groups),
+                              breakers=getattr(cluster, "_breakers", None),
+                              leg_names=list(cluster._names))
+            lo, hi = sorted(counts.values())
+            cost = {"estimator": "stats", "estimated_rows": dict(counts),
+                    "threshold": threshold,
+                    "broadcast_cost_s": model.broadcast_cost(lo, hi),
+                    "materialize_cost_s": model.materialize_cost(lo, hi),
+                    "coefficients": model.describe()}
+        else:
+            cost = {"fallback": "no-stats", "estimated_rows": est,
+                    "threshold": threshold}
+    if counts is None:        # planner off or cold stats: exact counts
+        counts = {a: int(cluster.query_count(Query(tables[a],
+                                                   _and(side_f[a]))))
+                  for a in aliases}
     eligible = [a for a in aliases if counts[a] <= threshold]
     if j.outer:
         # broadcasting the anchor of a LEFT join would NULL-extend its
@@ -695,10 +750,14 @@ def _broadcast_join(engine, cluster, sel: SqlSelect, text: str):
     if not eligible:
         outer_note = ", LEFT join anchors cannot broadcast" \
             if j.outer else ""
-        raise _Unsupported(
-            f"no broadcastable side (rows: "
+        word = "estimated rows" if cost.get("estimator") else "rows"
+        err = _Unsupported(
+            f"no broadcastable side ({word}: "
             f"{ {a: counts[a] for a in aliases} }, threshold: "
             f"{threshold}{outer_note})")
+        if cost:
+            err.cost = dict(cost, strategy="cluster-materialize")
+        raise err
     small = min(eligible, key=lambda a: counts[a])
 
     if _count_mode_ok(sel, j, deferred):
@@ -722,11 +781,28 @@ def _broadcast_join(engine, cluster, sel: SqlSelect, text: str):
                 raise _Unsupported(f"unqualified join column {it.expr!r}")
 
     sres = cluster.query(Query(tables[small], _and(side_f[small])))
+    if cost.get("estimator") == "stats" and sres.n > threshold:
+        # the estimate undershot: the fetched side is too big to ship.
+        # Fall back to cluster-materialize rather than broadcast a
+        # side the operator's threshold forbids.
+        err = _Unsupported(
+            f"estimated broadcast side {small!r} has {sres.n} rows "
+            f"(> threshold {threshold})")
+        err.cost = dict(cost, strategy="cluster-materialize",
+                        actual_rows=int(sres.n))
+        raise err
     sft = cluster.get_schema(tables[small])
     spec = {"sql": text, "broadcast": small, "mode": mode,
             "payload": _encode_batch(tables[small], sft, sres)}
+    # Z-prune the scatter by the LOCAL side's pushed filter: a leg
+    # whose owned z range cannot hold local-side matches would join
+    # the shipped batch against an empty slice — an empty partial
+    other = next(a for a in aliases if a != small)
+    legs_sel, prune_info = cluster.prune_for(tables[other],
+                                             _and(side_f[other]))
     results, missing = cluster.sql_join_partial(
-        spec, type_name=f"{tables[sel.alias]}*{tables[j.alias]}")
+        spec, type_name=f"{tables[sel.alias]}*{tables[j.alias]}",
+        legs=legs_sel)
     legs = sorted(results)
 
     from .engine import SqlResult, _order_limit
@@ -739,7 +815,9 @@ def _broadcast_join(engine, cluster, sel: SqlSelect, text: str):
         out = merge_partial_legs(sel, [results[n] for n in legs],
                                  qualified=True)
     else:
-        first = results[legs[0]] if legs else {"names": [], "cols": {}}
+        first = (results[legs[0]] if legs
+                 else {"names": [it.name for it in sel.items],
+                       "cols": {it.name: [] for it in sel.items}})
         names = first["names"]
         cols = {nm: np.array(
             [_dec_cell(v) for n in legs for v in results[n]["cols"][nm]],
@@ -751,13 +829,23 @@ def _broadcast_join(engine, cluster, sel: SqlSelect, text: str):
         "join": {"kind": j.kind, "on": [j.left_prop, j.right_prop],
                  "outer": j.outer},
         "broadcast": {"side": small, "table": tables[small],
-                      "rows": counts[small], "threshold": threshold},
+                      "rows": (int(sres.n)
+                               if cost.get("estimator") == "stats"
+                               else counts[small]),
+                      "threshold": threshold},
         "pushdown": {a: str(_and(side_f[a])) for a in aliases},
         "deferred": [str(f) for _, f in deferred] or None,
         "legs": legs,
         "merge": {"count": "psum", "agg": "by-key" if sel.group_by
                   else "fold", "rows": "concat"}[mode],
     }
+    if prune_info is not None:
+        out.plan["prune"] = dict(
+            prune_info, side=other, contacted=legs,
+            pruned=(sorted(set(cluster._names) - set(legs_sel))
+                    if legs_sel is not None else []))
+    if cost:
+        out.plan["cost"] = dict(cost, strategy="broadcast")
     if missing:
         out.plan["missing_groups"] = missing["groups"]
     return _flag(out, missing, sres)

@@ -389,6 +389,7 @@ class SqlEngine:
             out, reason = try_distributed(self, cluster, sel, text)
             if out is not None:
                 return out
+        self.__dict__.pop("_join_order_note", None)
         res = self._join_query(sel) if sel.joins else \
             self._single_table(sel)
         if res.plan is None:
@@ -396,7 +397,13 @@ class SqlEngine:
                                  if cluster is not None else "local"),
                         "distributed": False}
             if reason:
-                res.plan["fallback_reason"] = reason
+                res.plan["fallback_reason"] = str(reason)
+                cost = getattr(reason, "cost", None)
+                if cost:
+                    res.plan["cost"] = cost
+            note = self.__dict__.pop("_join_order_note", None)
+            if note:
+                res.plan["join_order"] = note
         return res
 
     def _cluster_store(self):
@@ -733,9 +740,22 @@ class SqlEngine:
                 name = sel.items[0].name
                 return SqlResult([name], {name: np.array([total])})
 
+        # cost-based join ordering: greedy smallest-estimated-side
+        # first over inner multi-join trees (estimates from the stats
+        # sketches; bails to statement order when any side is cold or
+        # the tree shape is irregular). Outer joins keep statement
+        # order — NULL extension is order-sensitive.
+        joins = list(sel.joins)
+        if len(joins) >= 2 and not outer_aliases:
+            from .planner import reorder_joins
+            joins, note = reorder_joins(self.store, sel.alias, joins,
+                                        tables, side_f)
+            if note:
+                self._join_order_note = note
+
         rows: dict[str, np.ndarray] = {
             sel.alias: np.arange(results[sel.alias].n, dtype=np.int64)}
-        for j in sel.joins:
+        for j in joins:
             rows = self._apply_join(j, results, rows, tables)
         for a, f in deferred:
             keep = self._post_join_mask(f, results[a], rows[a])

@@ -75,10 +75,43 @@ class StatsEstimator:
             return total
         if isinstance(f, ast.Exclude):
             return 0
-        sel = self._spatio_temporal_selectivity(f)
+        rest, attr_sel = self._split_attr_equality(f)
+        if rest is None:
+            # every conjunct was a sketch-backed attribute equality:
+            # estimable without any spatio-temporal bound
+            return int(round(attr_sel * total))
+        sel = self._spatio_temporal_selectivity(rest)
         if sel is None:
             return None
+        if attr_sel is not None:
+            sel *= attr_sel
         return int(round(sel * total))
+
+    def _split_attr_equality(self, f: ast.Filter):
+        """Factor sketch-backed ``attr = value`` conjuncts out of a
+        top-level AND: returns ``(rest, attr_selectivity)`` where rest
+        is the filter minus those conjuncts (None when nothing is
+        left) and attr_selectivity their combined count-min selectivity
+        (None when no conjunct had a sketch — behavior then matches
+        the pre-composition estimator exactly). Independence is
+        assumed across conjuncts, as the reference's estimator does."""
+        conjuncts = (list(f.children) if isinstance(f, ast.And) else [f])
+        sel = None
+        rest = []
+        for c in conjuncts:
+            est = None
+            if isinstance(c, ast.Compare) and c.op == ast.CompareOp.EQ:
+                est = self.attr_equality_estimate(c.prop, c.value)
+            if est is None:
+                rest.append(c)
+                continue
+            frac = min(1.0, est / max(self.count.count, 1))
+            sel = frac if sel is None else sel * frac
+        if sel is None:
+            return f, None
+        if not rest:
+            return None, sel
+        return (ast.And(rest) if len(rest) > 1 else rest[0]), sel
 
     def _spatio_temporal_selectivity(self, f: ast.Filter) -> float | None:
         geom = self.sft.geom_field

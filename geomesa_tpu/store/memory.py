@@ -673,6 +673,11 @@ class InMemoryDataStore(DataStore):
         if self.journal is not None:
             self.journal.log_create_schema(sft)
         self._types[sft.type_name] = self._new_state(sft)
+        # an estimator exists from schema creation: a type with zero
+        # observed rows estimates 0 (a cluster group that owns no rows
+        # of a type must not null the coordinator's merged estimate);
+        # only an explicit stats.clear() makes a type non-estimable
+        self.stats.ensure(sft)
         self._bump_pushdown_version(sft.type_name)
 
     def _new_state(self, sft: SimpleFeatureType) -> _TypeState:

@@ -525,6 +525,20 @@ class RemoteDataStore(DataStore):
         return int(self._json("GET", f"/rest/count/{quote(type_name)}")
                    ["count"])
 
+    def estimate_count(self, type_name: str, f=None) -> int | None:
+        """Server-side sketch cardinality estimate (GET /rest/estimate)
+        — the remote leg of the cluster-merged planner estimate. None
+        when the server cannot estimate OR cannot be reached: the
+        planner treats both as cold stats, never an error."""
+        try:
+            est = self._json(
+                "GET", f"/rest/estimate/{quote(type_name)}",
+                params={"cql": str(f) if f is not None else "INCLUDE"}
+            )["estimate"]
+        except Exception:  # noqa: BLE001 — estimates are advisory
+            return None
+        return None if est is None else int(est)
+
     # -- distributed SQL legs ----------------------------------------------
     # POST bodies, but read-only: idempotent=True keeps them eligible
     # for the client's retry/hedge machinery

@@ -277,6 +277,17 @@ def cmd_density(args) -> int:
     return 0
 
 
+def cmd_estimate(args) -> int:
+    """Sketch-based cardinality estimate (no scan) — the planner's
+    view of how many rows a filter matches."""
+    from ..sql.planner import estimate_for_store
+    est = estimate_for_store(_store(args), args.name,
+                             args.cql or "INCLUDE")
+    print(json.dumps({"type": args.name, "cql": args.cql or "INCLUDE",
+                      "estimate": est}))
+    return 0
+
+
 def cmd_sql(args) -> int:
     """Run a SQL SELECT against the store (spark-sql surface analog)."""
     from ..sql import SqlEngine
@@ -712,6 +723,7 @@ def main(argv=None) -> int:
         (["--max-features"], {"type": int, "default": None,
                               "dest": "max_features"}))
     add("count", cmd_count, name_arg, cql_arg)
+    add("estimate", cmd_estimate, name_arg, cql_arg)
     add("reindex", cmd_reindex, name_arg,
         (["--index-version"], {"type": int, "default": None,
                                "help": "target layout version "

@@ -492,6 +492,15 @@ class GeoMesaWebServer:
             if callable(vec):
                 out["lsn_vector"] = vec()
             return 200, "application/json", _j(out)
+        if len(parts) == 2 and parts[0] == "estimate":
+            # sketch-based cardinality estimate (never scans): the
+            # remote leg of the cluster-merged SQL planner estimates
+            from ..sql.planner import estimate_for_store
+            est = estimate_for_store(
+                self.store, parts[1], params.get("cql", ["INCLUDE"])[0])
+            return 200, "application/json", _j(
+                {"type": parts[1],
+                 "estimate": None if est is None else int(est)})
         if len(parts) == 2 and parts[0] == "knn":
             return self._knn(parts[1], params)
         if len(parts) == 2 and parts[0] == "stats":

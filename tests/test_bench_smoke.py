@@ -269,3 +269,30 @@ def test_config19_distributed_sql_smoke():
     assert p["typed_errors_knob_off"] == p["queries"] // 2
     assert p["partial_flagged_knob_on"] == p["queries"] // 2
     assert "gates_pass" in c
+
+
+@pytest.mark.bench_smoke
+@pytest.mark.sql
+@pytest.mark.cluster
+def test_config20_planner_smoke():
+    rng = np.random.default_rng(53)
+    c = bench.bench_config20(rng, n=5000, reps=2)
+    # the >=2x qps gate only means something at the full-size run; at
+    # toy sizes assert exactness and the structural contracts
+    assert c["selective_boxes"] > 0
+    for g in ("1_groups", "2_groups", "4_groups"):
+        for mix in ("selective", "broad"):
+            row = c[g][mix]
+            assert row["exact"] is True
+            assert row["qps_pruned"] > 0 and row["qps_unpruned"] > 0
+    four = c["4_groups"]["selective"]
+    # the acceptance shape: single-group boxes contact exactly one leg
+    # per query when pruning is on, all four when off
+    assert four["legs_pruned"] == c["selective_boxes"]
+    assert four["legs_unpruned"] == 4 * c["selective_boxes"]
+    x = c["crossover"]
+    assert x["correct"] is True
+    assert x["above_estimate"]["mode"] == "broadcast-join"
+    assert x["below_estimate"]["mode"] == "cluster-materialize"
+    assert x["below_estimate"]["strategy"] == "cluster-materialize"
+    assert "gates_pass" in c

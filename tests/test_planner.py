@@ -1,0 +1,638 @@
+"""Cost-based distributed query planner: Z-range shard pruning and
+cardinality-driven strategy selection.
+
+Property-style pruning-exactness suite (randomized bboxes and time
+windows over a 4-group cluster: pruned results must be id-exact
+against a planner-off oracle, and the contacted-leg set must equal the
+analytic Z-range intersection), plan-surface schema stability,
+pruned-legs-never-missing under both partial settings, broadcast vs
+cluster-materialize strategy choice with cost terms in the plan,
+cold-stats fallback to the static-threshold path, greedy join
+reordering, attribute-equality estimator composition, the geohash
+SQL/process surfaces, and the ``/rest/estimate`` endpoint. Both kill
+switches (``geomesa.cluster.prune``, ``geomesa.sql.planner``) must
+restore today's behavior bit-identically."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.cluster import ClusterDataStore
+from geomesa_tpu.cluster.coordinator import CLUSTER_PRUNE
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.filters import parse_ecql
+from geomesa_tpu.geometry import Point, Polygon
+from geomesa_tpu.index.api import Query
+from geomesa_tpu.sql import SqlEngine
+from geomesa_tpu.sql.distributed import SQL_BROADCAST_ROWS
+from geomesa_tpu.sql.planner import SQL_PLANNER, estimate_for_store
+from geomesa_tpu.store import InMemoryDataStore
+
+pytestmark = [pytest.mark.cluster, pytest.mark.sql]
+
+PTS_SPEC = ("*geom:Point:srid=4326,dtg:Date,"
+            "name:String:index=true,val:Integer")
+
+
+def _pts_batch(sft, n, seed=7):
+    rng = np.random.default_rng(seed)
+    ids = np.array([f"f{i:05d}" for i in range(n)], dtype=object)
+    names = np.array(["alpha", "bravo", "charlie"], dtype=object)
+    return FeatureBatch.from_dict(sft, ids, {
+        "geom": (rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)),
+        "dtg": np.int64(1_600_000_000_000)
+        + rng.integers(0, 10_000_000_000, n),
+        "name": names[rng.integers(0, 3, n)],
+        "val": rng.permutation(n).astype(np.int64),
+    })
+
+
+def _make_cluster(k=4, n=4000, **kw):
+    sft = parse_spec("pts", PTS_SPEC)
+    groups = [InMemoryDataStore() for _ in range(k)]
+    cluster = ClusterDataStore(groups, **kw)
+    cluster.create_schema(sft)
+    cluster.write("pts", _pts_batch(sft, n))
+    return cluster, groups
+
+
+@pytest.fixture(scope="module")
+def cluster4():
+    cluster, groups = _make_cluster(4)
+    assert all(g.count("pts") > 0 for g in groups)
+    yield cluster
+    cluster.close()
+
+
+def _ids(res):
+    return sorted(np.asarray(res.ids).astype(str))
+
+
+def _rows(res):
+    return sorted(tuple(map(str, r)) for r in res.rows())
+
+
+def _bbox_cql(x0, y0, x1, y1):
+    return f"BBOX(geom, {x0}, {y0}, {x1}, {y1})"
+
+
+def _analytic_legs(cluster, boxes):
+    """The leg set the Z-range math says the filter can touch."""
+    ranges = cluster._part.covering_ranges(boxes)
+    keep = cluster._part.groups_for_ranges(ranges)
+    return sorted(cluster._names[g] for g in keep)
+
+
+# -- property-style pruning exactness ----------------------------------------
+
+class TestPruningExactness:
+    def test_randomized_bboxes_exact_and_analytic(self, cluster4):
+        """Randomized boxes of mixed sizes: pruned results id-exact vs
+        the prune-off oracle, contacted legs == the analytic Z-range
+        intersection."""
+        rng = np.random.default_rng(42)
+        saw_pruned = 0
+        for _ in range(25):
+            w, h = rng.uniform(0.5, 60), rng.uniform(0.5, 40)
+            x0 = rng.uniform(-170, 170 - w)
+            y0 = rng.uniform(-80, 80 - h)
+            box = (x0, y0, x0 + w, y0 + h)
+            q = Query("pts", _bbox_cql(*box))
+            got = _ids(cluster4.query(q))
+            plan = cluster4.last_plan()
+            assert plan["pruning"] == "z-range"
+            assert sorted(plan["contacted"]) == _analytic_legs(
+                cluster4, [box])
+            CLUSTER_PRUNE.set("false")
+            try:
+                want = _ids(cluster4.query(q))
+            finally:
+                CLUSTER_PRUNE.set(None)
+            assert got == want
+            if plan["pruned"]:
+                saw_pruned += 1
+        # the sweep exercised actual pruning, not just all-leg plans
+        assert saw_pruned > 5
+
+    def test_randomized_bbox_and_time_window(self, cluster4):
+        rng = np.random.default_rng(43)
+        for _ in range(8):
+            x0 = rng.uniform(-170, 100)
+            y0 = rng.uniform(-80, 40)
+            box = (x0, y0, x0 + rng.uniform(1, 50),
+                   y0 + rng.uniform(1, 30))
+            t0 = 1_600_000_000_000 + int(rng.integers(0, 5_000_000_000))
+            from datetime import datetime, timezone
+
+            def iso(ms):
+                return datetime.fromtimestamp(
+                    ms / 1000, tz=timezone.utc).strftime(
+                    "%Y-%m-%dT%H:%M:%SZ")
+            cql = (f"{_bbox_cql(*box)} AND dtg DURING "
+                   f"{iso(t0)}/{iso(t0 + 2_000_000_000)}")
+            q = Query("pts", cql)
+            got = _ids(cluster4.query(q))
+            assert sorted(cluster4.last_plan()["contacted"]) == \
+                _analytic_legs(cluster4, [box])
+            CLUSTER_PRUNE.set("false")
+            try:
+                want = _ids(cluster4.query(q))
+            finally:
+                CLUSTER_PRUNE.set(None)
+            assert got == want
+
+    def test_single_group_bbox_issues_exactly_one_leg(self, cluster4):
+        """Acceptance: a bbox intersecting exactly one group's Z-range
+        ownership issues exactly one scatter leg, id-exact."""
+        rng = np.random.default_rng(44)
+        for _ in range(200):
+            x0 = rng.uniform(-170, 167)
+            y0 = rng.uniform(-80, 77)
+            box = (x0, y0, x0 + 3, y0 + 3)
+            if len(_analytic_legs(cluster4, [box])) == 1:
+                break
+        else:  # pragma: no cover - 4-group quadrants make this common
+            pytest.fail("no single-group box found")
+        q = Query("pts", _bbox_cql(*box))
+        got = _ids(cluster4.query(q))
+        plan = cluster4.last_plan()
+        assert len(plan["contacted"]) == 1
+        assert len(plan["pruned"]) == 3
+        CLUSTER_PRUNE.set("false")
+        try:
+            want = _ids(cluster4.query(q))
+        finally:
+            CLUSTER_PRUNE.set(None)
+        assert got == want
+
+    def test_query_count_pruned_exact(self, cluster4):
+        cql = _bbox_cql(10, 10, 40, 40)
+        got = cluster4.query_count(Query("pts", cql))
+        CLUSTER_PRUNE.set("false")
+        try:
+            want = cluster4.query_count(Query("pts", cql))
+        finally:
+            CLUSTER_PRUNE.set(None)
+        assert got == want
+
+    def test_non_spatial_filter_contacts_all_legs(self, cluster4):
+        q = Query("pts", "name = 'alpha'")
+        cluster4.query(q)
+        plan = cluster4.last_plan()
+        assert plan["pruning"] == "no-spatial-bound"
+        assert sorted(plan["contacted"]) == sorted(cluster4._names)
+        assert plan["pruned"] == []
+
+    def test_plan_schema_stable(self, cluster4):
+        """The plan surface is a stable, JSON-serializable contract."""
+        cluster4.query(Query("pts", _bbox_cql(20, 20, 23, 23)))
+        plan = cluster4.last_plan()
+        assert {"op", "type", "contacted", "pruned",
+                "pruning"} <= set(plan)
+        assert plan["op"] == "query" and plan["type"] == "pts"
+        assert plan["pruning"] == "z-range"
+        assert isinstance(plan["covering_ranges"], int)
+        json.dumps(plan)  # never carries non-serializable values
+        status = cluster4.cluster_status()
+        assert status["prune"] is True
+        assert status["last_plan"] == plan
+
+    def test_prune_cache_reused_and_invalidated(self, cluster4):
+        cluster4._prune_cache.clear()
+        q = Query("pts", _bbox_cql(30, 30, 33, 33))
+        cluster4.query(q)
+        assert len(cluster4._prune_cache) == 1
+        cluster4.query(q)  # same filter text: cache hit, no growth
+        assert len(cluster4._prune_cache) == 1
+        sft2 = parse_spec("pts_tmp", PTS_SPEC)
+        cluster4.create_schema(sft2)
+        try:
+            assert cluster4._prune_cache == {}
+        finally:
+            cluster4.remove_schema("pts_tmp")
+
+    def test_kill_switch_restores_unpruned_plan(self, cluster4):
+        CLUSTER_PRUNE.set("false")
+        try:
+            assert cluster4.prune_for(
+                "pts", parse_ecql(_bbox_cql(0, 0, 1, 1))) == (None, None)
+        finally:
+            CLUSTER_PRUNE.set(None)
+
+
+# -- pruned legs never count as missing (partial contract) -------------------
+
+class _Down:
+    """Shard whose every call fails (hedges and retries included)."""
+
+    def close(self):
+        pass
+
+    def __getattr__(self, key):
+        def boom(*a, **kw):
+            raise ConnectionError("injected: shard down")
+        return boom
+
+
+def _selective_box(cluster):
+    """A box owned by exactly one group, plus that group's index."""
+    rng = np.random.default_rng(3)
+    for _ in range(200):
+        x0, y0 = rng.uniform(-170, 167), rng.uniform(-80, 77)
+        box = (x0, y0, x0 + 3, y0 + 3)
+        ranges = cluster._part.covering_ranges([box])
+        keep = cluster._part.groups_for_ranges(ranges)
+        if len(keep) == 1:
+            return box, keep[0]
+    raise AssertionError("no single-group box found")
+
+
+class TestPrunedNotMissing:
+    @pytest.mark.parametrize("allow_partial", [True, False])
+    def test_dead_pruned_leg_is_not_missing(self, allow_partial):
+        """A leg the planner pruned is never contacted, so its death
+        must not surface as a partial result (or raise)."""
+        cluster, _ = _make_cluster(4, n=2000,
+                                   allow_partial=allow_partial)
+        try:
+            box, owner = _selective_box(cluster)
+            dead = (owner + 1) % 4  # a group the query cannot touch
+            cluster._groups[dead] = _Down()
+            res = cluster.query(Query("pts", _bbox_cql(*box)))
+            plan = cluster.last_plan()
+            assert plan["contacted"] == [cluster._names[owner]]
+            assert cluster._names[dead] in plan["pruned"]
+            assert res.n >= 0  # materialized without raising
+        finally:
+            cluster.close()
+
+    def test_contacted_leg_fails_pruned_leg_still_absent(self):
+        """When a CONTACTED leg dies under allow-partial, the missing
+        set names only it — never the pruned legs."""
+        cluster, _ = _make_cluster(4, n=2000, allow_partial=True)
+        try:
+            box, owner = _selective_box(cluster)
+            cluster._groups[owner] = _Down()
+            engine = SqlEngine(cluster)
+            x0, y0, x1, y1 = box
+            res = engine.query(
+                "SELECT COUNT(*) FROM pts WHERE ST_Contains("
+                f"ST_MakeBBOX({x0}, {y0}, {x1}, {y1}), geom)")
+            assert res.complete is False
+            assert res.missing_groups == [cluster._names[owner]]
+            pruned = set(res.plan["prune"]["pruned"])
+            assert pruned and not (pruned & set(res.missing_groups))
+        finally:
+            cluster.close()
+
+    def test_dead_pruned_leg_raises_only_when_contacted(self):
+        """Default (strict) mode still raises when the broad query
+        reaches the dead group — pruning must not mask real loss."""
+        from geomesa_tpu.cluster import ShardUnavailableError
+        cluster, _ = _make_cluster(4, n=2000, allow_partial=False)
+        try:
+            box, owner = _selective_box(cluster)
+            dead = (owner + 1) % 4
+            cluster._groups[dead] = _Down()
+            # selective query avoiding the dead group: fine
+            cluster.query(Query("pts", _bbox_cql(*box)))
+            # broad query hitting every group: typed error names it
+            with pytest.raises(ShardUnavailableError) as ei:
+                cluster.query(Query("pts", "INCLUDE"))
+            assert cluster._names[dead] in ei.value.groups
+        finally:
+            cluster.close()
+
+
+# -- SQL strategy choice ------------------------------------------------------
+
+ZONES_SPEC = "*geom:Polygon:srid=4326,zname:String"
+
+
+def _box_poly(x0, y0, x1, y1):
+    return Polygon(np.array(
+        [[x0, y0], [x1, y0], [x1, y1], [x0, y1], [x0, y0]], float))
+
+
+def _make_plane(n=2000):
+    """4-group cluster + single-store oracle with pts and 8 zones."""
+    psft = parse_spec("pts", PTS_SPEC)
+    zsft = parse_spec("zones", ZONES_SPEC)
+    pb = _pts_batch(psft, n)
+    zb = FeatureBatch.from_dict(
+        zsft, np.array([f"z{i}" for i in range(8)], dtype=object),
+        {"geom": np.array([_box_poly(-160 + 40 * i, -60, -130 + 40 * i,
+                                     -20) for i in range(8)],
+                          dtype=object),
+         "zname": np.array([f"zone{i}" for i in range(8)],
+                           dtype=object)})
+    groups = [InMemoryDataStore() for _ in range(4)]
+    cluster = ClusterDataStore(groups)
+    oracle = InMemoryDataStore()
+    for st in (cluster, oracle):
+        for sft, batch in ((psft, pb), (zsft, zb)):
+            st.create_schema(sft)
+            st.write(sft.type_name, batch)
+    return cluster, oracle, groups
+
+
+JOIN_STMT = ("SELECT COUNT(*) FROM pts p "
+             "JOIN zones z ON ST_Contains(z.geom, p.geom)")
+
+
+class TestStrategyChoice:
+    def test_broadcast_chosen_from_estimates(self):
+        cluster, oracle, _ = _make_plane()
+        try:
+            res = SqlEngine(cluster).query(JOIN_STMT)
+            want = SqlEngine(oracle).query(JOIN_STMT)
+            assert _rows(res) == _rows(want)
+            plan = res.plan
+            assert plan["mode"] == "broadcast-join"
+            cost = plan["cost"]
+            assert cost["strategy"] == "broadcast"
+            assert cost["estimator"] == "stats"
+            assert set(cost["estimated_rows"]) == {"p", "z"}
+            assert cost["estimated_rows"]["z"] == 8
+            assert cost["broadcast_cost_s"] > 0
+            assert cost["materialize_cost_s"] > 0
+            assert {"leg_s", "ship_s_per_row", "scan_s_per_row",
+                    "n_legs"} <= set(cost["coefficients"])
+            json.dumps(plan)
+        finally:
+            cluster.close()
+
+    def test_threshold_forces_cluster_materialize(self):
+        """Estimated cardinality above the broadcast threshold on both
+        sides: the planner picks cluster-materialize and reports why."""
+        cluster, oracle, _ = _make_plane()
+        SQL_BROADCAST_ROWS.set("4")
+        try:
+            res = SqlEngine(cluster).query(JOIN_STMT)
+            want = SqlEngine(oracle).query(JOIN_STMT)
+            assert _rows(res) == _rows(want)
+            assert res.plan["mode"] == "cluster-materialize"
+            assert "estimated rows" in res.plan["fallback_reason"]
+            assert res.plan["cost"]["strategy"] == "cluster-materialize"
+            assert res.plan["cost"]["estimator"] == "stats"
+        finally:
+            SQL_BROADCAST_ROWS.set(None)
+            cluster.close()
+
+    def test_cold_stats_fall_back_to_exact_counts(self):
+        """Satellite: estimate_count -> None routes to the static
+        exact-count path, flagged no-stats — never an error, and the
+        plan (minus the cost report) is identical to planner-off."""
+        cluster, oracle, groups = _make_plane()
+        try:
+            for g in groups:
+                g.stats.clear("zones")
+            assert estimate_for_store(cluster, "zones", None) is None
+            res = SqlEngine(cluster).query(JOIN_STMT)
+            want = SqlEngine(oracle).query(JOIN_STMT)
+            assert _rows(res) == _rows(want)
+            assert res.plan["mode"] == "broadcast-join"
+            assert res.plan["cost"]["fallback"] == "no-stats"
+            assert res.plan["broadcast"]["rows"] == 8  # exact, not est
+            SQL_PLANNER.set("false")
+            try:
+                off = SqlEngine(cluster).query(JOIN_STMT)
+            finally:
+                SQL_PLANNER.set(None)
+            assert _rows(off) == _rows(want)
+            assert "cost" not in off.plan
+            on_plan = {k: v for k, v in res.plan.items() if k != "cost"}
+            assert on_plan == off.plan  # bit-identical strategy
+        finally:
+            cluster.close()
+
+    def test_planner_kill_switch_drops_cost_key(self):
+        cluster, _, _ = _make_plane()
+        SQL_PLANNER.set("false")
+        try:
+            res = SqlEngine(cluster).query(JOIN_STMT)
+            assert res.plan["mode"] == "broadcast-join"
+            assert "cost" not in res.plan
+        finally:
+            SQL_PLANNER.set(None)
+            cluster.close()
+
+    def test_single_table_aggregate_cost_and_prune(self):
+        cluster, oracle, _ = _make_plane()
+        try:
+            stmt = ("SELECT name, COUNT(*) FROM pts WHERE ST_Contains("
+                    "ST_MakeBBOX(-40, -40, 40, 40), geom) GROUP BY name")
+            res = SqlEngine(cluster).query(stmt)
+            want = SqlEngine(oracle).query(stmt)
+            assert _rows(res) == _rows(want)
+            assert res.plan["mode"] == "distributed-aggregate"
+            assert res.plan["cost"]["estimator"] == "stats"
+            assert isinstance(res.plan["cost"]["estimated_rows"], int)
+            prune = res.plan["prune"]
+            assert prune["pruning"] == "z-range"
+            assert sorted(prune["contacted"]) == _analytic_legs(
+                cluster, [(-40, -40, 40, 40)])
+        finally:
+            cluster.close()
+
+
+# -- greedy join reordering ---------------------------------------------------
+
+class TestJoinReorder:
+    @staticmethod
+    def _store():
+        ds = InMemoryDataStore()
+        rng = np.random.default_rng(5)
+        for name, n, nkeys in (("big", 1500, 10), ("mid", 300, 5),
+                               ("small", 30, 2)):
+            sft = parse_spec(name, "*geom:Point:srid=4326,k:String")
+            ds.create_schema(sft)
+            ds.write(name, FeatureBatch.from_dict(
+                sft, np.array([f"{name}{i}" for i in range(n)],
+                              dtype=object),
+                {"geom": (rng.uniform(-10, 10, n),
+                          rng.uniform(-10, 10, n)),
+                 "k": np.array([f"k{i % nkeys}" for i in range(n)],
+                               dtype=object)}))
+        return ds
+
+    STMT = ("SELECT COUNT(*) FROM small s "
+            "JOIN big b ON s.k = b.k JOIN mid m ON s.k = m.k")
+
+    def test_reorder_smallest_first_same_rows(self):
+        engine = SqlEngine(self._store())
+        res = engine.query(self.STMT)
+        SQL_PLANNER.set("false")
+        try:
+            off = engine.query(self.STMT)
+        finally:
+            SQL_PLANNER.set(None)
+        assert _rows(res) == _rows(off)
+        note = res.plan["join_order"]
+        assert note["order"] == ["m", "b"]  # smallest estimate first
+        assert note["estimated_rows"]["b"] > note["estimated_rows"]["m"]
+        assert "join_order" not in off.plan
+
+    def test_statement_order_kept_when_already_optimal(self):
+        engine = SqlEngine(self._store())
+        stmt = ("SELECT COUNT(*) FROM small s "
+                "JOIN mid m ON s.k = m.k JOIN big b ON s.k = b.k")
+        res = engine.query(stmt)
+        assert "join_order" not in res.plan
+
+
+# -- estimator attribute-equality composition --------------------------------
+
+class TestEstimatorAttrEq:
+    @staticmethod
+    def _est(n=10_000):
+        sft = parse_spec(
+            "t", "kind:String:index=true,tag:String,"
+                 "*geom:Point:srid=4326")
+        from geomesa_tpu.stats.estimator import StatsEstimator
+        est = StatsEstimator(sft)
+        rng = np.random.default_rng(1)
+        kinds = np.where(rng.random(n) < 0.9, "big",
+                         "small").astype(object)
+        est.observe(FeatureBatch.from_dict(
+            sft, np.arange(n).astype(str).astype(object),
+            {"kind": kinds,
+             "tag": np.array(["x"] * n, dtype=object),
+             "geom": (rng.uniform(-10, 10, n),
+                      rng.uniform(-10, 10, n))}))
+        return est, kinds, n
+
+    def test_pure_attr_equality_estimable(self):
+        est, kinds, _ = self._est()
+        got = est.estimate_count(parse_ecql("kind = 'small'"))
+        assert got == pytest.approx((kinds == "small").sum(), rel=0.1)
+
+    def test_bbox_and_attr_composition(self):
+        est, kinds, n = self._est()
+        bbox_only = est.estimate_count(
+            parse_ecql("BBOX(geom, -10, -10, 10, 10)"))
+        both = est.estimate_count(parse_ecql(
+            "BBOX(geom, -10, -10, 10, 10) AND kind = 'small'"))
+        frac = (kinds == "small").sum() / n
+        assert both == pytest.approx(bbox_only * frac, rel=0.2)
+
+    def test_unindexed_attr_unchanged(self):
+        est, _, n = self._est()
+        # no sketch for 'tag': behavior matches the pre-composition
+        # estimator (the spatio-temporal bound alone)
+        bbox_only = est.estimate_count(
+            parse_ecql("BBOX(geom, -10, -10, 10, 10)"))
+        with_tag = est.estimate_count(parse_ecql(
+            "BBOX(geom, -10, -10, 10, 10) AND tag = 'x'"))
+        assert with_tag == bbox_only
+
+
+# -- geohash surfaces ---------------------------------------------------------
+
+class TestGeohashSurfaces:
+    def test_round_trip_containment(self):
+        from geomesa_tpu.analytics.st_functions import (
+            st_geohash, st_geom_from_geohash)
+        rng = np.random.default_rng(9)
+        for prec in (15, 20, 25, 32, 38):  # includes non-multiples of 5
+            for _ in range(20):
+                p = Point(rng.uniform(-179, 179), rng.uniform(-89, 89))
+                gh = st_geohash(p, prec)
+                assert len(gh) == -(-prec // 5)
+                cell = st_geom_from_geohash(gh, prec)
+                assert cell.envelope.contains_point(p.x, p.y)
+
+    def test_known_value_and_centroid(self):
+        from geomesa_tpu.analytics.st_functions import (
+            st_geohash, st_geom_from_geohash)
+        assert st_geohash(Point(12.34, 56.78), 25) == "u60g0"
+        poly = _box_poly(10, 50, 14, 58)  # centroid (12, 54)
+        assert st_geohash(poly, 25) == st_geohash(Point(12, 54), 25)
+        cell = st_geom_from_geohash("u60g0")
+        assert cell.envelope.contains_point(12.34, 56.78)
+
+    def test_sql_scalars(self):
+        sft = parse_spec("t", "*geom:Point:srid=4326,gh:String")
+        ds = InMemoryDataStore()
+        ds.create_schema(sft)
+        ds.write("t", FeatureBatch.from_dict(
+            sft, np.array(["a"], dtype=object),
+            {"geom": (np.array([12.34]), np.array([56.78])),
+             "gh": np.array(["u60g0"], dtype=object)}))
+        res = SqlEngine(ds).query(
+            "SELECT ST_GEOHASH(geom, 25) AS out FROM t")
+        assert list(res.rows()) == [("u60g0",)]
+        res = SqlEngine(ds).query(
+            "SELECT ST_GEOMFROMGEOHASH(gh, 25) AS cell FROM t")
+        cell = res.column("cell")[0]
+        assert cell.geom_type == "Polygon"
+        assert cell.envelope.contains_point(12.34, 56.78)
+
+    def test_process_twins(self):
+        from geomesa_tpu.analytics.processes import (
+            geohash_decode_process, geohash_process)
+        sft = parse_spec("t", "*geom:Point:srid=4326")
+        ds = InMemoryDataStore()
+        ds.create_schema(sft)
+        rng = np.random.default_rng(11)
+        n = 40
+        x, y = rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)
+        ds.write("t", FeatureBatch.from_dict(
+            sft, np.array([f"f{i}" for i in range(n)], dtype=object),
+            {"geom": (x, y)}))
+        hashes = geohash_process(ds, "t", "geom", prec=30)
+        assert len(hashes) == n and all(len(h) == 6 for h in hashes)
+        cells = geohash_decode_process(hashes, prec=30)
+        # process output order follows the store's scan order; compare
+        # as multisets of (hash, cell-contains-some-point) facts
+        for gh, cell in zip(hashes, cells):
+            env = cell.envelope
+            assert any(env.contains_point(xi, yi)
+                       for xi, yi in zip(x, y)), gh
+
+
+# -- the /rest/estimate endpoint ---------------------------------------------
+
+class TestRestEstimate:
+    @pytest.fixture(scope="class")
+    def server(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        ds = InMemoryDataStore()
+        sft = parse_spec("pts", PTS_SPEC)
+        ds.create_schema(sft)
+        ds.write("pts", _pts_batch(sft, 3000))
+        srv = GeoMesaWebServer(ds).start()
+        yield srv
+        srv.stop()
+
+    @staticmethod
+    def _get(srv, path):
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}{path}") as r:
+            return r.status, json.loads(r.read())
+
+    def test_estimate_include(self, server):
+        st, d = self._get(server, "/rest/estimate/pts")
+        assert st == 200
+        assert d == {"type": "pts", "estimate": 3000}
+
+    def test_estimate_filtered(self, server):
+        st, d = self._get(
+            server, "/rest/estimate/pts?cql=BBOX(geom,-40,-40,40,40)")
+        assert st == 200
+        assert 0 < d["estimate"] < 3000
+
+    def test_estimate_unknown_type_is_null(self, server):
+        st, d = self._get(server, "/rest/estimate/nope")
+        assert st == 200 and d["estimate"] is None
+
+    def test_remote_store_estimate(self, server):
+        from geomesa_tpu.store import RemoteDataStore
+        ds = RemoteDataStore("127.0.0.1", server.port)
+        assert ds.estimate_count("pts") == 3000
+        got = ds.estimate_count(
+            "pts", parse_ecql("BBOX(geom,-40,-40,40,40)"))
+        assert 0 < got < 3000
+        assert ds.estimate_count("nope") is None
