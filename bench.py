@@ -145,7 +145,7 @@ REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
                              "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,"
-                             "19,20,northstar")
+                             "19,20,21,northstar")
               .split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
@@ -3312,6 +3312,170 @@ def bench_config20(rng, n=None, reps=None):
     return out
 
 
+# -- config 21: elastic topology — hot shard heals via online split -------
+
+def bench_config21(rng, n=None, c=None, synthetic_hot_signal=False):
+    """What the elastic topology buys under a hot shard.
+
+    A 4-group cluster serves a hot-corner bbox workload at concurrency
+    ``c`` through three phases: (pre) uniform data, (hot) a skewed
+    ingest piles 2x the base volume into one group's corner, (post)
+    the SLO-driven autoscaler — watching the real per-leg breaker
+    latencies — fires an online split of the hot group at its
+    key-density median and the same workload runs again. Every 4th
+    query is a world-spanning bbox so all legs keep latency samples
+    flowing to the autoscaler.
+
+    Gates: the autoscaler fired on its own (an epoch-history entry
+    with reason ``auto``), zero acked loss / id-exactness vs a
+    single-store oracle across the flip, and the heal itself — the
+    density-median split halves the hot group's rows, so the hot LEG's
+    p99 (the same per-group signal the autoscaler watches; in a
+    multi-process deployment, the shard server's latency) must land
+    under 0.75x its hot-phase value. Client-side p50/p99 per phase are
+    reported for context but not gated: in this single-process harness
+    the GIL serializes the legs, so total scan work — conserved across
+    a split — bounds client latency regardless of topology.
+
+    ``synthetic_hot_signal`` (toy-size smoke runs only) feeds the
+    autoscaler per-leg latencies derived from actual per-group row
+    counts instead of the breaker EWMAs — at toy sizes scheduler noise
+    drowns the microsecond scan-cost skew the EWMAs would need, but
+    the decision loop, sustain window, split and flip all still run
+    for real."""
+    import threading
+
+    from geomesa_tpu.cluster import ClusterDataStore
+    from geomesa_tpu.cluster.autoscale import (RESHARD_AUTO,
+                                               RESHARD_HOT_FACTOR,
+                                               RESHARD_HOT_MIN_MS,
+                                               RESHARD_HOT_SUSTAIN_S,
+                                               Autoscaler)
+    from geomesa_tpu.features import FeatureBatch, parse_spec
+    from geomesa_tpu.store import InMemoryDataStore
+
+    n = n if n is not None else int(
+        os.environ.get("GEOMESA_TPU_BENCH_RESHARD_N", 240_000))
+    c = c if c is not None else 32
+    per_thread = 6
+    sft = parse_spec("pts21", "*geom:Point:srid=4326,val:Integer")
+    cluster = ClusterDataStore([InMemoryDataStore() for _ in range(4)],
+                               names=["g0", "g1", "g2", "g3"],
+                               leg_deadline_s=120)
+    oracle = InMemoryDataStore()
+    for st in (cluster, oracle):
+        st.create_schema(sft)
+
+    def write_both(prefix, xs, ys):
+        ids = np.array([f"{prefix}{i}" for i in range(len(xs))],
+                       dtype=object)
+        batch = FeatureBatch.from_dict(sft, ids, {
+            "geom": (xs, ys),
+            "val": np.arange(len(xs), dtype=np.int64)})
+        cluster.write("pts21", batch)
+        oracle.write("pts21", batch)
+
+    write_both("u", rng.uniform(-180, 180, n), rng.uniform(-90, 90, n))
+
+    hot_cql = "BBOX(geom, 100, 40, 112, 46)"
+    broad_cql = "BBOX(geom, -179, -89, 179, 89)"
+
+    def measure():
+        """The c-thread workload; per-query wall latencies (ms)."""
+        lats, lock = [], threading.Lock()
+
+        def worker():
+            mine = []
+            for i in range(per_thread):
+                cql = broad_cql if i % 4 == 3 else hot_cql
+                t0 = time.perf_counter()
+                cluster.query(cql, "pts21")
+                mine.append((time.perf_counter() - t0) * 1e3)
+            with lock:
+                lats.extend(mine)
+
+        threads = [threading.Thread(target=worker) for _ in range(c)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        arr = np.asarray(lats)
+        return {"p50_ms": round(float(np.percentile(arr, 50)), 3),
+                "p99_ms": round(float(np.percentile(arr, 99)), 3)}
+
+    out = {"n": n, "concurrency": c,
+           "queries_per_phase": c * per_thread}
+    measure()                      # warm: jit/parse spikes stay out
+    out["pre"] = measure()
+
+    # hotspot: one group's corner takes 2x the whole base volume —
+    # that leg now scans ~9x the rows of its peers
+    m = 2 * n
+    write_both("h", rng.uniform(100, 112, m), rng.uniform(40, 46, m))
+    out["hot"] = measure()
+
+    # the closed loop: per-leg latencies in, split out. The relative
+    # threshold sits well below the hot leg's skew; the absolute floor
+    # drops to zero because in-process legs serve sub-millisecond
+    RESHARD_AUTO.set("true")
+    RESHARD_HOT_FACTOR.set("1.5")
+    RESHARD_HOT_SUSTAIN_S.set("5")
+    RESHARD_HOT_MIN_MS.set("0")
+    try:
+        scaler = Autoscaler(cluster)
+        if synthetic_hot_signal:
+            scaler.observe = lambda: {
+                name: group.count("pts21") * 20e-9
+                for name, group in zip(cluster._names, cluster._groups)}
+        obs_hot = scaler.observe()
+        scaler.run_once(now=0.0)
+        decision = scaler.run_once(now=6.0)
+    finally:
+        RESHARD_AUTO.set(None)
+        RESHARD_HOT_FACTOR.set(None)
+        RESHARD_HOT_SUSTAIN_S.set(None)
+        RESHARD_HOT_MIN_MS.set(None)
+    out["decision"] = {k: decision.get(k)
+                       for k in ("action", "group", "executed",
+                                 "blocked", "hot_p99_s")}
+    out["post"] = measure()
+    obs_post = scaler.observe()
+
+    history = cluster.resharder.status()["history"]
+    out["epoch"] = cluster._part.epoch
+    out["history"] = history
+    auto_fired = any(e.get("reason") == "auto" and e.get("op") == "migrate"
+                     for e in history)
+    got = cluster.query("INCLUDE", "pts21")
+    want = oracle.query("INCLUDE", "pts21")
+    exact = (set(got.ids.astype(str)) == set(want.ids.astype(str))
+             and cluster.count("pts21") == oracle.count("pts21")
+             and set(cluster.query(hot_cql, "pts21").ids.astype(str))
+             == set(oracle.query(hot_cql, "pts21").ids.astype(str)))
+    out["auto_fired"] = bool(auto_fired)
+    out["exact"] = bool(exact)
+    hot_group = next((e["src"] for e in history
+                      if e.get("reason") == "auto"), None)
+    if hot_group is None:
+        hot_group = max(obs_hot, key=lambda k: obs_hot.get(k) or 0.0)
+    out["hot_group"] = hot_group
+    out["leg_p99_ms_hot"] = {
+        k: round(v * 1e3, 3) for k, v in obs_hot.items() if v is not None}
+    out["leg_p99_ms_post"] = {
+        k: round(v * 1e3, 3) for k, v in obs_post.items() if v is not None}
+    leg_hot = obs_hot.get(hot_group)
+    leg_post = obs_post.get(hot_group)
+    out["heal_ratio"] = (round(leg_post / max(leg_hot, 1e-9), 3)
+                         if leg_hot is not None and leg_post is not None
+                         else None)
+    out["gates_pass"] = bool(auto_fired and exact
+                             and out["heal_ratio"] is not None
+                             and out["heal_ratio"] < 0.75)
+    oracle.close()
+    cluster.close()
+    return out
+
+
 # -- config 10: storage integrity — scrub overhead + corrupt recovery -----
 
 def bench_config10(rng):
@@ -3592,6 +3756,8 @@ def main(argv=None):
         out["configs"]["19_distributed_sql"] = bench_config19(rng)
     if "20" in CONFIGS:
         out["configs"]["20_planner"] = bench_config20(rng)
+    if "21" in CONFIGS:
+        out["configs"]["21_reshard"] = bench_config21(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

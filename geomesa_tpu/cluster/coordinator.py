@@ -44,6 +44,7 @@ drops a shard's rows is worse than a single store):
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import threading
 import time
@@ -61,9 +62,11 @@ from ..store.api import DataStore
 from ..store.memory import QueryResult
 from ..utils.properties import SystemProperty
 from .partition import PREFIX_BITS, ZPrefixPartitioner
+from .reshard import _OpGate, ReshardError, StaleTopologyError
 
 __all__ = ["ClusterDataStore", "ClusterQueryResult",
            "ShardUnavailableError", "PartialCount",
+           "ReshardError", "StaleTopologyError",
            "CLUSTER_LEG_DEADLINE_S", "CLUSTER_HEDGE_MS",
            "CLUSTER_ALLOW_PARTIAL", "CLUSTER_PRUNE"]
 
@@ -83,6 +86,19 @@ CLUSTER_ALLOW_PARTIAL = SystemProperty("geomesa.cluster.allow.partial",
 # Z-range leg pruning kill switch: "false" scatters every read to
 # every group (today's pre-planner behavior, bit-identical)
 CLUSTER_PRUNE = SystemProperty("geomesa.cluster.prune", "true")
+
+
+def _gated(fn):
+    """Run a cluster read under the shared side of the op gate (see
+    ``ClusterDataStore._op``): concurrent with other ops, drained by
+    the reshard flip's exclusive section, typed-failed while a crashed
+    flip leaves the topology inconsistent."""
+    def wrapper(self, *args, **kwargs):
+        with self._op():
+            return fn(self, *args, **kwargs)
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
 
 
 class ShardUnavailableError(ConnectionError):
@@ -135,6 +151,7 @@ class _ClusterStream:
         self.complete = True
         self.missing_groups: list[str] = []
         self.missing_z_ranges: list[dict] = []
+        self._on_close = None
 
     def __iter__(self):
         return self
@@ -143,7 +160,17 @@ class _ClusterStream:
         return next(self._gen)
 
     def close(self):
+        # closing a never-started generator skips its finally, so the
+        # op-gate release hooks here too (idempotent)
         self._gen.close()
+        if self._on_close is not None:
+            self._on_close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — finalizer
+            pass
 
 
 class ClusterQueryResult(QueryResult):
@@ -208,10 +235,104 @@ class ClusterDataStore(DataStore):
         self._last_plan: dict | None = None
         # (type, filter-text) -> prune decision: real query mixes
         # repeat filter shapes, and the covering-range derivation is
-        # pure in (schema, filter, n_groups) — invalidated on schema
-        # change (see create_schema/remove_schema)
+        # pure in (schema, filter, topology) — invalidated on schema
+        # change (see create_schema/remove_schema) and on topology
+        # epoch change (the reshard flip)
         self._prune_cache: dict[tuple[str, str], tuple] = {}
+        # elastic topology: every op takes the shared side of the gate,
+        # the reshard flip takes the exclusive side; _migration is the
+        # in-flight range move (double-routing + staging), installed
+        # and cleared by the Resharder under the exclusive gate
+        self._gate = _OpGate()
+        self._migration = None
+        self._resharder = None
+        self._autoscaler = None
         registry.gauge("cluster.groups", len(self._groups))
+
+    # -- elastic topology --------------------------------------------------
+
+    @property
+    def resharder(self):
+        """The split/migrate executor for this cluster (lazy)."""
+        if self._resharder is None:
+            from .reshard import Resharder
+            self._resharder = Resharder(self, registry=self._registry)
+        return self._resharder
+
+    @property
+    def autoscaler(self):
+        """The SLO/latency-driven control loop for this cluster (lazy;
+        propose-only until ``geomesa.reshard.auto`` is set)."""
+        if self._autoscaler is None:
+            from .autoscale import Autoscaler
+            self._autoscaler = Autoscaler(self, self.resharder,
+                                          registry=self._registry)
+        return self._autoscaler
+
+    @contextlib.contextmanager
+    def _op(self):
+        """Every read/write runs under the shared side of the op gate
+        (the flip drains them via the exclusive side), and fails typed
+        while a crashed flip leaves the topology inconsistent —
+        exact-or-typed, never a silently duplicated merge."""
+        with self._gate.shared():
+            mig = self._migration
+            if mig is not None and mig.blocking:
+                raise ReshardError(
+                    f"topology flip incomplete (migration "
+                    f"{mig.src_name}->{mig.dst_name}, phase "
+                    f"{mig.phase}); resume or abort the reshard")
+            yield
+
+    def _check_epoch(self, topology_epoch):
+        """Zombie-write fence: a client that routed against a topology
+        the cluster has flipped past must fail typed and re-route (the
+        PR 8 promote-cutoff pattern pointed at topology)."""
+        if topology_epoch is None:
+            return
+        if int(topology_epoch) != self._part.epoch:
+            self._registry.counter("cluster.reshard.zombie.rejects")
+            raise StaleTopologyError(
+                f"write routed under topology epoch {topology_epoch} "
+                f"but the cluster is at epoch {self._part.epoch}",
+                epoch=int(topology_epoch), current=self._part.epoch)
+
+    def topology(self, include_counts: bool = True) -> dict:
+        """The versioned topology document (``GET /rest/topology``):
+        epoch, boundary segments, per-group owned ranges + row counts
+        (the key-density summary), the active migration, and the epoch
+        history."""
+        part = self._part
+        mig = self._migration
+        from .autoscale import RESHARD_AUTO
+        from .reshard import RESHARD_ENABLED
+        groups = []
+        for i, (name, g) in enumerate(zip(self._names, self._groups)):
+            ent: dict = {"name": name,
+                         "ranges": [{"prefix_lo": lo, "prefix_hi": hi}
+                                    for lo, hi
+                                    in part.owned_prefix_ranges(i)]}
+            if include_counts:
+                rows: int | None = 0
+                for tn in self.get_type_names():
+                    try:
+                        rows += int(g.count(tn))
+                    except Exception:  # noqa: BLE001 — status surface
+                        rows = None
+                        break
+                ent["rows"] = rows
+            groups.append(ent)
+        self._registry.gauge("cluster.topology.epoch", part.epoch)
+        return {"epoch": part.epoch,
+                "prefix_bits": PREFIX_BITS,
+                "n_groups": len(self._groups),
+                "enabled": bool(RESHARD_ENABLED.as_bool()),
+                "auto": bool(RESHARD_AUTO.as_bool()),
+                "segments": [dict(s, name=self._names[s["group"]])
+                             for s in part.segments()],
+                "groups": groups,
+                "migration": mig.describe() if mig is not None else None,
+                "history": list(self.resharder.history)}
 
     # -- knobs -------------------------------------------------------------
 
@@ -428,6 +549,7 @@ class ClusterDataStore(DataStore):
                      else [n for n in self._names if n in set(legs)])
         pruned = [n for n in self._names if n not in contacted]
         plan = {"op": op, "type": type_name,
+                "topology_epoch": self._part.epoch,
                 "contacted": contacted, "pruned": pruned}
         if info:
             plan.update(info)
@@ -488,6 +610,11 @@ class ClusterDataStore(DataStore):
             self._bump_lsn(name, group, ret)
         self._sfts[sft.type_name] = sft
         self._prune_cache.clear()
+        mig = self._migration
+        if mig is not None:
+            # keep the staging store's schema view current so later
+            # staged applies of the new type always land
+            mig.pending.create_schema(sft)
 
     def get_schema(self, type_name: str):
         sft = self._sfts.get(type_name)
@@ -521,6 +648,9 @@ class ClusterDataStore(DataStore):
             self._bump_lsn(name, group, ret)
         self._sfts.pop(type_name, None)
         self._prune_cache.clear()
+        mig = self._migration
+        if mig is not None and type_name in mig.pending.get_type_names():
+            mig.pending.remove_schema(type_name)
 
     # -- write path --------------------------------------------------------
 
@@ -552,29 +682,41 @@ class ClusterDataStore(DataStore):
             return dict(self._lsn_vector)
 
     def write(self, type_name: str, batch: FeatureBatch,
-              visibilities=None, **kwargs):
+              visibilities=None, topology_epoch=None, **kwargs):
         """Partition the batch by z-prefix owner and write each slice
         to its owning group. Returns the updated LSN vector. Groups
         are written in order; a failing group raises after earlier
         groups applied their slices (at-least-once on retry — the
         failed slice was never acked, so the zero-acked-loss contract
-        holds)."""
-        sft = self.get_schema(type_name)
-        owners = self._part.owners_for_batch(sft, batch)
-        vis_arr = (np.asarray(visibilities, dtype=object)
-                   if visibilities is not None else None)
-        routed = 0
-        for gi, (name, group) in enumerate(zip(self._names, self._groups)):
-            rows = np.flatnonzero(owners == gi)
-            if not len(rows):
-                continue
-            sub = batch if len(rows) == batch.n else batch.take(rows)
-            vis = None if vis_arr is None else list(vis_arr[rows])
-            ret = group.write(type_name, sub, visibilities=vis, **kwargs)
-            self._bump_lsn(name, group, ret)
-            routed += len(rows)
-        self._registry.counter("cluster.writes.routed", routed)
-        return self.lsn_vector()
+        holds). ``topology_epoch`` (optional) asserts the topology the
+        caller routed against — a stale epoch fails typed before any
+        slice lands."""
+        with self._op():
+            self._check_epoch(topology_epoch)
+            sft = self.get_schema(type_name)
+            owners = self._part.owners_for_batch(sft, batch)
+            vis_arr = (np.asarray(visibilities, dtype=object)
+                       if visibilities is not None else None)
+            routed = 0
+            for gi, (name, group) in enumerate(zip(self._names,
+                                                   self._groups)):
+                rows = np.flatnonzero(owners == gi)
+                if not len(rows):
+                    continue
+                sub = batch if len(rows) == batch.n else batch.take(rows)
+                vis = None if vis_arr is None else list(vis_arr[rows])
+                ret = group.write(type_name, sub, visibilities=vis,
+                                  **kwargs)
+                self._bump_lsn(name, group, ret)
+                routed += len(rows)
+            mig = self._migration
+            if mig is not None and mig.forward:
+                # non-durable source: double-route the moving slice to
+                # the migration's staging store (a durable source's
+                # WAL tail carries it instead)
+                mig.stage_write(sft, batch, visibilities=visibilities)
+            self._registry.counter("cluster.writes.routed", routed)
+            return self.lsn_vector()
 
     def write_many(self, type_name: str,
                    pairs: list[tuple[FeatureBatch, list | None]]):
@@ -585,35 +727,45 @@ class ClusterDataStore(DataStore):
         pairs = [(b, v) for b, v in pairs if b is not None and b.n]
         if not pairs:
             return None
-        sft = self.get_schema(type_name)
-        per_group: list[list] = [[] for _ in self._groups]
-        routed = 0
-        for batch, vis in pairs:
-            owners = self._part.owners_for_batch(sft, batch)
-            vis_arr = (np.asarray(vis, dtype=object)
-                       if vis is not None else None)
-            for gi in np.unique(owners):
-                rows = np.flatnonzero(owners == gi)
-                sub = batch if len(rows) == batch.n else batch.take(rows)
-                sv = None if vis_arr is None else list(vis_arr[rows])
-                per_group[int(gi)].append((sub, sv))
-                routed += len(rows)
-        for gi, (name, group) in enumerate(zip(self._names,
-                                               self._groups)):
-            if not per_group[gi]:
-                continue
-            ret = group.write_many(type_name, per_group[gi])
-            self._bump_lsn(name, group, ret)
-        self._registry.counter("cluster.writes.routed", routed)
-        return self.lsn_vector()
+        with self._op():
+            sft = self.get_schema(type_name)
+            per_group: list[list] = [[] for _ in self._groups]
+            routed = 0
+            for batch, vis in pairs:
+                owners = self._part.owners_for_batch(sft, batch)
+                vis_arr = (np.asarray(vis, dtype=object)
+                           if vis is not None else None)
+                for gi in np.unique(owners):
+                    rows = np.flatnonzero(owners == gi)
+                    sub = (batch if len(rows) == batch.n
+                           else batch.take(rows))
+                    sv = None if vis_arr is None else list(vis_arr[rows])
+                    per_group[int(gi)].append((sub, sv))
+                    routed += len(rows)
+            for gi, (name, group) in enumerate(zip(self._names,
+                                                   self._groups)):
+                if not per_group[gi]:
+                    continue
+                ret = group.write_many(type_name, per_group[gi])
+                self._bump_lsn(name, group, ret)
+            mig = self._migration
+            if mig is not None and mig.forward:
+                for batch, vis in pairs:
+                    mig.stage_write(sft, batch, visibilities=vis)
+            self._registry.counter("cluster.writes.routed", routed)
+            return self.lsn_vector()
 
     def delete(self, type_name: str, ids):
         """Broadcast: geometry-routed rows cannot be re-owned from ids
         alone, and deleting absent ids is a no-op everywhere."""
-        for name, group in zip(self._names, self._groups):
-            ret = group.delete(type_name, ids)
-            self._bump_lsn(name, group, ret)
-        return self.lsn_vector()
+        with self._op():
+            for name, group in zip(self._names, self._groups):
+                ret = group.delete(type_name, ids)
+                self._bump_lsn(name, group, ret)
+            mig = self._migration
+            if mig is not None and mig.forward:
+                mig.stage_delete(type_name, ids)
+            return self.lsn_vector()
 
     # -- read path ---------------------------------------------------------
 
@@ -624,6 +776,7 @@ class ClusterDataStore(DataStore):
             q = Query(type_name, q)
         return q
 
+    @_gated
     def query(self, q, type_name=None, explain_out=None):
         q = self._as_query(q, type_name)
 
@@ -674,6 +827,7 @@ class ClusterDataStore(DataStore):
             ids, batch, explain,
             FilterStrategy("cluster", q.filter, None), n=len(ids))
         out.lsn_vector = self.lsn_vector()
+        out.topology_epoch = self._part.epoch
         if missing:
             out.complete = False
             out.missing_groups = missing["groups"]
@@ -683,6 +837,7 @@ class ClusterDataStore(DataStore):
                     len(ids), index="cluster")
         return out
 
+    @_gated
     def query_count(self, q, type_name=None) -> int:
         q = self._as_query(q, type_name)
         from ..audit import audit_query, delegated_scope
@@ -706,11 +861,13 @@ class ClusterDataStore(DataStore):
             out = PartialCount(total)
             out.missing_groups = missing["groups"]
             out.missing_z_ranges = missing["z_ranges"]
+            out.topology_epoch = self._part.epoch
             return out
         return total
 
     # -- distributed SQL legs ----------------------------------------------
 
+    @_gated
     def sql_partial(self, stmt: str, type_name: str = "",
                     legs: list[str] | None = None) \
             -> tuple[dict, dict | None]:
@@ -745,6 +902,7 @@ class ClusterDataStore(DataStore):
                     index="sql-partial")
         return results, missing
 
+    @_gated
     def sql_join_partial(self, spec: dict, type_name: str = "",
                          legs: list[str] | None = None) \
             -> tuple[dict, dict | None]:
@@ -779,6 +937,7 @@ class ClusterDataStore(DataStore):
                     index="sql-join-partial")
         return results, missing
 
+    @_gated
     def count(self, type_name: str) -> int:
         results, failures = self._scatter(
             lambda name, group:
@@ -795,6 +954,7 @@ class ClusterDataStore(DataStore):
 
     # -- mergeable aggregates ----------------------------------------------
 
+    @_gated
     def stats_query(self, type_name: str, stat_spec: str, ecql=None):
         """Scatter the sketch, merge exactly (Stat.merge — every
         sketch in stats/sketches.py is a commutative monoid over
@@ -827,6 +987,7 @@ class ClusterDataStore(DataStore):
             merged.missing_z_ranges = missing["z_ranges"]
         return merged
 
+    @_gated
     def density(self, type_name: str, ecql, bbox, width: int, height: int,
                 weight_attr: str | None = None) -> np.ndarray:
         """Scatter the heatmap; grids over disjoint partitions sum
@@ -870,6 +1031,7 @@ class ClusterDataStore(DataStore):
         except Exception:  # noqa: BLE001 — pruning input is advisory
             return ecql
 
+    @_gated
     def bin_query(self, type_name: str, ecql, track: str | None = None,
                   label: str | None = None, sort: bool = False) -> bytes:
         """Scatter BIN encoding; sorted chunks k-way merge via
@@ -897,6 +1059,7 @@ class ClusterDataStore(DataStore):
             data.missing_z_ranges = missing["z_ranges"]
         return data
 
+    @_gated
     def arrow_ipc(self, type_name: str, ecql="INCLUDE",
                   sort_by: str | None = None) -> bytes:
         """Scatter arrow encoding (each leg sorts shard-locally), then
@@ -948,6 +1111,24 @@ class ClusterDataStore(DataStore):
         from ..arrow.delta import (STREAM_MAX_INFLIGHT,
                                    merge_sorted_streams, slice_batches)
         q = self._as_query(q, type_name)
+        # a stream holds the shared op gate for its whole lifetime
+        # (releases in the merge's finally): the reshard flip cannot
+        # swap topology under a half-consumed merge
+        self._gate.acquire_shared()
+        gate_released = threading.Event()
+
+        def release_gate():
+            if not gate_released.is_set():
+                gate_released.set()
+                self._gate.release_shared()
+
+        mig = self._migration
+        if mig is not None and mig.blocking:
+            release_gate()
+            raise ReshardError(
+                f"topology flip incomplete (migration "
+                f"{mig.src_name}->{mig.dst_name}, phase {mig.phase}); "
+                "resume or abort the reshard")
         deadline = self._leg_deadline_s()
         depth = max(STREAM_MAX_INFLIGHT.as_int() or 4, 1)
         self._registry.counter("cluster.scatter.calls")
@@ -1023,6 +1204,7 @@ class ClusterDataStore(DataStore):
                 yield val
 
         handle = _ClusterStream()
+        handle._on_close = release_gate
 
         def merged():
             try:
@@ -1046,6 +1228,7 @@ class ClusterDataStore(DataStore):
                 # here (strict mode raised typed during iteration);
                 # gating on it keeps the finally from raising anew.
                 stop.set()
+                release_gate()
                 if failures and self._allow_partial():
                     missing = self._missing(failures)
                     handle.complete = False
@@ -1077,6 +1260,7 @@ class ClusterDataStore(DataStore):
         return {"role": "cluster",
                 "n_groups": len(self._groups),
                 "prefix_bits": PREFIX_BITS,
+                "topology_epoch": self._part.epoch,
                 "allow_partial": self._allow_partial(),
                 "prune": bool(CLUSTER_PRUNE.as_bool()),
                 "leg_deadline_s": self._leg_deadline_s(),

@@ -683,6 +683,25 @@ class RemoteDataStore(DataStore):
         params = {"group": group} if group else None
         return self._json("POST", "/rest/cluster/promote", params)
 
+    def topology(self, include_counts: bool = True) -> dict:
+        """GET /rest/topology: the cluster's epoch-stamped segment
+        map (server must front a ClusterDataStore)."""
+        params = None if include_counts else {"counts": "false"}
+        return self._json("GET", "/rest/topology", params)
+
+    def reshard_status(self) -> dict:
+        """GET /rest/reshard: migrations in flight, epoch history,
+        cooldown."""
+        return self._json("GET", "/rest/reshard")
+
+    def reshard(self, verb: str, **params) -> dict:
+        """POST /rest/reshard/{split|migrate|resume|abort|auto}
+        (bearer-gated). Keyword args become query params (e.g.
+        ``reshard("split", src="shard2")``)."""
+        clean = {k: v for k, v in params.items() if v is not None}
+        return self._json("POST", f"/rest/reshard/{quote(verb)}",
+                          clean or None)
+
     def cache_status(self) -> dict:
         """GET /rest/cache: the server store's materialized-cache
         status (entries, bytes, hit/miss counters, refresher state)."""

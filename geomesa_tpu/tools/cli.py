@@ -523,6 +523,91 @@ def cmd_cluster(args) -> int:
     return 2
 
 
+def cmd_reshard(args) -> int:
+    """Elastic-topology administration: ``status`` dumps the
+    epoch-stamped segment map plus resharder state (in-flight
+    migration, history, cooldown); ``split``/``migrate`` move z-prefix
+    ranges online; ``auto`` ticks (or --state starts/stops) the
+    SLO-driven autoscaler. Mutating verbs are bearer-gated on remote
+    nodes (403 -> exit 3); typed reshard refusals (kill switch,
+    cooldown, broken migration) exit 2."""
+    path = args.path
+    remote = path.startswith("remote://")
+    if remote:
+        from ..store import RemoteDataStore
+        host, _, port = path[len("remote://"):].partition(":")
+        ds = RemoteDataStore(host or "127.0.0.1",
+                             int(port) if port else 8080,
+                             auth_token=getattr(args, "token", None))
+    elif path.startswith("cluster://"):
+        from ..cluster import ClusterDataStore
+        ds = ClusterDataStore.from_uri(path,
+                                       auth_token=getattr(args, "token",
+                                                          None))
+    else:
+        print("reshard commands need --path remote://host:port or "
+              "cluster://h1:p1,h2:p2", file=sys.stderr)
+        return 2
+    from ..cluster.reshard import ReshardError
+    from ..store.remote import RemoteError
+    cmd = args.reshard_command
+    try:
+        if cmd == "status":
+            out = {"topology": ds.topology(),
+                   "reshard": (ds.reshard_status() if remote
+                               else ds.resharder.status())}
+        elif cmd == "split":
+            if remote:
+                out = ds.reshard("split", src=args.src, dst=args.dst,
+                                 at=args.at)
+            else:
+                out = ds.resharder.split(args.src, dst=args.dst,
+                                         at=args.at, reason="cli")
+        elif cmd == "migrate":
+            if remote:
+                out = ds.reshard("migrate", prefix_lo=args.prefix_lo,
+                                 prefix_hi=args.prefix_hi,
+                                 src=args.src, dst=args.dst)
+            else:
+                out = ds.resharder.migrate(args.prefix_lo,
+                                           args.prefix_hi, args.src,
+                                           args.dst, reason="cli")
+        elif cmd == "auto":
+            state = getattr(args, "state", None)
+            if remote:
+                out = ds.reshard("auto", state=state)
+            elif state == "on":
+                ds.autoscaler.start()
+                out = ds.autoscaler.status()
+            elif state == "off":
+                ds.autoscaler.stop()
+                out = ds.autoscaler.status()
+            else:
+                out = ds.autoscaler.run_once()
+        else:
+            print(f"unknown reshard command {cmd!r}", file=sys.stderr)
+            return 2
+    except ReshardError as e:
+        print(f"reshard refused: {e}", file=sys.stderr)
+        return 2
+    except (KeyError, ValueError) as e:
+        msg = e.args[0] if e.args else e
+        print(f"reshard refused: {msg}", file=sys.stderr)
+        return 2
+    except RemoteError as e:
+        if e.status == 403:
+            print("reshard is gated: pass --token matching "
+                  "geomesa.web.auth.token", file=sys.stderr)
+            return 3
+        if e.status == 409:
+            print(f"reshard refused: {e}", file=sys.stderr)
+            return 2
+        raise
+    json.dump(out, sys.stdout, indent=2)
+    print()
+    return 0
+
+
 def cmd_cache(args) -> int:
     """Materialized-cache administration against a serving node:
     ``status`` dumps the store's cache/version state (entries, bytes,
@@ -811,6 +896,53 @@ def main(argv=None) -> int:
             cp.add_argument("--group", default=None,
                             help="shard group name to promote inside")
         cp.set_defaults(fn=cmd_cluster)
+
+    rsp = sub.add_parser("reshard",
+                         help="elastic topology: online z-shard "
+                              "split/migration + autoscaler")
+    rssub = rsp.add_subparsers(dest="reshard_command", required=True)
+    for rname, rhelp in (("status", "epoch-stamped segment map + "
+                                    "resharder/migration state"),
+                         ("split", "split a hot group's widest range "
+                                   "at its key-density median "
+                                   "(token-gated)"),
+                         ("migrate", "move one z-prefix range between "
+                                     "groups online (token-gated)"),
+                         ("auto", "tick or start/stop the SLO-driven "
+                                  "autoscaler (token-gated)")):
+        rp_ = rssub.add_parser(rname, help=rhelp)
+        rp_.add_argument("--path", required=True,
+                         help="coordinator node remote://host:port, or "
+                              "federation cluster://h1:p1,h2:p2")
+        rp_.add_argument("--token", default=None,
+                         help="admin bearer token "
+                              "(geomesa.web.auth.token)")
+        if rname == "split":
+            rp_.add_argument("--src", required=True,
+                             help="hot shard group to split")
+            rp_.add_argument("--dst", default=None,
+                             help="receiving group (default: lowest "
+                                  "p99)")
+            rp_.add_argument("--at", type=int, default=None,
+                             help="split prefix (default: weighted "
+                                  "median of the key density)")
+        if rname == "migrate":
+            rp_.add_argument("--prefix-lo", type=int, required=True,
+                             dest="prefix_lo",
+                             help="first z prefix to move (inclusive)")
+            rp_.add_argument("--prefix-hi", type=int, required=True,
+                             dest="prefix_hi",
+                             help="last z prefix to move (exclusive)")
+            rp_.add_argument("--src", required=True,
+                             help="group that owns the range now")
+            rp_.add_argument("--dst", required=True,
+                             help="group that should own it")
+        if rname == "auto":
+            rp_.add_argument("--state", choices=("on", "off"),
+                             default=None,
+                             help="start/stop the background loop "
+                                  "(default: run one tick)")
+        rp_.set_defaults(fn=cmd_reshard)
 
     cap = sub.add_parser("cache",
                          help="materialized pushdown-cache "

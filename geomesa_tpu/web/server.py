@@ -87,7 +87,8 @@ WEB_AUTH_TOKEN = SystemProperty("geomesa.web.auth.token", None)
 # GET /rest/wal stays open (read-only stats)
 _GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas"),
           ("POST", "wal"), ("POST", "replication"), ("POST", "integrity"),
-          ("POST", "cluster"), ("POST", "cache"), ("POST", "cq")}
+          ("POST", "cluster"), ("POST", "cache"), ("POST", "cq"),
+          ("POST", "reshard")}
 
 # load-shedding gate: max concurrent in-flight requests (unset ->
 # unlimited). Requests over the cap get 503 + Retry-After BEFORE any
@@ -583,6 +584,16 @@ class GeoMesaWebServer:
             return self._replication(method, parts[1:])
         if parts and parts[0] == "cluster":
             return self._cluster(method, parts[1:], params)
+        if parts == ["topology"] and method == "GET":
+            topology = getattr(self.store, "topology", None)
+            if not callable(topology):
+                return 404, "application/json", _j(
+                    {"error": "store has no cluster topology"})
+            counts = params.get("counts", ["true"])[0] != "false"
+            return 200, "application/json", _j(
+                topology(include_counts=counts))
+        if parts and parts[0] == "reshard":
+            return self._reshard(method, parts[1:], params)
         if parts == ["audit"]:
             # a server fronting a store without its own logger still
             # answers: surfaces without one record into the process
@@ -679,6 +690,74 @@ class GeoMesaWebServer:
                               "coordinator)"})
             group = params.get("group", [None])[0]
             return 200, "application/json", _j(promote(group))
+        return 404, "application/json", _j({"error": "not found"})
+
+    def _reshard(self, method, parts, params):
+        """Elastic-topology admin. GET /rest/reshard reports resharder
+        state (in-flight migration, epoch history, cooldown); POST
+        /rest/reshard/split|migrate|resume|abort (bearer-gated) drive
+        the verbs and POST /rest/reshard/auto ticks — or with
+        ?state=on|off starts/stops — the autoscaler loop. Typed
+        reshard refusals (kill switch, cooldown, in-flight limit) map
+        to 409: the request was well-formed but the topology cannot
+        change right now."""
+        if not hasattr(self.store, "resharder"):
+            return 404, "application/json", _j(
+                {"error": "store has no elastic topology"})
+        from ..cluster.reshard import ReshardError
+        resharder = self.store.resharder
+        if method == "GET" and not parts:
+            return 200, "application/json", _j(resharder.status())
+        if method != "POST" or len(parts) != 1:
+            return 404, "application/json", _j({"error": "not found"})
+        verb = parts[0]
+        try:
+            if verb == "split":
+                src = (params.get("src", [None])[0]
+                       or params.get("group", [None])[0])
+                if src is None:
+                    return 400, "application/json", _j(
+                        {"error": "split requires ?src=<group>"})
+                at = params.get("at", [None])[0]
+                entry = resharder.split(
+                    src, dst=params.get("dst", [None])[0],
+                    at=int(at) if at is not None else None,
+                    reason="rest")
+                return 200, "application/json", _j(entry)
+            if verb == "migrate":
+                need = ("prefix_lo", "prefix_hi", "src", "dst")
+                missing = [k for k in need if params.get(k, [None])[0]
+                           is None]
+                if missing:
+                    return 400, "application/json", _j(
+                        {"error": "migrate requires "
+                                  + ", ".join(f"?{k}=" for k in need)})
+                entry = resharder.migrate(
+                    int(params["prefix_lo"][0]),
+                    int(params["prefix_hi"][0]),
+                    params["src"][0], params["dst"][0], reason="rest")
+                return 200, "application/json", _j(entry)
+            if verb == "resume":
+                return 200, "application/json", _j(resharder.resume())
+            if verb == "abort":
+                return 200, "application/json", _j(resharder.abort())
+            if verb == "auto":
+                scaler = self.store.autoscaler
+                state = params.get("state", [None])[0]
+                if state == "on":
+                    scaler.start()
+                elif state == "off":
+                    scaler.stop()
+                elif state is not None:
+                    return 400, "application/json", _j(
+                        {"error": "state must be on|off"})
+                else:
+                    return 200, "application/json", _j(
+                        scaler.run_once())
+                return 200, "application/json", _j(scaler.status())
+        except ReshardError as e:
+            return (409, "application/json",
+                    _j({"error": str(e), "retryable": False}))
         return 404, "application/json", _j({"error": "not found"})
 
     def _wal(self, method, parts, params):
@@ -1051,10 +1130,16 @@ def _partial_headers(res) -> dict:
     """Response headers for the cluster partial-results contract: a
     result flagged ``complete=False`` (a shard group was down and
     ``geomesa.cluster.allow.partial`` let the query degrade) is marked
-    so no transport strips the flag. Complete results add nothing."""
+    so no transport strips the flag. Cluster results also carry the
+    topology epoch they were planned against, so clients straddling an
+    online reshard can detect the flip."""
+    hdrs: dict = {}
+    epoch = getattr(res, "topology_epoch", None)
+    if epoch is not None:
+        hdrs["X-GeoMesa-Topology-Epoch"] = str(int(epoch))
     if getattr(res, "complete", True) is not False:
-        return {}
-    hdrs = {"X-GeoMesa-Complete": "false"}
+        return hdrs
+    hdrs["X-GeoMesa-Complete"] = "false"
     groups = getattr(res, "missing_groups", None)
     if groups:
         hdrs["X-GeoMesa-Missing-Groups"] = ",".join(groups)

@@ -73,3 +73,8 @@ def pytest_configure(config):
         "markers", "sql: distributed SQL suites (partial-aggregate "
         "pushdown, broadcast spatial joins, plan surface, partial "
         "contract over SQL legs; select with -m sql)")
+    config.addinivalue_line(
+        "markers", "reshard: elastic-topology suites (online z-shard "
+        "split/migration, epoch fencing, kill-point crash loop, "
+        "SLO-driven autoscaler; select with -m reshard — the "
+        "randomized kill-point soak is additionally marked slow)")

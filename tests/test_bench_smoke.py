@@ -296,3 +296,31 @@ def test_config20_planner_smoke():
     assert x["below_estimate"]["mode"] == "cluster-materialize"
     assert x["below_estimate"]["strategy"] == "cluster-materialize"
     assert "gates_pass" in c
+
+
+@pytest.mark.bench_smoke
+@pytest.mark.reshard
+@pytest.mark.cluster
+def test_config21_reshard_smoke():
+    rng = np.random.default_rng(54)
+    # synthetic_hot_signal: at toy sizes scheduler noise drowns the
+    # breaker EWMAs' scan-cost skew, so the autoscaler observes
+    # per-group row counts instead — the decision loop, sustain window,
+    # split and flip all still run for real
+    c = bench.bench_config21(rng, n=6000, c=8, synthetic_hot_signal=True)
+    assert c["exact"] is True
+    assert c["auto_fired"] is True
+    assert c["epoch"] == 1
+    auto = [e for e in c["history"] if e.get("reason") == "auto"]
+    assert auto and auto[0]["op"] == "migrate"
+    assert auto[0]["rows_moved"] > 0
+    assert c["decision"]["action"] == "split"
+    assert c["decision"]["executed"] is True
+    assert c["hot_group"] == c["decision"]["group"]
+    for phase in ("pre", "hot", "post"):
+        assert c[phase]["p99_ms"] > 0
+    # with the synthetic (row-count) signal the density-median split
+    # halves the hot leg deterministically, so the heal gate holds
+    # even at toy size
+    assert c["heal_ratio"] < 0.75
+    assert "gates_pass" in c

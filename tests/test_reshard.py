@@ -1,0 +1,746 @@
+"""Elastic topology: online Z-shard split/migration + autoscaler.
+
+Covers the epoch-stamped segment topology (boundary-list partitioner,
+bit-identity of the uniform epoch-0 layout with the closed-form
+split), key-density split-point selection, the online migration
+protocol (snapshot + WAL tail + atomic flip) against non-durable and
+durable shard groups with a single-store oracle for id-exactness, the
+zombie-write epoch fence, the kill switch's bit-identical off
+behavior, prune-cache/plan invalidation across a flip, randomized
+kill-point crash safety (zero acked loss, no duplicate ids, clean
+resume-or-abort), concurrent exact-or-typed queries during a
+migration, the SLO-driven autoscaler's decision loop, and the
+REST/CLI admin surfaces.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.cluster import (Autoscaler, ClusterDataStore,
+                                 Resharder, ReshardError,
+                                 StaleTopologyError, ZPrefixPartitioner)
+from geomesa_tpu.cluster.partition import PREFIX_BITS, _N_PREFIXES
+from geomesa_tpu.cluster.reshard import RESHARD_ENABLED
+from geomesa_tpu.cluster.autoscale import (RESHARD_AUTO,
+                                           RESHARD_HOT_SUSTAIN_S)
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.store import InMemoryDataStore
+
+pytestmark = pytest.mark.reshard
+
+SPEC = "*geom:Point:srid=4326,dtg:Date,name:String"
+
+
+def hot_seeded(n=600, seed=3, hot_frac=0.7):
+    """Skewed rows: ``hot_frac`` of them packed into one small corner
+    box (a single shard group's keyspace), the rest uniform."""
+    rng = np.random.default_rng(seed)
+    ids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    n_hot = int(n * hot_frac)
+    x = np.concatenate([rng.uniform(100, 112, n_hot),
+                        rng.uniform(-180, 180, n - n_hot)])
+    y = np.concatenate([rng.uniform(40, 46, n_hot),
+                        rng.uniform(-90, 90, n - n_hot)])
+    cols = {
+        "geom": (x, y),
+        "dtg": (np.int64(1704067200000)
+                + np.arange(n, dtype=np.int64) * 3_600_000),
+        "name": np.array([f"n{i % 7}" for i in range(n)], dtype=object),
+    }
+    return ids, cols
+
+
+def make_cluster(k, n=600, names=None, groups=None, **kw):
+    """k shard groups (in-memory unless given) + an oracle, same rows."""
+    sft = parse_spec("pts", SPEC)
+    groups = groups or [InMemoryDataStore() for _ in range(k)]
+    cluster = ClusterDataStore(groups, names=names, **kw)
+    cluster.create_schema(sft)
+    oracle = InMemoryDataStore()
+    oracle.create_schema(sft)
+    ids, cols = hot_seeded(n)
+    cluster.write("pts", FeatureBatch.from_dict(sft, ids, cols))
+    oracle.write("pts", FeatureBatch.from_dict(sft, ids, cols))
+    return cluster, oracle, sft
+
+
+def hottest_group(cluster):
+    """The group name owning the most rows right now."""
+    topo = cluster.topology()
+    best = max(topo["groups"], key=lambda g: g["rows"])
+    return best["name"]
+
+
+def cluster_ids(store, ecql="INCLUDE"):
+    res = store.query(ecql, "pts")
+    return set() if res.batch is None else set(res.ids.astype(str))
+
+
+def assert_exact(cluster, oracle):
+    """Id-exact scatter-gather vs the single-store oracle, plus the
+    no-duplicate invariant across the shard groups themselves."""
+    for ecql in ("INCLUDE", "BBOX(geom, 100, 40, 112, 46)",
+                 "BBOX(geom, -60, -30, 60, 30)", "name = 'n3'"):
+        assert cluster_ids(cluster, ecql) == cluster_ids(oracle, ecql), ecql
+    assert cluster.count("pts") == oracle.count("pts")
+    per_group = [g.count("pts") for g in cluster._groups]
+    assert sum(per_group) == oracle.count("pts")  # no dup, no loss
+
+
+@pytest.fixture
+def reset_knobs():
+    yield
+    RESHARD_ENABLED.set(None)
+    RESHARD_AUTO.set(None)
+    RESHARD_HOT_SUSTAIN_S.set(None)
+
+
+# -- segment topology --------------------------------------------------------
+
+class TestSegmentTopology:
+    def test_uniform_matches_closed_form(self):
+        """Epoch 0 must be bit-identical to the ceil-div closed form
+        the pre-reshard partitioner used — the kill-switch contract."""
+        rng = np.random.default_rng(0)
+        x, y = rng.uniform(-180, 180, 1000), rng.uniform(-90, 90, 1000)
+        from geomesa_tpu.curves.sfc import Z2SFC
+        z = np.asarray(Z2SFC().index(x, y, lenient=True)).astype(np.uint64)
+        prefix = (z >> np.uint64(62 - PREFIX_BITS)).astype(np.int64)
+        for n in (1, 2, 3, 5, 8, 16):
+            want = (prefix * n) >> PREFIX_BITS
+            got = ZPrefixPartitioner(n).owners_xy(x, y)
+            assert (got == want).all(), n
+
+    def test_with_move_epoch_and_ownership(self):
+        part = ZPrefixPartitioner(4)
+        assert part.epoch == 0
+        moved = part.with_move(1000, 2000, 3)
+        assert moved.epoch == 1 and part.epoch == 0  # immutable
+        for p in (1000, 1500, 1999):
+            assert moved.owner_of(p) == 3
+        for p in (0, 999, 2000, _N_PREFIXES - 1):
+            assert moved.owner_of(p) == part.owner_of(p)
+
+    def test_segments_cover_and_disjoint_after_moves(self):
+        part = ZPrefixPartitioner(3)
+        part = part.with_move(100, 900, 2).with_move(40000, 41000, 0)
+        segs = part.segments()
+        assert segs[0]["prefix_lo"] == 0
+        assert segs[-1]["prefix_hi"] == _N_PREFIXES
+        for a, b in zip(segs, segs[1:]):
+            assert a["prefix_hi"] == b["prefix_lo"]
+            assert a["group"] != b["group"]  # coalesced
+
+    def test_id_hash_routing_survives_moves(self):
+        part = ZPrefixPartitioner(5)
+        moved = part.with_move(0, 30000, 4)
+        ids = [f"feat-{i}" for i in range(200)]
+        assert (part.owners_ids(ids) == moved.owners_ids(ids)).all()
+
+    def test_groups_for_ranges_tracks_move(self):
+        part = ZPrefixPartitioner(2)
+        lo, hi = 1000, 2000
+        shift = 62 - PREFIX_BITS
+        zr = [(lo << shift, (hi << shift) - 1)]
+        assert part.groups_for_ranges(zr) == [0]
+        assert part.with_move(lo, hi, 1).groups_for_ranges(zr) == [1]
+
+
+# -- split-point selection ---------------------------------------------------
+
+class TestSplitPoint:
+    def test_weighted_median_uniform_is_midpoint(self):
+        from geomesa_tpu.index.splitter import pick_split_prefix
+        counts = np.ones(100, dtype=np.int64)
+        assert pick_split_prefix(counts, 200, 300) == 250
+
+    def test_weighted_median_follows_mass(self):
+        from geomesa_tpu.index.splitter import pick_split_prefix
+        counts = np.zeros(100, dtype=np.int64)
+        counts[80] = 1000           # all keys in one high bin
+        at = pick_split_prefix(counts, 0, 100)
+        assert at == 81             # half the ROWS on each side
+
+    def test_clamped_inside_open_interval(self):
+        from geomesa_tpu.index.splitter import pick_split_prefix
+        counts = np.zeros(50, dtype=np.int64)
+        counts[0] = 10
+        assert pick_split_prefix(counts, 10, 60) == 11
+        counts = np.zeros(50, dtype=np.int64)
+        counts[49] = 10
+        assert pick_split_prefix(counts, 10, 60) == 59
+
+    def test_midpoint_fallbacks(self):
+        from geomesa_tpu.index.splitter import pick_split_prefix
+        assert pick_split_prefix(None, 0, 100) == 50
+        assert pick_split_prefix(np.zeros(100, np.int64), 0, 100) == 50
+        assert pick_split_prefix(np.ones(3, np.int64), 0, 100) == 50
+
+    def test_histogram_counts_rows(self):
+        from geomesa_tpu.index.splitter import prefix_histogram
+        cluster, oracle, _ = make_cluster(1, n=200)
+        h = prefix_histogram(oracle, "pts", 0, _N_PREFIXES)
+        assert int(h.sum()) == 200
+        cluster.close()
+
+
+# -- online migration: id-exact vs oracle ------------------------------------
+
+class TestMigrateOnline:
+    def test_split_hot_group_exact(self):
+        cluster, oracle, _ = make_cluster(3, names=["a", "b", "c"])
+        hot = hottest_group(cluster)
+        pre_rows = dict((g["name"], g["rows"])
+                        for g in cluster.topology()["groups"])
+        entry = cluster.resharder.split(hot)
+        assert entry["op"] == "migrate" and entry["src"] == hot
+        assert entry["rows_moved"] > 0
+        assert cluster._part.epoch == 1
+        assert_exact(cluster, oracle)
+        post_rows = dict((g["name"], g["rows"])
+                         for g in cluster.topology()["groups"])
+        assert post_rows[hot] < pre_rows[hot]
+        assert post_rows[entry["dst"]] == (pre_rows[entry["dst"]]
+                                           + entry["rows_moved"])
+        cluster.close()
+
+    def test_migrate_validates_range_and_groups(self):
+        cluster, _, _ = make_cluster(2, names=["a", "b"])
+        r = cluster.resharder
+        with pytest.raises(ReshardError):
+            r.migrate(0, 10, "a", "a")          # src == dst
+        with pytest.raises(ReshardError):
+            r.migrate(10, 5, "a", "b")          # inverted range
+        with pytest.raises(ReshardError):
+            r.migrate(0, 10, "nope", "b")       # unknown group
+        with pytest.raises(ReshardError):
+            # upper half belongs to b, not a
+            r.migrate(_N_PREFIXES - 10, _N_PREFIXES, "a", "b")
+        with pytest.raises(ReshardError):
+            r.resume()                          # nothing in flight
+        with pytest.raises(ReshardError):
+            r.abort()
+        cluster.close()
+
+    def test_topology_surface(self):
+        cluster, _, _ = make_cluster(2, names=["east", "west"])
+        topo = cluster.topology()
+        assert topo["epoch"] == 0
+        assert topo["n_groups"] == 2
+        assert [s["prefix_lo"] for s in topo["segments"]][0] == 0
+        cluster.resharder.split("east")
+        topo = cluster.topology()
+        assert topo["epoch"] == 1
+        hist = cluster.resharder.status()["history"]
+        assert len(hist) == 1 and hist[0]["epoch"] == 1
+        cluster.close()
+
+    def test_writes_during_epochs_route_correctly(self):
+        cluster, oracle, sft = make_cluster(3, names=["a", "b", "c"])
+        cluster.resharder.split(hottest_group(cluster))
+        # post-flip writes into the moved range: read-your-writes
+        ids = np.array(["post-1", "post-2"], dtype=object)
+        cols = {"geom": (np.array([105.0, 107.0]), np.array([42.0, 43.0])),
+                "dtg": np.int64([1704067200000, 1704067200001]),
+                "name": np.array(["nx", "nx"], dtype=object)}
+        batch = FeatureBatch.from_dict(sft, ids, cols)
+        cluster.write("pts", batch)
+        oracle.write("pts", batch)
+        assert cluster_ids(cluster, "name = 'nx'") == {"post-1", "post-2"}
+        assert_exact(cluster, oracle)
+        cluster.close()
+
+
+class TestDurableMigration:
+    def _durable_cluster(self, tmp_path, k=3):
+        from geomesa_tpu.wal import DurableStore
+        groups = [DurableStore(InMemoryDataStore(), tmp_path / f"g{i}",
+                               fsync="never") for i in range(k)]
+        return make_cluster(k, names=[f"g{i}" for i in range(k)],
+                            groups=groups)
+
+    def test_wal_tail_migration_exact(self, tmp_path):
+        cluster, oracle, sft = self._durable_cluster(tmp_path)
+        # deletes interleave with the snapshot->tail stream
+        drop = [f"f{i}" for i in range(0, 60)]
+        cluster.delete("pts", drop)
+        oracle.delete("pts", drop)
+        hot = hottest_group(cluster)
+        entry = cluster.resharder.split(hot)
+        assert entry["barrier_lsn"] is not None
+        assert cluster._part.epoch == 1
+        assert_exact(cluster, oracle)
+        res = cluster.query("INCLUDE", "pts")
+        assert res.topology_epoch == 1
+        cluster.close()
+
+    def test_stale_epoch_write_fenced(self, tmp_path):
+        cluster, oracle, sft = self._durable_cluster(tmp_path)
+        cluster.resharder.split(hottest_group(cluster))
+        ids = np.array(["z1"], dtype=object)
+        cols = {"geom": (np.array([105.0]), np.array([42.0])),
+                "dtg": np.int64([1704067200000]),
+                "name": np.array(["zz"], dtype=object)}
+        batch = FeatureBatch.from_dict(sft, ids, cols)
+        with pytest.raises(StaleTopologyError) as ei:
+            cluster.write("pts", batch, topology_epoch=0)
+        assert ei.value.current == 1
+        assert cluster_ids(cluster, "name = 'zz'") == set()  # rejected
+        cluster.write("pts", batch, topology_epoch=1)        # current ok
+        assert cluster_ids(cluster, "name = 'zz'") == {"z1"}
+        cluster.close()
+
+
+# -- kill switch -------------------------------------------------------------
+
+class TestKillSwitch:
+    def test_disabled_refuses_and_stays_bit_identical(self, reset_knobs):
+        cluster, oracle, _ = make_cluster(3, names=["a", "b", "c"])
+        RESHARD_ENABLED.set("false")
+        with pytest.raises(ReshardError):
+            cluster.resharder.split("a")
+        with pytest.raises(ReshardError):
+            cluster.resharder.migrate(0, 10, "a", "b")
+        assert cluster._part.epoch == 0
+        # routing identical to a freshly built uniform partitioner
+        rng = np.random.default_rng(5)
+        x, y = rng.uniform(-180, 180, 500), rng.uniform(-90, 90, 500)
+        assert (cluster._part.owners_xy(x, y)
+                == ZPrefixPartitioner(3).owners_xy(x, y)).all()
+        assert_exact(cluster, oracle)
+        # the autoscaler no-ops under the same switch
+        dec = Autoscaler(cluster).run_once(now=0.0)
+        assert dec["action"] == "none"
+        assert "enabled=false" in dec["blocked"]
+        cluster.close()
+
+
+# -- plan/prune-cache invalidation across the flip ---------------------------
+
+class TestPlanInvalidation:
+    def test_prune_plan_tracks_epoch(self):
+        cluster, oracle, _ = make_cluster(3, names=["a", "b", "c"])
+        hot_bbox = "BBOX(geom, 100, 40, 112, 46)"
+        assert cluster_ids(cluster, hot_bbox) == cluster_ids(oracle,
+                                                             hot_bbox)
+        plan0 = cluster.last_plan()
+        assert plan0["topology_epoch"] == 0
+        entry = cluster.resharder.split(hottest_group(cluster))
+        assert cluster_ids(cluster, hot_bbox) == cluster_ids(oracle,
+                                                             hot_bbox)
+        plan1 = cluster.last_plan()
+        assert plan1["topology_epoch"] == 1
+        # the moved upper half now lives on dst: the hot-corner scatter
+        # must contact it (a stale prune cache would skip it silently)
+        assert entry["dst"] in plan1["contacted"]
+        cluster.close()
+
+
+# -- crash safety: randomized kill points ------------------------------------
+
+def _crash_at(resharder, tag):
+    def hook(t):
+        if t == tag:
+            raise RuntimeError(f"injected crash @ {t}")
+    resharder.fault_hook = hook
+
+
+class TestCrashSafety:
+    @pytest.mark.parametrize("tag", Resharder.PHASES)
+    def test_kill_point_then_resume(self, tag):
+        cluster, oracle, _ = make_cluster(3, names=["a", "b", "c"],
+                                          n=300)
+        r = cluster.resharder
+        _crash_at(r, tag)
+        with pytest.raises(RuntimeError, match="injected crash"):
+            r.split(hottest_group(cluster))
+        mig = r._active
+        assert mig is not None
+        if mig.blocking:
+            # mid-flip: every cluster op fails typed, never silently
+            with pytest.raises(ReshardError):
+                cluster.count("pts")
+        else:
+            # pre-cut: the old topology still serves exactly
+            assert_exact(cluster, oracle)
+        r.fault_hook = None
+        entry = r.resume()
+        assert entry["epoch"] == 1
+        assert r._active is None
+        assert_exact(cluster, oracle)
+        cluster.close()
+
+    @pytest.mark.parametrize("tag", ["flip.copied", "flip.delete_src"])
+    def test_kill_point_then_abort(self, tag):
+        cluster, oracle, _ = make_cluster(3, names=["a", "b", "c"],
+                                          n=300)
+        r = cluster.resharder
+        _crash_at(r, tag)
+        with pytest.raises(RuntimeError, match="injected crash"):
+            r.split(hottest_group(cluster))
+        r.fault_hook = None
+        entry = r.abort()
+        assert entry["op"] == "abort"
+        assert cluster._part.epoch == 0          # old topology kept
+        assert r._active is None
+        assert_exact(cluster, oracle)            # zero acked loss
+        cluster.close()
+
+    def test_durable_kill_points(self, tmp_path):
+        from geomesa_tpu.wal import DurableStore
+        for i, tag in enumerate(("snapshot.done", "flip.barrier",
+                                 "flip.delete_src")):
+            groups = [DurableStore(InMemoryDataStore(),
+                                   tmp_path / f"r{i}g{j}", fsync="never")
+                      for j in range(3)]
+            cluster, oracle, _ = make_cluster(
+                3, names=["a", "b", "c"], groups=groups, n=300)
+            r = cluster.resharder
+            _crash_at(r, tag)
+            with pytest.raises(RuntimeError, match="injected crash"):
+                r.split(hottest_group(cluster))
+            r.fault_hook = None
+            r.resume()
+            assert cluster._part.epoch == 1
+            assert_exact(cluster, oracle)
+            cluster.close()
+
+    @pytest.mark.slow
+    def test_randomized_kill_point_soak(self, tmp_path):
+        """Randomized sweep: crash at a random kill point, randomly
+        resume or abort, repeat against the same live cluster. The
+        invariant after every round: id-exact vs the oracle, no
+        duplicate ids, epoch history consistent."""
+        from geomesa_tpu.wal import DurableStore
+        rng = np.random.default_rng(11)
+        groups = [DurableStore(InMemoryDataStore(), tmp_path / f"g{j}",
+                               fsync="never") for j in range(4)]
+        cluster, oracle, sft = make_cluster(
+            4, names=["a", "b", "c", "d"], groups=groups, n=500)
+        r = cluster.resharder
+        for round_no in range(12):
+            tag = Resharder.PHASES[rng.integers(len(Resharder.PHASES))]
+            _crash_at(r, tag)
+            try:
+                r.split(hottest_group(cluster))
+                crashed = False
+            except RuntimeError:
+                crashed = True
+            r.fault_hook = None
+            if crashed and r._active is not None:
+                if rng.random() < 0.5:
+                    r.resume()
+                else:
+                    r.abort()
+            assert_exact(cluster, oracle)
+            # interleave acked writes between rounds
+            ids = np.array([f"soak-{round_no}"], dtype=object)
+            cols = {"geom": (np.array([rng.uniform(100, 112)]),
+                             np.array([rng.uniform(40, 46)])),
+                    "dtg": np.int64([1704067200000]),
+                    "name": np.array(["soak"], dtype=object)}
+            batch = FeatureBatch.from_dict(sft, ids, cols)
+            cluster.write("pts", batch)
+            oracle.write("pts", batch)
+        assert_exact(cluster, oracle)
+        cluster.close()
+
+
+# -- concurrent queries during a migration -----------------------------------
+
+class TestConcurrentQueries:
+    def test_queries_exact_or_typed_during_migration(self):
+        cluster, oracle, _ = make_cluster(3, names=["a", "b", "c"],
+                                          n=400)
+        want = cluster_ids(oracle)
+        r = cluster.resharder
+
+        def slow_hook(tag):
+            import time as _t
+            _t.sleep(0.02)
+        r.fault_hook = slow_hook
+
+        errors, wrong = [], []
+        done = threading.Event()
+
+        def reader():
+            while not done.is_set():
+                try:
+                    got = cluster_ids(cluster)
+                except ReshardError:
+                    continue            # typed: acceptable during flip
+                except Exception as e:  # noqa: BLE001 — test collector
+                    errors.append(e)
+                    return
+                if got != want:
+                    wrong.append(got)
+                    return
+
+        threads = [threading.Thread(target=reader, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            r.split(hottest_group(cluster))
+        finally:
+            done.set()
+            for t in threads:
+                t.join(5.0)
+        assert not errors, errors
+        assert not wrong, "inexact result during migration"
+        assert cluster._part.epoch == 1
+        assert_exact(cluster, oracle)
+        cluster.close()
+
+
+# -- autoscaler --------------------------------------------------------------
+
+class TestAutoscaler:
+    def _scaler(self, cluster, lat):
+        scaler = Autoscaler(cluster)
+        scaler.observe = lambda: dict(lat)
+        return scaler
+
+    def test_sustain_then_propose(self, reset_knobs):
+        cluster, _, _ = make_cluster(3, names=["a", "b", "c"])
+        hot = hottest_group(cluster)
+        lat = {n: (0.5 if n == hot else 0.01)
+               for n in ("a", "b", "c")}
+        scaler = self._scaler(cluster, lat)
+        d0 = scaler.run_once(now=0.0)
+        assert d0["action"] == "split" and d0["group"] == hot
+        assert "sustain" in d0["blocked"]
+        d1 = scaler.run_once(now=11.0)      # sustained past 10s
+        assert d1["action"] == "split"
+        assert d1["blocked"] == "geomesa.reshard.auto=false (propose-only)"
+        assert not d1["executed"]
+        assert cluster._part.epoch == 0     # propose-only: no change
+        cluster.close()
+
+    def test_auto_fires_and_cooldown_guards(self, reset_knobs):
+        cluster, oracle, _ = make_cluster(3, names=["a", "b", "c"])
+        hot = hottest_group(cluster)
+        lat = {n: (0.5 if n == hot else 0.01)
+               for n in ("a", "b", "c")}
+        RESHARD_AUTO.set("true")
+        scaler = self._scaler(cluster, lat)
+        scaler.run_once(now=0.0)
+        d = scaler.run_once(now=12.0)
+        assert d["executed"] is True
+        assert d["result"]["epoch"] == 1
+        assert_exact(cluster, oracle)
+        # still "hot": the next sustained signal hits the cooldown
+        scaler.run_once(now=13.0)
+        d2 = scaler.run_once(now=25.0)
+        assert d2["action"] == "split" and not d2["executed"]
+        assert "cooldown" in d2["blocked"]
+        cluster.close()
+
+    def test_slo_fast_burn_waives_sustain(self, reset_knobs):
+        cluster, _, _ = make_cluster(3, names=["a", "b", "c"])
+        hot = hottest_group(cluster)
+        lat = {n: (0.5 if n == hot else 0.01)
+               for n in ("a", "b", "c")}
+
+        class _Burning:
+            def evaluate(self, now=None):
+                return {"query": {"fast_firing": True}}
+
+        scaler = Autoscaler(cluster, slo=_Burning())
+        scaler.observe = lambda: dict(lat)
+        d = scaler.run_once(now=0.0)        # first sighting, 0s sustain
+        assert d["action"] == "split"
+        assert d["slo_fast_burning"] is True
+        assert d["blocked"] == "geomesa.reshard.auto=false (propose-only)"
+        cluster.close()
+
+    def test_uniformly_slow_cluster_never_splits(self, reset_knobs):
+        cluster, _, _ = make_cluster(3, names=["a", "b", "c"])
+        scaler = self._scaler(cluster, {"a": 0.5, "b": 0.49, "c": 0.51})
+        for now in (0.0, 20.0, 40.0):
+            assert scaler.run_once(now=now)["action"] == "none"
+        # sub-floor absolute latencies are noise even when skewed
+        scaler2 = self._scaler(cluster, {"a": 0.004, "b": 0.0001,
+                                         "c": 0.0001})
+        assert scaler2.run_once(now=0.0)["action"] == "none"
+        cluster.close()
+
+
+# -- REST / CLI surfaces -----------------------------------------------------
+
+def _http(method, url, data=None, token=None):
+    req = urllib.request.Request(url, data=data, method=method)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+class TestRestSurface:
+    def test_topology_and_reshard_endpoints(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        cluster, oracle, _ = make_cluster(2, names=["east", "west"])
+        srv = GeoMesaWebServer(cluster).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            code, topo = _http("GET", base + "/rest/topology")
+            assert code == 200
+            assert topo["epoch"] == 0 and topo["n_groups"] == 2
+            assert topo["groups"][0]["rows"] >= 0
+            code, st = _http("GET", base + "/rest/reshard")
+            assert code == 200 and st["active"] is None
+            hot = hottest_group(cluster)
+            code, entry = _http("POST",
+                                base + f"/rest/reshard/split?src={hot}",
+                                data=b"")
+            assert code == 200 and entry["rows_moved"] > 0
+            code, topo = _http("GET", base + "/rest/topology")
+            assert topo["epoch"] == 1
+            assert_exact(cluster, oracle)
+        finally:
+            srv.stop()
+            cluster.close()
+
+    def test_reshard_is_token_gated(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        cluster, _, _ = make_cluster(2, names=["a", "b"])
+        srv = GeoMesaWebServer(cluster, auth_token="s3cret").start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            code, _ = _http("POST", base + "/rest/reshard/split?src=a",
+                            data=b"")
+            assert code == 403
+            # reads stay open
+            code, _ = _http("GET", base + "/rest/topology")
+            assert code == 200
+            code, _ = _http("GET", base + "/rest/reshard")
+            assert code == 200
+            # with the token the verb runs
+            code, entry = _http("POST",
+                                base + "/rest/reshard/split?src=a",
+                                data=b"", token="s3cret")
+            assert code == 200 and entry["epoch"] == 1
+        finally:
+            srv.stop()
+            cluster.close()
+
+    def test_typed_refusal_maps_to_409(self, reset_knobs):
+        from geomesa_tpu.web import GeoMesaWebServer
+        cluster, _, _ = make_cluster(2, names=["a", "b"])
+        srv = GeoMesaWebServer(cluster).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            RESHARD_ENABLED.set("false")
+            code, out = _http("POST", base + "/rest/reshard/split?src=a",
+                              data=b"")
+            assert code == 409
+            assert out["retryable"] is False
+            code, _ = _http("POST", base + "/rest/reshard/split",
+                            data=b"")
+            assert code == 400          # missing ?src=
+        finally:
+            srv.stop()
+            cluster.close()
+
+    def test_non_cluster_store_404s(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        srv = GeoMesaWebServer(InMemoryDataStore()).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            assert _http("GET", base + "/rest/topology")[0] == 404
+            assert _http("GET", base + "/rest/reshard")[0] == 404
+        finally:
+            srv.stop()
+
+    def test_epoch_header_on_query_results(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        cluster, _, _ = make_cluster(2, names=["a", "b"])
+        srv = GeoMesaWebServer(cluster).start()
+        url = (f"http://127.0.0.1:{srv.port}/rest/query/pts"
+               "?cql=INCLUDE&maxFeatures=2000")
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                assert r.headers.get("X-GeoMesa-Topology-Epoch") == "0"
+            cluster.resharder.split(hottest_group(cluster))
+            with urllib.request.urlopen(url, timeout=10) as r:
+                assert r.headers.get("X-GeoMesa-Topology-Epoch") == "1"
+        finally:
+            srv.stop()
+            cluster.close()
+
+    def test_autoscaler_tick_over_rest(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        cluster, _, _ = make_cluster(2, names=["a", "b"])
+        srv = GeoMesaWebServer(cluster).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            code, dec = _http("POST", base + "/rest/reshard/auto",
+                              data=b"")
+            assert code == 200 and dec["action"] == "none"
+            code, st = _http("POST",
+                             base + "/rest/reshard/auto?state=on",
+                             data=b"")
+            assert code == 200 and st["running"] is True
+            code, st = _http("POST",
+                             base + "/rest/reshard/auto?state=off",
+                             data=b"")
+            assert code == 200 and st["running"] is False
+        finally:
+            srv.stop()
+            cluster.close()
+
+
+class TestCli:
+    def test_reshard_status_and_split(self, capsys):
+        from geomesa_tpu.tools.cli import main as cli_main
+        from geomesa_tpu.web import GeoMesaWebServer
+        cluster, oracle, _ = make_cluster(2, names=["a", "b"])
+        srv = GeoMesaWebServer(cluster).start()
+        path = f"remote://127.0.0.1:{srv.port}"
+        try:
+            rc = cli_main(["reshard", "status", "--path", path])
+            assert rc in (0, None)
+            out = json.loads(capsys.readouterr().out)
+            assert out["topology"]["epoch"] == 0
+            assert out["reshard"]["active"] is None
+            hot = hottest_group(cluster)
+            rc = cli_main(["reshard", "split", "--path", path,
+                           "--src", hot])
+            assert rc in (0, None)
+            entry = json.loads(capsys.readouterr().out)
+            assert entry["epoch"] == 1
+            assert_exact(cluster, oracle)
+        finally:
+            srv.stop()
+            cluster.close()
+
+    def test_gated_verb_without_token_rc3(self, capsys):
+        from geomesa_tpu.tools.cli import main as cli_main
+        from geomesa_tpu.web import GeoMesaWebServer
+        cluster, _, _ = make_cluster(2, names=["a", "b"])
+        srv = GeoMesaWebServer(cluster, auth_token="s3cret").start()
+        path = f"remote://127.0.0.1:{srv.port}"
+        try:
+            rc = cli_main(["reshard", "split", "--path", path,
+                           "--src", "a"])
+            assert rc == 3
+            assert "token" in capsys.readouterr().err
+            rc = cli_main(["reshard", "split", "--path", path,
+                           "--src", "a", "--token", "s3cret"])
+            assert rc in (0, None)
+        finally:
+            srv.stop()
+            cluster.close()
+
+    def test_bad_path_rc2(self, capsys):
+        from geomesa_tpu.tools.cli import main as cli_main
+        rc = cli_main(["reshard", "status", "--path", "/tmp/nope"])
+        assert rc == 2
