@@ -145,7 +145,7 @@ REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
                              "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,"
-                             "19,20,21,northstar")
+                             "19,20,21,22,northstar")
               .split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
@@ -3476,6 +3476,224 @@ def bench_config21(rng, n=None, c=None, synthetic_hot_signal=False):
     return out
 
 
+# -- config 22: multi-tenant QoS — noisy-neighbor isolation ---------------
+
+def bench_config22(rng, n=None, c=None, nq=None, abuse_c=None,
+                   abuse_s=None):
+    """What the tenant QoS plane buys a polite tenant sharing a server
+    with an abusive one, in three phases.
+
+    (A) Baseline: the polite tenant alone runs a read workload of
+        ``c`` clients x ``nq`` bbox queries against one web server
+        with the QoS plane ON (tokens map two tenants; the polite
+        tenant has 4x the abuser's fair-share weight, the abuser has a
+        tight in-flight cap and a small ingest row bucket). Every
+        query's ids are checked exact against the store oracle;
+        latencies give the polite-alone p99.
+    (B) Abuse: ``abuse_c`` greedy clients flood the same server under
+        the abuser's token — a query flood plus an ingest flood into a
+        SEPARATE schema (so polite id-exactness stays meaningful) —
+        while the polite tenant re-runs the identical workload. The
+        headline gate: polite read p99 under abuse <= 2x the
+        polite-alone baseline, still id-exact, and the abuser was
+        actually throttled (sheds or row refusals observed).
+    (C) Restore: the abuse stops; every tenant's in-flight count and
+        row bucket must drain EXACTLY to zero and a final polite run
+        must land back within the same 2x envelope.
+    """
+    import threading
+
+    from geomesa_tpu.features import FeatureBatch, parse_spec
+    from geomesa_tpu.index.api import Query
+    from geomesa_tpu.scan.registry import batcher_registry
+    from geomesa_tpu.store import InMemoryDataStore
+    from geomesa_tpu.store.remote import RemoteDataStore
+    from geomesa_tpu.tenants import (QOS_ENABLED, WEB_AUTH_TOKENS,
+                                     tenant_registry)
+    from geomesa_tpu.utils.properties import SystemProperty
+    from geomesa_tpu.web.server import GeoMesaWebServer
+
+    n = int(n if n is not None
+            else os.environ.get("GEOMESA_TPU_BENCH_QOS_N", 200_000))
+    c = int(c if c is not None else 8)
+    nq = int(nq if nq is not None else 25)
+    abuse_c = int(abuse_c if abuse_c is not None else 64)
+    abuse_s = float(abuse_s if abuse_s is not None else 0.0)
+    out = {"n": n, "polite_clients": c, "queries_per_client": nq,
+           "abuse_clients": abuse_c}
+
+    sft = parse_spec("qos22", "dtg:Date,*geom:Point:srid=4326")
+    flood_sft = parse_spec("flood22", "dtg:Date,*geom:Point:srid=4326")
+    ds = InMemoryDataStore()
+    ds.create_schema(sft)
+    ds.create_schema(flood_sft)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    ms = rng.integers(T0_DAY * MS_DAY, T1_DAY * MS_DAY, n).astype(np.int64)
+    ds.write_dict("qos22", np.arange(n).astype(str).astype(object),
+                  {"dtg": ms, "geom": (x, y)})
+
+    def bbox_q(i, w=4.0, h=4.0):
+        x0 = -170.0 + (i * 37) % 330
+        y0 = -80.0 + (i * 23) % 150
+        return Query("qos22",
+                     f"BBOX(geom, {x0}, {y0}, {x0 + w}, {y0 + h})")
+
+    # oracle ids for every distinct box the polite workload asks for
+    oracle = {k: set(ds.query(bbox_q(k)).ids.astype(str))
+              for k in range(c * nq)}
+
+    knobs = [SystemProperty("geomesa.qos.tenant.polite.weight"),
+             SystemProperty("geomesa.qos.tenant.abuser.weight"),
+             SystemProperty("geomesa.qos.tenant.abuser.max.inflight"),
+             SystemProperty("geomesa.qos.tenant.abuser.max.inflight.rows")]
+
+    QOS_ENABLED.set("true")
+    WEB_AUTH_TOKENS.set("polite-tok:polite,abuse-tok:abuser")
+    knobs[0].set("4")
+    knobs[1].set("1")
+    knobs[2].set("4")
+    knobs[3].set("20000")
+    tenant_registry.reset()
+    batcher_registry.clear()
+    server = GeoMesaWebServer(ds, max_inflight=128).start()
+
+    def polite_phase():
+        lat: list = [None] * (c * nq)
+        exact = [True] * c
+        barrier = threading.Barrier(c)
+
+        def worker(ci):
+            client = RemoteDataStore("127.0.0.1", server.port,
+                                     auth_token="polite-tok", hedge=False)
+            barrier.wait()
+            for j in range(nq):
+                k = ci * nq + j
+                t0 = time.perf_counter()
+                res = client.query(bbox_q(k))
+                lat[k] = time.perf_counter() - t0
+                if set(res.ids.astype(str)) != oracle[k]:
+                    exact[ci] = False
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True) for i in range(c)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300.0)
+        assert not any(v is None for v in lat), "config 22 phase stuck"
+        return lat, all(exact)
+
+    try:
+        # warmup compiles the scan kernels and materializes the rects
+        warm = RemoteDataStore("127.0.0.1", server.port,
+                               auth_token="polite-tok", hedge=False)
+        for k in range(c * nq):
+            warm.query(bbox_q(k))
+
+        # -- phase A: polite alone --------------------------------------
+        lat_alone, exact_alone = polite_phase()
+        pa = _pcts(lat_alone)
+        out["polite_alone"] = {"p50_ms": round(pa["p50"] * 1e3, 2),
+                               "p99_ms": round(pa["p99"] * 1e3, 2),
+                               "ids_exact": bool(exact_alone)}
+
+        # -- phase B: abuse flood while polite re-runs ------------------
+        stop = threading.Event()
+        abuse_reqs = [0] * abuse_c
+
+        def abuser(ai):
+            client = RemoteDataStore("127.0.0.1", server.port,
+                                     auth_token="abuse-tok", hedge=False)
+            rows = 500
+            fx = np.zeros(rows)
+            fy = np.zeros(rows)
+            fms = np.full(rows, T0_DAY * MS_DAY, dtype=np.int64)
+            seq = 0
+            while not stop.is_set():
+                try:
+                    if ai % 2:
+                        ids = np.array([f"f{ai}-{seq}-{i}"
+                                        for i in range(rows)], object)
+                        seq += 1
+                        client.write("flood22", FeatureBatch.from_dict(
+                            flood_sft, ids, {"dtg": fms,
+                                             "geom": (fx, fy)}))
+                    else:
+                        client.query_count(bbox_q(ai, w=40.0, h=40.0))
+                    abuse_reqs[ai] += 1
+                except Exception:
+                    # shed 503s / 429s / exhausted client retry budgets
+                    # ARE the throttle working; keep hammering
+                    abuse_reqs[ai] += 1
+                if abuse_s:
+                    time.sleep(abuse_s)
+
+        abusers = [threading.Thread(target=abuser, args=(i,),
+                                    daemon=True) for i in range(abuse_c)]
+        for t in abusers:
+            t.start()
+        time.sleep(0.3)   # let the flood reach steady state
+        lat_abuse, exact_abuse = polite_phase()
+        qs = tenant_registry.status()["tenants"]
+        throttled = bool(qs.get("abuser", {}).get("sheds", 0) > 0
+                         or qs.get("abuser", {}).get("row_refusals", 0) > 0)
+        stop.set()
+        for t in abusers:
+            t.join(60.0)
+        pb = _pcts(lat_abuse)
+        out["polite_under_abuse"] = {
+            "p50_ms": round(pb["p50"] * 1e3, 2),
+            "p99_ms": round(pb["p99"] * 1e3, 2),
+            "ids_exact": bool(exact_abuse),
+            "p99_ratio_vs_alone": round(pb["p99"] / max(pa["p99"], 1e-9),
+                                        2)}
+        out["abuser"] = {"requests": int(sum(abuse_reqs)),
+                         "sheds": qs.get("abuser", {}).get("sheds", 0),
+                         "row_refusals": qs.get("abuser", {}).get(
+                             "row_refusals", 0),
+                         "throttled": throttled}
+
+        # -- phase C: abuse stops; budgets drain exactly ----------------
+        deadline = time.perf_counter() + 30.0
+        drained = False
+        while time.perf_counter() < deadline:
+            qs = tenant_registry.status()["tenants"]
+            drained = all(v["inflight"] == 0 and v["inflight_rows"] == 0
+                          for v in qs.values())
+            if drained:
+                break
+            time.sleep(0.01)
+        lat_after, exact_after = polite_phase()
+        pc = _pcts(lat_after)
+        out["restore"] = {
+            "budgets_drained": bool(drained),
+            "tenants": {k: {"inflight": v["inflight"],
+                            "inflight_rows": v["inflight_rows"]}
+                        for k, v in qs.items()},
+            "polite_p99_ms": round(pc["p99"] * 1e3, 2),
+            "ids_exact": bool(exact_after),
+            "p99_ratio_vs_alone": round(pc["p99"] / max(pa["p99"], 1e-9),
+                                        2)}
+    finally:
+        server.stop()
+        QOS_ENABLED.set(None)
+        WEB_AUTH_TOKENS.set(None)
+        for k in knobs:
+            k.set(None)
+        tenant_registry.reset()
+        batcher_registry.clear()
+
+    out["gates_pass"] = bool(
+        out["polite_alone"]["ids_exact"]
+        and out["polite_under_abuse"]["ids_exact"]
+        and out["polite_under_abuse"]["p99_ratio_vs_alone"] <= 2.0
+        and out["abuser"]["throttled"]
+        and out["restore"]["budgets_drained"]
+        and out["restore"]["ids_exact"])
+    return out
+
+
 # -- config 10: storage integrity — scrub overhead + corrupt recovery -----
 
 def bench_config10(rng):
@@ -3758,6 +3976,8 @@ def main(argv=None):
         out["configs"]["20_planner"] = bench_config20(rng)
     if "21" in CONFIGS:
         out["configs"]["21_reshard"] = bench_config21(rng)
+    if "22" in CONFIGS:
+        out["configs"]["22_multitenant"] = bench_config22(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

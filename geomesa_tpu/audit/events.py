@@ -32,6 +32,7 @@ class QueryEvent:
     cache_hit: bool = False
     batched: bool = False
     hedged: bool = False
+    tenant: str | None = None       # QoS tenant (tenants plane)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), default=str)

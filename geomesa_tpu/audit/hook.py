@@ -101,6 +101,7 @@ def audit_query(audit: AuditLogger | None, surface: str,
     if _suppress.get():
         return False
     from ..obs import current_trace_id, get_flag
+    from ..tenants import active_tenant
     logger = audit if audit is not None else global_audit()
     logger.record(
         type_name, filter_str, hints or {},
@@ -110,5 +111,6 @@ def audit_query(audit: AuditLogger | None, surface: str,
         rows_scanned=rows_scanned,
         cache_hit=bool(get_flag("cache_hit", False)),
         batched=batched or bool(get_flag("batched", False)),
-        hedged=bool(get_flag("hedged", False)))
+        hedged=bool(get_flag("hedged", False)),
+        tenant=active_tenant())
     return True

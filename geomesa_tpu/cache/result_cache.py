@@ -55,15 +55,20 @@ def _nbytes(stored) -> int:
 
 
 class _Entry:
-    __slots__ = ("version", "stored", "nbytes", "hits", "compute", "encode")
+    __slots__ = ("version", "stored", "nbytes", "hits", "compute",
+                 "encode", "tenant")
 
-    def __init__(self, version, stored, nbytes, compute, encode):
+    def __init__(self, version, stored, nbytes, compute, encode,
+                 tenant=None):
         self.version = version
         self.stored = stored
         self.nbytes = nbytes
         self.hits = 0
         self.compute = compute
         self.encode = encode
+        # installing tenant (None with QoS off): per-tenant byte
+        # budgets evict the owning tenant's LRU entries only
+        self.tenant = tenant
 
 
 class _Flight:
@@ -86,6 +91,7 @@ class ResultCache:
         self._entries: OrderedDict[tuple, _Entry] = OrderedDict()
         self._inflight: dict[tuple, _Flight] = {}
         self._bytes = 0
+        self._tenant_bytes: dict[str, int] = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -110,6 +116,15 @@ class ResultCache:
         single-flighted ``compute()`` otherwise."""
         if not self.enabled():
             return compute()
+        # tenant scoping (tenants plane): the plan key is suffixed with
+        # the tenant's VISIBILITY scope, so tenants with different
+        # visibilities never share an entry while same-visibility
+        # tenants still deduplicate. QoS off -> tenant is None and the
+        # key is byte-identical to the pre-QoS cache.
+        from ..tenants import active_tenant, tenant_registry
+        tenant = active_tenant()
+        if tenant is not None:
+            key = f"{key}|qosvis={tenant_registry.policy(tenant).visibility}"
         version = self._version_fn(type_name)
         k = (type_name, key)
         fk = (type_name, key, version)
@@ -166,36 +181,73 @@ class ResultCache:
             # unencodable payload: serve it, just don't memoize
             self._registry.counter("cache.encode_errors")
         if stored is not None:
-            self._install(k, version, stored, compute, encode)
+            self._install(k, version, stored, compute, encode,
+                          tenant=tenant)
         fl.stored = stored
         fl.event.set()
         with self._lock:
             self._inflight.pop(fk, None)
         return value
 
-    def _install(self, k, version, stored, compute, encode):
+    def _install(self, k, version, stored, compute, encode, tenant=None):
         nbytes = _nbytes(stored)
         budget = self.max_bytes()
+        tenant_budget = None
+        if tenant is not None:
+            from ..tenants import tenant_registry
+            tenant_budget = tenant_registry.policy(tenant).cache_max_bytes
         with self._lock:
             old = self._entries.pop(k, None)
             if old is not None:
-                self._bytes -= old.nbytes
-            if budget and nbytes > budget:
+                self._drop_bytes_locked(old)
+            if (budget and nbytes > budget) or \
+                    (tenant_budget and nbytes > tenant_budget):
                 # a single payload larger than the whole budget would
                 # evict everything and still not fit
                 self._gauges_locked()
                 return
-            e = _Entry(version, stored, nbytes, compute, encode)
+            e = _Entry(version, stored, nbytes, compute, encode,
+                       tenant=tenant)
             if old is not None:
                 e.hits = old.hits  # heat survives version bumps
             self._entries[k] = e
-            self._bytes += nbytes
+            self._add_bytes_locked(e)
             while budget and self._bytes > budget and self._entries:
                 _, ev = self._entries.popitem(last=False)
-                self._bytes -= ev.nbytes
+                self._drop_bytes_locked(ev)
                 self.evictions += 1
                 self._registry.counter("cache.evictions")
+            # per-tenant byte budget: evict THIS tenant's LRU entries
+            # until it fits — other tenants' entries are untouchable
+            while tenant_budget and \
+                    self._tenant_bytes.get(tenant, 0) > tenant_budget:
+                victim = next((vk for vk, ve in self._entries.items()
+                               if ve.tenant == tenant and vk != k), None)
+                if victim is None:
+                    break
+                self._drop_bytes_locked(self._entries.pop(victim))
+                self.evictions += 1
+                self._registry.counter("cache.evictions")
+                from ..tenants import tenant_label
+                self._registry.counter(
+                    "qos.cache.evictions",
+                    labels={"tenant": tenant_label(tenant)})
             self._gauges_locked()
+
+    def _add_bytes_locked(self, e: _Entry):
+        self._bytes += e.nbytes
+        if e.tenant is not None:
+            self._tenant_bytes[e.tenant] = \
+                self._tenant_bytes.get(e.tenant, 0) + e.nbytes
+
+    def _drop_bytes_locked(self, e: _Entry):
+        self._bytes -= e.nbytes
+        if e.tenant is not None:
+            left = self._tenant_bytes.get(e.tenant, 0) - e.nbytes
+            if left > 0:
+                self._tenant_bytes[e.tenant] = left
+            else:
+                self._tenant_bytes.pop(e.tenant, None)
 
     def _gauges_locked(self):
         self._registry.gauge("cache.bytes", self._bytes)
@@ -212,11 +264,12 @@ class ResultCache:
                 n = len(self._entries)
                 self._entries.clear()
                 self._bytes = 0
+                self._tenant_bytes.clear()
             else:
                 keys = [k for k in self._entries if k[0] == type_name]
                 n = len(keys)
                 for k in keys:
-                    self._bytes -= self._entries.pop(k).nbytes
+                    self._drop_bytes_locked(self._entries.pop(k))
             self.invalidations += n
             self._gauges_locked()
         if n:
@@ -249,7 +302,8 @@ class ResultCache:
             except Exception:
                 self._registry.counter("cache.refresh.errors")
                 continue
-            self._install((tn, key), version, stored, e.compute, e.encode)
+            self._install((tn, key), version, stored, e.compute, e.encode,
+                          tenant=e.tenant)
             with self._lock:
                 self.refreshes += 1
             self._registry.counter("cache.refreshes")
@@ -263,9 +317,11 @@ class ResultCache:
             per_type: dict[str, int] = {}
             for (tn, _), _e in self._entries.items():
                 per_type[tn] = per_type.get(tn, 0) + 1
+            tenant_bytes = dict(self._tenant_bytes)
             return {"enabled": self.enabled(),
                     "entries": len(self._entries),
                     "bytes": self._bytes,
+                    "tenant_bytes": tenant_bytes,
                     "max_bytes": self.max_bytes(),
                     "hits": self.hits,
                     "misses": self.misses,

@@ -194,13 +194,26 @@ class IngestPipeline:
             return ack
         if not self.governor.acquire(batch.n, block=block, timeout=timeout):
             return None
+        # per-tenant row bucket (tenants plane): the staging caller's
+        # tenant is charged here and credited by the writer thread when
+        # the group commits — the writer has no caller context, so the
+        # identity rides the queue entry. QoS off -> tenant is None and
+        # the global governor is the only gate, as before.
+        from ..tenants import active_tenant, tenant_registry
+        tenant = active_tenant()
+        if tenant is not None and not tenant_registry.acquire_rows(
+                tenant, batch.n, block=block, timeout=timeout):
+            self.governor.release(batch.n)
+            return None
         with self._cv:
             if self._closed:
                 self.governor.release(batch.n)
+                if tenant is not None:
+                    tenant_registry.release_rows(tenant, batch.n)
                 raise RuntimeError("ingest pipeline is closed")
             from ..obs import tracer
             self._q.append((type_name, batch, visibilities, ack,
-                            tracer.current()))
+                            tracer.current(), tenant))
             self._cv.notify()
         return ack
 
@@ -336,3 +349,7 @@ class IngestPipeline:
                     e[3]._complete(result=result)
             finally:
                 self.governor.release(rows)
+                for e in group:
+                    if len(e) > 5 and e[5] is not None:
+                        from ..tenants import tenant_registry
+                        tenant_registry.release_rows(e[5], e[1].n)

@@ -287,7 +287,7 @@ class SloEngine:
     # -- recording ---------------------------------------------------------
 
     def record(self, route: str, ok: bool, latency_s: float,
-               now: float | None = None):
+               now: float | None = None, tenant: str | None = None):
         if not self.enabled():
             return
         if now is None:
@@ -295,19 +295,30 @@ class SloEngine:
         lat_s = (SLO_LATENCY_MS.as_float() or 500.0) / 1e3
         route = sanitize_key(route)
         with self._lock:
-            s = self._series.get(route)
-            if s is None:
-                try:
-                    cap = int(SLO_MAX_ROUTES.get() or 64)
-                except (TypeError, ValueError):
-                    cap = 64
-                if len(self._series) >= cap:
-                    route = "other"
-                s = self._series.setdefault(route, _Series(route))
-            s.record(now, ok, latency_s, lat_s)
+            self._record_locked(route, now, ok, latency_s, lat_s)
+            if tenant is not None:
+                # per-tenant SLO series ride the same route rings under
+                # a derived name; the max-routes cap (collapse to
+                # "other") bounds tenant-driven cardinality
+                self._record_locked(
+                    f"{route}.tenant.{sanitize_key(str(tenant))}",
+                    now, ok, latency_s, lat_s)
             due = now - self._last_eval >= self._EVAL_EVERY_S
         if due:
             self.evaluate(now)
+
+    def _record_locked(self, route: str, now: float, ok: bool,
+                       latency_s: float, lat_s: float):
+        s = self._series.get(route)
+        if s is None:
+            try:
+                cap = int(SLO_MAX_ROUTES.get() or 64)
+            except (TypeError, ValueError):
+                cap = 64
+            if len(self._series) >= cap:
+                route = "other"
+            s = self._series.setdefault(route, _Series(route))
+        s.record(now, ok, latency_s, lat_s)
 
     # -- evaluation --------------------------------------------------------
 

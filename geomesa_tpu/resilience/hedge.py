@@ -108,6 +108,12 @@ class HedgePolicy:
         state = {"winner": None, "errs": [], "running": 0,
                  "resolved": False}
         key = sanitize_key(name) if name else ""
+        # with QoS on, hedges are charged to the caller's TENANT budget
+        # (tenants plane): tenant A burning its retries cannot suppress
+        # tenant B's hedging
+        from ..tenants import active_tenant, tenant_budget, tenant_label
+        budget = tenant_budget() or self.budget
+        tenant = active_tenant()
 
         def attempt(idx: int):
             try:
@@ -159,10 +165,14 @@ class HedgePolicy:
                     raise state["errs"][-1]
                 if not hedged and can_hedge \
                         and (state["running"] == 0 or now >= hedge_at):
-                    if self.budget is not None \
-                            and not self.budget.try_withdraw():
+                    if budget is not None \
+                            and not budget.try_withdraw():
                         self._registry.counter(
                             "resilience.hedge.suppressed.budget")
+                        if tenant is not None:
+                            self._registry.counter(
+                                "qos.hedge.suppressed",
+                                labels={"tenant": tenant_label(tenant)})
                         can_hedge = False
                         continue
                     hedged = True

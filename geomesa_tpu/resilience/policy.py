@@ -133,12 +133,22 @@ class RetryPolicy:
         ceiling = min(self.cap_s, self.base_s * (2 ** max(attempt - 1, 0)))
         return self._rng.uniform(0.0, ceiling)
 
+    def _call_budget(self):
+        """The budget THIS call charges: with QoS on and a tenant
+        bound, the tenant's own RetryBudget (so one tenant exhausting
+        retries cannot drain anybody else's); otherwise the policy's
+        shared budget unchanged."""
+        from ..tenants import tenant_budget
+        tb = tenant_budget()
+        return tb if tb is not None else self.budget
+
     def call(self, fn, *, retryable=None, on_retry=None, name: str = ""):
         classify = retryable or default_retryable
         deadline = (None if self.total_deadline_s is None
                     else time.monotonic() + self.total_deadline_s)
-        if self.budget is not None:
-            self.budget.deposit()
+        budget = self._call_budget()
+        if budget is not None:
+            budget.deposit()
         attempt = 0
         while True:
             try:
@@ -155,9 +165,15 @@ class RetryPolicy:
                 if deadline is not None \
                         and time.monotonic() + delay > deadline:
                     raise
-                if self.budget is not None \
-                        and not self.budget.try_withdraw():
+                if budget is not None \
+                        and not budget.try_withdraw():
                     self._registry.counter("resilience.budget.exhausted")
+                    from ..tenants import active_tenant, tenant_label
+                    t = active_tenant()
+                    if t is not None:
+                        self._registry.counter(
+                            "qos.retry.exhausted",
+                            labels={"tenant": tenant_label(t)})
                     raise
                 self._registry.counter("resilience.retries")
                 if name:

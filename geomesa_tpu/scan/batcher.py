@@ -88,7 +88,7 @@ _EWMA_ALPHA = 0.2
 
 
 class _Pending:
-    __slots__ = ("q", "ev", "result", "error", "span_ctx")
+    __slots__ = ("q", "ev", "result", "error", "span_ctx", "tenant")
 
     def __init__(self, q):
         self.q = q
@@ -99,6 +99,11 @@ class _Pending:
         # dispatch span to every waiter and grafts the dispatch
         # subtree back into their traces (obs/trace.py)
         self.span_ctx = None
+        # tenant identity captured at admission (None with QoS off):
+        # the leader drains per-tenant FIFO queues by deficit-weighted
+        # round-robin instead of one global FIFO (tenants/__init__.py)
+        from ..tenants import active_tenant
+        self.tenant = active_tenant()
 
     def resolve(self, result=None, error=None):
         self.result, self.error = result, error
@@ -168,6 +173,9 @@ class QueryBatcher:
         # cap can be read without touching the store
         self._cost_ewma: dict[tuple, float] = {}
         self._last_shape: dict[str, tuple] = {}
+        # per-(queue key, tenant) DWRR deficit counters: unspent
+        # fair-share credit carries across dispatches (tenants plane)
+        self._deficits: dict[str, dict[str, float]] = {}
         self._in_flight = 0
         self.total_queries = 0
         self.coalesced_queries = 0
@@ -313,9 +321,7 @@ class QueryBatcher:
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
-            while tq.items:
-                chunks.append(tq.items[:cap])
-                del tq.items[:cap]
+            chunks = self._drain_chunks(type_name, tq, cap)
             tq.has_leader = False
             self._in_flight += 1
         self.registry.gauge(
@@ -328,6 +334,46 @@ class QueryBatcher:
         finally:
             with self._cond:
                 self._in_flight -= 1
+
+    def _drain_chunks(self, key: str, tq: _TypeQueue,
+                      cap: int) -> list[list[_Pending]]:
+        """Drain the admission queue into cap-sized dispatch chunks.
+
+        With QoS off every pending item carries ``tenant=None`` and the
+        drain is the original global FIFO, bit-identically. With tenant
+        identities present, items regroup into per-tenant FIFO queues
+        filled by deficit-weighted round-robin (``weighted_drain``), so
+        coalescing still fuses but a flooding tenant cannot occupy
+        every batch slot. Called under ``self._cond``."""
+        chunks: list[list[_Pending]] = []
+        if not tq.items:
+            return chunks
+        tenants = {p.tenant for p in tq.items}
+        if tenants == {None}:
+            while tq.items:
+                chunks.append(tq.items[:cap])
+                del tq.items[:cap]
+            return chunks
+        from ..tenants import (DEFAULT_TENANT, tenant_label,
+                               tenant_registry, weighted_drain)
+        groups: dict[str, list[_Pending]] = {}
+        for p in tq.items:
+            groups.setdefault(p.tenant or DEFAULT_TENANT, []).append(p)
+        tq.items.clear()
+        deficits = self._deficits.setdefault(key, {})
+        weight_of = lambda t: tenant_registry.policy(t).weight  # noqa: E731
+        while any(groups.values()):
+            chunk = weighted_drain(groups, deficits, cap, weight_of)
+            if not chunk:
+                break
+            for t in {p.tenant or DEFAULT_TENANT for p in chunk}:
+                self.registry.counter(
+                    "qos.admission.dispatched",
+                    sum(1 for p in chunk
+                        if (p.tenant or DEFAULT_TENANT) == t),
+                    labels={"tenant": tenant_label(t)})
+            chunks.append(chunk)
+        return chunks
 
     def _effective_linger_s(self, tq: _TypeQueue) -> float:
         """The leader's wait budget for this dispatch, in seconds.

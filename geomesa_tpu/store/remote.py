@@ -613,6 +613,11 @@ class RemoteDataStore(DataStore):
         """SLO burn-rate/alert state (GET /rest/slo)."""
         return self._json("GET", "/rest/slo")
 
+    def qos_status(self) -> dict:
+        """Per-tenant QoS state: in-flight caps, row buckets, retry
+        budgets (GET /rest/qos)."""
+        return self._json("GET", "/rest/qos")
+
     def profile_collapsed(self) -> str:
         """Collapsed-stack profile text (GET /rest/profile)."""
         _, data = self._request("GET", "/rest/profile")

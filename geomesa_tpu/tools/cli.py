@@ -749,6 +749,26 @@ def cmd_health(args) -> int:
     return 2
 
 
+def cmd_qos(args) -> int:
+    """Multi-tenant QoS surface of a serving node: ``status`` dumps
+    the per-tenant admission/budget state (GET /rest/qos)."""
+    path = args.path
+    if not path.startswith("remote://"):
+        print("qos commands need --path remote://host:port",
+              file=sys.stderr)
+        return 2
+    from ..store import RemoteDataStore
+    host, _, port = path[len("remote://"):].partition(":")
+    ds = RemoteDataStore(host or "127.0.0.1", int(port) if port else 8080,
+                         auth_token=getattr(args, "token", None))
+    if args.qos_command == "status":
+        json.dump(ds.qos_status(), sys.stdout, indent=2)
+        print()
+        return 0
+    print(f"unknown qos command {args.qos_command!r}", file=sys.stderr)
+    return 2
+
+
 def cmd_version(args) -> int:
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -1022,6 +1042,20 @@ def main(argv=None) -> int:
                          help="admin bearer token "
                               "(geomesa.web.auth.token)")
         hcp.set_defaults(fn=cmd_health)
+
+    qp = sub.add_parser("qos",
+                        help="multi-tenant QoS: per-tenant admission "
+                             "and budget state")
+    qsub = qp.add_subparsers(dest="qos_command", required=True)
+    qcp = qsub.add_parser("status",
+                          help="per-tenant in-flight caps, row "
+                               "buckets, retry budgets")
+    qcp.add_argument("--path", required=True,
+                     help="serving node, remote://host:port")
+    qcp.add_argument("--token", default=None,
+                     help="bearer token (resolves the tenant via "
+                          "geomesa.web.auth.tokens)")
+    qcp.set_defaults(fn=cmd_qos)
 
     add("version", cmd_version, needs_store=False)
     add("env", cmd_env, needs_store=False)

@@ -21,6 +21,8 @@ stdlib http server — no framework dependency:
     GET  /rest/runtime                      -> compile/device/transfer
                                                telemetry snapshot
     GET  /rest/slo                          -> SLO burn-rate/alert state
+    GET  /rest/qos                          -> per-tenant QoS state
+                                               (tenants plane)
     GET  /rest/profile                      -> collapsed-stack profile
                                                (?format=json for stats)
     GET  /rest/cache                        -> materialized-cache status
@@ -61,6 +63,7 @@ Fault surface (resilience layer):
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -216,21 +219,36 @@ class GeoMesaWebServer:
                  "resilience": self._resilience_detail(),
                  "batcher": self._batcher_detail(),
                  "durability": self._durability_detail(),
-                 "ingest": self._ingest_detail()})
+                 "ingest": self._ingest_detail(),
+                 "qos": self._qos_detail()})
         if method == "GET" and parts == ["ready"]:
             return self._ready()
+        route = parts[0] if parts else ""
         if not self._acquire_slot():
             metrics.counter("resilience.web.sheds")
             # a shed IS an availability event on the route's SLO: the
             # caller got a 503, whatever the reason
             from ..obs.slo import slo_engine
-            slo_engine.record(parts[0] if parts else "", ok=False,
-                              latency_s=0.0)
-            retry_after = WEB_RETRY_AFTER.get() or "1"
+            slo_engine.record(route, ok=False, latency_s=0.0)
             return (503, "application/json",
                     _j({"error": "overloaded: in-flight request cap "
                                  "reached", "retryable": True}),
-                    {"Retry-After": retry_after})
+                    {"Retry-After": self._retry_after()})
+        # per-tenant shed gate (QoS on only): a tenant over ITS
+        # in-flight cap gets 503 while every other tenant proceeds
+        from ..tenants import tenant_registry, tenant_scope
+        tenant = self._tenant(headers)
+        if tenant is not None \
+                and not tenant_registry.try_acquire_inflight(tenant):
+            self._release_slot()
+            from ..obs.slo import slo_engine
+            slo_engine.record(route, ok=False, latency_s=0.0,
+                              tenant=tenant)
+            return (503, "application/json",
+                    _j({"error": "overloaded: tenant in-flight cap "
+                                 "reached", "retryable": True,
+                        "tenant": tenant}),
+                    {"Retry-After": self._retry_after()})
         slot_owned = True
         try:
             from ..audit import principal_scope
@@ -242,7 +260,6 @@ class GeoMesaWebServer:
             # the web span is the local trace root; an incoming
             # X-GeoMesa-Trace header continues the caller's trace
             # (RemoteDataStore client leg, upstream coordinator)
-            route = parts[0] if parts else ""
             labels = {"route": route, "method": method}
             if str(WEB_METRICS_PRINCIPAL.get()).lower() in \
                     ("true", "1", "yes"):
@@ -250,12 +267,16 @@ class GeoMesaWebServer:
             t_req = time.perf_counter()
             with tracer.span("web", name, root=True, remote=hdr) as wsp, \
                     metrics.time("web.request", labels=labels):
-                with principal_scope(self._principal(headers)):
+                if tenant is not None:
+                    wsp.set_attr(tenant=tenant)
+                with principal_scope(self._principal(headers)), \
+                        tenant_scope(tenant):
                     out = self._handle_routed(method, parts, params,
                                               body, headers)
                 wsp.set_attr(status=int(out[0]))
                 slo_engine.record(route, ok=int(out[0]) < 500,
-                                  latency_s=time.perf_counter() - t_req)
+                                  latency_s=time.perf_counter() - t_req,
+                                  tenant=tenant)
                 if len(out) >= 3 and not isinstance(
                         out[2], (bytes, bytearray, str)):
                     # streaming payload: the generator outlives this
@@ -264,12 +285,15 @@ class GeoMesaWebServer:
                     # (The web span closes at handoff — streamed
                     # byte time is not in the trace.)
                     wsp.annotate("streaming")
-                    out = (*out[:2], self._slot_guard(out[2]), *out[3:])
+                    out = (*out[:2], self._slot_guard(out[2], tenant),
+                           *out[3:])
                     slot_owned = False
                 return out
         finally:
             if slot_owned:
                 self._release_slot()
+                if tenant is not None:
+                    tenant_registry.release_inflight(tenant)
 
     @staticmethod
     def _principal(headers) -> str | None:
@@ -281,6 +305,30 @@ class GeoMesaWebServer:
             return "bearer:" + hashlib.sha1(
                 got[7:].encode()).hexdigest()[:8]
         return None
+
+    @staticmethod
+    def _tenant(headers) -> str | None:
+        """QoS tenant from the Authorization header via the
+        ``geomesa.web.auth.tokens`` map; None when QoS is disabled (the
+        bit-identical off path)."""
+        from ..tenants import qos_enabled, tenant_registry
+        if not qos_enabled():
+            return None
+        got = (headers or {}).get("Authorization", "") or ""
+        token = got[7:] if got.startswith("Bearer ") else None
+        return tenant_registry.resolve_token(token or None)
+
+    @staticmethod
+    def _retry_after() -> str:
+        """The advertised Retry-After with bounded full jitter:
+        U(0.5x, 1.5x) around ``geomesa.web.retry.after.s``, so a herd
+        of shed clients doesn't retry in one synchronized wave."""
+        try:
+            base = float(WEB_RETRY_AFTER.get() or 1.0)
+        except (TypeError, ValueError):
+            base = 1.0
+        base = max(base, 1e-3)
+        return f"{random.uniform(0.5 * base, 1.5 * base):.4f}"
 
     def _handle_routed(self, method, parts, params, body, headers):
         if parts and (method, parts[0]) in _GATED \
@@ -310,12 +358,16 @@ class GeoMesaWebServer:
             metrics.counter("resilience.web.errors")
             return 500, "application/json", _j({"error": repr(e)})
 
-    def _slot_guard(self, gen):
-        """Hold the shed slot for a streaming response's lifetime."""
+    def _slot_guard(self, gen, tenant=None):
+        """Hold the shed slot (and the tenant's in-flight slot) for a
+        streaming response's lifetime."""
         try:
             yield from gen
         finally:
             self._release_slot()
+            if tenant is not None:
+                from ..tenants import tenant_registry
+                tenant_registry.release_inflight(tenant)
 
     def _ready(self):
         """Readiness: the store answers and we're under the shed cap.
@@ -336,7 +388,7 @@ class GeoMesaWebServer:
         if ready:
             return 200, "application/json", body
         return (503, "application/json", body,
-                {"Retry-After": WEB_RETRY_AFTER.get() or "1"})
+                {"Retry-After": self._retry_after()})
 
     def _durability_detail(self) -> dict | None:
         """Durability health: None for non-durable stores, otherwise
@@ -365,6 +417,14 @@ class GeoMesaWebServer:
                 "max_inflight_rows": gov.max_inflight_rows,
                 "group_cap_rows": pipe.effective_group_rows(),
                 "shedding": gov.should_shed()}
+
+    def _qos_detail(self) -> dict | None:
+        """Tenant QoS health: per-tenant in-flight/budget state (the
+        ``/rest/qos`` document). None while QoS is disabled."""
+        from ..tenants import qos_enabled, tenant_registry
+        if not qos_enabled():
+            return None
+        return tenant_registry.status()
 
     def _batcher_detail(self) -> dict | None:
         """Serving-tier batcher health: per-type pending-queue depth
@@ -521,6 +581,9 @@ class GeoMesaWebServer:
         if method == "GET" and parts == ["slo"]:
             from ..obs.slo import slo_engine
             return 200, "application/json", _j(slo_engine.status())
+        if method == "GET" and parts == ["qos"]:
+            from ..tenants import tenant_registry
+            return 200, "application/json", _j(tenant_registry.status())
         if method == "GET" and parts == ["profile"]:
             from ..obs.prof import profiler, watchdog
             if params.get("format", [""])[0] == "json":
@@ -621,20 +684,19 @@ class GeoMesaWebServer:
         control refuses — the bucket of in-flight rows is full, or the
         read batchers are backed up and ingest must yield."""
         pipe = self._ingest_pipe()
-        retry_after = WEB_RETRY_AFTER.get() or "1"
         if pipe.governor.should_shed():
             metrics.counter("ingest.web.sheds")
             return (429, "application/json",
                     _j({"error": "ingest shed: read queues saturated",
                         "retryable": True}),
-                    {"Retry-After": retry_after})
+                    {"Retry-After": self._retry_after()})
         ack = pipe.write(type_name, batch, visibilities=vis, block=False)
         if ack is None:
             metrics.counter("ingest.web.backpressure")
             return (429, "application/json",
                     _j({"error": "ingest backpressure: in-flight row "
                                  "bucket full", "retryable": True}),
-                    {"Retry-After": retry_after})
+                    {"Retry-After": self._retry_after()})
         # block this request thread until the fused group commits: the
         # response's lsn must cover this write (read-your-writes)
         ack.wait()

@@ -74,6 +74,11 @@ def pytest_configure(config):
         "pushdown, broadcast spatial joins, plan surface, partial "
         "contract over SQL legs; select with -m sql)")
     config.addinivalue_line(
+        "markers", "qos: multi-tenant QoS suites (weighted fair-share "
+        "admission, per-tenant retry/hedge budgets, in-flight caps, "
+        "ingest row buckets, cache byte budgets, noisy-neighbor "
+        "isolation; select with -m qos)")
+    config.addinivalue_line(
         "markers", "reshard: elastic-topology suites (online z-shard "
         "split/migration, epoch fencing, kill-point crash loop, "
         "SLO-driven autoscaler; select with -m reshard — the "

@@ -324,3 +324,28 @@ def test_config21_reshard_smoke():
     # even at toy size
     assert c["heal_ratio"] < 0.75
     assert "gates_pass" in c
+
+
+@pytest.mark.bench_smoke
+@pytest.mark.qos
+def test_config22_multitenant_smoke():
+    rng = np.random.default_rng(51)
+    c = bench.bench_config22(rng, n=4000, c=3, nq=6, abuse_c=8)
+    # the <=2x p99 headline gate only means something at the full
+    # c=8x25 / abuse_c=64 run; at toy sizes assert the structural
+    # contracts instead
+    assert c["polite_alone"]["ids_exact"] is True
+    assert c["polite_alone"]["p99_ms"] > 0
+    # the polite tenant stayed id-exact WHILE the abuser flooded, and
+    # the abuser was actually throttled by its per-tenant caps
+    assert c["polite_under_abuse"]["ids_exact"] is True
+    assert c["abuser"]["requests"] > 0
+    assert c["abuser"]["throttled"] is True
+    # abuse over: every tenant's in-flight count and row bucket
+    # drained exactly to zero, and the polite tenant still answers
+    r = c["restore"]
+    assert r["budgets_drained"] is True
+    assert all(v["inflight"] == 0 and v["inflight_rows"] == 0
+               for v in r["tenants"].values())
+    assert r["ids_exact"] is True
+    assert "gates_pass" in c

@@ -1,0 +1,712 @@
+"""Multi-tenant QoS plane: identity resolution, deficit-weighted
+fair-share admission, per-tenant retry/hedge budgets, web in-flight
+caps, ingest row buckets, cache visibility scoping + byte budgets,
+metric-label safety, audit/trace/SLO attribution, and the
+``geomesa.qos.enabled`` kill switch's bit-identical off path."""
+
+import contextvars
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.audit import audit_query, global_audit
+from geomesa_tpu.audit.hook import _reset_global
+from geomesa_tpu.cache.result_cache import CACHE_ENABLED, ResultCache
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.index.api import Query
+from geomesa_tpu.metrics import MetricsRegistry, prometheus_text
+from geomesa_tpu.metrics.registry import METRICS_MAX_SERIES
+from geomesa_tpu.resilience.policy import RetryBudget, RetryPolicy
+from geomesa_tpu.scan.batcher import QueryBatcher, _Pending, _TypeQueue
+from geomesa_tpu.scan.registry import batcher_registry
+from geomesa_tpu.store.memory import InMemoryDataStore
+from geomesa_tpu.tenants import (DEFAULT_TENANT, QOS_ENABLED,
+                                 WEB_AUTH_TOKENS, TenantRegistry,
+                                 active_tenant, tenant_budget,
+                                 tenant_label, tenant_registry,
+                                 tenant_scope, weighted_drain)
+from geomesa_tpu.utils.properties import SystemProperty
+from geomesa_tpu.web import GeoMesaWebServer
+
+pytestmark = pytest.mark.qos
+
+SPEC = "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326"
+
+
+def seeded_store(n=100):
+    rng = np.random.default_rng(5)
+    sft = parse_spec("people", SPEC)
+    ds = InMemoryDataStore()
+    ds.create_schema(sft)
+    ds.write("people", FeatureBatch.from_dict(
+        sft, [f"p{i}" for i in range(n)],
+        {"name": [f"n{i % 7}" for i in range(n)],
+         "age": np.arange(n),
+         "dtg": rng.integers(0, 10**12, n),
+         "geom": (rng.uniform(-100, -60, n), rng.uniform(25, 50, n))}))
+    return ds
+
+
+@pytest.fixture
+def qos_on():
+    """QoS enabled with a clean registry; every override undone."""
+    QOS_ENABLED.set("true")
+    tenant_registry.reset()
+    try:
+        yield
+    finally:
+        QOS_ENABLED.set(None)
+        WEB_AUTH_TOKENS.set(None)
+        tenant_registry.reset()
+
+
+def _knob(name):
+    return SystemProperty(name)
+
+
+# -- identity --------------------------------------------------------------
+
+class TestIdentity:
+    def test_token_map_resolves(self, qos_on):
+        WEB_AUTH_TOKENS.set("tok1:alice, tok2:bob")
+        assert tenant_registry.resolve_token("tok1") == "alice"
+        assert tenant_registry.resolve_token("tok2") == "bob"
+        assert tenant_registry.resolve_token("nope") == DEFAULT_TENANT
+        assert tenant_registry.resolve_token(None) == DEFAULT_TENANT
+
+    def test_no_map_means_default(self, qos_on):
+        assert tenant_registry.resolve_token("anything") == DEFAULT_TENANT
+
+    def test_kill_switch_hides_tenant(self):
+        QOS_ENABLED.set("false")
+        try:
+            with tenant_scope("alice"):
+                assert active_tenant() is None
+                assert tenant_budget() is None
+        finally:
+            QOS_ENABLED.set(None)
+
+    def test_scope_nests_and_restores(self, qos_on):
+        assert active_tenant() is None
+        with tenant_scope("a"):
+            assert active_tenant() == "a"
+            with tenant_scope("b"):
+                assert active_tenant() == "b"
+            assert active_tenant() == "a"
+        assert active_tenant() is None
+
+    def test_identity_survives_copied_context(self, qos_on):
+        """Hedge attempts and scatter legs run in copied contexts; the
+        tenant identity must ride along."""
+        with tenant_scope("a"):
+            ctx = contextvars.copy_context()
+        assert ctx.run(active_tenant) == "a"
+
+
+# -- fair share: deficit-weighted round robin ------------------------------
+
+class TestWeightedDrain:
+    def test_two_to_one_weights_two_to_one_share(self):
+        queues = {"a": list(range(100)), "b": list(range(100, 200))}
+        deficits = {}
+        got = weighted_drain(queues, deficits, 30,
+                             lambda t: 2.0 if t == "a" else 1.0)
+        assert len(got) == 30
+        assert sum(1 for v in got if v < 100) == 20
+        assert sum(1 for v in got if v >= 100) == 10
+
+    def test_fifo_within_tenant(self):
+        queues = {"a": [1, 2, 3, 4], "b": [10, 20, 30, 40]}
+        got = weighted_drain(queues, {}, 8, None)
+        assert [v for v in got if v < 10] == [1, 2, 3, 4]
+        assert [v for v in got if v >= 10] == [10, 20, 30, 40]
+
+    def test_deficit_carries_fractional_credit(self):
+        """weight 0.5 earns a HALF unit per round: the unspent credit
+        must carry into the next dispatch, so the tenant lands every
+        other chunk instead of never."""
+        deficits = {}
+        w = {"a": 1.0, "b": 0.5}
+        queues = {"a": [1, 2, 3, 4], "b": [10, 11]}
+        first = weighted_drain(queues, deficits, 2, w.get)
+        assert first == [1, 2]               # b banked 0.5, spent none
+        assert deficits["b"] == pytest.approx(0.5)
+        second = weighted_drain(queues, deficits, 2, w.get)
+        assert second == [3, 10]             # the carried half funds b
+
+    def test_idle_tenant_banks_no_credit(self):
+        """A tenant with an empty queue has its deficit dropped, so a
+        long-idle tenant cannot return and monopolize a dispatch."""
+        deficits = {}
+        weighted_drain({"a": list(range(10)), "b": [99]}, deficits, 11,
+                       lambda t: 5.0)
+        assert "b" not in deficits          # drained empty -> dropped
+        for _ in range(50):                  # b idle for many rounds
+            weighted_drain({"a": list(range(4))}, deficits, 4,
+                           lambda t: 5.0)
+        assert deficits.get("b", 0.0) == 0.0
+        got = weighted_drain({"a": list(range(10)),
+                              "b": list(range(100, 110))}, deficits, 10,
+                             lambda t: 1.0)
+        # equal weights on return: an even split, not a b-monopoly
+        assert sum(1 for v in got if v >= 100) == 5
+
+    def test_cap_and_mutation(self):
+        queues = {"a": [1, 2, 3]}
+        got = weighted_drain(queues, {}, 2, None)
+        assert got == [1, 2] and queues["a"] == [3]
+
+
+class TestBatcherAdmission:
+    def _batcher(self):
+        return QueryBatcher(seeded_store(), max_batch=4)
+
+    def _pending(self, tenant):
+        p = _Pending(Query("people", "INCLUDE"))
+        p.tenant = tenant
+        return p
+
+    def test_off_path_is_plain_fifo(self):
+        """QoS off: every pending carries tenant=None and the drain is
+        the original global FIFO chunking, bit-identically."""
+        b = self._batcher()
+        tq = _TypeQueue()
+        tq.items = [self._pending(None) for _ in range(10)]
+        order = list(tq.items)
+        with b._cond:
+            chunks = b._drain_chunks("people", tq, 4)
+        assert [len(c) for c in chunks] == [4, 4, 2]
+        assert [p for c in chunks for p in c] == order
+        assert b._deficits == {}             # the DWRR path never ran
+
+    def test_tenants_interleave_by_weight(self, qos_on):
+        _knob("geomesa.qos.tenant.heavy.weight").set("3")
+        try:
+            b = self._batcher()
+            tq = _TypeQueue()
+            heavy = [self._pending("heavy") for _ in range(12)]
+            light = [self._pending("light") for _ in range(12)]
+            tq.items = heavy + light
+            with b._cond:
+                chunks = b._drain_chunks("people", tq, 4)
+            first = chunks[0]
+            assert sum(1 for p in first if p.tenant == "heavy") == 3
+            assert sum(1 for p in first if p.tenant == "light") == 1
+            # FIFO preserved within each tenant across all chunks
+            flat = [p for c in chunks for p in c]
+            assert [p for p in flat if p.tenant == "heavy"] == heavy
+            assert [p for p in flat if p.tenant == "light"] == light
+            assert len(flat) == 24
+        finally:
+            _knob("geomesa.qos.tenant.heavy.weight").set(None)
+
+    def test_fused_results_stay_exact_under_qos(self, qos_on):
+        """End-to-end through query_batched: two tenants' queries fuse
+        and every caller still gets its own exact rows."""
+        ds = seeded_store()
+        b = QueryBatcher(ds, max_batch=8, linger_us=4000)
+        qs = [Query("people", f"age < {5 + i}") for i in range(6)]
+        want = [set(ds.query(q).ids.astype(str)) for q in qs]
+        got: list = [None] * 6
+
+        def run(i):
+            with tenant_scope("t-even" if i % 2 else "t-odd"):
+                got[i] = set(b.query(qs[i]).ids.astype(str))
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert got == want
+
+
+# -- per-tenant retry / hedge budgets --------------------------------------
+
+class TestRetryBudgetIsolation:
+    def test_tenant_exhaustion_spares_others(self, qos_on):
+        _knob("geomesa.qos.tenant.ra.retry.budget").set("1")
+        shared = RetryBudget(capacity=100.0)
+        pol = RetryPolicy(max_attempts=5, base_s=0.0, cap_s=0.0,
+                          budget=shared, sleep=lambda s: None)
+        boom = [0]
+
+        def flaky():
+            boom[0] += 1
+            raise ConnectionError("flap")
+
+        try:
+            with tenant_scope("ra"):
+                with pytest.raises(ConnectionError):
+                    pol.call(flaky)
+            # capacity 1 + the 0.2 deposit funds exactly one retry
+            assert boom[0] == 2
+            assert shared.tokens == 100.0    # shared budget untouched
+            # tenant rb has its own fresh budget: retries keep flowing
+            boom[0] = 0
+            with tenant_scope("rb"):
+                with pytest.raises(ConnectionError):
+                    pol.call(flaky)
+            assert boom[0] == 5              # attempt cap, not budget
+        finally:
+            _knob("geomesa.qos.tenant.ra.retry.budget").set(None)
+
+    def test_off_path_charges_policy_budget(self):
+        QOS_ENABLED.set("false")
+        shared = RetryBudget(capacity=1.0)
+        pol = RetryPolicy(max_attempts=5, base_s=0.0, cap_s=0.0,
+                          budget=shared, sleep=lambda s: None)
+        boom = [0]
+
+        def flaky():
+            boom[0] += 1
+            raise ConnectionError("flap")
+
+        try:
+            with tenant_scope("ra"):
+                with pytest.raises(ConnectionError):
+                    pol.call(flaky)
+            assert boom[0] == 2              # the shared budget gated it
+        finally:
+            QOS_ENABLED.set(None)
+
+    def test_exhaustion_counts_tenant_metric(self, qos_on):
+        reg = MetricsRegistry()
+        _knob("geomesa.qos.tenant.rx.retry.budget").set("0")
+        pol = RetryPolicy(max_attempts=5, base_s=0.0, cap_s=0.0,
+                          budget=None, sleep=lambda s: None, registry=reg)
+        try:
+            with tenant_scope("rx"):
+                with pytest.raises(ConnectionError):
+                    pol.call(lambda: (_ for _ in ()).throw(
+                        ConnectionError("x")))
+            counters = reg.snapshot()["counters"]
+            assert counters.get('qos.retry.exhausted{tenant="rx"}') == 1
+        finally:
+            _knob("geomesa.qos.tenant.rx.retry.budget").set(None)
+
+
+class TestHedgeBudgetIsolation:
+    def test_drained_tenant_budget_suppresses_hedge(self, qos_on):
+        from geomesa_tpu.resilience.hedge import HedgePolicy
+        reg = MetricsRegistry()
+        _knob("geomesa.qos.tenant.h0.retry.budget").set("0")
+        hp = HedgePolicy(budget=RetryBudget(capacity=50.0), registry=reg)
+        try:
+            with tenant_scope("h0"):
+                # delay 0 wants to hedge at once; the tenant's empty
+                # budget must refuse while the call still resolves
+                assert hp.call(lambda: (time.sleep(0.03), "v")[1],
+                               0.0) == "v"
+            counters = reg.snapshot()["counters"]
+            assert counters.get("resilience.hedge.attempts", 0) == 0
+            assert counters.get('qos.hedge.suppressed{tenant="h0"}',
+                                0) >= 1
+        finally:
+            _knob("geomesa.qos.tenant.h0.retry.budget").set(None)
+
+
+# -- web: per-tenant in-flight caps + jittered Retry-After -----------------
+
+@pytest.fixture
+def qos_server(qos_on):
+    WEB_AUTH_TOKENS.set("a-tok:alpha,b-tok:beta,z-tok:blocked")
+    _knob("geomesa.qos.tenant.blocked.max.inflight").set("0")
+    srv = GeoMesaWebServer(seeded_store()).start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+        _knob("geomesa.qos.tenant.blocked.max.inflight").set(None)
+        batcher_registry.clear()
+
+
+def _get(srv, path, token=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{srv.port}{path}")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+class TestWebTenantGate:
+    def test_capped_tenant_sheds_others_proceed(self, qos_server):
+        st, hdrs, body = _get(qos_server, "/rest/schemas", token="z-tok")
+        assert st == 503
+        d = json.loads(body)
+        assert d["retryable"] is True and d["tenant"] == "blocked"
+        assert float(hdrs["Retry-After"]) > 0
+        # a different tenant's requests are untouched by the shed
+        st, _, body = _get(qos_server, "/rest/schemas", token="a-tok")
+        assert st == 200 and json.loads(body) == ["people"]
+        qs = tenant_registry.status()["tenants"]
+        assert qs["blocked"]["sheds"] >= 1
+        assert qs["alpha"]["sheds"] == 0
+        assert qs["alpha"]["inflight"] == 0   # released after serving
+
+    def test_retry_after_is_jittered(self, qos_server):
+        """Two shed responses must not advertise the same Retry-After:
+        a herd of shed clients would otherwise retry in one wave."""
+        values = set()
+        for _ in range(4):
+            st, hdrs, _ = _get(qos_server, "/rest/schemas", token="z-tok")
+            assert st == 503
+            v = float(hdrs["Retry-After"])
+            assert 0 < v <= 1.5             # U(0.5x, 1.5x) around 1s
+            values.add(hdrs["Retry-After"])
+        assert len(values) > 1
+
+    def test_rest_qos_and_health_documents(self, qos_server):
+        _get(qos_server, "/rest/schemas", token="a-tok")
+        st, _, body = _get(qos_server, "/rest/qos")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["enabled"] is True
+        assert "alpha" in doc["tenants"]
+        a = doc["tenants"]["alpha"]
+        assert a["inflight"] == 0 and a["weight"] == 1.0
+        st, _, body = _get(qos_server, "/rest/health")
+        assert json.loads(body)["qos"]["enabled"] is True
+
+    def test_kill_switch_off_no_gate_no_detail(self):
+        QOS_ENABLED.set("false")
+        _knob("geomesa.qos.tenant.blocked.max.inflight").set("0")
+        srv = GeoMesaWebServer(seeded_store()).start()
+        try:
+            st, _, body = _get(srv, "/rest/schemas", token="z-tok")
+            assert st == 200                 # no tenant gate at all
+            st, _, body = _get(srv, "/rest/qos")
+            assert json.loads(body) == {"enabled": False, "tenants": {}}
+            st, _, body = _get(srv, "/rest/health")
+            assert json.loads(body)["qos"] is None
+        finally:
+            srv.stop()
+            QOS_ENABLED.set(None)
+            _knob("geomesa.qos.tenant.blocked.max.inflight").set(None)
+            batcher_registry.clear()
+            tenant_registry.reset()
+
+
+# -- ingest: per-tenant row buckets ----------------------------------------
+
+class TestIngestRowBuckets:
+    def test_bucket_refuses_and_restores(self, qos_on):
+        _knob("geomesa.qos.tenant.w.max.inflight.rows").set("100")
+        try:
+            assert tenant_registry.acquire_rows("w", 80, block=False)
+            # 80 + 30 > 100 -> refused without blocking
+            assert not tenant_registry.acquire_rows("w", 30, block=False)
+            st = tenant_registry.status()["tenants"]["w"]
+            assert st["inflight_rows"] == 80
+            assert st["row_refusals"] == 1
+            tenant_registry.release_rows("w", 80)
+            assert tenant_registry.acquire_rows("w", 30, block=False)
+            tenant_registry.release_rows("w", 30)
+            st = tenant_registry.status()["tenants"]["w"]
+            assert st["inflight_rows"] == 0  # exact restoration
+        finally:
+            _knob("geomesa.qos.tenant.w.max.inflight.rows").set(None)
+
+    def test_oversize_batch_admitted_alone(self, qos_on):
+        """IngestGovernor semantics: a batch bigger than the whole cap
+        is admitted once the bucket is empty, never deadlocked."""
+        _knob("geomesa.qos.tenant.w2.max.inflight.rows").set("10")
+        try:
+            assert tenant_registry.acquire_rows("w2", 50, block=False)
+            tenant_registry.release_rows("w2", 50)
+        finally:
+            _knob("geomesa.qos.tenant.w2.max.inflight.rows").set(None)
+
+    def test_pipeline_charges_and_credits_tenant(self, qos_on):
+        from geomesa_tpu.ingest.pipeline import IngestPipeline
+        _knob("geomesa.qos.tenant.ing.max.inflight.rows").set("8")
+        sft = parse_spec("qpipe", "dtg:Date,*geom:Point:srid=4326")
+        ds = InMemoryDataStore()
+        ds.create_schema(sft)
+        pipe = IngestPipeline(ds)
+        try:
+            batch = FeatureBatch.from_dict(
+                sft, np.array(["a", "b", "c"], dtype=object),
+                {"dtg": np.array([1, 2, 3], dtype=np.int64),
+                 "geom": (np.zeros(3), np.zeros(3))})
+            with tenant_scope("ing"):
+                ack = pipe.write("qpipe", batch)
+            assert ack is not None
+            ack.wait()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                st = tenant_registry.status()["tenants"].get("ing")
+                if st and st["inflight_rows"] == 0:
+                    break
+                time.sleep(0.01)
+            st = tenant_registry.status()["tenants"]["ing"]
+            assert st["inflight_rows"] == 0  # writer credited the rows
+            assert ds.query_count(Query("qpipe", "INCLUDE")) == 3
+        finally:
+            pipe.close()
+            _knob("geomesa.qos.tenant.ing.max.inflight.rows").set(None)
+
+    def test_pipeline_nonblock_refusal_returns_none(self, qos_on):
+        from geomesa_tpu.ingest.pipeline import IngestPipeline
+
+        class SlowStore(InMemoryDataStore):
+            def __init__(self):
+                super().__init__()
+                self.gate = threading.Event()
+
+            def write(self, *a, **kw):
+                self.gate.wait(10.0)
+                return super().write(*a, **kw)
+
+        _knob("geomesa.qos.tenant.nb.max.inflight.rows").set("4")
+        sft = parse_spec("qnb", "dtg:Date,*geom:Point:srid=4326")
+        ds = SlowStore()
+        ds.create_schema(sft)
+        pipe = IngestPipeline(ds)
+        try:
+            def mk(ids):
+                k = len(ids)
+                return FeatureBatch.from_dict(
+                    sft, np.array(ids, dtype=object),
+                    {"dtg": np.arange(k, dtype=np.int64),
+                     "geom": (np.zeros(k), np.zeros(k))})
+
+            with tenant_scope("nb"):
+                first = pipe.write("qnb", mk(["a", "b", "c"]),
+                                   block=False)
+                assert first is not None
+                # bucket holds 3 of 4; 3 more cannot fit -> refusal
+                second = pipe.write("qnb", mk(["d", "e", "f"]),
+                                    block=False)
+            assert second is None
+            st = tenant_registry.status()["tenants"]["nb"]
+            assert st["row_refusals"] >= 1
+            ds.gate.set()
+            first.wait()
+        finally:
+            ds.gate.set()
+            pipe.close()
+            _knob("geomesa.qos.tenant.nb.max.inflight.rows").set(None)
+
+
+# -- cache: visibility scoping + per-tenant byte budgets -------------------
+
+class TestCacheTenantScoping:
+    def _cache(self):
+        CACHE_ENABLED.set("true")
+        return ResultCache(version_fn=lambda tn: 1,
+                           registry=MetricsRegistry())
+
+    def teardown_method(self):
+        CACHE_ENABLED.set(None)
+
+    def test_visibility_scopes_sharing(self, qos_on):
+        _knob("geomesa.qos.tenant.va.visibility").set("secret")
+        _knob("geomesa.qos.tenant.vb.visibility").set("secret")
+        _knob("geomesa.qos.tenant.vc.visibility").set("public")
+        cache = self._cache()
+        calls = [0]
+
+        def compute():
+            calls[0] += 1
+            return b"payload"
+
+        try:
+            with tenant_scope("va"):
+                cache.get_or_compute("t", "k1", compute)
+            with tenant_scope("vb"):    # same visibility: shares
+                cache.get_or_compute("t", "k1", compute)
+            assert calls[0] == 1
+            with tenant_scope("vc"):    # different visibility: never
+                cache.get_or_compute("t", "k1", compute)
+            assert calls[0] == 2
+        finally:
+            for t in ("va", "vb", "vc"):
+                _knob(f"geomesa.qos.tenant.{t}.visibility").set(None)
+
+    def test_off_path_key_is_byte_identical(self):
+        QOS_ENABLED.set("false")
+        cache = self._cache()
+        try:
+            with tenant_scope("va"):
+                cache.get_or_compute("t", "k1", lambda: b"x")
+            assert list(cache._entries) == [("t", "k1")]
+        finally:
+            QOS_ENABLED.set(None)
+
+    def test_tenant_byte_budget_evicts_own_entries_only(self, qos_on):
+        _knob("geomesa.qos.tenant.small.cache.max.bytes").set("250")
+        cache = self._cache()
+        try:
+            with tenant_scope("big"):
+                cache.get_or_compute("t", "kb", lambda: b"B" * 200)
+            with tenant_scope("small"):
+                for i in range(4):
+                    cache.get_or_compute("t", f"k{i}",
+                                         lambda: b"S" * 100)
+            status = cache.status()
+            # small stayed under 250 bytes by evicting ITS oldest
+            assert status["tenant_bytes"]["small"] <= 250
+            # big's entry was never touched
+            assert status["tenant_bytes"]["big"] == 200
+            assert cache.evictions >= 2
+            # the freshest small entry is resident
+            hits0 = cache.hits
+            with tenant_scope("small"):
+                cache.get_or_compute("t", "k3", lambda: b"S" * 100)
+            assert cache.hits == hits0 + 1
+        finally:
+            _knob("geomesa.qos.tenant.small.cache.max.bytes").set(None)
+
+    def test_single_payload_over_tenant_budget_not_memoized(self,
+                                                            qos_on):
+        _knob("geomesa.qos.tenant.tiny.cache.max.bytes").set("10")
+        cache = self._cache()
+        try:
+            with tenant_scope("tiny"):
+                v = cache.get_or_compute("t", "k", lambda: b"X" * 50)
+            assert v == b"X" * 50            # served, just not cached
+            assert cache.status()["entries"] == 0
+        finally:
+            _knob("geomesa.qos.tenant.tiny.cache.max.bytes").set(None)
+
+
+# -- metrics: tenant-label cardinality safety ------------------------------
+
+class TestTenantMetricsSafety:
+    def test_hostile_names_sanitize(self):
+        assert tenant_label('evil"\ntenant{x}') == "evil_tenant_x_"
+        assert "\n" not in tenant_label("a\nb")
+        assert len(tenant_label("x" * 500)) <= 64
+
+    def test_hostile_tenant_keeps_exposition_parseable(self, qos_on):
+        reg = MetricsRegistry()
+        registry = TenantRegistry(registry=reg)
+        registry.try_acquire_inflight('evil"\nname # HELP bomb')
+        text = prometheus_text(reg.snapshot())
+        for line in text.splitlines():
+            assert line.startswith("#") or " " in line
+        assert 'tenant="evil' in text
+
+    def test_tenant_flood_collapses_to_other(self, qos_on):
+        reg = MetricsRegistry()
+        registry = TenantRegistry(registry=reg)
+        METRICS_MAX_SERIES.set("4")
+        try:
+            for i in range(20):
+                registry.try_acquire_inflight(f"t{i}")
+        finally:
+            METRICS_MAX_SERIES.set(None)
+        gauges = reg.snapshot()["gauges"]
+        fam = [k for k in gauges if k.startswith("qos.web.inflight")]
+        assert len(fam) == 5                 # cap + one `other` series
+        assert any('tenant="other"' in k for k in fam)
+        assert reg.snapshot()["counters"]["metrics.series.dropped"] > 0
+
+
+# -- attribution: audit events, trace root span, SLO series ----------------
+
+class TestAttribution:
+    def test_audit_event_carries_tenant(self, qos_on):
+        from geomesa_tpu.audit import AuditLogger
+        log = AuditLogger()
+        with tenant_scope("aud"):
+            assert audit_query(log, "memory", "pts", "INCLUDE", {},
+                               1.0, 2.0, 3)
+        assert log.query()[-1].tenant == "aud"
+        # off path: the field stays None
+        QOS_ENABLED.set("false")
+        audit_query(log, "memory", "pts", "INCLUDE", {}, 1.0, 2.0, 3)
+        QOS_ENABLED.set("true")
+        assert log.query()[-1].tenant is None
+
+    def test_cluster_query_one_event_tenant_attributed(self, qos_on):
+        """Delegated legs stay suppressed: one logical query through
+        the coordinator is ONE audit event, and the tenant identity
+        crosses into it."""
+        from geomesa_tpu.cluster import ClusterDataStore
+        _reset_global()
+        sft = parse_spec("qclu", "dtg:Date,*geom:Point:srid=4326")
+        cluster = ClusterDataStore(
+            [InMemoryDataStore(), InMemoryDataStore()],
+            names=["g0", "g1"])
+        try:
+            cluster.create_schema(sft)
+            rng = np.random.default_rng(7)
+            n = 64
+            cluster.write("qclu", FeatureBatch.from_dict(
+                sft, np.array([f"f{i}" for i in range(n)], dtype=object),
+                {"dtg": rng.integers(0, 10**12, n).astype(np.int64),
+                 "geom": (rng.uniform(-170, 170, n),
+                          rng.uniform(-80, 80, n))}))
+            ev0 = len(global_audit().query())
+            with tenant_scope("clu-t"):
+                res = cluster.query("INCLUDE", "qclu")
+            assert res.n == n
+            events = global_audit().query()[ev0:]
+            cluster_events = [e for e in events if e.surface == "cluster"]
+            assert len(cluster_events) == 1
+            assert cluster_events[0].tenant == "clu-t"
+            assert not [e for e in events if e.surface == "remote"]
+        finally:
+            cluster.close()
+            _reset_global()
+
+    def test_web_root_span_annotated(self, qos_server):
+        from geomesa_tpu.obs import tracer
+        from geomesa_tpu.obs.trace import TRACE_SAMPLE
+        TRACE_SAMPLE.set("1.0")
+        tracer.clear()
+        try:
+            _get(qos_server, "/rest/schemas", token="b-tok")
+            webs = [d for t in tracer.traces()
+                    for d in (tracer.get(t["trace_id"]) or [])
+                    if d["kind"] == "web"]
+            assert any(d.get("attrs", {}).get("tenant") == "beta"
+                       for d in webs)
+        finally:
+            TRACE_SAMPLE.set(None)
+            tracer.clear()
+
+    def test_slo_engine_grows_tenant_series(self, qos_on):
+        from geomesa_tpu.obs.slo import slo_engine
+        slo_engine.clear()
+        try:
+            slo_engine.record("query", ok=True, latency_s=0.01,
+                              tenant="slo-t")
+            routes = slo_engine.status()["routes"]
+            assert "query" in routes
+            assert "query.tenant.slo-t" in routes
+            # off path: no tenant -> no derived series
+            slo_engine.clear()
+            slo_engine.record("query", ok=True, latency_s=0.01)
+            assert list(slo_engine.status()["routes"]) == ["query"]
+        finally:
+            slo_engine.clear()
+
+
+# -- CLI -------------------------------------------------------------------
+
+class TestCli:
+    def test_qos_status_roundtrip(self, qos_server, capsys):
+        from geomesa_tpu.tools.cli import main as cli_main
+        _get(qos_server, "/rest/schemas", token="a-tok")
+        rc = cli_main(["qos", "status", "--path",
+                       f"remote://127.0.0.1:{qos_server.port}"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["enabled"] is True and "alpha" in doc["tenants"]
+
+    def test_qos_needs_remote_path(self, tmp_path, capsys):
+        from geomesa_tpu.tools.cli import main as cli_main
+        rc = cli_main(["qos", "status", "--path", str(tmp_path)])
+        assert rc == 2
