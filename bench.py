@@ -145,7 +145,7 @@ REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
                              "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,"
-                             "19,20,21,22,northstar")
+                             "19,20,21,22,23,northstar")
               .split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
@@ -3694,6 +3694,122 @@ def bench_config22(rng, n=None, c=None, nq=None, abuse_c=None,
     return out
 
 
+# -- config 23: materialized views — incremental folds vs re-execution ----
+
+def bench_config23(rng, n=None, commit_rows=None, commits=None,
+                   reps=None):
+    """What incremental view maintenance buys over full re-execution.
+
+    A standing grouped-aggregate view (COUNT/SUM/AVG/MIN/MAX over 32
+    groups) rides a 1M-row table under a 1k-row/commit firehose. Each
+    commit is timed end to end — the write-path fold plus a fresh read
+    through the LSN-keyed cache — against the O(table) baseline of
+    re-running the statement from scratch per refresh. Gates: the
+    folded state stays bit-identical to from-scratch re-execution at
+    the final LSN (including a delete wave exercising retraction), the
+    incremental path wins by >= 5x per commit, and the kill switch off
+    leaves the write path untouched and the table contents identical
+    to a store that never loaded the subsystem."""
+    from geomesa_tpu.features import FeatureBatch, parse_spec
+    from geomesa_tpu.sql import SqlEngine
+    from geomesa_tpu.store import InMemoryDataStore
+    from geomesa_tpu.views import VIEWS_ENABLED, ViewRegistry
+
+    n = n if n is not None else int(
+        os.environ.get("GEOMESA_TPU_BENCH_VIEWS_N", 1_000_000))
+    commit_rows = commit_rows if commit_rows is not None else 1_000
+    commits = commits if commits is not None else 20
+    reps = reps if reps is not None else max(TRIALS, 3)
+    sft = parse_spec("pts23", "*geom:Point:srid=4326,name:String,"
+                              "val:Integer")
+    names = np.array([f"grp{i}" for i in range(32)], dtype=object)
+
+    def _batch(m, prefix):
+        ids = np.array([f"{prefix}{i}" for i in range(m)], dtype=object)
+        return FeatureBatch.from_dict(sft, ids, {
+            "geom": (rng.uniform(-170, 170, m), rng.uniform(-80, 80, m)),
+            "name": names[rng.integers(0, len(names), m)],
+            "val": rng.integers(0, 1_000_000, m).astype(np.int64)})
+
+    seed_batch = _batch(n, "s")
+    ds = InMemoryDataStore()
+    ds.create_schema(sft)
+    ds.write("pts23", seed_batch)
+
+    sql = ("SELECT name, COUNT(*) AS c, SUM(val) AS s, AVG(val) AS a, "
+           "MIN(val) AS lo, MAX(val) AS hi FROM pts23 GROUP BY name")
+    eng = SqlEngine(ds)
+
+    def _canon(res):
+        return [tuple(str(v) for v in r) for r in res.rows()]
+
+    out = {"n": n, "commit_rows": commit_rows, "commits": commits,
+           "reps": reps}
+
+    # -- baseline: full re-execution per refresh (O(table)) ---------------
+    eng.query(sql)  # warm
+    samples = [_timed(lambda: eng.query(sql)) for _ in range(reps)]
+    full_s = _p50(samples)
+
+    # -- incremental: fold + cached read per firehose commit --------------
+    VIEWS_ENABLED.set("true")
+    try:
+        reg = ViewRegistry(ds, restore=False)
+        reg.register("hot23", sql)
+        fire = [_batch(commit_rows, f"c{j}_") for j in range(commits)]
+        inc_samples = []
+        for b in fire:
+            t0 = time.perf_counter()
+            ds.write("pts23", b)
+            reg.result("hot23")
+            inc_samples.append(time.perf_counter() - t0)
+        inc_s = _p50(inc_samples)
+
+        # a delete wave exercises the retraction path before the gate
+        doom = [f"c0_{i}" for i in range(min(commit_rows, 500))]
+        ds.delete("pts23", doom)
+        exact = _canon(reg.result("hot23")) == _canon(eng.query(sql))
+        view_status = reg.get("hot23").status()
+        reg.close()
+    finally:
+        VIEWS_ENABLED.set(None)
+
+    # -- kill switch off: register refuses, write path untouched ----------
+    off = InMemoryDataStore()
+    off.create_schema(sft)
+    off_reg = ViewRegistry(off, restore=False)
+    try:
+        off_reg.register("x", sql)
+        off_refuses = False
+    except ValueError:
+        off_refuses = True
+    off_inert = not off_reg._orig and "write" not in off.__dict__
+    m = min(n, 100_000)
+    off.write("pts23", seed_batch.take(np.arange(m)))
+    twin = InMemoryDataStore()
+    twin.create_schema(sft)
+    twin.write("pts23", seed_batch.take(np.arange(m)))
+    off_exact = (_canon(SqlEngine(off).query(sql))
+                 == _canon(SqlEngine(twin).query(sql)))
+
+    out.update({
+        "full_reexec_s": round(full_s, 5),
+        "incremental_commit_s": round(inc_s, 5),
+        "speedup": round(full_s / inc_s, 2) if inc_s else float("inf"),
+        "exact_after_firehose_and_deletes": bool(exact),
+        "folds": view_status["folds"],
+        "rows_folded": view_status["rows_folded"],
+        "retraction_fallbacks": view_status["retraction_fallbacks"],
+        "off_refuses": bool(off_refuses),
+        "off_write_path_inert": bool(off_inert),
+        "off_results_identical": bool(off_exact),
+    })
+    out["gates_pass"] = bool(
+        exact and out["speedup"] >= 5.0 and off_refuses
+        and off_inert and off_exact)
+    return out
+
+
 # -- config 10: storage integrity — scrub overhead + corrupt recovery -----
 
 def bench_config10(rng):
@@ -3978,6 +4094,8 @@ def main(argv=None):
         out["configs"]["21_reshard"] = bench_config21(rng)
     if "22" in CONFIGS:
         out["configs"]["22_multitenant"] = bench_config22(rng)
+    if "23" in CONFIGS:
+        out["configs"]["23_matviews"] = bench_config23(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

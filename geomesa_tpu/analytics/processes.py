@@ -499,3 +499,42 @@ def geohash_decode_process(hashes, prec: int | None = None) -> np.ndarray:
     return np.array([st_geom_from_geohash(h, prec) if h is not None
                      else None for h in np.asarray(hashes, object)],
                     object)
+
+
+def point_n_process(store, type_name: str, attribute: str, n: int,
+                    ecql=None) -> np.ndarray:
+    """Per-feature n-th vertex of a LineString attribute (process form
+    of ST_PointN); None for nulls / non-lines / out of range."""
+    from .st_functions import st_point_n
+    res = store.query(Query(type_name, ecql or "INCLUDE"))
+    if res.batch is None or res.n == 0:
+        return np.empty(0, object)
+    col = res.batch.col(attribute)
+    return np.array([st_point_n(g, n) if (g := col.value(i)) is not None
+                     else None for i in range(res.batch.n)], object)
+
+
+def exterior_ring_process(store, type_name: str, attribute: str,
+                          ecql=None) -> np.ndarray:
+    """Per-feature polygon shell as a LineString (process form of
+    ST_ExteriorRing); None for nulls / non-polygons."""
+    from .st_functions import st_exterior_ring
+    res = store.query(Query(type_name, ecql or "INCLUDE"))
+    if res.batch is None or res.n == 0:
+        return np.empty(0, object)
+    col = res.batch.col(attribute)
+    return np.array([st_exterior_ring(g) if (g := col.value(i)) is not None
+                     else None for i in range(res.batch.n)], object)
+
+
+def num_points_process(store, type_name: str, attribute: str,
+                       ecql=None) -> np.ndarray:
+    """Per-feature vertex count (process form of ST_NumPoints); -1 for
+    null geometries (int column, no NaN slot)."""
+    from .st_functions import st_num_points
+    res = store.query(Query(type_name, ecql or "INCLUDE"))
+    if res.batch is None or res.n == 0:
+        return np.empty(0, np.int64)
+    col = res.batch.col(attribute)
+    return np.array([st_num_points(g) if (g := col.value(i)) is not None
+                     else -1 for i in range(res.batch.n)], np.int64)

@@ -29,6 +29,7 @@ __all__ = [
     "convex_hull_points",
     "st_closest_point", "st_translate", "st_point", "st_make_bbox",
     "st_geom_from_wkt", "st_as_text", "st_x", "st_y",
+    "st_point_n", "st_exterior_ring", "st_num_points", "st_make_polygon",
     "st_relate", "st_relate_bool", "st_buffer", "st_buffer_point",
     "st_distance_spheroid", "st_length_spheroid",
     "st_antimeridian_safe_geom", "st_cast_to_point", "st_cast_to_linestring",
@@ -53,6 +54,46 @@ def st_make_bbox(xmin, ymin, xmax, ymax) -> Polygon:
 
 def st_geom_from_wkt(wkt: str) -> Geometry:
     return parse_wkt(wkt)
+
+
+def st_point_n(g: Geometry, n) -> Point | None:
+    """N-th vertex of a LineString, 1-based (st_pointN); negative n
+    counts from the end. None for other types or out of range — the
+    reference returns null rather than raising."""
+    if not isinstance(g, LineString):
+        return None
+    n = int(n)
+    size = len(g.coords)
+    if n < 0:
+        n = size + n + 1
+    if n < 1 or n > size:
+        return None
+    x, y = g.coords[n - 1]
+    return Point(float(x), float(y))
+
+
+def st_exterior_ring(g: Geometry) -> LineString | None:
+    """Polygon shell as a (closed) LineString (st_exteriorRing); None
+    for non-polygons."""
+    if not isinstance(g, Polygon):
+        return None
+    return LineString(g.shell)
+
+
+def st_num_points(g: Geometry) -> int:
+    """Total vertex count over every ring/part (st_numPoints)."""
+    if isinstance(g, Point):
+        return 1
+    return int(sum(len(c) for c in g.coords_list()))
+
+
+def st_make_polygon(shell: LineString) -> Polygon | None:
+    """Polygon from a LineString shell (st_makePolygon); None for
+    other types or degenerate (< 3 point) rings — the reference
+    returns null rather than raising."""
+    if not isinstance(shell, LineString) or len(shell.coords) < 3:
+        return None
+    return Polygon(shell.coords)
 
 
 def st_as_text(g: Geometry) -> str:
@@ -466,6 +507,13 @@ SQL_SCALARS = {
     "ST_GEOHASH": lambda g, prec=25: st_geohash(g, int(prec)),
     "ST_GEOMFROMGEOHASH": lambda gh, prec=None: st_geom_from_geohash(
         gh, None if prec is None else int(prec)),
+    "ST_POINTN": lambda g, n: st_point_n(g, int(n)),
+    "ST_EXTERIORRING": st_exterior_ring,
+    "ST_NUMPOINTS": st_num_points,
+    # all-literal constructors: the parser passes '__const__' as the
+    # column and the engine broadcasts the constructed value per row
+    "ST_MAKEBBOX": lambda *args: st_make_bbox(*(float(a) for a in args)),
+    "ST_MAKEPOLYGON": st_make_polygon,
 }
 
 

@@ -660,6 +660,14 @@ class SqlEngine:
             if col_name in ("__fid__", "id"):
                 add(it.name, ids)
                 continue
+            if it.agg == "st" and col_name == "__const__":
+                # all-literal constructor: one evaluation, broadcast
+                from ..analytics.st_functions import SQL_SCALARS
+                v = SQL_SCALARS[it.fn](*it.args)
+                arr = np.empty(batch.n, dtype=object)
+                arr.fill(v)
+                add(it.name, arr)
+                continue
             c = batch.col(col_name)
             vals = np.array([c.value(i) for i in range(c.n)],
                             dtype=object)

@@ -332,8 +332,16 @@ class _Parser:
                 and self.t.peek(1)[0] == "lparen":
             fn = self.t.next()[1].upper()
             self.t.expect("lparen")
-            col = self._name()
-            args: list = []
+            if self.t.peek()[0] in ("number", "string"):
+                # all-literal constructor (ST_MakeBBOX(0,0,1,1)): no
+                # source column — the engine broadcasts the value
+                kk, vv = self.t.next()
+                first = _num(vv) if kk == "number" else _unquote(vv)
+                col = "__const__"
+                args = [first]
+            else:
+                col = self._name()
+                args = []
             while self.t.peek()[0] == "comma":
                 self.t.next()
                 kk, vv = self.t.peek()

@@ -735,3 +735,28 @@ class RemoteDataStore(DataStore):
     def cq_unregister(self, name: str) -> dict:
         """POST /rest/cq/unregister?name= (bearer-gated)."""
         return self._json("POST", "/rest/cq/unregister", {"name": name})
+
+    def views_status(self) -> dict:
+        """GET /rest/views: registered materialized views with fold
+        counters and LSN staleness."""
+        return self._json("GET", "/rest/views")
+
+    def views_get(self, name: str) -> dict:
+        """GET /rest/views/{name}: the view's rows at its fold LSN."""
+        return self._json("GET", f"/rest/views/{quote(name)}")
+
+    def views_register(self, name: str, sql: str) -> dict:
+        """POST /rest/views/register (bearer-gated); the standing
+        SELECT travels in a JSON body, not the query string."""
+        body = json.dumps({"name": name, "sql": sql}).encode()
+        return self._json("POST", "/rest/views/register", body=body)
+
+    def views_unregister(self, name: str) -> dict:
+        """POST /rest/views/unregister?name= (bearer-gated)."""
+        return self._json("POST", "/rest/views/unregister",
+                          {"name": name})
+
+    def views_refresh(self, name: str) -> dict:
+        """POST /rest/views/refresh?name= (bearer-gated): full
+        re-execution — the O(table) baseline the folds replace."""
+        return self._json("POST", "/rest/views/refresh", {"name": name})

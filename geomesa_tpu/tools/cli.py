@@ -686,6 +686,49 @@ def cmd_cq(args) -> int:
     return 0
 
 
+def cmd_views(args) -> int:
+    """Materialized-view administration against a serving node:
+    ``list`` dumps registered views + fold counters; ``get`` dumps one
+    view's rows at its fold LSN; ``register``/``unregister`` mutate
+    the standing population (bearer-gated on remote nodes)."""
+    path = args.path
+    if not path.startswith("remote://"):
+        print("views commands need --path remote://host:port",
+              file=sys.stderr)
+        return 2
+    from ..store import RemoteDataStore
+    from ..store.remote import RemoteError
+    host, _, port = path[len("remote://"):].partition(":")
+    ds = RemoteDataStore(host or "127.0.0.1", int(port) if port else 8080,
+                         auth_token=getattr(args, "token", None))
+    try:
+        if args.views_command == "list":
+            json.dump(ds.views_status(), sys.stdout, indent=2)
+        elif args.views_command == "get":
+            json.dump(ds.views_get(args.name), sys.stdout, indent=2)
+        elif args.views_command == "register":
+            json.dump(ds.views_register(args.name, args.sql),
+                      sys.stdout, indent=2)
+        elif args.views_command == "unregister":
+            json.dump(ds.views_unregister(args.name), sys.stdout,
+                      indent=2)
+        else:
+            print(f"unknown views command {args.views_command!r}",
+                  file=sys.stderr)
+            return 2
+    except RemoteError as e:
+        if e.status == 403:
+            print("views mutation is gated: pass --token matching "
+                  "geomesa.web.auth.token", file=sys.stderr)
+            return 3
+        if e.status == 400:
+            print(f"statement refused: {e}", file=sys.stderr)
+            return 2
+        raise
+    print()
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Distributed-trace inspection against a serving node: ``list``
     dumps recent trace summaries (id, root, duration, span kinds);
@@ -1008,6 +1051,30 @@ def main(argv=None) -> int:
             qp.add_argument("--cql", default=None,
                             help="ECQL filter (default INCLUDE)")
         qp.set_defaults(fn=cmd_cq)
+
+    vwp = sub.add_parser("views",
+                         help="materialized-view (standing aggregate) "
+                              "administration")
+    vwsub = vwp.add_subparsers(dest="views_command", required=True)
+    for vname, vhelp in (("list", "registered views + fold counters"),
+                         ("get", "one view's rows at its fold LSN"),
+                         ("register", "add a standing aggregate view "
+                                      "(token-gated)"),
+                         ("unregister", "drop a view (token-gated)")):
+        vp = vwsub.add_parser(vname, help=vhelp)
+        vp.add_argument("--path", required=True,
+                        help="serving node, remote://host:port")
+        vp.add_argument("--token", default=None,
+                        help="admin bearer token "
+                             "(geomesa.web.auth.token)")
+        if vname in ("get", "register", "unregister"):
+            vp.add_argument("--name", required=True,
+                            help="materialized view name")
+        if vname == "register":
+            vp.add_argument("--sql", required=True,
+                            help="single-table GROUP BY aggregate "
+                                 "SELECT the view maintains")
+        vp.set_defaults(fn=cmd_views)
 
     trp = sub.add_parser("trace",
                          help="distributed request-trace inspection")

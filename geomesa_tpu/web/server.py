@@ -91,7 +91,7 @@ WEB_AUTH_TOKEN = SystemProperty("geomesa.web.auth.token", None)
 _GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas"),
           ("POST", "wal"), ("POST", "replication"), ("POST", "integrity"),
           ("POST", "cluster"), ("POST", "cache"), ("POST", "cq"),
-          ("POST", "reshard")}
+          ("POST", "reshard"), ("POST", "views")}
 
 # load-shedding gate: max concurrent in-flight requests (unset ->
 # unlimited). Requests over the cap get 503 + Retry-After BEFORE any
@@ -127,7 +127,7 @@ class GeoMesaWebServer:
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
                  audit=None, auth_token: str | None = None,
                  batcher=None, max_inflight: int | None = None,
-                 cq=None):
+                 cq=None, views=None):
         from ..scan.registry import shared_batcher
         self.store = store
         # continuous-query publisher behind /rest/cq: pass one in, or
@@ -135,6 +135,11 @@ class GeoMesaWebServer:
         # store with a mutation bus)
         self.cq = cq
         self._owns_cq = False
+        # materialized-view registry behind /rest/views: pass one in
+        # (a store may only have ONE registry hooking its write path),
+        # or the first /rest/views request creates it lazily
+        self.views = views
+        self._owns_views = False
         self.audit = audit if audit is not None \
             else getattr(store, "audit", None)
         self.auth_token = (auth_token if auth_token is not None
@@ -194,6 +199,8 @@ class GeoMesaWebServer:
             self._ingest_pipeline.close()
         if self._owns_cq and self.cq is not None:
             self.cq.close()
+        if self._owns_views and self.views is not None:
+            self.views.close()
         if self._owns_prof:
             self._owns_prof = False
             from ..obs.prof import profiler
@@ -607,6 +614,8 @@ class GeoMesaWebServer:
             return self._cache(method, parts[1:], params)
         if parts and parts[0] == "cq":
             return self._cq(method, parts[1:], params, body)
+        if parts and parts[0] == "views":
+            return self._views(method, parts[1:], params, body, headers)
         if parts == ["sql", "join-partial"]:
             # one shard-group leg of a distributed broadcast join:
             # this server joins the shipped small side against its
@@ -1143,6 +1152,74 @@ class GeoMesaWebServer:
                     {"registered": cq.name, "type": cq.type_name,
                      "topic": cq.topic})
             pub.unregister(name)
+            return 200, "application/json", _j({"unregistered": name})
+        return 404, "application/json", _j({"error": "not found"})
+
+    def _views_registry(self):
+        if self.views is None:
+            from ..views import ViewRegistry
+            self.views = ViewRegistry(self.store)
+            self._owns_views = True
+        return self.views
+
+    def _views(self, method, parts, params, body, headers=None):
+        """Materialized-view admin: GET /rest/views (status, open),
+        GET /rest/views/{name} (rows at the view's LSN — conditional,
+        ETag = exact pushdown version), POST
+        /rest/views/register?name=&sql=, POST
+        /rest/views/unregister?name= and POST /rest/views/refresh?name=
+        (mutating, bearer-gated via _GATED). Register args also
+        accepted as a JSON body — a standing SELECT reads better there
+        than in a query string."""
+        reg = self._views_registry()
+        if method == "GET" and not parts:
+            return 200, "application/json", _j({"views": reg.status()})
+        if method == "GET" and len(parts) == 1:
+            v = reg.get(parts[0])
+            etag = self._etag_for(v.state.table, f"view:{v.name}")
+            if etag is not None and self._not_modified(etag, headers):
+                return 304, "application/json", b"", {"ETag": etag}
+            res = reg.result(parts[0])
+            extra = {"ETag": etag} if etag is not None else {}
+            return (200, "application/json", _j(
+                {"name": v.name, "lsn": v.lsn, "columns": res.names,
+                 "rows": [list(r) for r in res.rows()]}), extra)
+        if method == "POST" and parts in (["register"], ["unregister"],
+                                          ["refresh"]):
+            args = {k: v[0] for k, v in params.items()}
+            if body:
+                try:
+                    parsed = json.loads(body)
+                    if not isinstance(parsed, dict):
+                        raise ValueError("body must be a JSON object")
+                    args.update(parsed)
+                except ValueError as e:
+                    return 400, "application/json", _j(
+                        {"error": f"bad JSON body: {e}"})
+            name = args.get("name")
+            if not name:
+                return 400, "application/json", _j(
+                    {"error": "name required"})
+            if parts == ["register"]:
+                sql = args.get("sql")
+                if not sql:
+                    return 400, "application/json", _j(
+                        {"error": "sql required"})
+                try:
+                    view = reg.register(name, sql)
+                except ValueError as e:
+                    # unsupported/malformed statements refuse typed at
+                    # compile time — surface the parser/planner message
+                    # as a client error, never a 500
+                    return 400, "application/json", _j(
+                        {"error": str(e)})
+                return 201, "application/json", _j(
+                    {"registered": view.name,
+                     "status": view.status(reg._lsn(view.state.table))})
+            if parts == ["refresh"]:
+                return 200, "application/json", _j(
+                    {"refreshed": name, "status": reg.refresh(name)})
+            reg.unregister(name)
             return 200, "application/json", _j({"unregistered": name})
         return 404, "application/json", _j({"error": "not found"})
 

@@ -83,3 +83,9 @@ def pytest_configure(config):
         "split/migration, epoch fencing, kill-point crash loop, "
         "SLO-driven autoscaler; select with -m reshard — the "
         "randomized kill-point soak is additionally marked slow)")
+    config.addinivalue_line(
+        "markers", "views: materialized-view suites (fold-state "
+        "bit-identity vs from-scratch re-execution under randomized "
+        "write/delete interleavings, MIN/MAX retraction reservoir, "
+        "checkpoint restore, exactly-once delta subscribers; select "
+        "with -m views)")

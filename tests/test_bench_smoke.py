@@ -349,3 +349,21 @@ def test_config22_multitenant_smoke():
                for v in r["tenants"].values())
     assert r["ids_exact"] is True
     assert "gates_pass" in c
+
+
+@pytest.mark.bench_smoke
+@pytest.mark.views
+def test_config23_matviews_smoke():
+    rng = np.random.default_rng(61)
+    c = bench.bench_config23(rng, n=6000, commit_rows=200, commits=4,
+                             reps=2)
+    # bit-identity gates hold at any size; the 5x speedup headline
+    # only means something at the full 1M-row run
+    assert c["exact_after_firehose_and_deletes"] is True
+    assert c["folds"] >= 4 and c["rows_folded"] >= 4 * 200
+    assert c["off_refuses"] is True
+    assert c["off_write_path_inert"] is True
+    assert c["off_results_identical"] is True
+    assert c["incremental_commit_s"] > 0
+    assert c["full_reexec_s"] > 0
+    assert "gates_pass" in c

@@ -810,6 +810,88 @@ class TestSpheroidAndAntimeridian:
         assert isinstance(r.column("g")[0], MultiPolygon)
 
 
+class TestAccessorFunctions:
+    """ST_* parity additions: vertex accessors and constructors
+    (ST_PointN / ST_ExteriorRing / ST_NumPoints / ST_MakeBBOX /
+    ST_MakePolygon), via the SQL function table and the analytics
+    process surface."""
+
+    def test_accessor_oracle_values(self):
+        from geomesa_tpu.analytics.st_functions import (
+            st_exterior_ring, st_make_bbox, st_make_polygon,
+            st_num_points, st_point_n)
+        from geomesa_tpu.geometry import LineString, Point, Polygon
+        line = LineString(np.array([[0.0, 0.0], [1.0, 2.0], [3.0, 4.0]]))
+        p = st_point_n(line, 2)
+        assert isinstance(p, Point) and (p.x, p.y) == (1.0, 2.0)
+        tail = st_point_n(line, -1)
+        assert (tail.x, tail.y) == (3.0, 4.0)
+        assert st_point_n(line, 4) is None
+        assert st_point_n(line, 0) is None
+        assert st_point_n(Point(1.0, 1.0), 1) is None
+
+        poly = Polygon(np.array([[0.0, 0.0], [4.0, 0.0], [4.0, 4.0],
+                                 [0.0, 4.0], [0.0, 0.0]]))
+        ring = st_exterior_ring(poly)
+        assert isinstance(ring, LineString)
+        assert np.array_equal(ring.coords, poly.shell)
+        assert st_exterior_ring(line) is None
+
+        assert st_num_points(Point(1.0, 1.0)) == 1
+        assert st_num_points(line) == 3
+        assert st_num_points(poly) == 5
+
+        box = st_make_bbox(0.0, 0.0, 2.0, 3.0)
+        assert isinstance(box, Polygon) and box.area == 6.0
+
+        made = st_make_polygon(ring)
+        assert isinstance(made, Polygon)
+        assert np.array_equal(made.shell, poly.shell)
+        assert st_make_polygon(
+            LineString(np.array([[0.0, 0.0], [1.0, 1.0]]))) is None
+
+    def test_accessor_sql_and_process(self):
+        from geomesa_tpu.analytics import (exterior_ring_process,
+                                           num_points_process,
+                                           point_n_process)
+        from geomesa_tpu.features import parse_spec
+        from geomesa_tpu.geometry import LineString, Point, Polygon
+        from geomesa_tpu.sql import SqlEngine
+        from geomesa_tpu.store import InMemoryDataStore
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("shapes", "*g:Geometry:srid=4326"))
+        ds.write_dict("shapes", ["s0", "s1", "s2"], {
+            "g": ["LINESTRING (0 0, 1 2, 3 4)",
+                  "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))",
+                  "POINT (7 8)"]})
+        eng = SqlEngine(ds)
+        r = eng.query("SELECT ST_PointN(g, 2) AS p, ST_NumPoints(g) "
+                      "AS n, ST_ExteriorRing(g) AS ring FROM shapes")
+        ps = r.column("p")
+        assert isinstance(ps[0], Point) and (ps[0].x, ps[0].y) == (1.0,
+                                                                   2.0)
+        assert ps[1] is None and ps[2] is None
+        assert [v for v in r.column("n")] == [3, 5, 1]
+        rings = r.column("ring")
+        assert rings[0] is None and isinstance(rings[1], LineString)
+        # process twins agree with the SQL surface
+        assert [None if v is None else (v.x, v.y)
+                for v in point_n_process(ds, "shapes", "g", 2)] == \
+            [None if v is None else (v.x, v.y) for v in ps]
+        assert num_points_process(ds, "shapes", "g").tolist() == [3, 5, 1]
+        pr = exterior_ring_process(ds, "shapes", "g")
+        assert pr[0] is None and np.array_equal(pr[1].coords,
+                                                rings[1].coords)
+        # all-literal constructor broadcasts one value per row
+        r2 = eng.query("SELECT ST_MakeBBOX(0, 0, 2, 3) AS b FROM shapes")
+        assert all(isinstance(v, Polygon) and v.area == 6.0
+                   for v in r2.column("b"))
+        # ST_MakePolygon on a non-ring input degrades to None per row
+        r4 = eng.query("SELECT ST_MakePolygon(g) AS poly FROM shapes")
+        assert isinstance(r4.column("poly")[0], Polygon)
+        assert r4.column("poly")[1] is None and r4.column("poly")[2] is None
+
+
 class TestExtentAggregate:
     """ST_Extent: the bounding-envelope aggregate, grouped and
     ungrouped, against a manually folded envelope oracle."""

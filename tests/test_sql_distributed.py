@@ -75,7 +75,10 @@ def _seed_stores(cluster, oracle):
 @pytest.fixture(scope="module")
 def plane():
     groups = [InMemoryDataStore() for _ in range(4)]
-    cluster = ClusterDataStore(groups)
+    # generous leg deadline: the heavy join legs JIT-compile on first
+    # use and the default 5s trips under full-suite load (same idiom
+    # as the web-backed plane below)
+    cluster = ClusterDataStore(groups, leg_deadline_s=30)
     oracle = InMemoryDataStore()
     _seed_stores(cluster, oracle)
     # rows actually land on every shard — otherwise the equivalence

@@ -1,0 +1,439 @@
+"""Incrementally-maintained materialized views.
+
+The gate for everything here is BIT-IDENTITY: after any interleaving
+of group-commits and deletes, a view's folded state must finalize to
+exactly what re-running its statement from scratch at the same LSN
+produces — float payloads compared by hex pattern, dtypes included.
+Covers randomized write/delete interleavings, delete-all-of-a-group,
+the MIN/MAX retraction reservoir draining into the recompute fallback,
+checkpoint save + restart restore (no rebuild on a clean stamp, one
+rebuild on a stale sidecar), the kill switch, the delta bus stream
+(exactly-once across a broker kill/restart), and the /rest/views web
+surface (typed 400s for refused statements, ETag/304 reads).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.sql import SqlEngine
+from geomesa_tpu.store import InMemoryDataStore
+from geomesa_tpu.views import (VIEW_RESERVOIR_K, VIEWS_ENABLED,
+                               ViewDeltaSubscriber, ViewRegistry,
+                               ViewState, view_topic)
+
+pytestmark = pytest.mark.views
+
+SPEC = "cat:String,n:Integer,v:Double,*geom:Point:srid=4326"
+
+NUM_SQL = ("SELECT cat, count(*) AS c, sum(n) AS s, avg(v) AS a, "
+           "min(v) AS lo, max(v) AS hi FROM t WHERE n > 0 "
+           "GROUP BY cat ORDER BY cat")
+GEO_SQL = ("SELECT cat, count(*) AS c, st_extent(geom) AS ext, "
+           "st_convexHull(geom) AS hull FROM t GROUP BY cat")
+SIMPLE_SQL = "SELECT cat, count(*) AS c, sum(n) AS s FROM t GROUP BY cat"
+
+
+@pytest.fixture(autouse=True)
+def _views_on():
+    VIEWS_ENABLED.set("true")
+    yield
+    VIEWS_ENABLED.set(None)
+    VIEW_RESERVOIR_K.set(None)
+
+
+def make_batch(sft, n, seed=7, id_prefix="f", cats=("a", "b", "c")):
+    rng = np.random.default_rng(seed)
+    ids = np.array([f"{id_prefix}{i}" for i in range(n)], dtype=object)
+    return FeatureBatch.from_dict(sft, ids, {
+        "cat": np.array([cats[i % len(cats)] for i in range(n)],
+                        dtype=object),
+        "n": rng.integers(-50, 50, n),
+        "v": rng.normal(0.0, 10.0, n),
+        "geom": (rng.uniform(-100, -60, n), rng.uniform(25, 50, n))})
+
+
+def fresh_store(n=0, seed=7, **store_kw):
+    sft = parse_spec("t", SPEC)
+    ds = InMemoryDataStore(**store_kw)
+    ds.create_schema(sft)
+    if n:
+        ds.write("t", make_batch(sft, n, seed=seed))
+    return ds, sft
+
+
+def canon(res):
+    """Bit-exact canonical form of a SqlResult: dtypes + per-value
+    encoding where floats compare by hex bit pattern and geometries by
+    WKT."""
+    from geomesa_tpu.geometry import to_wkt
+    from geomesa_tpu.geometry.base import Geometry
+
+    def enc(x):
+        if isinstance(x, np.generic):
+            x = x.item()
+        if isinstance(x, float):
+            return ("f", float(x).hex())
+        if isinstance(x, Geometry):
+            return ("g", to_wkt(x))
+        return x
+
+    dtypes = [str(np.asarray(res.columns[n]).dtype) for n in res.names]
+    return (list(res.names), dtypes,
+            [tuple(enc(x) for x in r) for r in res.rows()])
+
+
+def assert_matches_scratch(reg, ds, name, sql):
+    assert canon(reg.result(name)) == canon(SqlEngine(ds).query(sql))
+
+
+# -- fold-state bit-identity ---------------------------------------------------
+
+
+class TestBitIdentity:
+    def test_initial_build_matches_engine(self):
+        ds, sft = fresh_store(60)
+        reg = ViewRegistry(ds)
+        reg.register("num", NUM_SQL)
+        reg.register("geo", GEO_SQL)
+        assert_matches_scratch(reg, ds, "num", NUM_SQL)
+        assert_matches_scratch(reg, ds, "geo", GEO_SQL)
+
+    @pytest.mark.parametrize("seed", [3, 17, 202, 4049])
+    def test_randomized_write_delete_interleavings(self, seed):
+        """Hammer both views with a random mix of inserts and deletes;
+        after EVERY commit the folded state must match a from-scratch
+        re-execution at the same LSN."""
+        ds, sft = fresh_store(40, seed=seed)
+        reg = ViewRegistry(ds)
+        reg.register("num", NUM_SQL)
+        reg.register("geo", GEO_SQL)
+        rng = np.random.default_rng(seed)
+        live = {f"f{i}" for i in range(40)}
+        for step in range(20):
+            if live and rng.random() < 0.45:
+                k = int(rng.integers(1, min(8, len(live)) + 1))
+                doom = [str(x) for x in rng.choice(
+                    sorted(live), size=k, replace=False)]
+                ds.delete("t", doom)
+                live -= set(doom)
+            else:
+                k = int(rng.integers(1, 9))
+                b = make_batch(sft, k, seed=int(rng.integers(1 << 30)),
+                               id_prefix=f"s{step}_")
+                ds.write("t", b)
+                live |= {str(i) for i in b.ids}
+            assert_matches_scratch(reg, ds, "num", NUM_SQL)
+            assert_matches_scratch(reg, ds, "geo", GEO_SQL)
+
+    def test_delete_all_of_a_group_removes_it(self):
+        ds, sft = fresh_store()
+        reg = ViewRegistry(ds)
+        ds.write("t", make_batch(sft, 12))
+        reg.register("s", SIMPLE_SQL)
+        # cats cycle a,b,c -> group "b" is rows 1,4,7,10
+        ds.delete("t", [f"f{i}" for i in (1, 4, 7, 10)])
+        res = reg.result("s")
+        assert [r[0] for r in res.rows()] == ["a", "c"]
+        assert_matches_scratch(reg, ds, "s", SIMPLE_SQL)
+        # ... and an empty view finalizes like the engine's empty result
+        ds.delete("t", [f"f{i}" for i in (0, 2, 3, 5, 6, 8, 9, 11)])
+        assert_matches_scratch(reg, ds, "s", SIMPLE_SQL)
+        assert list(reg.result("s").rows()) == []
+
+    def test_minmax_reservoir_drain_falls_back_to_recompute(self):
+        """Deleting past the runner-up reservoir (K=2) must drain into
+        the per-group recompute fallback — counted, and still
+        bit-identical."""
+        VIEW_RESERVOIR_K.set("2")
+        sql = "SELECT cat, min(v) AS lo, max(v) AS hi FROM t GROUP BY cat"
+        ds, sft = fresh_store()
+        vals = [0.5, 1.5, 2.5, 3.5, 4.5, 5.5, 6.5, 7.5]
+        ids = np.array([f"f{i}" for i in range(len(vals))], dtype=object)
+        ds.write("t", FeatureBatch.from_dict(sft, ids, {
+            "cat": np.array(["a"] * len(vals), dtype=object),
+            "n": np.arange(len(vals)),
+            "v": np.array(vals),
+            "geom": (np.zeros(len(vals)), np.zeros(len(vals)))}))
+        reg = ViewRegistry(ds)
+        reg.register("m", sql)
+        # the 3 smallest: the first two pop the K=2 low reservoir, the
+        # third finds it empty with live rows left -> fallback
+        ds.delete("t", ["f0"])
+        ds.delete("t", ["f1"])
+        ds.delete("t", ["f2"])
+        assert reg.get("m").retraction_fallbacks > 0
+        assert_matches_scratch(reg, ds, "m", sql)
+        # drain the top side too
+        ds.delete("t", ["f7", "f6", "f5"])
+        assert_matches_scratch(reg, ds, "m", sql)
+
+    def test_refresh_is_a_noop_on_clean_state(self):
+        ds, sft = fresh_store(30)
+        reg = ViewRegistry(ds)
+        reg.register("s", SIMPLE_SQL)
+        before = canon(reg.result("s"))
+        reg.refresh("s")
+        assert canon(reg.result("s")) == before
+
+
+# -- refusals + kill switch ----------------------------------------------------
+
+
+class TestRefusalsAndKillSwitch:
+    def test_unsupported_shapes_refuse_typed(self):
+        ds, sft = fresh_store(5)
+        reg = ViewRegistry(ds)
+        for stmt, needle in [
+                ("SELECT count(*) FROM t", "GROUP BY"),
+                ("SELECT cat FROM t GROUP BY cat ORDER BY nope",
+                 "ORDER BY"),
+                ("SELECT cat, sum(cat) AS s FROM t GROUP BY cat", "sum"),
+                ("SELEC nope", "SELEC")]:
+            with pytest.raises(ValueError, match=needle):
+                reg.register("x", stmt)
+        assert reg.status() == []
+
+    def test_kill_switch_off_register_refuses_and_no_hooks(self):
+        VIEWS_ENABLED.set(None)    # default: false
+        ds, sft = fresh_store(5)
+        reg = ViewRegistry(ds)
+        with pytest.raises(ValueError, match="disabled"):
+            reg.register("x", SIMPLE_SQL)
+        # no hook ever installed: the write path is the class's own
+        assert reg._orig == {}
+        assert "write" not in ds.__dict__ and "delete" not in ds.__dict__
+        ds.write("t", make_batch(sft, 3, id_prefix="g"))
+        assert ds.count("t") == 8
+
+    def test_unregister_restores_write_path(self):
+        ds, sft = fresh_store(5)
+        reg = ViewRegistry(ds)
+        reg.register("s", SIMPLE_SQL)
+        assert reg._orig
+        reg.unregister("s")
+        assert reg._orig == {}
+        ds.write("t", make_batch(sft, 2, id_prefix="g"))
+        assert ds.count("t") == 7
+        with pytest.raises(KeyError):
+            reg.unregister("s")
+
+
+# -- durability: checkpoint save + restart restore -------------------------------
+
+
+class TestRestartRecovery:
+    def test_checkpoint_then_reopen_restores_without_rebuild(
+            self, tmp_path, monkeypatch):
+        root = str(tmp_path / "dur")
+        ds, sft = fresh_store(50, durable_dir=root, wal_fsync="never")
+        reg = ViewRegistry(ds)
+        reg.register("num", NUM_SQL)
+        ds.write("t", make_batch(sft, 10, seed=23, id_prefix="g"))
+        ds.delete("t", ["f1", "f2"])
+        expect = canon(reg.result("num"))
+        ds.checkpoint()            # hook saves the sidecar post-mark
+
+        builds = []
+        orig_build = ViewState.build
+        monkeypatch.setattr(
+            ViewState, "build",
+            lambda self, store: (builds.append(1),
+                                 orig_build(self, store))[1])
+        ds2 = InMemoryDataStore(durable_dir=root, wal_fsync="never")
+        reg2 = ViewRegistry(ds2)
+        assert builds == []        # restored from the sidecar, no scan
+        assert [v["name"] for v in reg2.status()] == ["num"]
+        assert canon(reg2.result("num")) == expect
+        # the restored registry keeps folding
+        ds2.write("t", make_batch(sft, 5, seed=99, id_prefix="h"))
+        assert_matches_scratch(reg2, ds2, "num", NUM_SQL)
+
+    def test_stale_sidecar_rebuilds_once(self, tmp_path, monkeypatch):
+        root = str(tmp_path / "dur")
+        ds, sft = fresh_store(30, durable_dir=root, wal_fsync="never")
+        reg = ViewRegistry(ds)
+        reg.register("s", SIMPLE_SQL)
+        ds.checkpoint()
+        # writes land AFTER the save, then the process "crashes"
+        # (no close, no checkpoint): the sidecar stamp is stale
+        ds.write("t", make_batch(sft, 7, seed=5, id_prefix="g"))
+
+        builds = []
+        orig_build = ViewState.build
+        monkeypatch.setattr(
+            ViewState, "build",
+            lambda self, store: (builds.append(1),
+                                 orig_build(self, store))[1])
+        ds2 = InMemoryDataStore(durable_dir=root, wal_fsync="never")
+        reg2 = ViewRegistry(ds2)
+        assert builds == [1]       # exactly one rebuild from recovery
+        assert canon(reg2.result("s")) == canon(
+            SqlEngine(ds2).query(SIMPLE_SQL))
+
+
+# -- delta stream ---------------------------------------------------------------
+
+
+class TestDeltaStream:
+    def test_fold_publishes_changed_and_removed_groups(self):
+        from geomesa_tpu.store.live import MessageBus
+        bus = MessageBus()
+        ds, sft = fresh_store(6)
+        got = []
+        bus.subscribe(view_topic("s"), lambda m: got.append(
+            json.loads(m.ids[0])))
+        reg = ViewRegistry(ds, bus=bus)
+        reg.register("s", SIMPLE_SQL)
+        ds.write("t", make_batch(sft, 3, seed=2, id_prefix="g",
+                                 cats=("a",)))
+        assert len(got) == 1 and got[0]["seq"] == 0
+        assert [r["key"] for r in got[0]["rows"]] == [["a"]]
+        row = got[0]["rows"][0]["row"]
+        assert row["c"] == 5        # 2 seed rows of cat=a + 3 new
+        # delete every row of cat "b" -> its key publishes as removed
+        ds.delete("t", ["f1", "f4"])
+        assert got[-1]["seq"] == 1 and got[-1]["removed"] == [["b"]]
+
+    def test_exactly_once_across_broker_restart(self, tmp_path):
+        """Per-view delta seq stays contiguous and duplicate-free
+        across a broker kill/restart with a durable log, and a fresh
+        same-group subscriber resumes with zero replays."""
+        from geomesa_tpu.store import SocketBroker, SocketBus
+        root = str(tmp_path / "viewlog")
+        broker = SocketBroker(root=root).start()
+        port = broker.port
+        ds, sft = fresh_store(10)
+        pub_bus = SocketBus(broker.host, port, group="view-pub")
+        reg = ViewRegistry(ds, bus=pub_bus)
+        reg.register("hot", SIMPLE_SQL)
+        sub = ViewDeltaSubscriber("hot", host=broker.host, port=port,
+                                  group="g1", timeout_s=10.0)
+        deltas = []
+        sub.on_delta(deltas.append)
+        try:
+            for i in range(4):
+                ds.write("t", make_batch(sft, 3, seed=i + 1,
+                                         id_prefix=f"a{i}_"))
+            deadline = time.monotonic() + 15.0
+            while len(deltas) < 4 and time.monotonic() < deadline:
+                sub.poll(wait_s=1.0)
+            assert [d["seq"] for d in deltas] == [0, 1, 2, 3]
+            committed = sub.offset()
+
+            broker.stop()
+            broker = SocketBroker(port=port, root=root).start()
+
+            for i in range(4):
+                ds.write("t", make_batch(sft, 3, seed=100 + i,
+                                         id_prefix=f"b{i}_"))
+            deadline = time.monotonic() + 15.0
+            while len(deltas) < 8 and time.monotonic() < deadline:
+                sub.poll(wait_s=1.0)
+            assert [d["seq"] for d in deltas] == list(range(8))
+            assert sub.offset() > committed
+
+            sub2 = ViewDeltaSubscriber("hot", host=broker.host,
+                                       port=port, group="g1",
+                                       timeout_s=10.0)
+            replays = []
+            sub2.on_delta(replays.append)
+            sub2.poll(wait_s=0.5)
+            assert replays == []
+            sub2.close()
+        finally:
+            sub.close()
+            pub_bus.close()
+            broker.stop()
+
+
+# -- web surface -----------------------------------------------------------------
+
+
+def _req(base, method, path, body=None, hdrs=None):
+    r = urllib.request.Request(base + path, data=body, method=method,
+                               headers=hdrs or {})
+    try:
+        with urllib.request.urlopen(r) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+class TestViewsWeb:
+    @pytest.fixture()
+    def server(self):
+        from geomesa_tpu.web import GeoMesaWebServer
+        ds, sft = fresh_store(12)
+        srv = GeoMesaWebServer(ds, port=0, auth_token="sekret").start()
+        yield ds, sft, srv, f"http://127.0.0.1:{srv.port}/rest"
+        srv.stop()
+
+    def test_register_read_etag_unregister(self, server):
+        ds, sft, srv, base = server
+        tok = {"Authorization": "Bearer sekret"}
+        s, _, p = _req(base, "GET", "/views")
+        assert s == 200 and json.loads(p) == {"views": []}
+
+        body = json.dumps({"name": "s", "sql": SIMPLE_SQL}).encode()
+        s, _, p = _req(base, "POST", "/views/register", body, tok)
+        assert s == 201 and json.loads(p)["registered"] == "s"
+
+        s, h, p = _req(base, "GET", "/views/s")
+        assert s == 200
+        etag = h.get("ETag")
+        assert etag
+        s, _, _ = _req(base, "GET", "/views/s",
+                       hdrs={"If-None-Match": etag})
+        assert s == 304
+        # a fold advances the LSN: the old tag misses, rows are fresh
+        ds.write("t", make_batch(sft, 3, seed=9, id_prefix="g"))
+        s, _, p = _req(base, "GET", "/views/s",
+                       hdrs={"If-None-Match": etag})
+        assert s == 200
+        wire = [tuple(r) for r in json.loads(p)["rows"]]
+        oracle = [tuple(json.loads(json.dumps(
+            [x.item() if isinstance(x, np.generic) else x for x in r])))
+            for r in SqlEngine(ds).query(SIMPLE_SQL).rows()]
+        assert wire == oracle
+
+        s, _, p = _req(base, "POST", "/views/refresh",
+                       json.dumps({"name": "s"}).encode(), tok)
+        assert s == 200
+        s, _, p = _req(base, "POST", "/views/unregister",
+                       json.dumps({"name": "s"}).encode(), tok)
+        assert s == 200 and json.loads(p) == {"unregistered": "s"}
+        s, _, _ = _req(base, "GET", "/views/s")
+        assert s == 404
+
+    def test_register_validation_errors_are_400_with_message(self, server):
+        """Satellite fix: a refused statement must surface the parser
+        message as a 400 — never a 500."""
+        ds, sft, srv, base = server
+        tok = {"Authorization": "Bearer sekret"}
+        for stmt, needle in [
+                ("SELECT count(*) FROM t", b"GROUP BY"),
+                ("SELEC nope", b"SELEC"),
+                ("SELECT cat, sum(cat) AS s FROM t GROUP BY cat",
+                 b"sum")]:
+            s, _, p = _req(base, "POST", "/views/register",
+                           json.dumps({"name": "bad",
+                                       "sql": stmt}).encode(), tok)
+            assert s == 400, (stmt, s, p)
+            assert needle in p
+        s, _, p = _req(base, "POST", "/views/register",
+                       json.dumps({"name": "bad"}).encode(), tok)
+        assert s == 400 and b"sql required" in p
+
+    def test_mutations_are_token_gated(self, server):
+        ds, sft, srv, base = server
+        body = json.dumps({"name": "s", "sql": SIMPLE_SQL}).encode()
+        s, _, _ = _req(base, "POST", "/views/register", body)
+        assert s == 403
+        s, _, _ = _req(base, "GET", "/views")    # reads stay open
+        assert s == 200
