@@ -145,7 +145,7 @@ REPS = max(int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 512)), 2)
 TRIALS = max(int(os.environ.get("GEOMESA_TPU_BENCH_TRIALS", 3)), 1)
 CONFIGS = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
                              "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,"
-                             "19,20,21,22,23,northstar")
+                             "19,20,21,22,23,24,northstar")
               .split(","))
 MS_DAY = 86_400_000
 N_BIG = int(os.environ.get("GEOMESA_TPU_BENCH_NBIG", 100_000_000))
@@ -3810,6 +3810,243 @@ def bench_config23(rng, n=None, commit_rows=None, commits=None,
     return out
 
 
+# -- config 24: online reindex under mixed load (evolve/ subsystem) -------
+
+def bench_config24(rng, n=None, c=None, write_rows=None):
+    """Online reindex of a 1M-row durable type under c=32 mixed load.
+
+    16 writer threads append unique-id batches (tracking every acked
+    id) while 16 reader threads run an exact-id ECQL query whose
+    expected result set is pinned to the seed data, and the evolver
+    reindexes the type from index v2 to v1 in the middle of it all.
+    Gates: every reader observation is exact-or-typed (zero silent
+    mismatches), no acked write is lost across the flip, the flip
+    lands exactly once, and no single write stalls longer than 10 s.
+    Two side legs ride along: a crash at a randomly chosen kill point
+    followed by resume() that completes the migration exactly once,
+    and the kill switch off leaving a twin store bit-identical."""
+    import tempfile
+
+    from geomesa_tpu.evolve import EVOLVE_ENABLED, SchemaEvolutionError
+    from geomesa_tpu.features import FeatureBatch, parse_spec
+    from geomesa_tpu.store import InMemoryDataStore
+
+    n = n if n is not None else int(
+        os.environ.get("GEOMESA_TPU_BENCH_EVOLVE_N", 1_000_000))
+    c = c if c is not None else 32
+    write_rows = write_rows if write_rows is not None else 200
+    writers = max(c // 2, 1)
+    readers = max(c - writers, 1)
+    spec = "*geom:Point:srid=4326,name:String,val:Integer"
+    sft = parse_spec("pts24", spec)
+    names = np.array([f"grp{i}" for i in range(32)], dtype=object)
+
+    def _batch(m, prefix, name=None, bsft=None):
+        ids = np.array([f"{prefix}{i}" for i in range(m)], dtype=object)
+        col = (np.full(m, name, dtype=object) if name is not None
+               else names[rng.integers(0, len(names), m)])
+        return FeatureBatch.from_dict(bsft if bsft is not None else sft,
+                                      ids, {
+            "geom": (rng.uniform(-170, 170, m), rng.uniform(-80, 80, m)),
+            "name": col,
+            "val": rng.integers(0, 1_000_000, m).astype(np.int64)})
+
+    out = {"n": n, "c": c, "writers": writers, "readers": readers,
+           "write_rows": write_rows}
+
+    with tempfile.TemporaryDirectory() as root:
+        ds = InMemoryDataStore(durable_dir=os.path.join(root, "wal"),
+                               wal_fsync="never")
+        ds.create_schema(sft)
+        seed = _batch(n, "s")
+        ds.write("pts24", seed)
+        # the readers' ground truth: writers only ever append
+        # name='writer' rows, so the grp7 id set is frozen for the
+        # whole run — across snapshot, catch-up, and the flip itself
+        name_col = seed.col("name")
+        expected = {seed.ids[i] for i in range(n)
+                    if name_col.value(i) == "grp7"}
+
+        EVOLVE_ENABLED.set("true")
+        try:
+            t0 = time.perf_counter()
+            _run_mixed_load(out, rng, ds, _batch, expected, writers,
+                            readers, write_rows, SchemaEvolutionError)
+            out["online_reindex_s"] = round(time.perf_counter() - t0, 3)
+
+            # -- crash at a random kill point, then resume --------------
+            out.update(_crash_resume_leg(rng, ds, SchemaEvolutionError))
+        finally:
+            EVOLVE_ENABLED.set(None)
+        ds.close()
+
+    # -- kill switch off: evolver refuses, twin stays identical ----------
+    out.update(_evolve_off_leg(rng, _batch, sft, SchemaEvolutionError))
+
+    out["gates_pass"] = bool(
+        out["reader_mismatches"] == 0
+        and out["untyped_errors"] == 0
+        and out["acked_writes_lost"] == 0
+        and out["flips_recorded"] == 1
+        and out["write_stall_max_s"] <= 10.0
+        and out["resume_completed_once"]
+        and out["off_refuses"] and out["off_results_identical"])
+    return out
+
+
+def _run_mixed_load(out, rng, ds, _batch, expected, writers, readers,
+                    write_rows, SchemaEvolutionError):
+    import threading
+
+    stop = threading.Event()
+    acked = [set() for _ in range(writers)]
+    stalls = [0.0] * writers
+    errs = {"mismatch": 0, "typed": 0, "untyped": 0, "refresh": 0}
+    lock = threading.Lock()
+
+    def _writer(w):
+        # a correct ingest client: when the flip bumps index_version
+        # the held SFT no longer equals the store's (user_data is part
+        # of schema identity) and the write is refused before it is
+        # journaled — refresh the schema and re-submit the same ids
+        k = 0
+        cur = ds.get_schema("pts24")
+        while not stop.is_set():
+            b = _batch(write_rows, f"w{w}_{k}_", name="writer", bsft=cur)
+            t0 = time.perf_counter()
+            try:
+                ds.write("pts24", b)
+            except SchemaEvolutionError:
+                with lock:
+                    errs["typed"] += 1
+                continue
+            except ValueError:
+                cur = ds.get_schema("pts24")
+                with lock:
+                    errs["refresh"] += 1
+                continue
+            except Exception:
+                with lock:
+                    errs["untyped"] += 1
+                continue
+            stalls[w] = max(stalls[w], time.perf_counter() - t0)
+            acked[w].update(b.ids.tolist())
+            k += 1
+
+    def _reader():
+        while not stop.is_set():
+            try:
+                res = ds.query("name = 'grp7'", "pts24")
+                got = set(res.ids.tolist())
+            except SchemaEvolutionError:
+                with lock:
+                    errs["typed"] += 1
+                continue
+            except Exception:
+                with lock:
+                    errs["untyped"] += 1
+                continue
+            if got != expected:
+                with lock:
+                    errs["mismatch"] += 1
+
+    threads = ([threading.Thread(target=_writer, args=(w,), daemon=True)
+                for w in range(writers)]
+               + [threading.Thread(target=_reader, daemon=True)
+                  for _ in range(readers)])
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+
+    t0 = time.perf_counter()
+    ds.evolver.reindex("pts24", 1)
+    flip_s = time.perf_counter() - t0
+    time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+
+    all_acked = set().union(*acked) if acked else set()
+    final = ds.query("INCLUDE", "pts24")
+    final_ids = set(final.ids.tolist())
+    lost = len(all_acked - final_ids)
+    hist = ds.evolver.history
+    flips = sum(1 for h in hist
+                if h.get("op") == "reindex" and h.get("type") == "pts24")
+    out.update({
+        "reindex_under_load_s": round(flip_s, 3),
+        "index_version": ds.get_schema("pts24").index_version,
+        "rows_final": final.n,
+        "rows_acked": len(all_acked),
+        "reader_mismatches": errs["mismatch"],
+        "typed_refusals": errs["typed"],
+        "schema_refreshes": errs["refresh"],
+        "untyped_errors": errs["untyped"],
+        "acked_writes_lost": lost,
+        "flips_recorded": flips,
+        "write_stall_max_s": round(max(stalls), 3) if stalls else 0.0,
+    })
+
+
+def _crash_resume_leg(rng, ds, SchemaEvolutionError):
+    from geomesa_tpu.evolve import Evolver
+
+    phases = Evolver.PHASES
+    phase = phases[int(rng.integers(0, len(phases)))]
+    before = len([h for h in ds.evolver.history
+                  if h.get("op") == "reindex"])
+
+    class _Boom(RuntimeError):
+        pass
+
+    def _hook(tag):
+        if tag == phase:
+            raise _Boom(tag)
+
+    ds.evolver.fault_hook = _hook
+    crashed = False
+    try:
+        ds.evolver.reindex("pts24", 2)
+    except _Boom:
+        crashed = True
+    finally:
+        ds.evolver.fault_hook = None
+    ds.evolver.resume()
+    after = len([h for h in ds.evolver.history
+                 if h.get("op") == "reindex"])
+    return {
+        "crash_phase": phase,
+        "crash_injected": crashed,
+        "resume_completed_once": (
+            after == before + 1
+            and ds.get_schema("pts24").index_version == 2),
+    }
+
+
+def _evolve_off_leg(rng, _batch, sft, SchemaEvolutionError):
+    from geomesa_tpu.store import InMemoryDataStore
+
+    m = 20_000
+    b = _batch(m, "o")
+    off = InMemoryDataStore()
+    off.create_schema(sft)
+    off.write("pts24", b)
+    twin = InMemoryDataStore()
+    twin.create_schema(sft)
+    twin.write("pts24", b)
+    try:
+        off.evolver.reindex("pts24", 1)
+        refuses = False
+    except SchemaEvolutionError:
+        refuses = True
+    same = (set(off.query("name = 'grp3'", "pts24").ids.tolist())
+            == set(twin.query("name = 'grp3'", "pts24").ids.tolist())
+            and off.query("INCLUDE", "pts24").n
+            == twin.query("INCLUDE", "pts24").n)
+    return {"off_refuses": bool(refuses),
+            "off_results_identical": bool(same)}
+
+
 # -- config 10: storage integrity — scrub overhead + corrupt recovery -----
 
 def bench_config10(rng):
@@ -4096,6 +4333,8 @@ def main(argv=None):
         out["configs"]["22_multitenant"] = bench_config22(rng)
     if "23" in CONFIGS:
         out["configs"]["23_matviews"] = bench_config23(rng)
+    if "24" in CONFIGS:
+        out["configs"]["24_evolve"] = bench_config24(rng)
 
     big_ds = None
     if CONFIGS & {"5", "northstar"}:

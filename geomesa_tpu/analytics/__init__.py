@@ -3,18 +3,21 @@ WPS-style processes (geomesa-spark-sql + geomesa-process analogs)."""
 
 from . import st_functions
 from .join import contains_join, dwithin_join, knn
-from .processes import (exterior_ring_process, knn_process,
-                        length_spheroid_process, minmax_process,
-                        num_points_process, point_n_process,
-                        proximity_process, tube_select_process,
+from .processes import (exterior_ring_process, idl_safe_geom_process,
+                        knn_process, length_spheroid_process,
+                        minmax_process, num_points_process,
+                        point_n_process, proximity_process,
+                        translate_process, tube_select_process,
                         unique_process)
-from .st_functions import st_antimeridian_safe_geom, st_length_spheroid
+from .st_functions import (st_antimeridian_safe_geom, st_idl_safe_geom,
+                           st_length_spheroid)
 from .tube import TubeBuilder, tube_select_mask
 
 __all__ = ["st_functions", "contains_join", "dwithin_join", "knn",
-           "exterior_ring_process", "knn_process",
-           "length_spheroid_process", "minmax_process",
+           "exterior_ring_process", "idl_safe_geom_process",
+           "knn_process", "length_spheroid_process", "minmax_process",
            "num_points_process", "point_n_process",
-           "proximity_process", "tube_select_process", "unique_process",
-           "st_antimeridian_safe_geom", "st_length_spheroid",
-           "TubeBuilder", "tube_select_mask"]
+           "proximity_process", "translate_process",
+           "tube_select_process", "unique_process",
+           "st_antimeridian_safe_geom", "st_idl_safe_geom",
+           "st_length_spheroid", "TubeBuilder", "tube_select_mask"]

@@ -538,3 +538,32 @@ def num_points_process(store, type_name: str, attribute: str,
     col = res.batch.col(attribute)
     return np.array([st_num_points(g) if (g := col.value(i)) is not None
                      else -1 for i in range(res.batch.n)], np.int64)
+
+
+def translate_process(store, type_name: str, attribute: str,
+                      dx: float, dy: float, ecql=None) -> np.ndarray:
+    """Per-feature geometry shifted by (dx, dy) (process form of
+    ST_Translate); None for null geometries."""
+    from .st_functions import st_translate
+    res = store.query(Query(type_name, ecql or "INCLUDE"))
+    if res.batch is None or res.n == 0:
+        return np.empty(0, object)
+    col = res.batch.col(attribute)
+    return np.array([st_translate(g, dx, dy)
+                     if (g := col.value(i)) is not None
+                     else None for i in range(res.batch.n)], object)
+
+
+def idl_safe_geom_process(store, type_name: str, attribute: str,
+                          ecql=None) -> np.ndarray:
+    """Per-feature dateline-safe geometry (process form of
+    ST_IdlSafeGeom, the st_antimeridianSafeGeom alias); None for null
+    geometries."""
+    from .st_functions import st_idl_safe_geom
+    res = store.query(Query(type_name, ecql or "INCLUDE"))
+    if res.batch is None or res.n == 0:
+        return np.empty(0, object)
+    col = res.batch.col(attribute)
+    return np.array([st_idl_safe_geom(g)
+                     if (g := col.value(i)) is not None
+                     else None for i in range(res.batch.n)], object)

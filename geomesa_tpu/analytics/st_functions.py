@@ -32,7 +32,8 @@ __all__ = [
     "st_point_n", "st_exterior_ring", "st_num_points", "st_make_polygon",
     "st_relate", "st_relate_bool", "st_buffer", "st_buffer_point",
     "st_distance_spheroid", "st_length_spheroid",
-    "st_antimeridian_safe_geom", "st_cast_to_point", "st_cast_to_linestring",
+    "st_antimeridian_safe_geom", "st_idl_safe_geom",
+    "st_cast_to_point", "st_cast_to_linestring",
     "st_cast_to_polygon", "st_cast_to_geometry", "st_as_binary",
     "st_geom_from_wkb", "st_as_geojson", "SQL_SCALARS",
     "st_geohash", "st_geom_from_geohash",
@@ -452,6 +453,14 @@ def st_antimeridian_safe_geom(g: Geometry) -> Geometry:
     return g
 
 
+def st_idl_safe_geom(g: Geometry) -> Geometry:
+    """The reference's st_idlSafeGeom — an exact alias of
+    st_antimeridianSafeGeom (GeometryProcessingFunctions.scala registers
+    both names over one implementation). Kept as a named function so
+    the alias contract is testable: the two must stay bit-identical."""
+    return st_antimeridian_safe_geom(g)
+
+
 def st_geohash(g: Geometry, prec: int = 25) -> str:
     """Base-32 geohash of the geometry at ``prec`` BITS of precision
     (the reference's st_geoHash; GeoHash.scala:25 takes bit precision).
@@ -504,6 +513,7 @@ SQL_SCALARS = {
     "ST_RELATEBOOL": lambda g, o, p: st_relate_bool(g, o, str(p)),
     "ST_LENGTHSPHEROID": st_length_spheroid,
     "ST_ANTIMERIDIANSAFEGEOM": st_antimeridian_safe_geom,
+    "ST_IDLSAFEGEOM": st_idl_safe_geom,
     "ST_GEOHASH": lambda g, prec=25: st_geohash(g, int(prec)),
     "ST_GEOMFROMGEOHASH": lambda gh, prec=None: st_geom_from_geohash(
         gh, None if prec is None else int(prec)),

@@ -350,6 +350,61 @@ class JsonConverter(SimpleFeatureConverter):
                 return None
         return cur
 
+    def iter_column_chunks(self, source, rows: int):
+        """Columnar JSON-lines parse: Arrow ``read_json`` decodes whole
+        blocks in C and declared paths resolve as struct-field hops
+        instead of a python dict walk per record. Yields
+        ``(cols, n, ragged, n_bad)`` tuples for ``process_columns``.
+
+        Degradations keep scalar semantics exactly: top-level-array
+        sources, configs whose transforms read the ``$0`` record, and
+        paths with list indices take the record path from the start; a
+        block Arrow refuses (malformed line, mixed field types) sends
+        that block AND the rest of the stream to the per-record parser,
+        which isolates bad lines row-for-row."""
+        from .vectorized import parse_json_arrow
+        if not isinstance(source, str):
+            source = source.read()
+        stripped = source.strip()
+        use_arrow = not (_uses_col0(self.id_ast)
+                         or any(_uses_col0(a)
+                                for _, a in self.ordered_asts))
+
+        def record_chunks(records_iter):
+            chunk: list[list] = []
+            for rec in records_iter:
+                chunk.append(rec)
+                if len(chunk) >= rows:
+                    yield self._record_cols(chunk)
+                    chunk = []
+            if chunk:
+                yield self._record_cols(chunk)
+
+        if not use_arrow or stripped.startswith("["):
+            yield from record_chunks(self._records(stripped))
+            return
+        lines = [ln for ln in stripped.splitlines() if ln.strip()]
+        for at in range(0, len(lines), rows):
+            got = parse_json_arrow("\n".join(lines[at:at + rows]),
+                                   self.paths)
+            if got is None:
+                yield from record_chunks(
+                    self._records("\n".join(lines[at:])))
+                return
+            yield got
+
+    @staticmethod
+    def _record_cols(chunk: list[list]):
+        """Scalar record chunk -> the (cols, n, ragged, n_bad) shape
+        ``process_columns`` takes (bad records masked out, counted)."""
+        from .vectorized import _transpose
+        good = [r for r in chunk if r is not _BAD_RECORD]
+        n_bad = len(chunk) - len(good)
+        if not good:
+            return [np.empty(0, dtype=object)], 0, False, n_bad
+        cols, ragged = _transpose(good)
+        return cols, len(good), ragged, n_bad
+
     def _records(self, source):
         if not isinstance(source, str):
             source = source.read()  # file-like: parse the whole stream

@@ -51,12 +51,14 @@ except Exception:  # pragma: no cover — arrow-less fallback stays live
     pacsv = None
 
 __all__ = ["process_columnar", "process_columns", "INGEST_BATCH_ROWS",
-           "INGEST_VECTORIZED", "INGEST_VERIFY", "INGEST_ARROW_CSV"]
+           "INGEST_VECTORIZED", "INGEST_VERIFY", "INGEST_ARROW_CSV",
+           "INGEST_ARROW_JSON"]
 
 INGEST_BATCH_ROWS = SystemProperty("geomesa.ingest.batch.rows", "65536")
 INGEST_VECTORIZED = SystemProperty("geomesa.ingest.vectorized", "true")
 INGEST_VERIFY = SystemProperty("geomesa.ingest.verify", "false")
 INGEST_ARROW_CSV = SystemProperty("geomesa.ingest.arrow.csv", "true")
+INGEST_ARROW_JSON = SystemProperty("geomesa.ingest.arrow.json", "true")
 
 # pads ragged delimited rows in the chunk transpose; a column reference
 # that lands on the pad errs that row (the scalar path's IndexError)
@@ -114,6 +116,55 @@ def parse_csv_arrow(joined: str, delimiter: str):
     cols: list[Any] = [np.full(n, "", dtype=object)]
     for i in range(w):
         cols.append(_ArrowCol(table.column(i).combine_chunks()))
+    return cols, n, False, 0
+
+
+def parse_json_arrow(joined: str, paths: list[str]):
+    """One block of JSON-lines -> converter columns, or None when Arrow
+    (or its json module) is unavailable, the block fails to parse
+    (malformed line, mixed field types), or a declared path needs
+    semantics ``read_json`` can't give (list indexing). Declared paths
+    resolve through Arrow struct columns in C; string results stay in
+    Arrow (``_ArrowCol``) for the C cast paths, everything else
+    materializes to python objects so null/err semantics match the
+    scalar ``_resolve`` exactly (missing field -> None column)."""
+    if pa is None or not INGEST_ARROW_JSON.as_bool():
+        return None
+    norm = [p.replace("$.", "").split(".") for p in paths]
+    if any(part.isdigit() for parts in norm for part in parts):
+        return None  # list-index path: scalar traversal only
+    try:
+        from pyarrow import json as pajson
+    except Exception:  # pragma: no cover — arrow build without json
+        return None
+    try:
+        table = pajson.read_json(io.BytesIO(joined.encode("utf-8")))
+    except Exception:
+        return None
+    n = table.num_rows
+    if n == 0:
+        return None
+    # $0 (the parsed record) is never materialized here; converters
+    # whose transforms read it stay on the record path
+    cols: list[Any] = [np.full(n, None, dtype=object)]
+    for parts in norm:
+        try:
+            arr = table.column(parts[0]).combine_chunks()
+            for part in parts[1:]:
+                arr = pc.struct_field(arr, part)
+        except (KeyError, pa.ArrowInvalid, pa.ArrowTypeError,
+                TypeError):
+            # absent field / non-struct traversal: the scalar resolve
+            # yields None for every row
+            cols.append(np.full(n, None, dtype=object))
+            continue
+        if pa.types.is_string(arr.type) or pa.types.is_large_string(
+                arr.type):
+            cols.append(_ArrowCol(arr))
+        else:
+            vals = np.empty(n, dtype=object)
+            vals[:] = arr.to_pylist()
+            cols.append(vals)
     return cols, n, False, 0
 
 

@@ -652,6 +652,11 @@ class InMemoryDataStore(DataStore):
         self._pushdown_clock = 0
         self._pushdown_versions: dict[str, int] = {}
         self.result_cache = ResultCache(self.pushdown_version)
+        # evolve/ subsystem: per-type dual-feed taps installed while a
+        # shadow schema build is in flight (empty = zero-cost path),
+        # and the lazily built Evolver behind them
+        self._evolve_feeds: dict = {}
+        self._evolver = None
         # opt-in durability: journal mutations to a WAL under
         # durable_dir (validate -> journal -> apply) and replay the
         # last checkpoint + log tail on open (wal/ subsystem)
@@ -704,7 +709,25 @@ class InMemoryDataStore(DataStore):
     def _state(self, type_name: str) -> _TypeState:
         if type_name not in self._types:
             raise KeyError(f"no such schema: {type_name}")
+        if self._evolve_feeds:
+            # a mid-flip evolution fences every op on its type typed
+            # (SchemaEvolutionError) until resume()/abort() restores a
+            # consistent state — exact-or-typed, never silently stale
+            feed = self._evolve_feeds.get(type_name)
+            if feed is not None:
+                feed.guard()
         return self._types[type_name]
+
+    @property
+    def evolver(self):
+        """The online schema-evolution plane for this store (evolve/
+        subsystem), built on first touch."""
+        if self._evolver is None:
+            with self._op_lock:
+                if self._evolver is None:
+                    from ..evolve import Evolver
+                    self._evolver = Evolver(self)
+        return self._evolver
 
     # -- pushdown versions (cache/ subsystem) ------------------------------
 
@@ -747,6 +770,13 @@ class InMemoryDataStore(DataStore):
         st = self._state(type_name)
         if batch.sft != st.sft:
             raise ValueError("batch schema does not match store schema")
+        feed = self._evolve_feeds.get(type_name) \
+            if self._evolve_feeds else None
+        if feed is not None:
+            # refuse before journaling: a write that conflicts with an
+            # in-flight evolution (non-null values for a mid-drop
+            # attribute) must never be acked
+            feed.check_write(batch)
         if self.journal is not None:
             # write-ahead: validate (so the journaled record is known
             # applyable), journal, then apply
@@ -758,6 +788,10 @@ class InMemoryDataStore(DataStore):
         # auto-maintained stats, the write-side StatsCombiner analog
         # (accumulo/data/stats/StatsCombiner.scala)
         self.stats.observe(st.sft, batch)
+        if feed is not None:
+            # dual-feed: non-durable stores queue the acked mutation
+            # for the shadow build (durable stores tail the WAL)
+            feed.on_write(batch, visibilities)
         # initial bulk load only: chunked ingests must not re-merge the
         # whole accumulated table per batch (later chunks stay lazy and
         # fold into ONE incremental merge at the next read)
@@ -801,6 +835,10 @@ class InMemoryDataStore(DataStore):
             self.journal.log_delete(type_name, sorted(ids))
         st.delete(ids)
         self._bump_pushdown_version(type_name)
+        feed = self._evolve_feeds.get(type_name) \
+            if self._evolve_feeds else None
+        if feed is not None:
+            feed.on_delete(ids)
 
     # -- durability (wal/ subsystem, opt-in via durable_dir) ---------------
 

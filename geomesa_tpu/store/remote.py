@@ -707,6 +707,36 @@ class RemoteDataStore(DataStore):
         return self._json("POST", f"/rest/reshard/{quote(verb)}",
                           clean or None)
 
+    def reindex(self, type_name: str,
+                to_version: int | None = None) -> dict:
+        """POST /rest/reindex/{type}?version= (bearer-gated): the
+        BLOCKING reindex oracle — the server holds its store op lock
+        for the whole rebuild. Use ``evolve("reindex", ...)`` for the
+        online shadow-build migration."""
+        params = ({"version": int(to_version)}
+                  if to_version is not None else None)
+        return self._json("POST", f"/rest/reindex/{quote(type_name)}",
+                          params)
+
+    def evolve_status(self) -> dict:
+        """GET /rest/evolve: active evolution (phase, cursor, barrier)
+        plus completed history."""
+        return self._json("GET", "/rest/evolve")
+
+    def evolve(self, verb: str, **params) -> dict:
+        """POST /rest/evolve/{reindex|update|resume|abort}
+        (bearer-gated). Keyword args become query params; an ``update``
+        change list ships in a JSON body (e.g. ``evolve("update",
+        type="t", changes=[{"op": "add", ...}])``)."""
+        clean = {k: v for k, v in params.items() if v is not None}
+        body = None
+        changes = clean.pop("changes", None)
+        if changes is not None:
+            body = json.dumps({"type": clean.pop("type", None),
+                               "changes": changes}).encode()
+        return self._json("POST", f"/rest/evolve/{quote(verb)}",
+                          clean or None, body=body)
+
     def cache_status(self) -> dict:
         """GET /rest/cache: the server store's materialized-cache
         status (entries, bytes, hit/miss counters, refresher state)."""

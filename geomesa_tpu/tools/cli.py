@@ -608,6 +608,82 @@ def cmd_reshard(args) -> int:
     return 0
 
 
+def cmd_evolve(args) -> int:
+    """Online schema evolution: ``status`` dumps evolver state (active
+    evolution phase/cursor, history); ``reindex`` migrates a type's
+    z-index layout as a shadow build with WAL-tail catch-up and an
+    atomic flip; ``update`` applies a change list (add/widen/drop);
+    ``resume``/``abort`` recover an interrupted evolution. Mutating
+    verbs are bearer-gated on remote nodes (403 -> exit 3); typed
+    evolve refusals (kill switch, verb in flight, bad change spec)
+    exit 2."""
+    path = args.path
+    remote = path.startswith("remote://")
+    if remote:
+        from ..store import RemoteDataStore
+        host, _, port = path[len("remote://"):].partition(":")
+        ds = RemoteDataStore(host or "127.0.0.1",
+                             int(port) if port else 8080,
+                             auth_token=getattr(args, "token", None))
+    else:
+        ds = _store(args)
+        if not hasattr(ds, "evolver"):
+            print("store has no schema-evolution plane",
+                  file=sys.stderr)
+            return 2
+    from ..evolve import SchemaEvolutionError
+    from ..store.remote import RemoteError
+    cmd = args.evolve_command
+    changes = None
+    if cmd == "update":
+        try:
+            changes = json.loads(args.changes)
+        except ValueError as e:
+            print(f"bad --changes JSON: {e}", file=sys.stderr)
+            return 2
+    try:
+        if cmd == "status":
+            out = ds.evolve_status() if remote else ds.evolver.status()
+        elif cmd == "reindex":
+            if remote:
+                out = ds.evolve("reindex", type=args.type,
+                                version=args.index_version)
+            else:
+                out = ds.evolver.reindex(args.type, args.index_version)
+        elif cmd == "update":
+            if remote:
+                out = ds.evolve("update", type=args.type,
+                                changes=changes)
+            else:
+                out = ds.evolver.update_schema(args.type, changes)
+        elif cmd == "resume":
+            out = ds.evolve("resume") if remote else ds.evolver.resume()
+        elif cmd == "abort":
+            out = ds.evolve("abort") if remote else ds.evolver.abort()
+        else:
+            print(f"unknown evolve command {cmd!r}", file=sys.stderr)
+            return 2
+    except SchemaEvolutionError as e:
+        print(f"evolve refused: {e}", file=sys.stderr)
+        return 2
+    except (KeyError, ValueError) as e:
+        msg = e.args[0] if e.args else e
+        print(f"evolve refused: {msg}", file=sys.stderr)
+        return 2
+    except RemoteError as e:
+        if e.status == 403:
+            print("evolve is gated: pass --token matching "
+                  "geomesa.web.auth.token", file=sys.stderr)
+            return 3
+        if e.status in (400, 409):
+            print(f"evolve refused: {e}", file=sys.stderr)
+            return 2
+        raise
+    json.dump(out, sys.stdout, indent=2)
+    print()
+    return 0
+
+
 def cmd_cache(args) -> int:
     """Materialized-cache administration against a serving node:
     ``status`` dumps the store's cache/version state (entries, bytes,
@@ -1006,6 +1082,42 @@ def main(argv=None) -> int:
                              help="start/stop the background loop "
                                   "(default: run one tick)")
         rp_.set_defaults(fn=cmd_reshard)
+
+    evp = sub.add_parser("evolve",
+                         help="online schema evolution: shadow-build "
+                              "reindex/update with atomic flip")
+    evsub = evp.add_subparsers(dest="evolve_command", required=True)
+    for ename, ehelp in (("status", "active evolution phase/cursor + "
+                                    "history"),
+                         ("reindex", "migrate a type's z-index layout "
+                                     "online (token-gated)"),
+                         ("update", "add/widen/drop attributes online "
+                                    "(token-gated)"),
+                         ("resume", "re-drive an interrupted "
+                                    "evolution (token-gated)"),
+                         ("abort", "cancel and restore the pre-evolve "
+                                   "state (token-gated)")):
+        ep_ = evsub.add_parser(ename, help=ehelp)
+        ep_.add_argument("--path", required=True,
+                         help="serving node remote://host:port, or a "
+                              "durable store directory")
+        ep_.add_argument("--token", default=None,
+                         help="admin bearer token "
+                              "(geomesa.web.auth.token)")
+        if ename in ("reindex", "update"):
+            ep_.add_argument("--type", required=True,
+                             help="schema to evolve")
+        if ename == "reindex":
+            ep_.add_argument("--index-version", type=int, default=None,
+                             dest="index_version",
+                             help="target z-index layout version "
+                                  "(default: current)")
+        if ename == "update":
+            ep_.add_argument("--changes", required=True,
+                             help="JSON change list, e.g. "
+                                  '\'[{"op": "add", "name": "score", '
+                                  '"type": "Double", "default": 0}]\'')
+        ep_.set_defaults(fn=cmd_evolve)
 
     cap = sub.add_parser("cache",
                          help="materialized pushdown-cache "

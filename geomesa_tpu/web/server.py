@@ -91,7 +91,8 @@ WEB_AUTH_TOKEN = SystemProperty("geomesa.web.auth.token", None)
 _GATED = {("POST", "write"), ("POST", "delete"), ("DELETE", "schemas"),
           ("POST", "wal"), ("POST", "replication"), ("POST", "integrity"),
           ("POST", "cluster"), ("POST", "cache"), ("POST", "cq"),
-          ("POST", "reshard"), ("POST", "views")}
+          ("POST", "reshard"), ("POST", "views"), ("POST", "reindex"),
+          ("POST", "evolve")}
 
 # load-shedding gate: max concurrent in-flight requests (unset ->
 # unlimited). Requests over the cap get 503 + Retry-After BEFORE any
@@ -666,6 +667,19 @@ class GeoMesaWebServer:
                 topology(include_counts=counts))
         if parts and parts[0] == "reshard":
             return self._reshard(method, parts[1:], params)
+        if (len(parts) == 2 and parts[0] == "reindex"
+                and method == "POST"):
+            # the blocking reindex oracle on the wire: holds the store
+            # op lock for the rebuild (use /rest/evolve for online)
+            v = params.get("version", [None])[0]
+            self.store.reindex(parts[1],
+                               int(v) if v is not None else None)
+            return 200, "application/json", _j(
+                {"reindexed": parts[1],
+                 "index_version":
+                     self.store.get_schema(parts[1]).index_version})
+        if parts and parts[0] == "evolve":
+            return self._evolve(method, parts[1:], params, body)
         if parts == ["audit"]:
             # a server fronting a store without its own logger still
             # answers: surfaces without one record into the process
@@ -827,6 +841,69 @@ class GeoMesaWebServer:
                         scaler.run_once())
                 return 200, "application/json", _j(scaler.status())
         except ReshardError as e:
+            return (409, "application/json",
+                    _j({"error": str(e), "retryable": False}))
+        return 404, "application/json", _j({"error": "not found"})
+
+    def _evolve(self, method, parts, params, body):
+        """Schema-evolution admin. GET /rest/evolve reports evolver
+        state (active evolution phase/cursor, history); POST
+        /rest/evolve/reindex?type=&version=, /rest/evolve/update?type=
+        (change list as JSON body or ?changes=), /rest/evolve/resume
+        and /rest/evolve/abort (bearer-gated) drive the verbs. Typed
+        refusals (kill switch, verb in flight, bad change spec,
+        mid-flip fence) map to 409: well-formed request, but the
+        schema cannot change right now."""
+        if not hasattr(self.store, "evolver"):
+            return 404, "application/json", _j(
+                {"error": "store has no schema-evolution plane"})
+        from ..evolve import SchemaEvolutionError
+        evolver = self.store.evolver
+        if method == "GET" and not parts:
+            return 200, "application/json", _j(evolver.status())
+        if method != "POST" or len(parts) != 1:
+            return 404, "application/json", _j({"error": "not found"})
+        verb = parts[0]
+        try:
+            if verb == "reindex":
+                tn = params.get("type", [None])[0]
+                if tn is None:
+                    return 400, "application/json", _j(
+                        {"error": "reindex requires ?type=<name>"})
+                v = params.get("version", [None])[0]
+                entry = evolver.reindex(
+                    tn, int(v) if v is not None else None)
+                return 200, "application/json", _j(entry)
+            if verb == "update":
+                args = {k: v[0] for k, v in params.items()}
+                if body:
+                    try:
+                        parsed = json.loads(body)
+                        if not isinstance(parsed, dict):
+                            raise ValueError("body must be a JSON "
+                                             "object")
+                        args.update(parsed)
+                    except ValueError as e:
+                        return 400, "application/json", _j(
+                            {"error": f"bad JSON body: {e}"})
+                tn = args.get("type")
+                if not tn:
+                    return 400, "application/json", _j(
+                        {"error": "update requires a type"})
+                changes = args.get("changes")
+                if isinstance(changes, str):
+                    try:
+                        changes = json.loads(changes)
+                    except ValueError as e:
+                        return 400, "application/json", _j(
+                            {"error": f"bad changes JSON: {e}"})
+                entry = evolver.update_schema(tn, changes)
+                return 200, "application/json", _j(entry)
+            if verb == "resume":
+                return 200, "application/json", _j(evolver.resume())
+            if verb == "abort":
+                return 200, "application/json", _j(evolver.abort())
+        except SchemaEvolutionError as e:
             return (409, "application/json",
                     _j({"error": str(e), "retryable": False}))
         return 404, "application/json", _j({"error": "not found"})

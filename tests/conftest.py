@@ -89,3 +89,9 @@ def pytest_configure(config):
         "write/delete interleavings, MIN/MAX retraction reservoir, "
         "checkpoint restore, exactly-once delta subscribers; select "
         "with -m views)")
+    config.addinivalue_line(
+        "markers", "evolve: online reindex / schema-evolution suites "
+        "(shadow builds with WAL-tail catch-up, atomic flip, "
+        "kill-point crash+resume sweep, mid-drop write conflicts, "
+        "REST/CLI surfaces; select with -m evolve — the randomized "
+        "kill-point soak is additionally marked slow)")

@@ -367,3 +367,22 @@ def test_config23_matviews_smoke():
     assert c["incremental_commit_s"] > 0
     assert c["full_reexec_s"] > 0
     assert "gates_pass" in c
+
+
+@pytest.mark.bench_smoke
+@pytest.mark.evolve
+def test_config24_evolve_smoke():
+    rng = np.random.default_rng(71)
+    c = bench.bench_config24(rng, n=3000, c=6, write_rows=50)
+    # the correctness gates hold at any size; the flip-latency
+    # headline only means something at the full 1M-row c=32 run
+    assert c["reader_mismatches"] == 0
+    assert c["untyped_errors"] == 0
+    assert c["acked_writes_lost"] == 0
+    assert c["flips_recorded"] == 1
+    assert c["index_version"] == 1
+    assert c["crash_injected"] is True
+    assert c["resume_completed_once"] is True
+    assert c["off_refuses"] is True
+    assert c["off_results_identical"] is True
+    assert c["gates_pass"] is True

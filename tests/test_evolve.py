@@ -1,0 +1,604 @@
+"""Online reindex & schema evolution (evolve/ subsystem): shadow
+builds with WAL-tail catch-up and an atomic flip that survives crashes
+mid-migration. Covers the kill switch's bit-identical off contract,
+update_schema validation, dual-feed catch-up on both store flavors,
+the exact-or-typed query contract across the flip, the kill-point
+crash+resume/abort sweep (every named phase), the REST/remote/CLI
+surfaces, and the token gate on the blocking reindex oracle."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.evolve import EVOLVE_ENABLED, Evolver, SchemaEvolutionError
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.store import InMemoryDataStore
+
+pytestmark = pytest.mark.evolve
+
+SPEC = "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326"
+
+
+@pytest.fixture
+def evolve_on():
+    EVOLVE_ENABLED.set("true")
+    yield
+    EVOLVE_ENABLED.set(None)
+
+
+def make_batch(sft, ids, rng=None, name=None):
+    rng = rng or np.random.default_rng(7)
+    n = len(ids)
+    return FeatureBatch.from_dict(sft, np.array(ids, dtype=object), {
+        "name": np.array([name if name is not None else f"n{i % 5}"
+                          for i in range(n)], dtype=object),
+        "age": np.arange(n, dtype=np.int64),
+        "dtg": rng.integers(0, 10**12, n),
+        "geom": (rng.uniform(-100, -60, n), rng.uniform(25, 50, n))})
+
+
+def make_store(n=120, durable_dir=None):
+    sft = parse_spec("t", SPEC)
+    ds = (InMemoryDataStore(durable_dir=str(durable_dir),
+                            wal_fsync="never")
+          if durable_dir is not None else InMemoryDataStore())
+    ds.create_schema(sft)
+    ds.write("t", make_batch(sft, [f"f{i}" for i in range(n)]))
+    return ds, sft
+
+
+def snap(ds, tn="t"):
+    """Canonical content: sorted (id, attr=value...) rows — the
+    bit-identity and no-acked-loss oracle."""
+    res = ds.query("INCLUDE", tn)
+    b = res.batch
+    sft = ds.get_schema(tn)
+    rows = []
+    for i in range(b.n):
+        rows.append((str(b.ids[i]),)
+                    + tuple(f"{a.name}={b.col(a.name).value(i)}"
+                            for a in sft.attributes))
+    return sorted(rows)
+
+
+# -- kill switch -------------------------------------------------------------
+
+class TestKillSwitch:
+    def test_disabled_refuses_every_verb(self):
+        ds, _ = make_store(10)
+        ev = ds.evolver
+        with pytest.raises(SchemaEvolutionError, match="disabled"):
+            ev.reindex("t", 1)
+        with pytest.raises(SchemaEvolutionError, match="disabled"):
+            ev.update_schema("t", [{"op": "drop", "name": "name"}])
+        with pytest.raises(SchemaEvolutionError):
+            ev.resume()
+        with pytest.raises(SchemaEvolutionError):
+            ev.abort()
+        assert ev.status()["enabled"] is False
+        assert ev.status()["active"] is None
+
+    def test_off_bit_identical_to_untouched_store(self):
+        ds, sft = make_store(60)
+        twin = InMemoryDataStore()
+        twin.create_schema(sft)
+        twin.write("t", make_batch(sft, [f"f{i}" for i in range(60)]))
+        with pytest.raises(SchemaEvolutionError):
+            ds.evolver.reindex("t", 1)
+        # the refused verb left zero write-path residue: no feed taps,
+        # no schema change, contents identical to the untouched twin
+        assert ds._evolve_feeds == {}
+        assert ds.get_schema("t").index_version \
+            == twin.get_schema("t").index_version
+        assert snap(ds) == snap(twin)
+
+
+# -- update_schema validation + transforms -----------------------------------
+
+class TestUpdateSchema:
+    def test_add_with_default_backfill(self, evolve_on):
+        ds, _ = make_store(40)
+        entry = ds.evolver.update_schema("t", [
+            {"op": "add", "name": "score", "type": "Double",
+             "default": 1.5}])
+        assert entry["op"] == "update"
+        assert entry["changes"]["adds"] == ["score"]
+        sft = ds.get_schema("t")
+        assert [a.name for a in sft.attributes][-1] == "score"
+        b = ds.query("INCLUDE", "t").batch
+        assert b.n == 40
+        assert all(b.col("score").value(i) == 1.5 for i in range(40))
+
+    def test_add_null_backfill(self, evolve_on):
+        ds, _ = make_store(10)
+        ds.evolver.update_schema("t", [
+            {"op": "add", "name": "tag", "type": "String"}])
+        b = ds.query("INCLUDE", "t").batch
+        assert all(b.col("tag").value(i) is None for i in range(10))
+
+    def test_widen_preserves_values(self, evolve_on):
+        ds, _ = make_store(25)
+        before = [r[0] for r in snap(ds)]
+        ds.evolver.update_schema("t", [
+            {"op": "widen", "name": "age", "type": "Long"}])
+        sft = ds.get_schema("t")
+        assert {a.name: a.type.name for a in sft.attributes}["age"] \
+            == "Long"
+        b = ds.query("INCLUDE", "t").batch
+        got = {str(b.ids[i]): b.col("age").value(i) for i in range(b.n)}
+        assert sorted(got) == before
+        assert got["f7"] == 7
+
+    def test_drop_removes_attribute_only(self, evolve_on):
+        ds, _ = make_store(30)
+        before = {r[0]: r for r in
+                  ((s[0],) + s[2:] for s in snap(ds))}  # minus name
+        ds.evolver.update_schema("t", [{"op": "drop", "name": "name"}])
+        sft = ds.get_schema("t")
+        assert "name" not in [a.name for a in sft.attributes]
+        after = {r[0]: r for r in snap(ds)}
+        assert after == before
+
+    @pytest.mark.parametrize("changes,msg", [
+        ([], "non-empty"),
+        ([{"op": "nope", "name": "x"}], "unknown change op"),
+        ([{"op": "add", "name": "age"}], "already exists"),
+        ([{"op": "add", "name": "g2", "type": "Point"}],
+         "cannot backfill"),
+        ([{"op": "add", "name": "l", "type": "List[Integer]"}],
+         "cannot backfill"),
+        ([{"op": "widen", "name": "name", "type": "Double"}],
+         "cannot widen"),
+        ([{"op": "widen", "name": "age", "type": "Integer"}],
+         "cannot widen"),
+        ([{"op": "widen", "name": "ghost", "type": "Long"}],
+         "no attribute"),
+        ([{"op": "drop", "name": "geom"}], "default geometry"),
+        ([{"op": "drop", "name": "ghost"}], "no attribute"),
+        ([{"op": "add", "name": "x", "type": "Integer"},
+          {"op": "drop", "name": "x"}], "changed and dropped"),
+        ([{"op": "drop"}], "needs a 'name'"),
+        (["drop name"], "expected a mapping"),
+    ])
+    def test_validation_refuses_typed(self, evolve_on, changes, msg):
+        ds, _ = make_store(5)
+        before = snap(ds)
+        with pytest.raises(SchemaEvolutionError, match=msg):
+            ds.evolver.update_schema("t", changes)
+        assert snap(ds) == before       # nothing half-applied
+
+    def test_reindex_noop_and_bad_targets(self, evolve_on):
+        ds, _ = make_store(5)
+        cur = ds.get_schema("t").index_version
+        assert ds.evolver.reindex("t", cur)["noop"] is True
+        with pytest.raises(ValueError):
+            ds.evolver.reindex("t", 99)
+        with pytest.raises(KeyError):
+            ds.evolver.reindex("ghost", 1)
+
+
+# -- online reindex + dual feed ----------------------------------------------
+
+class TestOnlineReindex:
+    def test_reindex_both_flavors(self, evolve_on, tmp_path):
+        for ds, _ in (make_store(80),
+                      make_store(80, durable_dir=tmp_path / "w")):
+            before = snap(ds)
+            v = 1 if ds.get_schema("t").index_version != 1 else 2
+            entry = ds.evolver.reindex("t", v)
+            assert entry["to_version"] == v
+            assert entry["rows"] == 80
+            assert ds.get_schema("t").index_version == v
+            assert snap(ds) == before   # same data, new layout
+            ds.close()
+
+    def test_durable_reindex_survives_reopen(self, evolve_on, tmp_path):
+        ds, _ = make_store(50, durable_dir=tmp_path / "w")
+        v = 1 if ds.get_schema("t").index_version != 1 else 2
+        ds.evolver.reindex("t", v)
+        before = snap(ds)
+        ds.close()
+        re = InMemoryDataStore(durable_dir=str(tmp_path / "w"),
+                               wal_fsync="never")
+        assert re.get_schema("t").index_version == v
+        assert snap(re) == before
+        re.close()
+
+    @pytest.mark.parametrize("durable", [False, True])
+    def test_dual_feed_catches_mid_build_mutations(self, evolve_on,
+                                                   tmp_path, durable):
+        ds, sft = make_store(
+            60, durable_dir=(tmp_path / "w") if durable else None)
+        fed = {}
+
+        def hook(tag):
+            # a writer lands a write + a delete after catch-up settled
+            # but before the flip: the final barrier replay (durable:
+            # WAL tail; non-durable: feed queue) must carry both
+            if tag == "catchup.done" and not fed:
+                fed["done"] = True
+                ds.write("t", make_batch(sft, ["late1", "late2"]))
+                ds.delete("t", ["f3"])
+
+        ds.evolver.fault_hook = hook
+        v = 1 if ds.get_schema("t").index_version != 1 else 2
+        entry = ds.evolver.reindex("t", v)
+        ds.evolver.fault_hook = None
+        assert entry["rows"] == 60 + 2 - 1
+        ids = set(ds.query("INCLUDE", "t").ids.tolist())
+        assert {"late1", "late2"} <= ids and "f3" not in ids
+        ds.close()
+
+    def test_mid_drop_write_conflict_typed(self, evolve_on):
+        ds, sft = make_store(30)
+        seen = {}
+
+        def hook(tag):
+            if tag != "catchup.done" or seen:
+                return
+            seen["done"] = True
+            # non-null values for the dropped attribute: refused typed
+            # BEFORE the ack (nothing journaled, nothing staged)
+            try:
+                ds.write("t", make_batch(sft, ["bad1"], name="boom"))
+            except SchemaEvolutionError as e:
+                seen["refused"] = str(e)
+            # all-null for the dropped attribute is compatible: acked
+            b = make_batch(sft, ["ok1"])
+            b.columns["name"] = type(b.columns["name"])(
+                "name", np.full(1, -1, np.int32),
+                np.empty(0, dtype=object))
+            ds.write("t", b)
+
+        ds.evolver.fault_hook = hook
+        ds.evolver.update_schema("t", [{"op": "drop", "name": "name"}])
+        ds.evolver.fault_hook = None
+        assert "dropped" in seen["refused"]
+        ids = set(ds.query("INCLUDE", "t").ids.tolist())
+        assert "ok1" in ids and "bad1" not in ids
+
+    def test_concurrent_readers_exact_or_typed(self, evolve_on,
+                                               tmp_path):
+        ds, sft = make_store(300, durable_dir=tmp_path / "w")
+        expected = set(ds.query("name = 'n2'", "t").ids.tolist())
+        stop = threading.Event()
+        errs = {"mismatch": 0, "typed": 0, "other": 0}
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    got = set(ds.query("name = 'n2'", "t").ids.tolist())
+                except SchemaEvolutionError:
+                    errs["typed"] += 1
+                    continue
+                except Exception:
+                    errs["other"] += 1
+                    continue
+                if got != expected:
+                    errs["mismatch"] += 1
+
+        threads = [threading.Thread(target=reader, daemon=True)
+                   for _ in range(4)]
+        for th in threads:
+            th.start()
+        v = 1 if ds.get_schema("t").index_version != 1 else 2
+        ds.evolver.reindex("t", v)
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+        assert errs["mismatch"] == 0 and errs["other"] == 0
+        ds.close()
+
+    def test_verb_exclusion_while_in_flight(self, evolve_on):
+        ds, _ = make_store(20)
+        ev = ds.evolver
+
+        def hook(tag):
+            if tag == "snapshot.done":
+                raise RuntimeError("injected crash @ snapshot.done")
+
+        ev.fault_hook = hook
+        with pytest.raises(RuntimeError, match="injected"):
+            ev.reindex("t", 1 if ds.get_schema("t").index_version != 1
+                       else 2)
+        ev.fault_hook = None
+        # a second verb cannot start over the interrupted one
+        with pytest.raises(SchemaEvolutionError, match="in flight"):
+            ev.update_schema("t", [{"op": "drop", "name": "name"}])
+        ev.resume()
+        assert ev._active is None
+
+
+# -- crash safety: every named kill point ------------------------------------
+
+def _crash_at(evolver, tag):
+    def hook(t):
+        if t == tag:
+            raise RuntimeError(f"injected crash @ {t}")
+    evolver.fault_hook = hook
+
+
+class TestCrashSafety:
+    @pytest.mark.parametrize("tag", Evolver.PHASES)
+    def test_kill_point_then_resume(self, evolve_on, tag):
+        ds, _ = make_store(50)
+        before = snap(ds)
+        ev = ds.evolver
+        _crash_at(ev, tag)
+        with pytest.raises(RuntimeError, match="injected crash"):
+            ev.update_schema("t", [
+                {"op": "add", "name": "score", "type": "Double",
+                 "default": 2.0}])
+        evo = ev._active
+        assert evo is not None
+        if evo.phase == "done":
+            pass                        # flip landed; bookkeeping left
+        elif evo.blocking:
+            # mid-flip: ops on the type fail typed, never silently
+            with pytest.raises(SchemaEvolutionError):
+                ds.query("INCLUDE", "t")
+        else:
+            # pre-cut: the old state still serves exactly
+            assert snap(ds) == before
+        ev.fault_hook = None
+        entry = ev.resume()
+        assert entry["op"] == "update"
+        assert ev._active is None
+        b = ds.query("INCLUDE", "t").batch
+        assert b.n == 50
+        assert all(b.col("score").value(i) == 2.0 for i in range(50))
+        # exactly one completion recorded, no double-apply
+        assert len([h for h in ev.history
+                    if h["op"] == "update"]) == 1
+
+    @pytest.mark.parametrize("tag", Evolver.PHASES)
+    def test_durable_kill_point_resume_reopen(self, evolve_on,
+                                              tmp_path, tag):
+        ds, _ = make_store(40, durable_dir=tmp_path / tag)
+        v = 1 if ds.get_schema("t").index_version != 1 else 2
+        ev = ds.evolver
+        _crash_at(ev, tag)
+        with pytest.raises(RuntimeError, match="injected crash"):
+            ev.reindex("t", v)
+        ev.fault_hook = None
+        ev.resume()
+        assert ds.get_schema("t").index_version == v
+        before = snap(ds)
+        ds.close()
+        re = InMemoryDataStore(durable_dir=str(tmp_path / tag),
+                               wal_fsync="never")
+        assert re.get_schema("t").index_version == v
+        assert snap(re) == before
+        re.close()
+
+    @pytest.mark.parametrize("tag", ["feed.installed", "catchup.done",
+                                     "flip.barrier", "flip.swap"])
+    def test_kill_point_then_abort(self, evolve_on, tag):
+        ds, _ = make_store(35)
+        before = snap(ds)
+        old_v = ds.get_schema("t").index_version
+        ev = ds.evolver
+        _crash_at(ev, tag)
+        with pytest.raises(RuntimeError, match="injected crash"):
+            ev.reindex("t", 1 if old_v != 1 else 2)
+        ev.fault_hook = None
+        entry = ev.abort()
+        assert entry["op"] == "abort"
+        assert ev._active is None
+        assert ds._evolve_feeds == {}
+        assert ds.get_schema("t").index_version == old_v
+        assert snap(ds) == before       # pre-evolve state restored
+        # the plane is reusable after an abort
+        ev.reindex("t", 1 if old_v != 1 else 2)
+        assert snap(ds) == before
+
+    def test_abort_after_flip_refuses(self, evolve_on):
+        ds, _ = make_store(10)
+        ev = ds.evolver
+        _crash_at(ev, "flip.done")
+        with pytest.raises(RuntimeError, match="injected crash"):
+            ev.reindex("t", 1 if ds.get_schema("t").index_version != 1
+                       else 2)
+        ev.fault_hook = None
+        with pytest.raises(SchemaEvolutionError, match="already "
+                                                       "flipped"):
+            ev.abort()
+        ev.resume()                      # bookkeeping-only close-out
+        assert ev._active is None
+
+    @pytest.mark.slow
+    def test_randomized_kill_point_soak(self, evolve_on, tmp_path):
+        """Crash at a random kill point, randomly resume or abort,
+        interleave acked writes, repeat. Invariant after every round:
+        store contents exactly match the oracle dict, never a silent
+        divergence."""
+        rng = np.random.default_rng(11)
+        ds, sft = make_store(100, durable_dir=tmp_path / "soak")
+        oracle = {r[0]: r for r in snap(ds)}
+        ev = ds.evolver
+        for round_no in range(10):
+            tag = Evolver.PHASES[rng.integers(len(Evolver.PHASES))]
+            cur = ds.get_schema("t").index_version
+            _crash_at(ev, tag)
+            try:
+                ev.reindex("t", 1 if cur != 1 else 2)
+                crashed = False
+            except RuntimeError:
+                crashed = True
+            ev.fault_hook = None
+            if crashed and ev._active is not None:
+                if rng.random() < 0.5:
+                    ev.resume()
+                else:
+                    ev.abort()
+            assert {r[0] for r in snap(ds)} == set(oracle)
+            # interleave an acked write (current schema) between rounds
+            cur_sft = ds.get_schema("t")
+            wid = f"soak{round_no}"
+            ds.write("t", make_batch(cur_sft, [wid], name="soak"))
+            oracle[wid] = None
+        assert {r[0] for r in snap(ds)} == set(oracle)
+        ds.close()
+
+
+# -- REST / remote / CLI surfaces --------------------------------------------
+
+def _request(port, method, path, data=None, token=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method)
+    if token is not None:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestWebSurfaces:
+    TOKEN = "s3kr1t"
+
+    def _serve(self, n=40, token=None):
+        from geomesa_tpu.web import GeoMesaWebServer
+        ds, _ = make_store(n)
+        return GeoMesaWebServer(ds, auth_token=token).start()
+
+    def test_blocking_reindex_endpoint_contract(self):
+        srv = self._serve(token=self.TOKEN)
+        try:
+            p = srv.port
+            st, body = _request(p, "POST", "/rest/reindex/t")
+            assert st == 403 and body == {"error": "forbidden"}
+            st, _b = _request(p, "POST", "/rest/reindex/t",
+                              token="wrong")
+            assert st == 403
+            st, body = _request(p, "POST", "/rest/reindex/t?version=1",
+                                token=self.TOKEN)
+            assert st == 200
+            assert body == {"reindexed": "t", "index_version": 1}
+            assert srv.store.get_schema("t").index_version == 1
+            st, _b = _request(p, "POST", "/rest/reindex/ghost",
+                              token=self.TOKEN)
+            assert st == 404
+            st, _b = _request(p, "POST", "/rest/reindex/t?version=99",
+                              token=self.TOKEN)
+            assert st == 400
+        finally:
+            srv.stop()
+
+    def test_evolve_endpoints_gated_and_typed(self, evolve_on):
+        srv = self._serve(token=self.TOKEN)
+        try:
+            p = srv.port
+            # the status read stays open; mutating verbs are gated
+            st, body = _request(p, "GET", "/rest/evolve")
+            assert st == 200 and body["enabled"] is True
+            assert body["phases"] == list(Evolver.PHASES)
+            for verb in ("reindex", "update", "resume", "abort"):
+                st, _b = _request(p, "POST", f"/rest/evolve/{verb}")
+                assert st == 403
+            st, _b = _request(p, "POST", "/rest/evolve/reindex",
+                              token=self.TOKEN)
+            assert st == 400            # well-formed auth, no type
+            st, body = _request(
+                p, "POST", "/rest/evolve/reindex?type=t&version=1",
+                token=self.TOKEN)
+            assert st == 200 and body["to_version"] == 1
+            st, body = _request(
+                p, "POST", "/rest/evolve/update",
+                data=json.dumps({"type": "t", "changes": [
+                    {"op": "add", "name": "score", "type": "Double",
+                     "default": 3.5}]}).encode(),
+                token=self.TOKEN)
+            assert st == 200 and body["changes"]["adds"] == ["score"]
+            # typed refusal -> 409 with the retryable=False contract
+            st, body = _request(p, "POST", "/rest/evolve/resume",
+                                token=self.TOKEN)
+            assert st == 409 and body["retryable"] is False
+            st, body = _request(p, "GET", "/rest/evolve")
+            assert [h["op"] for h in body["history"]] \
+                == ["reindex", "update"]
+        finally:
+            srv.stop()
+
+    def test_evolve_disabled_maps_to_409(self):
+        srv = self._serve(token=self.TOKEN)
+        try:
+            st, body = _request(srv.port, "POST",
+                                "/rest/evolve/reindex?type=t&version=1",
+                                token=self.TOKEN)
+            assert st == 409
+            assert "disabled" in body["error"]
+            assert body["retryable"] is False
+        finally:
+            srv.stop()
+
+    def test_remote_store_passthroughs(self, evolve_on):
+        from geomesa_tpu.store import RemoteDataStore
+        srv = self._serve(token=self.TOKEN)
+        try:
+            ds = RemoteDataStore("127.0.0.1", srv.port,
+                                 auth_token=self.TOKEN)
+            assert ds.evolve_status()["enabled"] is True
+            out = ds.evolve("reindex", type="t", version=1)
+            assert out["to_version"] == 1
+            out = ds.evolve("update", type="t", changes=[
+                {"op": "drop", "name": "name"}])
+            assert out["changes"]["drops"] == ["name"]
+            # the blocking oracle passthrough (fresh server: v1 -> v2)
+            out = ds.reindex("t", 2)
+            assert out == {"reindexed": "t", "index_version": 2}
+            # an unauthenticated client is rejected on every verb
+            bare = RemoteDataStore("127.0.0.1", srv.port)
+            with pytest.raises(Exception, match="forbidden"):
+                bare.evolve("abort")
+            with pytest.raises(Exception, match="forbidden"):
+                bare.reindex("t", 1)
+        finally:
+            srv.stop()
+
+
+class TestEvolveCli:
+    def test_rc_contract_remote(self, evolve_on, capsys):
+        from geomesa_tpu.tools.cli import main as cli_main
+        from geomesa_tpu.web import GeoMesaWebServer
+        ds, _ = make_store(20)
+        srv = GeoMesaWebServer(ds, auth_token="tok").start()
+        path = f"remote://127.0.0.1:{srv.port}"
+        try:
+            assert cli_main(["evolve", "reindex", "--path", path,
+                             "--type", "t", "--index-version", "1"]) \
+                == 3                     # gated: no token
+            assert "gated" in capsys.readouterr().err
+            assert cli_main(["evolve", "reindex", "--path", path,
+                             "--token", "tok", "--type", "t",
+                             "--index-version", "1"]) == 0
+            out = json.loads(capsys.readouterr().out)
+            assert out["to_version"] == 1
+            assert cli_main(["evolve", "status", "--path", path]) == 0
+            out = json.loads(capsys.readouterr().out)
+            assert [h["op"] for h in out["history"]] == ["reindex"]
+            assert cli_main(["evolve", "update", "--path", path,
+                             "--token", "tok", "--type", "t",
+                             "--changes", "not json"]) == 2
+            assert "bad --changes" in capsys.readouterr().err
+            assert cli_main(["evolve", "update", "--path", path,
+                             "--token", "tok", "--type", "t",
+                             "--changes",
+                             '[{"op": "drop", "name": "geom"}]']) == 2
+            assert "refused" in capsys.readouterr().err
+            assert cli_main(["evolve", "resume", "--path", path,
+                             "--token", "tok"]) == 2  # nothing active
+        finally:
+            srv.stop()
+
+    def test_local_path_without_plane_rc2(self, evolve_on, tmp_path,
+                                          capsys):
+        from geomesa_tpu.tools.cli import main as cli_main
+        assert cli_main(["evolve", "status", "--path",
+                         str(tmp_path)]) == 2
+        assert "no schema-evolution plane" in capsys.readouterr().err

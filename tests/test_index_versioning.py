@@ -139,3 +139,51 @@ class TestStoreMigration:
         ds2 = FileSystemDataStore(str(tmp_path))
         assert ds2.get_schema("events").index_version == \
             CURRENT_INDEX_VERSION
+
+    def test_fs_mesh_sidecar_version_consistent_after_reindex(
+            self, tmp_path):
+        """Regression for the fs.py reindex mirror write: after a
+        parent-store reindex, every loaded sub-store mirrors the new
+        index_version, and the fs-mesh tier's persisted sort-order
+        sidecar (which carries the OLD version) is rejected on reopen
+        instead of silently serving v1 orders under a v2 schema."""
+        from geomesa_tpu.store import FsBackedDistributedDataStore
+        mesh = FsBackedDistributedDataStore(str(tmp_path))
+        mesh.create_schema(parse_spec("events", SPEC_V1))
+        x, y, ms = _sample(4_000)
+        mesh.write_dict("events", [f"e{i}" for i in range(len(x))],
+                        {"kind": ["k"] * len(x), "dtg": ms,
+                         "geom": (x, y)})
+        want = {f"e{i}" for i in _expect(x, y, ms)}
+        assert set(mesh.query(ECQL, "events").ids.astype(str)) == want
+        # persist the v1 sort orders as the mesh sidecar
+        assert mesh.persist_index("events") is True
+
+        # reindex through the durable parent; its loaded sub-stores
+        # must mirror the new version (the fs.py cache-mirror write)
+        fs = mesh.fs
+        assert set(fs.query(ECQL, "events").ids.astype(str)) == want
+        assert fs._state("events").cache    # sub-stores loaded
+        fs.reindex("events")
+        assert fs.get_schema("events").index_version == \
+            CURRENT_INDEX_VERSION
+        for mem in fs._state("events").cache.values():
+            assert mem.get_schema("events").index_version == \
+                CURRENT_INDEX_VERSION
+        assert set(fs.query(ECQL, "events").ids.astype(str)) == want
+
+        # reopen the mesh tier: schema comes back at the new version
+        # and the stale v1 sidecar must NOT install (ZKeyIndex
+        # load_state rejects the version mismatch -> lazy rebuild)
+        mesh2 = FsBackedDistributedDataStore(str(tmp_path))
+        assert mesh2.get_schema("events").index_version == \
+            CURRENT_INDEX_VERSION
+        assert set(mesh2.query(ECQL, "events").ids.astype(str)) == want
+        assert mesh2._state("events").zindex.version == \
+            CURRENT_INDEX_VERSION
+        # re-persisted sidecar under the new version round-trips
+        assert mesh2.persist_index("events") is True
+        mesh3 = FsBackedDistributedDataStore(str(tmp_path))
+        assert set(mesh3.query(ECQL, "events").ids.astype(str)) == want
+        assert mesh3._state("events").zindex.version == \
+            CURRENT_INDEX_VERSION

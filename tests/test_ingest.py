@@ -437,3 +437,152 @@ class TestIngestCli:
             assert main(argv) == 0
         out = capsys.readouterr().out
         assert out.count("total: 50 ingested, 0 failed") == 2
+
+
+JSON_SPEC = "name:String,mmsi:Integer,speed:Double,*geom:Point:srid=4326"
+JSON_CONF = {
+    "type": "json", "id-field": "$1",
+    "fields": [
+        {"path": "$.id"},
+        {"name": "name", "path": "$.props.name"},
+        {"name": "mmsi", "path": "$.mmsi",
+         "transform": "try($3::int, 0)"},
+        {"name": "speed", "path": "$.speed",
+         "transform": "try($4::double, 0.0)"},
+        {"name": "geom", "path": "$.x",
+         "transform": "point($5::double, $6::double)"},
+        {"path": "$.y"},
+    ]}
+
+
+def _json_line(i, name=None):
+    return json.dumps({"id": f"r{i}", "mmsi": i,
+                       "props": {"name": name or f"n{i % 4}"},
+                       "speed": i / 2.0, "x": float(i % 90),
+                       "y": float(i % 45)})
+
+
+def _run_json(text, arrow_json=True, vectorized=True, batch_rows=7):
+    from geomesa_tpu.convert.vectorized import INGEST_ARROW_JSON
+    sft = parse_spec("boats", JSON_SPEC)
+    conv = converter_for(sft, JSON_CONF)
+    ctx = EvaluationContext()
+    INGEST_VECTORIZED.thread_local_set(
+        "true" if vectorized else "false")
+    INGEST_ARROW_JSON.thread_local_set(
+        "true" if arrow_json else "false")
+    try:
+        batches = [b for b, _ in conv.iter_batches(
+            text, ctx=ctx, batch_rows=batch_rows)]
+    finally:
+        INGEST_VECTORIZED.thread_local_set(None)
+        INGEST_ARROW_JSON.thread_local_set(None)
+    ids, rows = [], []
+    for b in batches:
+        ids.extend(str(i) for i in b.ids)
+        for i in range(b.n):
+            f = b.feature(i)
+            rows.append(tuple(
+                round(v, 9) if isinstance(v, float) else str(v)
+                for v in (f[a.name] for a in sft.attributes)))
+    return ids, rows, ctx.counters()
+
+
+def _assert_json_parity(text, batch_rows=7):
+    """Scalar oracle == record-path columnar == Arrow-JSON columnar."""
+    oracle = _run_json(text, vectorized=False)
+    for arrow_json in (False, True):
+        got = _run_json(text, arrow_json=arrow_json,
+                        batch_rows=batch_rows)
+        assert got[0] == oracle[0], f"ids diverge (arrow={arrow_json})"
+        assert got[1] == oracle[1], \
+            f"values diverge (arrow={arrow_json})"
+        assert got[2] == oracle[2], \
+            f"counters diverge (arrow={arrow_json})"
+    return oracle
+
+
+class TestJsonColumnar:
+    def test_arrow_engages_on_nested_paths(self):
+        from geomesa_tpu.convert.vectorized import (_ArrowCol,
+                                                    parse_json_arrow)
+        pa = pytest.importorskip("pyarrow")
+        text = "\n".join(_json_line(i) for i in range(6))
+        out = parse_json_arrow(text, [f["path"] for f in
+                                      JSON_CONF["fields"]
+                                      if "path" in f])
+        assert out is not None
+        cols, n, ragged, n_bad = out
+        assert n == 6 and ragged is False and n_bad == 0
+        # $0 is never materialized on the columnar path
+        assert all(v is None for v in cols[0])
+        # nested struct hop: $.props.name stays in Arrow
+        assert isinstance(cols[2], _ArrowCol)
+        assert list(cols[2].objs()[:4]) == ["n0", "n1", "n2", "n3"]
+
+    def test_parity_clean_stream_chunked(self):
+        text = "\n".join(_json_line(i) for i in range(40))
+        ids, rows, counters = _assert_json_parity(text)
+        assert ids == [f"r{i}" for i in range(40)]
+        assert counters == {"success": 40, "failure": 0, "line": 40}
+
+    def test_malformed_line_degrades_block_not_stream_result(self):
+        # a quoted-garbage line Arrow refuses: the block (and the rest
+        # of the stream) fall back to the per-record parser, which
+        # isolates the bad line row-for-row — identically to scalar
+        lines = [_json_line(i) for i in range(20)]
+        lines[9] = '{"id": "broken", unquoted}'
+        ids, _, counters = _assert_json_parity("\n".join(lines))
+        assert len(ids) == 19 and "r9" not in ids
+        assert counters == {"success": 19, "failure": 1, "line": 20}
+
+    def test_bad_value_rows_fail_identically(self):
+        # a record whose x can't cast to double: the ::double blows up
+        # on every tier, so the row fails with identical counters on
+        # scalar, record-columnar and Arrow-columnar (ragged, not fatal)
+        lines = [_json_line(i) for i in range(10)]
+        lines[4] = json.dumps({"id": "badx", "mmsi": 4,
+                               "props": {"name": "n"}, "speed": 2.0,
+                               "x": "oops", "y": 1.0})
+        ids, _, counters = _assert_json_parity("\n".join(lines))
+        assert "badx" not in ids and len(ids) == 9
+        assert counters["failure"] == 1
+
+    def test_missing_field_null_semantics_preserved(self):
+        # a record without x yields a null $5. The vectorized tier has
+        # always fed that null straight into point() (a pre-existing
+        # scalar/vectorized divergence the Arrow fast path must not
+        # change) — so assert the Arrow route matches the record route
+        # exactly, nulls included.
+        lines = [_json_line(i) for i in range(10)]
+        lines[4] = json.dumps({"id": "nox", "mmsi": 4,
+                               "props": {"name": "n"}, "speed": 2.0,
+                               "y": 1.0})
+        text = "\n".join(lines)
+        record = _run_json(text, arrow_json=False)
+        arrow = _run_json(text, arrow_json=True)
+        assert arrow == record
+
+    def test_list_index_paths_take_record_path(self):
+        # list-index hops aren't struct fields: parse_json_arrow
+        # declines and the record path serves the whole stream
+        from geomesa_tpu.convert.vectorized import parse_json_arrow
+        assert parse_json_arrow('{"a": [1, 2]}', ["$.a.0"]) is None
+
+    def test_top_level_array_source_parity(self):
+        recs = ",".join(_json_line(i) for i in range(8))
+        ids, _, counters = _assert_json_parity(f"[{recs}]")
+        assert ids == [f"r{i}" for i in range(8)]
+        assert counters["success"] == 8
+
+    def test_knob_off_is_scalar_identical(self):
+        from geomesa_tpu.convert.vectorized import (INGEST_ARROW_JSON,
+                                                    parse_json_arrow)
+        INGEST_ARROW_JSON.thread_local_set("false")
+        try:
+            assert parse_json_arrow('{"a": 1}', ["$.a"]) is None
+        finally:
+            INGEST_ARROW_JSON.thread_local_set(None)
+        text = "\n".join(_json_line(i) for i in range(12))
+        assert _run_json(text, arrow_json=False) \
+            == _run_json(text, vectorized=False)

@@ -809,6 +809,73 @@ class TestSpheroidAndAntimeridian:
             "SELECT ST_AntimeridianSafeGeom(area) AS g FROM zones")
         assert isinstance(r.column("g")[0], MultiPolygon)
 
+    def test_idl_safe_geom_alias_contract(self):
+        # st_idlSafeGeom is the reference's second name for the same
+        # implementation: identical output on every shape class,
+        # including the identity fast path for in-range geometries
+        from geomesa_tpu.analytics import (st_antimeridian_safe_geom,
+                                           st_idl_safe_geom)
+        from geomesa_tpu.analytics.st_functions import SQL_SCALARS
+        from geomesa_tpu.geometry import MultiPolygon, Point
+        from geomesa_tpu.geometry.wkt import parse_wkt
+        assert SQL_SCALARS["ST_IDLSAFEGEOM"] is st_idl_safe_geom
+        box = parse_wkt("POLYGON ((170 -10, 190 -10, 190 10, 170 10, "
+                        "170 -10))")
+        a = st_idl_safe_geom(box)
+        b = st_antimeridian_safe_geom(box)
+        assert isinstance(a, MultiPolygon) and isinstance(b, MultiPolygon)
+        assert sorted(p.area for p in a.parts) == \
+            sorted(p.area for p in b.parts)
+        assert {tuple(map(tuple, p.shell)) for p in a.parts} == \
+            {tuple(map(tuple, p.shell)) for p in b.parts}
+        p = st_idl_safe_geom(Point(190.0, 5.0))
+        assert (p.x, p.y) == (-170.0, 5.0)
+        ok = parse_wkt("LINESTRING (0 0, 10 10)")
+        assert st_idl_safe_geom(ok) is ok
+
+    def test_idl_safe_and_translate_sql_and_process(self):
+        from geomesa_tpu.analytics import (idl_safe_geom_process,
+                                           st_idl_safe_geom,
+                                           translate_process)
+        from geomesa_tpu.features import parse_spec
+        from geomesa_tpu.geometry import MultiPolygon, Point, Polygon
+        from geomesa_tpu.sql import SqlEngine
+        from geomesa_tpu.store import InMemoryDataStore
+        ds = InMemoryDataStore()
+        ds.create_schema(parse_spec("zones", "*area:Geometry:srid=4326"))
+        ds.write_dict("zones", ["z0", "z1", "z2"], {
+            "area": ["POLYGON ((170 -10, 190 -10, 190 10, 170 10, "
+                     "170 -10))",
+                     "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))",
+                     "POINT (190 5)"]})
+        eng = SqlEngine(ds)
+        r = eng.query("SELECT ST_IdlSafeGeom(area) AS g, "
+                      "ST_Translate(area, 1.0, -2.0) AS t FROM zones")
+        gs, res = r.column("g"), ds.query("INCLUDE", "zones")
+        # SQL rows match the scalar applied per-row over a plain scan
+        want = [st_idl_safe_geom(res.batch.col("area").value(i))
+                for i in range(res.n)]
+        assert isinstance(gs[0], MultiPolygon)
+        assert isinstance(gs[1], Polygon) and gs[1].area == 100.0
+        assert (gs[2].x, gs[2].y) == (-170.0, 5.0)
+        ts = r.column("t")
+        assert (ts[2].x, ts[2].y) == (191.0, 3.0)
+        # process twins agree with the SQL surface, row for row
+        proc = idl_safe_geom_process(ds, "zones", "area")
+        assert len(proc) == 3
+        for got, via_sql, oracle in zip(proc, gs, want):
+            assert type(got) is type(via_sql) is type(oracle)
+        assert sorted(p.area for p in proc[0].parts) == \
+            sorted(p.area for p in gs[0].parts)
+        tp = translate_process(ds, "zones", "area", 1.0, -2.0)
+        assert (tp[2].x, tp[2].y) == (191.0, 3.0)
+        assert np.array_equal(tp[1].shell, ts[1].shell)
+        # ecql pushdown narrows the process scan like any other query
+        only_pt = idl_safe_geom_process(ds, "zones", "area",
+                                        ecql="IN ('z2')")
+        assert len(only_pt) == 1 and (only_pt[0].x,
+                                      only_pt[0].y) == (-170.0, 5.0)
+
 
 class TestAccessorFunctions:
     """ST_* parity additions: vertex accessors and constructors
